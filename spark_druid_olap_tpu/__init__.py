@@ -1,0 +1,46 @@
+"""spark_druid_olap_tpu — a TPU-native OLAP acceleration framework.
+
+A ground-up rebuild of the capabilities of SparklineData's ``spark-druid-olap``
+(the Sparkline BI Accelerator, reference at ``/root/reference``): a SQL front
+end with an extensible rewrite engine that turns star-schema analytic queries
+(project/filter pushdown, star-join collapse, group-by / grouping sets,
+approximate count-distinct, sort/limit/topN) into plans executed by an
+**in-tree columnar engine on TPU** — where the reference delegated execution to
+an external Druid cluster over HTTP (reference:
+``org/sparklinedata/druid/client/DruidClient.scala``), here the engine is
+JAX/XLA/Pallas: dictionary-encoded column chunks live in TPU HBM as
+time-sharded segments, scan-filter-aggregate kernels replace Druid
+historicals, and ICI collectives replace the broker's scatter/gather.
+
+Public API::
+
+    import spark_druid_olap_tpu as sdot
+    ctx = sdot.Context()
+    ctx.ingest_dataframe("lineitem", df, time_column="l_shipdate")
+    result = ctx.sql("SELECT l_returnflag, sum(l_quantity) FROM lineitem GROUP BY 1")
+    result.to_pandas()
+
+Layer map (mirrors SURVEY.md §1, re-seamed for TPU):
+
+==========  ==============================  =========================================
+Layer       Package                         Reference counterpart
+==========  ==============================  =========================================
+server      ``server/``                     thriftserver (``HiveThriftServer2.scala``)
+session     ``context.py``                  ``SPLSessionState`` / ``ModuleLoader``
+sql         ``sql/``                        ``SparklineDataParser`` + Spark SQL parser
+planner     ``planner/``                    ``DruidPlanner``/``DruidStrategy`` + transforms
+IR          ``ir/``                         ``DruidQuerySpec``/``DruidQueryBuilder``
+kernels     ``ops/``                        Druid historical scan/agg engine (external)
+segments    ``segment/``                    Druid segment store (external)
+parallel    ``parallel/``                   broker scatter/gather + ``DruidRDD``
+metadata    ``metadata/``                   ``org/sparklinedata/druid/metadata/``
+utils       ``utils/``                      conf/retry/logging shims
+==========  ==============================  =========================================
+"""
+
+from spark_druid_olap_tpu.context import Context
+from spark_druid_olap_tpu.utils.config import Config
+
+__version__ = "0.1.0"
+
+__all__ = ["Context", "Config", "__version__"]

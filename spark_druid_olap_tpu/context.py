@@ -1,0 +1,95 @@
+"""Session context — the framework's entry point.
+
+≈ the reference's session/extension layer: ``SPLSessionState`` +
+``ModuleLoader`` (``SPLSessionState.scala:80-132``,
+``SparklineDataModule.scala:70-87``) wire the parser, logical rules, and
+physical strategy into a Spark session; here ``Context`` wires the SQL front
+end, planner, engine, metadata catalog, and config into one object.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+from spark_druid_olap_tpu.ir import spec as S
+from spark_druid_olap_tpu.result import QueryResult
+from spark_druid_olap_tpu.segment.ingest import (
+    ingest_csv,
+    ingest_dataframe,
+    ingest_parquet,
+)
+from spark_druid_olap_tpu.segment.store import SegmentStore
+from spark_druid_olap_tpu.utils.config import Config
+
+
+def _enable_x64_once():
+    # f64 merge accumulators need x64; hot-path dtypes are all explicit
+    # f32/int32 so this does not change kernel layouts.
+    try:
+        jax.config.update("jax_enable_x64", True)
+    except Exception:
+        pass
+
+
+class Context:
+    def __init__(self, config: Optional[Dict] = None, mesh=None,
+                 auto_mesh: bool = False):
+        _enable_x64_once()
+        self.config = Config(config)
+        self.store = SegmentStore()
+        if mesh is None and auto_mesh and len(jax.devices()) > 1:
+            from spark_druid_olap_tpu.parallel.mesh import make_mesh
+            mesh = make_mesh()
+        self.mesh = mesh
+        from spark_druid_olap_tpu.parallel.executor import QueryEngine
+        self.engine = QueryEngine(self.store, self.config, mesh)
+        from spark_druid_olap_tpu.metadata.catalog import Catalog
+        self.catalog = Catalog(self.store)
+        from spark_druid_olap_tpu.metadata.history import QueryHistory
+        from spark_druid_olap_tpu.utils.config import QUERY_HISTORY_SIZE
+        self.history = QueryHistory(self.config.get(QUERY_HISTORY_SIZE))
+
+    # -- ingest / registration ------------------------------------------------
+    def ingest_dataframe(self, name, df, **kwargs):
+        ds = ingest_dataframe(name, df, **kwargs)
+        self.store.register(ds)
+        return ds
+
+    def ingest_parquet(self, name, path, **kwargs):
+        ds = ingest_parquet(name, path, **kwargs)
+        self.store.register(ds)
+        return ds
+
+    def ingest_csv(self, name, path, **kwargs):
+        ds = ingest_csv(name, path, **kwargs)
+        self.store.register(ds)
+        return ds
+
+    def register_star_schema(self, star_schema) -> None:
+        self.catalog.register_star_schema(star_schema)
+
+    # -- query ----------------------------------------------------------------
+    def execute(self, q: S.QuerySpec) -> QueryResult:
+        """Execute a raw engine QuerySpec (≈ ``ON DRUIDDATASOURCE ... EXECUTE
+        QUERY <json>``, reference ``PlanUtil.logicalPlan:49-66``)."""
+        r = self.engine.execute(q)
+        self.history.record(q, self.engine.last_stats)
+        return r
+
+    def sql(self, query: str) -> QueryResult:
+        try:
+            from spark_druid_olap_tpu.sql.session import run_sql
+        except ImportError as e:
+            raise NotImplementedError(
+                "SQL front end not available in this build") from e
+        return run_sql(self, query)
+
+    def explain(self, query: str) -> str:
+        try:
+            from spark_druid_olap_tpu.sql.session import explain_sql
+        except ImportError as e:
+            raise NotImplementedError(
+                "SQL front end not available in this build") from e
+        return explain_sql(self, query)

@@ -1,0 +1,4 @@
+from spark_druid_olap_tpu.ir import expr as E
+from spark_druid_olap_tpu.ir.spec import *  # noqa: F401,F403
+
+__all__ = ["E"]

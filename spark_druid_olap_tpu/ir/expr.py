@@ -1,0 +1,274 @@
+"""Scalar expression IR.
+
+Plays the role Catalyst ``Expression`` trees play in the reference: the common
+currency between the SQL front end, the planner's rewrite rules, and code
+generation. Where the reference compiles unsupported-but-deterministic
+expressions to **JavaScript executed inside Druid**
+(``jscodegen/JSCodeGenerator.scala:59-66``), we compile them to **XLA** via
+``ops/expr_compile.py`` — and, exactly like ``JSCodeGenerator`` returning
+``None``, the compiler bails cleanly on unsupported nodes so the planner can
+leave a host-side residual.
+
+Deliberately small: no exprIds/resolution machinery — names are resolved by
+the planner against the (globally-unique, star-schema-wide) column namespace,
+which the reference also requires (``StarSchemaInfo.scala:127-165``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+
+class Expr:
+    """Base scalar expression node."""
+
+    def children(self) -> Tuple["Expr", ...]:
+        return ()
+
+    # -- convenience builders (used by tests and the planner) -----------------
+    def __add__(self, o): return BinaryOp("+", self, lit(o))
+    def __sub__(self, o): return BinaryOp("-", self, lit(o))
+    def __mul__(self, o): return BinaryOp("*", self, lit(o))
+    def __truediv__(self, o): return BinaryOp("/", self, lit(o))
+    def __radd__(self, o): return BinaryOp("+", lit(o), self)
+    def __rsub__(self, o): return BinaryOp("-", lit(o), self)
+    def __rmul__(self, o): return BinaryOp("*", lit(o), self)
+    def eq(self, o): return Comparison("=", self, lit(o))
+    def ne(self, o): return Comparison("!=", self, lit(o))
+    def lt(self, o): return Comparison("<", self, lit(o))
+    def le(self, o): return Comparison("<=", self, lit(o))
+    def gt(self, o): return Comparison(">", self, lit(o))
+    def ge(self, o): return Comparison(">=", self, lit(o))
+
+
+def lit(v) -> "Expr":
+    return v if isinstance(v, Expr) else Literal(v)
+
+
+@dataclasses.dataclass(frozen=True)
+class Column(Expr):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Literal(Expr):
+    value: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class BinaryOp(Expr):
+    op: str  # + - * / %
+    left: Expr
+    right: Expr
+
+    def children(self): return (self.left, self.right)
+
+
+@dataclasses.dataclass(frozen=True)
+class Comparison(Expr):
+    op: str  # = != < <= > >=
+    left: Expr
+    right: Expr
+
+    def children(self): return (self.left, self.right)
+
+
+@dataclasses.dataclass(frozen=True)
+class And(Expr):
+    parts: Tuple[Expr, ...]
+
+    def children(self): return self.parts
+
+
+@dataclasses.dataclass(frozen=True)
+class Or(Expr):
+    parts: Tuple[Expr, ...]
+
+    def children(self): return self.parts
+
+
+@dataclasses.dataclass(frozen=True)
+class Not(Expr):
+    child: Expr
+
+    def children(self): return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNull(Expr):
+    child: Expr
+    negated: bool = False
+
+    def children(self): return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class InList(Expr):
+    child: Expr
+    values: Tuple[Any, ...]
+    negated: bool = False
+
+    def children(self): return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Between(Expr):
+    child: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+    def children(self): return (self.child, self.low, self.high)
+
+
+@dataclasses.dataclass(frozen=True)
+class Like(Expr):
+    child: Expr
+    pattern: str           # SQL LIKE pattern (% and _)
+    negated: bool = False
+
+    def children(self): return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Func(Expr):
+    """Named scalar function call (``year``, ``month``, ``extract``,
+    ``date_trunc``, ``substr``, ``lower``, ``abs``, ...)."""
+
+    name: str
+    args: Tuple[Expr, ...]
+
+    def children(self): return self.args
+
+
+@dataclasses.dataclass(frozen=True)
+class Cast(Expr):
+    child: Expr
+    to: str  # 'long' | 'double' | 'string' | 'date' | 'timestamp'
+
+    def children(self): return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Case(Expr):
+    """CASE WHEN c1 THEN v1 [WHEN ...] ELSE e END."""
+
+    branches: Tuple[Tuple[Expr, Expr], ...]
+    otherwise: Optional[Expr]
+
+    def children(self):
+        out = []
+        for c, v in self.branches:
+            out += [c, v]
+        if self.otherwise is not None:
+            out.append(self.otherwise)
+        return tuple(out)
+
+
+# -- aggregate call (only valid inside SELECT/HAVING/ORDER trees) --------------
+@dataclasses.dataclass(frozen=True)
+class AggCall(Expr):
+    """sum/min/max/avg/count/count_distinct over an argument expression."""
+
+    fn: str                      # sum | min | max | avg | count | count_distinct
+    arg: Optional[Expr]          # None for count(*)
+    distinct: bool = False
+    approx: bool = False         # approximate count-distinct (HLL)
+
+    def children(self):
+        return (self.arg,) if self.arg is not None else ()
+
+
+def walk(e: Expr):
+    yield e
+    for c in e.children():
+        yield from walk(c)
+
+
+def columns_in(e: Expr):
+    return {n.name for n in walk(e) if isinstance(n, Column)}
+
+
+def agg_calls_in(e: Expr):
+    return [n for n in walk(e) if isinstance(n, AggCall)]
+
+
+def transform(e: Expr, fn):
+    """Bottom-up rewrite: rebuild each node from transformed children, then
+    apply ``fn``. ≈ Catalyst ``transformUp``."""
+    if isinstance(e, BinaryOp):
+        e2 = BinaryOp(e.op, transform(e.left, fn), transform(e.right, fn))
+    elif isinstance(e, Comparison):
+        e2 = Comparison(e.op, transform(e.left, fn), transform(e.right, fn))
+    elif isinstance(e, And):
+        e2 = And(tuple(transform(p, fn) for p in e.parts))
+    elif isinstance(e, Or):
+        e2 = Or(tuple(transform(p, fn) for p in e.parts))
+    elif isinstance(e, Not):
+        e2 = Not(transform(e.child, fn))
+    elif isinstance(e, IsNull):
+        e2 = IsNull(transform(e.child, fn), e.negated)
+    elif isinstance(e, InList):
+        e2 = InList(transform(e.child, fn), e.values, e.negated)
+    elif isinstance(e, Between):
+        e2 = Between(transform(e.child, fn), transform(e.low, fn),
+                     transform(e.high, fn), e.negated)
+    elif isinstance(e, Like):
+        e2 = Like(transform(e.child, fn), e.pattern, e.negated)
+    elif isinstance(e, Func):
+        e2 = Func(e.name, tuple(transform(a, fn) for a in e.args))
+    elif isinstance(e, Cast):
+        e2 = Cast(transform(e.child, fn), e.to)
+    elif isinstance(e, Case):
+        e2 = Case(tuple((transform(c, fn), transform(v, fn))
+                        for c, v in e.branches),
+                  None if e.otherwise is None else transform(e.otherwise, fn))
+    elif isinstance(e, AggCall):
+        e2 = AggCall(e.fn, None if e.arg is None else transform(e.arg, fn),
+                     e.distinct, e.approx)
+    else:
+        e2 = e
+    return fn(e2)
+
+
+def to_sql(e: Expr) -> str:
+    """Debug/explain rendering."""
+    if isinstance(e, Column):
+        return e.name
+    if isinstance(e, Literal):
+        return repr(e.value)
+    if isinstance(e, BinaryOp):
+        return f"({to_sql(e.left)} {e.op} {to_sql(e.right)})"
+    if isinstance(e, Comparison):
+        return f"({to_sql(e.left)} {e.op} {to_sql(e.right)})"
+    if isinstance(e, And):
+        return "(" + " AND ".join(to_sql(p) for p in e.parts) + ")"
+    if isinstance(e, Or):
+        return "(" + " OR ".join(to_sql(p) for p in e.parts) + ")"
+    if isinstance(e, Not):
+        return f"(NOT {to_sql(e.child)})"
+    if isinstance(e, IsNull):
+        return f"({to_sql(e.child)} IS {'NOT ' if e.negated else ''}NULL)"
+    if isinstance(e, InList):
+        vals = ", ".join(repr(v) for v in e.values)
+        return f"({to_sql(e.child)} {'NOT ' if e.negated else ''}IN ({vals}))"
+    if isinstance(e, Between):
+        return (f"({to_sql(e.child)} {'NOT ' if e.negated else ''}BETWEEN "
+                f"{to_sql(e.low)} AND {to_sql(e.high)})")
+    if isinstance(e, Like):
+        return f"({to_sql(e.child)} {'NOT ' if e.negated else ''}LIKE {e.pattern!r})"
+    if isinstance(e, Func):
+        return f"{e.name}({', '.join(to_sql(a) for a in e.args)})"
+    if isinstance(e, Cast):
+        return f"CAST({to_sql(e.child)} AS {e.to})"
+    if isinstance(e, Case):
+        parts = " ".join(f"WHEN {to_sql(c)} THEN {to_sql(v)}"
+                         for c, v in e.branches)
+        tail = f" ELSE {to_sql(e.otherwise)}" if e.otherwise is not None else ""
+        return f"CASE {parts}{tail} END"
+    if isinstance(e, AggCall):
+        arg = "*" if e.arg is None else to_sql(e.arg)
+        d = "DISTINCT " if e.distinct else ""
+        return f"{e.fn}({d}{arg})"
+    return repr(e)

@@ -1,0 +1,63 @@
+"""Catalog: datasource registry views + star-schema bindings.
+
+≈ the reference metadata layer: ``DruidMetadataCache`` (datasource schemas),
+``DruidRelationInfo`` (table ↔ datasource binding), ``DruidMetadataViews``
+(SQL-queryable virtual tables). Star-schema specifics live in
+``metadata/star.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+import pandas as pd
+
+from spark_druid_olap_tpu.segment.store import SegmentStore
+
+
+class Catalog:
+    def __init__(self, store: SegmentStore):
+        self.store = store
+        self.star_schemas: Dict[str, object] = {}   # fact table -> StarSchema
+        self._table_to_star: Dict[str, object] = {}
+
+    def register_star_schema(self, star) -> None:
+        self.star_schemas[star.fact_table] = star
+        for t in star.tables():
+            self._table_to_star[t] = star
+
+    def star_schema_of(self, table: str):
+        return self._table_to_star.get(table)
+
+    # -- metadata views (≈ DruidMetadataViews.metadataDFs) --------------------
+    def datasources_view(self) -> pd.DataFrame:
+        rows = []
+        for name in self.store.names():
+            ds = self.store.get(name)
+            lo, hi = ds.interval()
+            rows.append({"name": name, "numRows": ds.num_rows,
+                         "numSegments": ds.num_segments,
+                         "intervalStart": np.datetime64(int(lo), "ms"),
+                         "intervalEnd": np.datetime64(int(hi), "ms"),
+                         "timeColumn": ds.time_column})
+        return pd.DataFrame(rows)
+
+    def segments_view(self) -> pd.DataFrame:
+        rows = []
+        for name in self.store.names():
+            ds = self.store.get(name)
+            for s in ds.segments:
+                rows.append({"datasource": name, "segment": s.id,
+                             "rows": s.num_rows,
+                             "start": np.datetime64(s.min_millis, "ms"),
+                             "end": np.datetime64(s.max_millis, "ms")})
+        return pd.DataFrame(rows)
+
+    def columns_view(self) -> pd.DataFrame:
+        rows = []
+        for name in self.store.names():
+            md = self.store.get(name).metadata()
+            for col, info in md["columns"].items():
+                rows.append({"datasource": name, "column": col, **info})
+        return pd.DataFrame(rows)

@@ -1,0 +1,50 @@
+"""Bounded in-memory query history.
+
+≈ ``DruidQueryHistory`` (reference ``DruidQueryHistory.scala:39-76``: bounded
+queue of 500 executed Druid queries with timings, surfaced in a web-UI tab and
+SQL-queryable metadata views)."""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import List, Optional
+
+
+class QueryExecutionRecord:
+    __slots__ = ("started_at", "query_type", "datasource", "sql", "stats")
+
+    def __init__(self, query_type, datasource, stats, sql=None):
+        self.started_at = time.time()
+        self.query_type = query_type
+        self.datasource = datasource
+        self.stats = dict(stats)
+        self.sql = sql
+
+    def to_dict(self):
+        return {"startedAt": self.started_at, "queryType": self.query_type,
+                "datasource": self.datasource, "sql": self.sql,
+                **self.stats}
+
+
+class QueryHistory:
+    def __init__(self, max_size: int = 500):
+        self._q = collections.deque(maxlen=max_size)
+        self._lock = threading.Lock()
+
+    def record(self, query, stats, sql: Optional[str] = None):
+        rec = QueryExecutionRecord(type(query).__name__,
+                                   getattr(query, "datasource", None),
+                                   stats, sql)
+        with self._lock:
+            self._q.append(rec)
+        return rec
+
+    def entries(self) -> List[QueryExecutionRecord]:
+        with self._lock:
+            return list(self._q)
+
+    def clear(self):
+        with self._lock:
+            self._q.clear()

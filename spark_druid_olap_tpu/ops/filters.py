@@ -1,0 +1,259 @@
+"""FilterSpec -> vectorized device predicate masks.
+
+The in-tree replacement for Druid's filter evaluation engine (the reference
+only *models* filters — ``FilterSpec`` hierarchy ``DruidQuerySpec.scala:152-281``
+— and ships them to Druid). Every filter lowers to a bool [S, R] mask over the
+stacked segment tensors:
+
+- selector  -> one integer compare on dictionary codes
+- bound     -> two integer compares (sorted global dictionary ⇒ lexicographic
+               bounds are code ranges; numeric bounds compare values directly)
+- in        -> host ``np.isin`` over the dictionary -> constant code-mask gather
+- like/regex/contains -> host regex over the dictionary -> code-mask gather
+- expr      -> compiled XLA predicate (replaces the JavaScript filter)
+- and/or/not, is-null, time-interval masks
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_druid_olap_tpu.ir import spec as S
+from spark_druid_olap_tpu.ops import expr_compile as EC
+from spark_druid_olap_tpu.ops import time_ops
+from spark_druid_olap_tpu.ops.scan import ScanContext
+from spark_druid_olap_tpu.segment.column import ColumnKind
+
+
+def lower_filter(f: Optional[S.FilterSpec], ctx: ScanContext):
+    """Lower a FilterSpec to a bool mask (None -> None, meaning all-true)."""
+    if f is None:
+        return None
+    if isinstance(f, S.SelectorFilter):
+        return _selector(f, ctx)
+    if isinstance(f, S.BoundFilter):
+        return _bound(f, ctx)
+    if isinstance(f, S.InFilter):
+        return _in(f, ctx)
+    if isinstance(f, S.PatternFilter):
+        return _pattern(f, ctx)
+    if isinstance(f, S.NullFilter):
+        nv = ctx.null_valid(f.dimension)
+        valid = ctx.row_valid() if nv is None else nv
+        return valid if f.negated else ~valid
+    if isinstance(f, S.LogicalFilter):
+        return _logical(f, ctx)
+    if isinstance(f, S.ExprFilter):
+        v = EC.compile_expr(f.expr, ctx)
+        return EC._as_bool(v)
+    raise EC.Unsupported(f"filter {type(f).__name__}")
+
+
+def _false(ctx):
+    return jnp.zeros_like(ctx.row_valid())
+
+
+def _nullsafe(mask, name: str, ctx: ScanContext):
+    nv = ctx.null_valid(name)
+    return mask if nv is None else (mask & nv)
+
+
+def _selector(f: S.SelectorFilter, ctx):
+    kind = ctx.kind(f.dimension)
+    if f.value is None:
+        nv = ctx.null_valid(f.dimension)
+        return ~nv if nv is not None else _false(ctx)
+    if kind == ColumnKind.DIM:
+        code = ctx.ds.dims[f.dimension].code_of(str(f.value))
+        if code < 0:
+            return _false(ctx)
+        return _nullsafe(ctx.col(f.dimension) == code, f.dimension, ctx)
+    if kind in (ColumnKind.LONG, ColumnKind.DOUBLE):
+        v = float(f.value) if kind == ColumnKind.DOUBLE else int(float(f.value))
+        return _nullsafe(ctx.col(f.dimension) == v, f.dimension, ctx)
+    if kind == ColumnKind.DATE:
+        return ctx.col(f.dimension) == time_ops.date_literal_to_days(f.value)
+    if kind == ColumnKind.TIME:
+        ms = time_ops.date_literal_to_millis(f.value)
+        day, rem = divmod(ms, time_ops.MILLIS_PER_DAY)
+        return (ctx.col(f.dimension) == day) & (ctx.time_ms() == rem)
+    raise EC.Unsupported(f"selector on {kind}")
+
+
+def _bound(f: S.BoundFilter, ctx):
+    kind = ctx.kind(f.dimension)
+    if kind == ColumnKind.DIM and not f.numeric:
+        lo, hi = ctx.ds.dims[f.dimension].code_range(
+            None if f.lower is None else str(f.lower),
+            None if f.upper is None else str(f.upper),
+            f.lower_strict, f.upper_strict)
+        if lo >= hi:
+            return _false(ctx)
+        codes = ctx.col(f.dimension)
+        mask = None
+        if lo > 0:
+            mask = codes >= lo
+        if hi < ctx.ds.dims[f.dimension].cardinality:
+            m2 = codes < hi
+            mask = m2 if mask is None else (mask & m2)
+        if mask is None:
+            nv = ctx.null_valid(f.dimension)
+            return nv if nv is not None else ctx.row_valid()
+        return _nullsafe(mask, f.dimension, ctx)
+    if kind == ColumnKind.DIM and f.numeric:
+        # numeric ordering over string dictionary: host-parse to LUT
+        vals = ctx.dictionary(f.dimension)
+        lut = np.array([_try_float(s) for s in vals], dtype=np.float32)
+        arr = EC._take_lut(lut, ctx.col(f.dimension))
+        return _nullsafe(_range_mask(arr, f, float), f.dimension, ctx)
+    if kind in (ColumnKind.LONG, ColumnKind.DOUBLE):
+        conv = float if kind == ColumnKind.DOUBLE else (lambda x: int(float(x)))
+        return _nullsafe(_range_mask(ctx.col(f.dimension), f, conv),
+                         f.dimension, ctx)
+    if kind == ColumnKind.DATE:
+        return _range_mask(ctx.col(f.dimension), f,
+                           time_ops.date_literal_to_days)
+    if kind == ColumnKind.TIME:
+        return _time_bound(f, ctx)
+    raise EC.Unsupported(f"bound on {kind}")
+
+
+def _try_float(s):
+    try:
+        return float(s)
+    except (TypeError, ValueError):
+        return np.nan
+
+
+def _range_mask(arr, f: S.BoundFilter, conv):
+    mask = None
+    if f.lower is not None:
+        lo = conv(f.lower)
+        m = (arr > lo) if f.lower_strict else (arr >= lo)
+        mask = m
+    if f.upper is not None:
+        hi = conv(f.upper)
+        m = (arr < hi) if f.upper_strict else (arr <= hi)
+        mask = m if mask is None else (mask & m)
+    return mask if mask is not None else (arr == arr)
+
+
+def _time_bound(f: S.BoundFilter, ctx):
+    days = ctx.col(f.dimension)
+    ms = ctx.time_ms()
+    mask = None
+    if f.lower is not None:
+        lo = time_ops.date_literal_to_millis(f.lower)
+        d, r = divmod(lo, time_ops.MILLIS_PER_DAY)
+        cmp = (ms > r) if f.lower_strict else (ms >= r)
+        m = (days > d) | ((days == d) & cmp)
+        mask = m
+    if f.upper is not None:
+        hi = time_ops.date_literal_to_millis(f.upper)
+        d, r = divmod(hi, time_ops.MILLIS_PER_DAY)
+        cmp = (ms < r) if f.upper_strict else (ms <= r)
+        m = (days < d) | ((days == d) & cmp)
+        mask = m if mask is None else (mask & m)
+    return mask if mask is not None else ctx.row_valid()
+
+
+def _in(f: S.InFilter, ctx):
+    kind = ctx.kind(f.dimension)
+    if kind == ColumnKind.DIM:
+        mask = np.isin(ctx.dictionary(f.dimension).astype(str),
+                       np.array([str(v) for v in f.values]))
+        return _nullsafe(EC._take_mask(mask, ctx.col(f.dimension)),
+                         f.dimension, ctx)
+    arr = ctx.col(f.dimension)
+    out = None
+    for v in f.values:
+        if kind == ColumnKind.DATE:
+            b = arr == time_ops.date_literal_to_days(v)
+        elif kind == ColumnKind.DOUBLE:
+            b = arr == float(v)
+        else:
+            b = arr == int(float(v))
+        out = b if out is None else (out | b)
+    return _nullsafe(out if out is not None else _false(ctx),
+                     f.dimension, ctx)
+
+
+def _pattern(f: S.PatternFilter, ctx):
+    if ctx.kind(f.dimension) != ColumnKind.DIM:
+        raise EC.Unsupported("pattern filter on non-string column")
+    vals = ctx.dictionary(f.dimension)
+    if f.kind == "like":
+        rx = re.compile(EC.like_to_regex(f.pattern))
+        mask = np.array([bool(rx.match(s)) for s in vals])
+    elif f.kind == "regex":
+        rx = re.compile(f.pattern)
+        mask = np.array([bool(rx.search(s)) for s in vals])
+    elif f.kind == "contains":
+        mask = np.array([f.pattern in s for s in vals])
+    else:
+        raise EC.Unsupported(f"pattern kind {f.kind}")
+    return _nullsafe(EC._take_mask(mask, ctx.col(f.dimension)),
+                     f.dimension, ctx)
+
+
+def _logical(f: S.LogicalFilter, ctx):
+    if f.op == "not":
+        inner = lower_filter(f.fields[0], ctx)
+        base = ctx.row_valid() if inner is None else ~inner
+        return base
+    masks = [lower_filter(x, ctx) for x in f.fields]
+    if f.op == "or":
+        # an all-true (None) operand makes the whole OR all-true
+        if not masks or any(m is None for m in masks):
+            return None
+    else:
+        masks = [m for m in masks if m is not None]
+        if not masks:
+            return None
+    out = masks[0]
+    for m in masks[1:]:
+        out = (out & m) if f.op == "and" else (out | m)
+    return out
+
+
+def interval_mask(intervals, ctx: ScanContext):
+    """Residual device mask for time intervals (after host-side segment
+    pruning; segments straddling an interval edge need the row-level mask).
+
+    ≈ the reference's ``QueryIntervals`` constraints that Druid applies
+    per-segment."""
+    if not intervals or ctx.ds.time is None:
+        return None
+    days = ctx.col(ctx.ds.time.name)
+    ms = ctx.time_ms()
+    out = None
+    for lo, hi in intervals:
+        dlo, rlo = divmod(int(lo), time_ops.MILLIS_PER_DAY)
+        dhi, rhi = divmod(int(hi), time_ops.MILLIS_PER_DAY)
+        m_lo = (days > dlo) | ((days == dlo) & (ms >= rlo))
+        m_hi = (days < dhi) | ((days == dhi) & (ms < rhi))
+        m = m_lo & m_hi
+        out = m if out is None else (out | m)
+    return out
+
+
+def columns_of_filter(f: Optional[S.FilterSpec]):
+    """Source columns a filter touches (for array binding)."""
+    if f is None:
+        return set()
+    if isinstance(f, (S.SelectorFilter, S.BoundFilter, S.InFilter,
+                      S.PatternFilter, S.NullFilter)):
+        return {f.dimension}
+    if isinstance(f, S.LogicalFilter):
+        out = set()
+        for x in f.fields:
+            out |= columns_of_filter(x)
+        return out
+    if isinstance(f, S.ExprFilter):
+        from spark_druid_olap_tpu.ir import expr as E
+        return E.columns_in(f.expr)
+    return set()

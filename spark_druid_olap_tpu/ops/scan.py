@@ -1,0 +1,120 @@
+"""Scan context: the bridge between host-side metadata (dictionaries, column
+kinds) and the traced device arrays inside a compiled query program.
+
+A ``ScanContext`` is constructed inside the jitted query function: the device
+arrays it holds are **tracers** (function inputs), while the dictionaries and
+cardinalities it consults are host constants — so dictionary-derived predicate
+masks become small embedded constants in the compiled executable, and no
+string ever reaches the device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+from spark_druid_olap_tpu.segment.column import ColumnKind
+from spark_druid_olap_tpu.segment.store import Datasource
+
+TIME_MS_KEY = "__time_ms__"
+ROW_VALID_KEY = "__rows__"
+NULL_VALID_PREFIX = "__nulls__"
+
+
+@dataclasses.dataclass
+class ScanContext:
+    """Host metadata + traced device arrays for one scan program."""
+
+    ds: Datasource
+    arrays: Dict[str, object]          # name -> traced [S, R] array
+    min_day: int                       # over the selected segments
+    max_day: int
+
+    # -- device array access --------------------------------------------------
+    def col(self, name: str):
+        if name not in self.arrays:
+            raise KeyError(
+                f"column {name!r} not bound into this scan program "
+                f"(bound: {sorted(self.arrays)})")
+        return self.arrays[name]
+
+    def row_valid(self):
+        return self.arrays[ROW_VALID_KEY]
+
+    def time_ms(self):
+        return self.arrays.get(TIME_MS_KEY)
+
+    def null_valid(self, name: str):
+        """Validity mask for a nullable column, or None if non-nullable."""
+        return self.arrays.get(NULL_VALID_PREFIX + name)
+
+    # -- host metadata --------------------------------------------------------
+    def kind(self, name: str) -> ColumnKind:
+        return self.ds.column_kind(name)
+
+    def is_time(self, name: str) -> bool:
+        return self.ds.time is not None and name == self.ds.time.name
+
+    def dictionary(self, name: str) -> np.ndarray:
+        return self.ds.dims[name].dictionary
+
+    def date_bounds(self, name: str):
+        """(min_day, max_day) for a TIME or DATE column — bounds any
+        granularity/extraction bucket cardinality."""
+        if self.is_time(name):
+            return self.min_day, self.max_day
+        m = self.ds.metrics[name]
+        lo, hi = m.min, m.max
+        return int(lo if lo is not None else 0), int(hi if hi is not None else 0)
+
+
+def array_names(ds: Datasource, columns, need_time_ms: bool):
+    """The array keys a scan program over ``columns`` binds."""
+    names = list(columns)
+    for name in columns:
+        if ds.stacked_null_validity(name) is not None:
+            names.append(NULL_VALID_PREFIX + name)
+    if need_time_ms and ds.time is not None:
+        names.append(TIME_MS_KEY)
+    names.append(ROW_VALID_KEY)
+    return names
+
+
+def build_array(ds: Datasource, key: str,
+                segment_indices: Optional[np.ndarray] = None,
+                pad_segments_to: Optional[int] = None) -> np.ndarray:
+    """Materialize one host-side stacked array by key.
+
+    ``segment_indices`` selects (pruned) segments; ``pad_segments_to`` pads
+    the segment axis with empty segments so the compiled program shape is
+    stable across prunings (compile-cache friendliness) and divisible by the
+    mesh size.
+    """
+    if key == ROW_VALID_KEY:
+        arr = ds.stacked_row_validity()
+    elif key == TIME_MS_KEY:
+        arr = ds.stacked_time_ms()
+    elif key.startswith(NULL_VALID_PREFIX):
+        arr = ds.stacked_null_validity(key[len(NULL_VALID_PREFIX):])
+    else:
+        arr = ds.stacked(key)
+    if segment_indices is not None and (
+            len(segment_indices) != ds.num_segments
+            or not np.array_equal(segment_indices,
+                                  np.arange(ds.num_segments))):
+        arr = arr[segment_indices]
+    if pad_segments_to is not None and arr.shape[0] < pad_segments_to:
+        pad = np.zeros((pad_segments_to - arr.shape[0],) + arr.shape[1:],
+                       dtype=arr.dtype)
+        arr = np.concatenate([arr, pad], axis=0)
+    return arr
+
+
+def required_arrays(ds: Datasource, columns, need_time_ms: bool,
+                    segment_indices: Optional[np.ndarray] = None,
+                    pad_segments_to: Optional[int] = None) -> Dict[str, np.ndarray]:
+    """Materialize every host-side stacked array a program needs."""
+    return {k: build_array(ds, k, segment_indices, pad_segments_to)
+            for k in array_names(ds, columns, need_time_ms)}

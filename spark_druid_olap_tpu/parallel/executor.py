@@ -1,0 +1,818 @@
+"""Query executor: lowers a QuerySpec onto compiled XLA scan programs and runs
+them single-chip or sharded over a device mesh.
+
+This layer merges three reference components, re-seamed for TPU:
+
+- ``DruidRDD`` (``DruidRDD.scala:152-277``): partitioning the scan across
+  historicals/segments -> here, the segment axis of the stacked tensors,
+  sharded over the mesh by ``shard_map``;
+- the broker/historical scatter-gather + Spark-side final aggregate
+  (``DruidStrategy.scala:349-360``, ``PostAggregate``): -> ICI collectives
+  (psum/pmin/pmax) inside the compiled program;
+- result-row materialization (``DruidRDD.scala:235-241`` value transforms):
+  -> host-side group decoding through the global dictionaries.
+
+Compile model: one XLA program per (query structure, padded shapes) — cached,
+so repeated dashboard-style queries hit a warm executable (the reference's
+analog is Druid's own query planning being stateless but fast; our compile
+cost is front-loaded and amortized, tracked by the cost model's compile-cost
+knob).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_druid_olap_tpu.ir import expr as E
+from spark_druid_olap_tpu.ir import spec as S
+from spark_druid_olap_tpu.ops import expr_compile as EC
+from spark_druid_olap_tpu.ops import filters as F
+from spark_druid_olap_tpu.ops import groupby as G
+from spark_druid_olap_tpu.ops import hll as HLL
+from spark_druid_olap_tpu.ops import time_ops as T
+from spark_druid_olap_tpu.ops.scan import (
+    ScanContext,
+    array_names,
+    build_array,
+    ROW_VALID_KEY,
+    NULL_VALID_PREFIX,
+    TIME_MS_KEY,
+)
+from spark_druid_olap_tpu.parallel.mesh import SEGMENT_AXIS, mesh_size
+from spark_druid_olap_tpu.result import QueryResult
+from spark_druid_olap_tpu.segment.column import ColumnKind
+from spark_druid_olap_tpu.segment.store import Datasource, SegmentStore
+from spark_druid_olap_tpu.utils import host_eval
+from spark_druid_olap_tpu.utils.config import (
+    Config,
+    GROUPBY_DENSE_MAX_KEYS,
+    GROUPBY_MATMUL_MAX_KEYS,
+    HLL_LOG2M,
+)
+
+
+class EngineFallback(Exception):
+    """Query (or part) can't run on the device path; planner must evaluate a
+    host residual instead. ≈ the reference leaving unpushable predicates
+    above the Druid scan (``ProjectFilterTransfom.addUnpushedAttributes``)."""
+
+
+# =============================================================================
+# dimension planning (host side; card/decode known before tracing)
+# =============================================================================
+
+@dataclasses.dataclass
+class DimPlan:
+    output_name: str
+    card: int
+    build: object            # ctx -> int32 codes in [0, card)
+    decode: object           # np.ndarray[int] -> np.ndarray of output values
+    source_cols: tuple
+
+
+def _with_null_slot(build, decode, card, name, nullable):
+    """Nullable grouping columns get slot 0 = the null group (Druid emits a
+    null group for null dimension values); non-null codes shift by one."""
+    if not nullable:
+        return build, decode, card
+
+    def build2(ctx):
+        nv = ctx.null_valid(name)
+        codes = build(ctx)
+        if nv is None:
+            return codes + 1
+        return jnp.where(nv, codes + 1, 0)
+
+    def decode2(idx):
+        idx = np.asarray(idx, np.int64)
+        vals = decode(np.maximum(idx - 1, 0))
+        out = np.empty(len(idx), dtype=object)
+        out[:] = [None if i == 0 else v for i, v in zip(idx, vals)]
+        return out
+
+    return build2, decode2, card + 1
+
+
+def _plan_plain(name: str, ds: Datasource, out: str, min_day, max_day) -> DimPlan:
+    kind = ds.column_kind(name)
+    if kind == ColumnKind.DIM:
+        col = ds.dims[name]
+        build, decode, card = _with_null_slot(
+            lambda ctx: ctx.col(name),
+            lambda idx: col.dictionary[np.asarray(idx, np.int64)],
+            col.cardinality, name, col.validity is not None)
+        return DimPlan(out, card, build, decode, (name,))
+    if kind == ColumnKind.DATE:
+        m = ds.metrics[name]
+        lo = int(m.min) if m.min is not None else 0
+        hi = int(m.max) if m.max is not None else 0
+        build, decode, card = _with_null_slot(
+            lambda ctx: ctx.col(name) - lo,
+            lambda idx: (np.asarray(idx, np.int64) + lo)
+            .astype("datetime64[D]"),
+            hi - lo + 1, name, m.validity is not None)
+        return DimPlan(out, card, build, decode, (name,))
+    if kind == ColumnKind.LONG:
+        m = ds.metrics[name]
+        lo = int(m.min) if m.min is not None else 0
+        hi = int(m.max) if m.max is not None else 0
+        if hi - lo + 1 > (1 << 22):
+            raise EngineFallback(f"grouping on wide-range long {name}")
+        build, decode, card = _with_null_slot(
+            lambda ctx: ctx.col(name) - lo,
+            lambda idx: np.asarray(idx, np.int64) + lo,
+            hi - lo + 1, name, m.validity is not None)
+        return DimPlan(out, card, build, decode, (name,))
+    if kind == ColumnKind.TIME:
+        # raw-time grouping only supported at day grain via extraction
+        raise EngineFallback("group by raw time column; use an extraction")
+    raise EngineFallback(f"group by {kind}")
+
+
+_FIELD_CARDS = {"month": (1, 12), "quarter": (1, 4), "day": (1, 31),
+                "dow": (1, 7), "doy": (1, 366), "hour": (0, 23),
+                "minute": (0, 59), "second": (0, 59)}
+
+
+def _plan_time_extraction(dspec: S.DimensionSpec, ds: Datasource,
+                          min_day: int, max_day: int) -> DimPlan:
+    ex = dspec.extraction
+    assert isinstance(ex, S.TimeExtraction)
+    name = dspec.dimension
+    kind = ds.column_kind(name)
+    if kind not in (ColumnKind.TIME, ColumnKind.DATE, ColumnKind.DIM):
+        raise EngineFallback(f"time extraction over {kind}")
+    if kind == ColumnKind.DIM:
+        # date-string dim: convert through host LUT then treat as days
+        col = ds.dims[name]
+        lut = np.array([T.date_literal_to_days(s) if s else 0
+                        for s in col.dictionary], dtype=np.int32)
+        day_build = lambda ctx: EC._take_lut(lut, ctx.col(name))
+        lo_day, hi_day = int(lut.min()), int(lut.max())
+    elif kind == ColumnKind.DATE:
+        m = ds.metrics[name]
+        lo_day = int(m.min) if m.min is not None else 0
+        hi_day = int(m.max) if m.max is not None else 0
+        day_build = lambda ctx: ctx.col(name)
+    else:
+        lo_day, hi_day = min_day, max_day
+        day_build = lambda ctx: ctx.col(name)
+
+    field = ex.field
+    if field.startswith("trunc_"):
+        grain = field[len("trunc_"):]
+        def build(ctx, grain=grain):
+            days = day_build(ctx)
+            ms = ctx.time_ms() if kind == ColumnKind.TIME else None
+            b, _, _ = T.bucket_and_cardinality(grain, days, ms, lo_day, hi_day)
+            return b
+        _, card, decode1 = T.bucket_and_cardinality(
+            grain, np.zeros(1, np.int32), np.zeros(1, np.int32),
+            lo_day, hi_day)
+        decode = lambda idx: np.array([decode1(i) for i in np.asarray(idx)],
+                                      dtype="datetime64[ms]")
+        return DimPlan(dspec.output_name, card, build, decode, (name,))
+    if field == "year":
+        y_lo = host_eval._civil(np.array([lo_day]))[0][0]
+        y_hi = host_eval._civil(np.array([hi_day]))[0][0]
+        card = int(y_hi - y_lo + 1)
+        def build(ctx):
+            days = day_build(ctx)
+            return T.extract_field("year", days) - int(y_lo)
+        return DimPlan(dspec.output_name, card, build,
+                       lambda idx: np.asarray(idx, np.int64) + int(y_lo),
+                       (name,))
+    if field == "week":
+        lo = (lo_day + 3) // 7
+        hi = (hi_day + 3) // 7
+        def build(ctx):
+            return T.extract_field("week", day_build(ctx)) - lo
+        return DimPlan(dspec.output_name, hi - lo + 1, build,
+                       lambda idx: ((np.asarray(idx, np.int64) + lo) * 7 - 3)
+                       .astype("datetime64[D]"), (name,))
+    if field in _FIELD_CARDS:
+        f_lo, f_hi = _FIELD_CARDS[field]
+        needs_ms = field in ("hour", "minute", "second")
+        if needs_ms and kind != ColumnKind.TIME:
+            raise EngineFallback(f"{field} of a date column")
+        def build(ctx, field=field, f_lo=f_lo):
+            days = day_build(ctx)
+            ms = ctx.time_ms() if kind == ColumnKind.TIME else None
+            return T.extract_field(field, days, ms) - f_lo
+        return DimPlan(dspec.output_name, f_hi - f_lo + 1, build,
+                       lambda idx: np.asarray(idx, np.int64) + f_lo, (name,))
+    raise EngineFallback(f"time extraction field {field}")
+
+
+def plan_granularity_dim(gran: S.Granularity, ds: Datasource, min_day: int,
+                         max_day: int) -> DimPlan:
+    """Granularity bucketing as a leading group dimension named 'timestamp'
+    (Druid result rows' timestamp field). Uses absolute time buckets for
+    every grain incl. hour/minute/duration."""
+    if ds.time is None:
+        raise EngineFallback("granularity on time-less datasource")
+    tname = ds.time.name
+    kind = gran.kind
+    if kind == "none":
+        raise EngineFallback("'none' granularity (row-grain) on agg path")
+    try:
+        _, card, decode1 = T.bucket_and_cardinality(
+            kind, np.zeros(1, np.int32), np.zeros(1, np.int32),
+            min_day, max_day, gran.duration_millis)
+    except ValueError as e:
+        raise EngineFallback(str(e))
+
+    def build(ctx):
+        b, _, _ = T.bucket_and_cardinality(
+            kind, ctx.col(tname), ctx.time_ms(), min_day, max_day,
+            gran.duration_millis)
+        return b
+
+    decode = lambda idx: np.array([decode1(i) for i in np.asarray(idx)],
+                                  dtype="datetime64[ms]")
+    return DimPlan("timestamp", card, build, decode, (tname,))
+
+
+def _plan_expr_extraction(dspec: S.DimensionSpec, ds: Datasource,
+                          min_day: int, max_day: int) -> DimPlan:
+    ex = dspec.extraction
+    assert isinstance(ex, S.ExprExtraction)
+    cols = sorted(E.columns_in(ex.expr))
+    # single string-dim expression: evaluate over the dictionary domain on
+    # host, factorize, remap codes through a LUT (dictionary-functional path)
+    if len(cols) == 1 and cols[0] in ds.dims:
+        dim = ds.dims[cols[0]]
+        try:
+            vals = host_eval.eval_expr(ex.expr, {cols[0]: dim.dictionary})
+        except host_eval.HostEvalError as e:
+            raise EngineFallback(str(e))
+        vals = np.asarray(vals)
+        if vals.shape != dim.dictionary.shape:
+            raise EngineFallback("non-elementwise dim expression")
+        uniq, remap = np.unique(vals.astype(object) if vals.dtype == object
+                                else vals, return_inverse=True)
+        lut = remap.astype(np.int32)
+        name = cols[0]
+        return DimPlan(dspec.output_name, len(uniq),
+                       lambda ctx: EC._take_lut(lut, ctx.col(name)),
+                       lambda idx: uniq[np.asarray(idx, np.int64)],
+                       (name,))
+    # general expression: compile to device; needs a declared or derivable
+    # small integer range
+    card = ex.cardinality
+    if card is None:
+        raise EngineFallback(
+            "expression dimension without cardinality bound "
+            f"({E.to_sql(ex.expr)})")
+
+    def build(ctx):
+        v = EC.compile_expr(ex.expr, ctx)
+        if isinstance(v, EC.BoolValue):
+            return v.arr.astype(jnp.int32)
+        if isinstance(v, EC.NumValue) and not v.is_float:
+            return jnp.clip(v.arr, 0, card - 1)
+        raise EC.Unsupported("expression dimension must be int/bool")
+
+    return DimPlan(dspec.output_name, card, build,
+                   lambda idx: np.asarray(idx, np.int64), tuple(cols))
+
+
+def plan_dimension(dspec: S.DimensionSpec, ds: Datasource, min_day: int,
+                   max_day: int) -> DimPlan:
+    try:
+        if dspec.extraction is None:
+            return _plan_plain(dspec.dimension, ds, dspec.output_name,
+                               min_day, max_day)
+        if isinstance(dspec.extraction, S.TimeExtraction):
+            return _plan_time_extraction(dspec, ds, min_day, max_day)
+        if isinstance(dspec.extraction, S.ExprExtraction):
+            return _plan_expr_extraction(dspec, ds, min_day, max_day)
+    except EC.Unsupported as e:
+        raise EngineFallback(str(e))
+    raise EngineFallback(f"extraction {type(dspec.extraction).__name__}")
+
+
+# =============================================================================
+# aggregation planning
+# =============================================================================
+
+@dataclasses.dataclass
+class AggPlan:
+    spec: S.AggregationSpec
+    kind: str                    # 'count'|'sum'|'min'|'max'|'hll'
+    out_dtype: object
+    source_cols: tuple
+
+    def build_values(self, ctx: ScanContext):
+        a = self.spec
+        if a.field is not None:
+            k = ctx.kind(a.field)
+            if self.kind == "hll":
+                if k == ColumnKind.DIM:
+                    return ctx.col(a.field)
+                if k in (ColumnKind.LONG, ColumnKind.DATE):
+                    return ctx.col(a.field)
+                if k == ColumnKind.DOUBLE:
+                    return ctx.col(a.field).view(jnp.int32) \
+                        if hasattr(ctx.col(a.field), "view") else \
+                        jax.lax.bitcast_convert_type(ctx.col(a.field),
+                                                     jnp.int32)
+                raise EngineFallback(f"cardinality over {k}")
+            if k in (ColumnKind.LONG, ColumnKind.DOUBLE, ColumnKind.DATE):
+                return ctx.col(a.field)
+            if k == ColumnKind.DIM and self.kind in ("min", "max", "sum"):
+                # numeric-parsed dim (Druid coerces); host LUT
+                lut = np.array([host_eval_try_float(s)
+                                for s in ctx.dictionary(a.field)],
+                               dtype=np.float32)
+                return EC._take_lut(lut, ctx.col(a.field))
+            raise EngineFallback(f"aggregate {a.kind} over {k}")
+        if a.expr is not None:
+            v = EC.compile_expr(a.expr, ctx)
+            n = EC._as_num(v, ctx)
+            return n.arr
+        return None
+
+    def build_mask(self, ctx: ScanContext):
+        a = self.spec
+        masks = []
+        if a.filter is not None:
+            m = F.lower_filter(a.filter, ctx)
+            if m is not None:
+                masks.append(m)
+        if a.field is not None:
+            nv = ctx.null_valid(a.field)
+            if nv is not None:
+                masks.append(nv)
+        if a.expr is not None:
+            for c in E.columns_in(a.expr):
+                nv = ctx.null_valid(c)
+                if nv is not None:
+                    masks.append(nv)
+        if not masks:
+            return None
+        out = masks[0]
+        for m in masks[1:]:
+            out = out & m
+        return out
+
+
+def host_eval_try_float(s):
+    try:
+        return float(s)
+    except (TypeError, ValueError):
+        return np.nan
+
+
+_AGG_KIND = {"count": ("count", np.int64), "longsum": ("sum", np.int64),
+             "doublesum": ("sum", np.float64), "longmin": ("min", np.int64),
+             "longmax": ("max", np.int64), "doublemin": ("min", np.float64),
+             "doublemax": ("max", np.float64), "cardinality": ("hll", np.int64)}
+
+
+def plan_aggregation(a: S.AggregationSpec, ds: Datasource) -> AggPlan:
+    if a.kind not in _AGG_KIND:
+        raise EngineFallback(f"aggregation kind {a.kind}")
+    kind, dtype = _AGG_KIND[a.kind]
+    cols = set()
+    if a.field is not None:
+        cols.add(a.field)
+    if a.expr is not None:
+        cols |= E.columns_in(a.expr)
+    cols |= F.columns_of_filter(a.filter)
+    return AggPlan(a, kind, dtype, tuple(sorted(cols)))
+
+
+# =============================================================================
+# the engine
+# =============================================================================
+
+class QueryEngine:
+    def __init__(self, store: SegmentStore, config: Optional[Config] = None,
+                 mesh: Optional[Mesh] = None):
+        self.store = store
+        self.config = config or Config()
+        self.mesh = mesh
+        self._programs: Dict[tuple, object] = {}   # compile cache
+        self._device_arrays: Dict[tuple, object] = {}
+        self.last_stats: Dict[str, object] = {}
+
+    # -- public ---------------------------------------------------------------
+    def execute(self, q: S.QuerySpec) -> QueryResult:
+        t0 = _time.perf_counter()
+        if isinstance(q, S.GroupByQuerySpec):
+            r = self._run_agg(q, list(q.dimensions), q.aggregations,
+                              q.post_aggregations, q.having, q.limit,
+                              q.granularity, q.filter, q.intervals)
+        elif isinstance(q, S.TimeseriesQuerySpec):
+            r = self._run_agg(q, [], q.aggregations, q.post_aggregations,
+                              None, None, q.granularity, q.filter,
+                              q.intervals)
+        elif isinstance(q, S.TopNQuerySpec):
+            limit = S.LimitSpec((S.OrderByColumn(q.metric, ascending=False),),
+                                q.threshold)
+            r = self._run_agg(q, [q.dimension], q.aggregations,
+                              q.post_aggregations, None, limit,
+                              q.granularity, q.filter, q.intervals)
+        elif isinstance(q, S.SelectQuerySpec):
+            r = self._run_select(q)
+        elif isinstance(q, S.SearchQuerySpec):
+            r = self._run_search(q)
+        else:
+            raise EngineFallback(f"query type {type(q).__name__}")
+        self.last_stats["total_ms"] = (_time.perf_counter() - t0) * 1000
+        return r
+
+    # -- aggregation path -----------------------------------------------------
+    def _run_agg(self, q, dimensions: List[S.DimensionSpec], aggregations,
+                 post_aggregations, having, limit, granularity, filter_spec,
+                 intervals) -> QueryResult:
+        ds = self.store.get(q.datasource)
+        seg_idx = ds.prune_segments(intervals)
+        gran_kind = granularity.kind if granularity else "all"
+
+        if ds.num_rows == 0 or len(seg_idx) == 0:
+            names = (["timestamp"] if gran_kind != "all" else [])
+            names += [d.output_name for d in dimensions]
+            names += [a.name for a in aggregations]
+            names += [p.name for p in post_aggregations]
+            return QueryResult.empty(names)
+
+        mins, maxs = ds.segment_time_bounds()
+        min_day = int(mins[seg_idx].min() // T.MILLIS_PER_DAY)
+        max_day = int(maxs[seg_idx].max() // T.MILLIS_PER_DAY)
+
+        # --- plan dims/aggs (raises EngineFallback on unsupported) -----------
+        dim_plans = [plan_dimension(d, ds, min_day, max_day)
+                     for d in dimensions]
+        gran_plan = None
+        if gran_kind != "all":
+            gran_plan = plan_granularity_dim(granularity, ds, min_day,
+                                             max_day)
+        all_dim_plans = ([gran_plan] if gran_plan else []) + dim_plans
+
+        agg_plans = [plan_aggregation(a, ds) for a in aggregations]
+
+        cards = [p.card for p in all_dim_plans]
+        n_keys = 1
+        for c in cards:
+            n_keys *= c
+        if n_keys > self.config.get(GROUPBY_DENSE_MAX_KEYS):
+            raise EngineFallback(
+                f"group key cardinality {n_keys} exceeds dense limit")
+
+        # --- bind arrays ------------------------------------------------------
+        needed = set()
+        for p in all_dim_plans:
+            needed |= set(p.source_cols)
+        for p in agg_plans:
+            needed |= set(p.source_cols)
+        needed |= F.columns_of_filter(filter_spec)
+        time_in_play = ds.time is not None and (
+            intervals is not None or gran_kind not in ("all",)
+            or (ds.time.name in needed))
+        if time_in_play:
+            needed.add(ds.time.name)
+        need_ms = time_in_play
+
+        sharded = self._should_shard(q, ds, seg_idx)
+        n_dev = mesh_size(self.mesh) if sharded else 1
+        s_pad = _pad_segments(len(seg_idx), n_dev)
+
+        # --- build / fetch program -------------------------------------------
+        names = array_names(ds, sorted(needed), need_ms)
+        sig = ("agg", ds.name, id(ds), repr(q), s_pad, ds.padded_rows,
+               min_day, max_day, sharded, n_dev, tuple(names))
+        prog = self._programs.get(sig)
+        if prog is None:
+            prog = self._build_agg_program(
+                ds, all_dim_plans, agg_plans, filter_spec, intervals,
+                min_day, max_day, n_keys, sharded)
+            self._programs[sig] = prog
+
+        dev_arrays = self._bind_arrays(ds, names, seg_idx, s_pad, sharded)
+        out = prog(dev_arrays)
+        out = {k: np.asarray(v) for k, v in out.items()}
+
+        # --- decode -----------------------------------------------------------
+        rows = out["__rows__"]
+        sel = np.nonzero(rows > 0)[0]
+        data: Dict[str, np.ndarray] = {}
+        columns: List[str] = []
+        if all_dim_plans:
+            code_lists = G.unfuse_key(sel, cards)
+            for p, codes in zip(all_dim_plans, code_lists):
+                data[p.output_name] = p.decode(codes)
+                columns.append(p.output_name)
+        for p in agg_plans:
+            name = p.spec.name
+            if p.kind == "hll":
+                regs = out[name]
+                est = HLL.estimate(regs)[sel]
+                data[name] = np.round(est).astype(np.int64)
+            else:
+                v = out[name][sel]
+                if p.kind in ("min", "max"):
+                    # groups whose (filtered) agg matched no rows keep the
+                    # +/-F32_MAX sentinel -> emit null (NaN), like Druid
+                    empty = np.abs(v) >= 3.0e38
+                    if empty.any():
+                        data[name] = np.where(empty, np.nan,
+                                              v).astype(np.float64)
+                    elif np.issubdtype(p.out_dtype, np.integer):
+                        data[name] = np.round(v).astype(np.int64)
+                    else:
+                        data[name] = v.astype(np.float64)
+                elif np.issubdtype(p.out_dtype, np.integer):
+                    data[name] = np.round(v).astype(np.int64)
+                else:
+                    data[name] = v.astype(np.float64)
+            columns.append(name)
+
+        # --- post aggregations / having / limit (host epilogue) --------------
+        for pa in post_aggregations:
+            data[pa.name] = np.asarray(host_eval.eval_expr(pa.expr, data))
+            columns.append(pa.name)
+        if having is not None:
+            keep = np.asarray(host_eval.eval_expr(having.expr, data),
+                              dtype=bool)
+            data = {k: v[keep] for k, v in data.items()}
+        if limit is not None and limit.columns:
+            order_keys = []
+            for oc in reversed(limit.columns):
+                k = data[oc.name]
+                if k.dtype == object:
+                    k = k.astype(str)
+                order_keys.append(k if oc.ascending else _neg_key(k))
+            idx = np.lexsort(order_keys)
+            if limit.limit is not None:
+                idx = idx[: limit.limit]
+            data = {k: v[idx] for k, v in data.items()}
+        elif limit is not None and limit.limit is not None:
+            data = {k: v[: limit.limit] for k, v in data.items()}
+
+        self.last_stats.update({
+            "datasource": ds.name, "segments": int(len(seg_idx)),
+            "sharded": sharded, "groups": int(len(sel)),
+            "rows_scanned": int(ds.num_rows)})
+        return QueryResult(columns, data)
+
+    def _build_agg_program(self, ds, dim_plans, agg_plans, filter_spec,
+                           intervals, min_day, max_day, n_keys, sharded):
+        matmul_max = self.config.get(GROUPBY_MATMUL_MAX_KEYS)
+        log2m = self.config.get(HLL_LOG2M)
+        hll_plans = [p for p in agg_plans if p.kind == "hll"]
+        dense_plans = [p for p in agg_plans if p.kind != "hll"]
+
+        def core(arrays):
+            ctx = ScanContext(ds, arrays, min_day, max_day)
+            base = ctx.row_valid()
+            fm = F.lower_filter(filter_spec, ctx)
+            if fm is not None:
+                base = base & fm
+            im = F.interval_mask(intervals, ctx)
+            if im is not None:
+                base = base & im
+            if dim_plans:
+                codes = [p.build(ctx) for p in dim_plans]
+                key, _ = G.fuse_keys(codes, [p.card for p in dim_plans])
+            else:
+                key = jnp.zeros_like(base, dtype=jnp.int32)
+            inputs = []
+            for p in dense_plans:
+                inputs.append(G.AggInput(p.spec.name, p.kind,
+                                         p.build_values(ctx),
+                                         p.build_mask(ctx)))
+            out = G.dense_groupby(key, base, n_keys, inputs, matmul_max)
+            for p in hll_plans:
+                vals = p.build_values(ctx)
+                am = p.build_mask(ctx)
+                m = base if am is None else (base & am)
+                out[p.spec.name] = HLL.hll_registers(
+                    key, m, vals, n_keys, log2m)
+            return out
+
+        if not sharded:
+            return jax.jit(core)
+
+        mesh = self.mesh
+        dense_inputs = [G.AggInput(p.spec.name, p.kind) for p in dense_plans]
+
+        def sharded_core(arrays):
+            out = core(arrays)
+            merged = G.merge_partials(
+                {k: v for k, v in out.items()
+                 if not any(k == p.spec.name for p in hll_plans)},
+                dense_inputs + [G.AggInput("__rows__", "count")],
+                SEGMENT_AXIS)
+            for p in hll_plans:
+                merged[p.spec.name] = HLL.merge_registers(
+                    out[p.spec.name], SEGMENT_AXIS)
+            return merged
+
+        in_specs = P(SEGMENT_AXIS, None)
+        fn = jax.shard_map(sharded_core, mesh=mesh,
+                           in_specs=(in_specs,), out_specs=P(),
+                           check_vma=False)
+        return jax.jit(lambda arrays: fn(arrays))
+
+    # -- select path ----------------------------------------------------------
+    def _run_select(self, q: S.SelectQuerySpec) -> QueryResult:
+        ds = self.store.get(q.datasource)
+        cols = list(q.columns) or ds.column_names()
+        seg_idx = ds.prune_segments(q.intervals)
+        if len(seg_idx) == 0:
+            return QueryResult.empty(cols)
+        # row mask on host via numpy evaluation over raw columns (select is
+        # IO-bound; ≈ Druid Select query paged through the broker)
+        mask = self._host_mask(ds, q.filter, q.intervals)
+        idx = np.nonzero(mask)[0]
+        if q.descending:
+            idx = idx[::-1]
+        page = idx[q.page_offset: q.page_offset + q.page_size]
+        data = {}
+        for c in cols:
+            data[c] = _host_column_values(ds, c, page)
+        self.last_stats.update({"datasource": ds.name,
+                                "rows": int(len(page))})
+        return QueryResult(cols, data)
+
+    def _run_search(self, q: S.SearchQuerySpec) -> QueryResult:
+        ds = self.store.get(q.datasource)
+        mask = self._host_mask(ds, q.filter, q.intervals)
+        needle = q.query if q.case_sensitive else q.query.lower()
+        dims_out, vals_out, counts_out = [], [], []
+        for dname in q.dimensions:
+            dim = ds.dims[dname]
+            cand = [i for i, s in enumerate(dim.dictionary)
+                    if needle in (s if q.case_sensitive else s.lower())]
+            if not cand:
+                continue
+            codes = dim.codes
+            sub = codes[mask] if mask is not None else codes
+            counts = np.bincount(sub, minlength=dim.cardinality)
+            for c in cand:
+                if counts[c] > 0:
+                    dims_out.append(dname)
+                    vals_out.append(dim.dictionary[c])
+                    counts_out.append(int(counts[c]))
+        if q.limit is not None:
+            dims_out = dims_out[: q.limit]
+            vals_out = vals_out[: q.limit]
+            counts_out = counts_out[: q.limit]
+        return QueryResult(
+            ["dimension", "value", "count"],
+            {"dimension": np.array(dims_out, dtype=object),
+             "value": np.array(vals_out, dtype=object),
+             "count": np.array(counts_out, dtype=np.int64)})
+
+    # -- helpers --------------------------------------------------------------
+    def _host_mask(self, ds: Datasource, filter_spec, intervals):
+        n = ds.num_rows
+        mask = np.ones(n, dtype=bool)
+        if intervals is not None and ds.time is not None:
+            ms = ds.time.millis
+            im = np.zeros(n, dtype=bool)
+            for lo, hi in intervals:
+                im |= (ms >= lo) & (ms < hi)
+            mask &= im
+        if filter_spec is not None:
+            env = {}
+            for c in _filter_columns_all(filter_spec):
+                env[c] = _host_column_values(ds, c, None)
+            expr = filter_to_expr(filter_spec)
+            mask &= np.asarray(host_eval.eval_expr(expr, env), dtype=bool)
+        return mask
+
+    def _should_shard(self, q, ds, seg_idx) -> bool:
+        if self.mesh is None or mesh_size(self.mesh) <= 1:
+            return False
+        pref = q.context.prefer_sharded if hasattr(q, "context") else None
+        if pref is not None:
+            return bool(pref)
+        # segment padding fills the axis up to the mesh size, so any multi-
+        # device mesh can shard; the cost model may veto for tiny scans
+        return len(seg_idx) >= 1
+
+    def _bind_arrays(self, ds, names, seg_idx, s_pad, sharded):
+        """Fetch-or-build the device arrays a program binds. Cached per
+        (datasource, array, segment selection, layout) so repeated dashboard
+        queries never re-upload host data (≈ segments staying resident on
+        Druid historicals between queries)."""
+        sharding = NamedSharding(self.mesh, P(SEGMENT_AXIS, None)) \
+            if sharded else None
+        seg_sig = (len(seg_idx), hash(seg_idx.tobytes()))
+        out = {}
+        for k in names:
+            key = (id(ds), k, s_pad, seg_sig, bool(sharded))
+            dev = self._device_arrays.get(key)
+            if dev is None:
+                host = build_array(ds, k, seg_idx, s_pad)
+                dev = jax.device_put(host, sharding)
+                self._device_arrays[key] = dev
+            out[k] = dev
+        return out
+
+    def clear_caches(self):
+        self._programs.clear()
+        self._device_arrays.clear()
+
+
+def _neg_key(k: np.ndarray):
+    if np.issubdtype(k.dtype, np.number):
+        return -k
+    if np.issubdtype(k.dtype, np.datetime64):
+        return -(k.astype(np.int64))
+    # descending strings: invert via negated rank
+    uniq, inv = np.unique(k, return_inverse=True)
+    return -inv
+
+
+def _pad_segments(s: int, n_dev: int) -> int:
+    p = 1
+    while p < s:
+        p <<= 1
+    p = max(p, n_dev)
+    if p % n_dev:
+        p = -(-p // n_dev) * n_dev
+    return p
+
+
+def _host_column_values(ds: Datasource, name: str,
+                        idx: Optional[np.ndarray]):
+    """Decoded host values of a column (optionally row-subset)."""
+    if name in ds.dims:
+        col = ds.dims[name]
+        codes = col.codes if idx is None else col.codes[idx]
+        vals = col.dictionary[codes.astype(np.int64)]
+        if col.validity is not None:
+            v = col.validity if idx is None else col.validity[idx]
+            vals = np.where(v, vals, None)
+        return vals
+    if name in ds.metrics:
+        m = ds.metrics[name]
+        vals = m.values if idx is None else m.values[idx]
+        if m.kind == ColumnKind.DATE:
+            return vals.astype("datetime64[D]")
+        if m.kind == ColumnKind.LONG:
+            out = vals.astype(np.int64)
+        else:
+            out = vals.astype(np.float64)
+        if m.validity is not None:
+            v = m.validity if idx is None else m.validity[idx]
+            out = out.astype(np.float64)
+            out = np.where(v, out, np.nan)
+        return out
+    if ds.time is not None and name == ds.time.name:
+        ms = ds.time.millis if idx is None else ds.time.millis[idx]
+        return ms.astype("datetime64[ms]")
+    raise KeyError(name)
+
+
+def _filter_columns_all(f: S.FilterSpec):
+    return F.columns_of_filter(f)
+
+
+def filter_to_expr(f: S.FilterSpec) -> E.Expr:
+    """FilterSpec -> Expr (for host-side evaluation)."""
+    if isinstance(f, S.SelectorFilter):
+        if f.value is None:
+            return E.IsNull(E.Column(f.dimension))
+        return E.Comparison("=", E.Column(f.dimension), E.Literal(f.value))
+    if isinstance(f, S.BoundFilter):
+        parts = []
+        c = E.Column(f.dimension)
+        if f.lower is not None:
+            parts.append(E.Comparison(">" if f.lower_strict else ">=", c,
+                                      E.Literal(f.lower)))
+        if f.upper is not None:
+            parts.append(E.Comparison("<" if f.upper_strict else "<=", c,
+                                      E.Literal(f.upper)))
+        return E.And(tuple(parts)) if len(parts) != 1 else parts[0]
+    if isinstance(f, S.InFilter):
+        return E.InList(E.Column(f.dimension), tuple(f.values))
+    if isinstance(f, S.PatternFilter):
+        if f.kind == "like":
+            return E.Like(E.Column(f.dimension), f.pattern)
+        if f.kind == "contains":
+            return E.Like(E.Column(f.dimension), f"%{f.pattern}%")
+        raise EngineFallback("regex filter on host path")
+    if isinstance(f, S.NullFilter):
+        return E.IsNull(E.Column(f.dimension), negated=f.negated)
+    if isinstance(f, S.LogicalFilter):
+        subs = tuple(filter_to_expr(x) for x in f.fields)
+        if f.op == "and":
+            return E.And(subs) if subs else E.Literal(True)
+        if f.op == "or":
+            return E.Or(subs)
+        return E.Not(subs[0])
+    if isinstance(f, S.ExprFilter):
+        return f.expr
+    raise EngineFallback(f"filter {type(f).__name__}")

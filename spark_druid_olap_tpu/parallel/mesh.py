@@ -1,0 +1,44 @@
+"""Device mesh helpers.
+
+The reference's cluster topology plane (ZooKeeper discovery via
+``CuratorConnection.scala``, historical-server assignment in
+``DruidMetadataCache.historicalServers:105-148``) collapses, on TPU, into the
+JAX device runtime: ``jax.devices()`` *is* the discovery service, and a 1-D
+``Mesh`` over the chips is the scan-parallel axis (segments shard across it
+the way segments spread across historicals). Multi-host pods extend the same
+mesh over ICI/DCN via ``jax.distributed`` — no new code path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SEGMENT_AXIS = "shards"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over (the first n) local devices; the single axis is the
+    segment-scan axis."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (SEGMENT_AXIS,))
+
+
+def segment_sharding(mesh: Mesh) -> NamedSharding:
+    """[S, R] arrays shard along the segment axis."""
+    return NamedSharding(mesh, P(SEGMENT_AXIS, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def mesh_size(mesh: Optional[Mesh]) -> int:
+    return 1 if mesh is None else int(np.prod(list(mesh.shape.values())))

@@ -1,0 +1,19 @@
+from spark_druid_olap_tpu.segment.column import (
+    ColumnKind,
+    DimColumn,
+    MetricColumn,
+    TimeColumn,
+)
+from spark_druid_olap_tpu.segment.store import Datasource, Segment, SegmentStore
+from spark_druid_olap_tpu.segment.ingest import ingest_dataframe
+
+__all__ = [
+    "ColumnKind",
+    "DimColumn",
+    "MetricColumn",
+    "TimeColumn",
+    "Datasource",
+    "Segment",
+    "SegmentStore",
+    "ingest_dataframe",
+]

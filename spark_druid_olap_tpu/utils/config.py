@@ -1,0 +1,167 @@
+"""Three-tier configuration system.
+
+Mirrors the reference's conf layering (SURVEY.md §5 "Config / flag system"):
+
+1. per-datasource options at registration time
+   (reference: ``DefaultSource.scala:197-308`` — ~17 DataSource options);
+2. session-level flags under the ``sdot.*`` namespace
+   (reference: ``spark.sparklinedata.*`` SQLConf entries,
+   ``DruidPlanner.scala:60-169``);
+3. per-session overrides of datasource options via
+   ``sdot.datasource.option.<name>``
+   (reference: ``DruidRelationInfo.scala:103-138``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfigEntry:
+    key: str
+    default: Any
+    doc: str
+    parse: Callable[[str], Any] = lambda s: s
+
+
+def _parse_bool(s: str) -> bool:
+    return str(s).strip().lower() in ("1", "true", "yes", "on")
+
+
+_REGISTRY: Dict[str, ConfigEntry] = {}
+
+
+def _entry(key: str, default: Any, doc: str, parse=None) -> ConfigEntry:
+    if parse is None:
+        if isinstance(default, bool):
+            parse = _parse_bool
+        elif isinstance(default, int):
+            parse = int
+        elif isinstance(default, float):
+            parse = float
+        else:
+            parse = lambda s: s
+    e = ConfigEntry(key, default, doc, parse)
+    _REGISTRY[key] = e
+    return e
+
+
+# --- planner flags (reference: DruidPlanner.scala:60-169) ---------------------
+DEBUG_TRANSFORMATIONS = _entry(
+    "sdot.debug.transformations", False,
+    "Log each planner transform's input and output (reference: "
+    "spark.sparklinedata.druid.debug.transformations).")
+TZ_ID = _entry(
+    "sdot.timezone", "UTC",
+    "Timezone for time bucketing and interval arithmetic (reference: "
+    "spark.sparklinedata.tz.id).")
+SELECT_PAGE_SIZE = _entry(
+    "sdot.select.pagesize", 10000,
+    "Rows per page for non-aggregate (select) scans (reference: "
+    "spark.sparklinedata.druid.selectquery.pagesize).")
+ALLOW_TOPN = _entry(
+    "sdot.querycostmodel.topn.allow", True,
+    "Allow rewriting single-dim ordered-limit group-bys to the approximate "
+    "topN path (reference: spark.sparklinedata.druid.allow.topn).")
+TOPN_THRESHOLD = _entry(
+    "sdot.querycostmodel.topn.threshold", 100000,
+    "Max limit value eligible for the topN rewrite (reference: "
+    "spark.sparklinedata.druid.topn.threshold).")
+QUERY_HISTORY = _entry(
+    "sdot.enable.query.history", True,
+    "Record executed engine queries with timings into the bounded history "
+    "queue (reference: spark.sparklinedata.enable.druid.query.history).")
+QUERY_HISTORY_SIZE = _entry(
+    "sdot.query.history.size", 500,
+    "Bounded size of the in-memory query history queue (reference: "
+    "DruidQueryHistory MAX_SIZE=500).")
+NON_AGG_PUSHDOWN = _entry(
+    "sdot.nonagg.handling", "push_project_and_filters",
+    "Handling of non-aggregate queries: push_project_and_filters | "
+    "push_filters | push_none (reference: NonAggregateQueryHandling, "
+    "DruidRelationInfo.scala:27-32).")
+# --- cost model knobs (reference: DruidQueryCostModel via DruidPlanner) -------
+COST_MODEL_ENABLED = _entry(
+    "sdot.querycostmodel.enabled", True,
+    "Use the cost model to pick single-chip vs sharded execution and the "
+    "segments-per-wave; if false always use the sharded path (reference: "
+    "spark.sparklinedata.querycostmodel.enabled).")
+COST_PER_ROW_SCAN = _entry(
+    "sdot.querycostmodel.historical.processing.cost", 1e-8,
+    "Abstract cost to scan+filter one row on one chip (reference: "
+    "historicalProcessingCostPerRow).", float)
+COST_PER_ROW_MERGE = _entry(
+    "sdot.querycostmodel.historical.merge.cost", 7e-8,
+    "Abstract cost to merge one output row across shards (reference: "
+    "historicalTimeSeriesProcessingCostPerRow).", float)
+COST_PER_BYTE_TRANSPORT = _entry(
+    "sdot.querycostmodel.transport.cost", 2.5e-9,
+    "Abstract cost to move one byte host<->device or across DCN (reference: "
+    "sparkSchedulingCostPerTask/shuffleCostPerByte family).", float)
+COST_COMPILE = _entry(
+    "sdot.querycostmodel.compile.cost", 0.05,
+    "Fixed abstract cost charged per distinct compiled program (XLA "
+    "compilation amortization; no reference analog — TPU-specific).", float)
+# --- engine knobs (TPU-specific; no reference analog) -------------------------
+SEGMENT_ROWS = _entry(
+    "sdot.segment.target.rows", 1 << 20,
+    "Target rows per time-sharded segment at ingest.")
+GROUPBY_MATMUL_MAX_KEYS = _entry(
+    "sdot.engine.groupby.matmul.max.keys", 4096,
+    "Dense group-by uses the MXU one-hot matmul path when the fused key "
+    "cardinality is at most this; above it, scatter-add.")
+GROUPBY_DENSE_MAX_KEYS = _entry(
+    "sdot.engine.groupby.dense.max.keys", 1 << 22,
+    "Max fused key cardinality for the dense device group-by; above it the "
+    "planner falls back to hashed group-by.")
+HLL_LOG2M = _entry(
+    "sdot.engine.hll.log2m", 11,
+    "log2 of the HLL register count for approximate count-distinct "
+    "(reference: Druid hyperUnique uses 2^11 registers).")
+
+
+class Config:
+    """A mutable key-value session config over the registered entries.
+
+    Unknown ``sdot.*`` keys are accepted (forward compatibility), mirroring the
+    reference importing every ``spark.sparklinedata.*`` SparkConf key into the
+    session conf (``SPLSessionState.scala:90-103``).
+    """
+
+    DATASOURCE_OVERRIDE_PREFIX = "sdot.datasource.option."
+
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None):
+        self._values: Dict[str, Any] = {}
+        if overrides:
+            for k, v in overrides.items():
+                self.set(k, v)
+
+    def set(self, key: str, value: Any) -> None:
+        entry = _REGISTRY.get(key)
+        if entry is not None and isinstance(value, str) and not isinstance(entry.default, str):
+            value = entry.parse(value)
+        self._values[key] = value
+
+    def get(self, entry_or_key) -> Any:
+        if isinstance(entry_or_key, ConfigEntry):
+            return self._values.get(entry_or_key.key, entry_or_key.default)
+        entry = _REGISTRY.get(entry_or_key)
+        if entry is not None:
+            return self._values.get(entry.key, entry.default)
+        return self._values.get(entry_or_key)
+
+    def datasource_option_overrides(self) -> Dict[str, Any]:
+        """Per-session overrides of datasource options (tier 3)."""
+        p = self.DATASOURCE_OVERRIDE_PREFIX
+        return {k[len(p):]: v for k, v in self._values.items() if k.startswith(p)}
+
+    def copy(self) -> "Config":
+        c = Config()
+        c._values = dict(self._values)
+        return c
+
+    @staticmethod
+    def registry() -> Dict[str, ConfigEntry]:
+        return dict(_REGISTRY)

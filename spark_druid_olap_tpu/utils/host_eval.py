@@ -1,0 +1,293 @@
+"""Host-side (numpy) evaluator for ``ir.expr`` trees.
+
+Three jobs, mirroring three reference facilities:
+
+1. evaluate post-aggregation arithmetic over merged agg columns
+   (≈ ``ArithmeticPostAggregationSpec`` evaluated inside Druid);
+2. evaluate HAVING predicates and residual (unpushable) filters over small
+   host-side result sets (≈ the FilterExec Spark leaves above the Druid scan,
+   ``DruidStrategy.scala:244-270``);
+3. evaluate dimension-expression transforms over the *dictionary domain*
+   (code -> value) at plan time — the host half of the dictionary-functional
+   string strategy.
+
+Operates elementwise over numpy arrays or python scalars; string columns are
+object arrays (dictionaries are small, python-loop cost is irrelevant).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import math
+import re
+
+import numpy as np
+
+from spark_druid_olap_tpu.ir import expr as E
+from spark_druid_olap_tpu.ops.time_ops import (
+    date_literal_to_days,
+    days_from_civil,
+)
+
+
+class HostEvalError(Exception):
+    pass
+
+
+def _is_str_like(v):
+    if isinstance(v, str):
+        return True
+    return isinstance(v, np.ndarray) and v.dtype == object
+
+
+def _map1(v, fn):
+    if isinstance(v, np.ndarray) and v.dtype == object:
+        return np.array([fn(x) for x in v], dtype=object)
+    return fn(v)
+
+
+def _to_days(v):
+    """Coerce scalar-or-array date-ish value to int days."""
+    if isinstance(v, np.ndarray):
+        if np.issubdtype(v.dtype, np.datetime64):
+            return v.astype("datetime64[D]").astype(np.int64)
+        if v.dtype == object:
+            return np.array([date_literal_to_days(x) for x in v],
+                            dtype=np.int64)
+        return v.astype(np.int64)
+    return date_literal_to_days(v)
+
+
+def _civil(days):
+    days = np.asarray(days)
+    dates = days.astype("datetime64[D]")
+    y = dates.astype("datetime64[Y]").astype(np.int64) + 1970
+    m = (dates.astype("datetime64[M]").astype(np.int64) % 12) + 1
+    d = (dates - dates.astype("datetime64[M]")).astype(np.int64) + 1
+    return y, m, d
+
+
+def eval_expr(e: E.Expr, env: dict):
+    """Evaluate ``e``; ``env`` maps column name -> scalar or numpy array."""
+    if isinstance(e, E.Column):
+        if e.name not in env:
+            raise HostEvalError(f"unbound column {e.name!r}")
+        return env[e.name]
+    if isinstance(e, E.Literal):
+        return e.value
+    if isinstance(e, E.BinaryOp):
+        a = eval_expr(e.left, env)
+        b = eval_expr(e.right, env)
+        a, b = _date_promote(a, b, e.op)
+        if e.op == "+":
+            return a + b
+        if e.op == "-":
+            return a - b
+        if e.op == "*":
+            return a * b
+        if e.op == "/":
+            return np.divide(a, b)
+        if e.op == "%":
+            return np.mod(a, b)
+        raise HostEvalError(e.op)
+    if isinstance(e, E.Comparison):
+        a = eval_expr(e.left, env)
+        b = eval_expr(e.right, env)
+        a, b = _cmp_promote(a, b)
+        ops = {"=": "eq", "!=": "ne", "<": "lt", "<=": "le", ">": "gt",
+               ">=": "ge"}
+        import operator
+        return getattr(operator, ops[e.op])(a, b)
+    if isinstance(e, E.And):
+        out = True
+        for p in e.parts:
+            out = np.logical_and(out, eval_expr(p, env))
+        return out
+    if isinstance(e, E.Or):
+        out = False
+        for p in e.parts:
+            out = np.logical_or(out, eval_expr(p, env))
+        return out
+    if isinstance(e, E.Not):
+        return np.logical_not(eval_expr(e.child, env))
+    if isinstance(e, E.IsNull):
+        v = eval_expr(e.child, env)
+        isnull = _map_null(v)
+        return np.logical_not(isnull) if e.negated else isnull
+    if isinstance(e, E.InList):
+        v = eval_expr(e.child, env)
+        if _is_str_like(v):
+            vals = set(e.values)
+            out = _map1(v, lambda x: x in vals)
+        else:
+            out = np.isin(v, [x for x in e.values])
+        return np.logical_not(out) if e.negated else out
+    if isinstance(e, E.Between):
+        v = eval_expr(e.child, env)
+        lo = eval_expr(e.low, env)
+        hi = eval_expr(e.high, env)
+        v1, lo = _cmp_promote(v, lo)
+        v2, hi = _cmp_promote(v, hi)
+        out = np.logical_and(v1 >= lo, v2 <= hi)
+        return np.logical_not(out) if e.negated else out
+    if isinstance(e, E.Like):
+        v = eval_expr(e.child, env)
+        from spark_druid_olap_tpu.ops.expr_compile import like_to_regex
+        rx = re.compile(like_to_regex(e.pattern))
+        out = _map1(v, lambda s: bool(rx.match(s)))
+        if isinstance(out, np.ndarray):
+            out = out.astype(bool)
+        return np.logical_not(out) if e.negated else out
+    if isinstance(e, E.Func):
+        return _func(e, env)
+    if isinstance(e, E.Cast):
+        v = eval_expr(e.child, env)
+        to = e.to.lower()
+        if to in ("double", "float", "decimal"):
+            return np.asarray(v, dtype=np.float64) if isinstance(v, np.ndarray) \
+                else float(v)
+        if to in ("long", "int", "bigint", "integer"):
+            if _is_str_like(v):
+                return _map1(v, lambda s: int(float(s)))
+            return np.asarray(v).astype(np.int64) if isinstance(v, np.ndarray) \
+                else int(v)
+        if to in ("string", "varchar"):
+            if isinstance(v, np.ndarray):
+                return np.array([str(x) for x in v], dtype=object)
+            return str(v)
+        if to in ("date", "timestamp"):
+            return _to_days(v)
+        raise HostEvalError(f"cast {to}")
+    if isinstance(e, E.Case):
+        otherwise = eval_expr(e.otherwise, env) if e.otherwise is not None else 0
+        out = otherwise
+        for c, v in reversed(e.branches):
+            cond = eval_expr(c, env)
+            val = eval_expr(v, env)
+            out = np.where(cond, val, out)
+        return out
+    raise HostEvalError(f"node {type(e).__name__}")
+
+
+def _map_null(v):
+    return _map1(v, lambda x: x is None or (isinstance(x, float) and math.isnan(x))) \
+        if isinstance(v, np.ndarray) and v.dtype == object \
+        else (np.isnan(v) if isinstance(v, np.ndarray)
+              and np.issubdtype(v.dtype, np.floating) else
+              np.zeros(np.shape(v), dtype=bool))
+
+
+def _date_promote(a, b, op):
+    """date +/- int means day arithmetic."""
+    a_date = isinstance(a, (np.datetime64, _dt.date)) or (
+        isinstance(a, np.ndarray) and np.issubdtype(a.dtype, np.datetime64))
+    if a_date and op in "+-":
+        return _to_days(a), b
+    return a, b
+
+
+def _cmp_promote(a, b):
+    """Make date-vs-string / date-vs-date comparisons integer-day compares."""
+    def dateish(v):
+        return isinstance(v, (np.datetime64, _dt.date)) or (
+            isinstance(v, np.ndarray) and np.issubdtype(v.dtype, np.datetime64))
+    if dateish(a) or dateish(b):
+        return _to_days(a), _to_days(b)
+    return a, b
+
+
+def _func(e: E.Func, env):
+    name = e.name.lower()
+    args = [eval_expr(a, env) for a in e.args]
+    if name in ("year", "month", "day", "quarter", "dow", "doy", "week",
+                "hour", "minute", "second"):
+        days = _to_days(args[0])
+        y, m, d = _civil(days)
+        if name == "year":
+            return y
+        if name == "month":
+            return m
+        if name == "day":
+            return d
+        if name == "quarter":
+            return (m - 1) // 3 + 1
+        if name == "dow":
+            return (np.asarray(days) + 3) % 7 + 1
+        if name == "doy":
+            jan1 = np.array([days_from_civil(int(yy), 1, 1) for yy in np.atleast_1d(y)])
+            return np.asarray(days) - (jan1 if jan1.size > 1 else jan1[0]) + 1
+        if name == "week":
+            return (np.asarray(days) + 3) // 7
+        raise HostEvalError(f"{name} needs sub-day time")
+    if name in ("date_add", "dateadd"):
+        return _to_days(args[0]) + np.asarray(args[1])
+    if name in ("date_sub",):
+        return _to_days(args[0]) - np.asarray(args[1])
+    if name == "datediff":
+        return _to_days(args[0]) - _to_days(args[1])
+    if name in ("date_trunc", "trunc"):
+        grain = args[0].lower()
+        days = _to_days(args[1])
+        dates = np.asarray(days).astype("datetime64[D]")
+        if grain == "day":
+            return dates
+        if grain == "week":
+            return ((np.asarray(days) + 3) // 7 * 7 - 3).astype("datetime64[D]")
+        if grain == "month":
+            return dates.astype("datetime64[M]").astype("datetime64[D]")
+        if grain == "year":
+            return dates.astype("datetime64[Y]").astype("datetime64[D]")
+        if grain == "quarter":
+            mi = dates.astype("datetime64[M]").astype(np.int64)
+            return (mi // 3 * 3).astype("datetime64[M]").astype("datetime64[D]")
+        raise HostEvalError(grain)
+    if name in ("lower", "upper", "trim", "ltrim", "rtrim", "reverse"):
+        fn = {"lower": str.lower, "upper": str.upper, "trim": str.strip,
+              "ltrim": str.lstrip, "rtrim": str.rstrip,
+              "reverse": lambda s: s[::-1]}[name]
+        return _map1(args[0], fn)
+    if name in ("substr", "substring"):
+        start = int(args[1])
+        ln = int(args[2]) if len(args) > 2 else None
+        i0 = start - 1 if start > 0 else start
+        return _map1(args[0],
+                     lambda s: s[i0: i0 + ln] if ln is not None else s[i0:])
+    if name == "concat":
+        def cc(*xs):
+            return "".join(str(x) for x in xs)
+        arrs = [a for a in args if isinstance(a, np.ndarray)]
+        if not arrs:
+            return cc(*args)
+        n = len(arrs[0])
+        return np.array(["".join(str(a[i] if isinstance(a, np.ndarray) else a)
+                                 for a in args) for i in range(n)], dtype=object)
+    if name == "replace":
+        return _map1(args[0], lambda s: s.replace(args[1], args[2]))
+    if name in ("length", "char_length"):
+        out = _map1(args[0], len)
+        return out.astype(np.int64) if isinstance(out, np.ndarray) else out
+    if name in ("lpad", "rpad"):
+        n = int(args[1])
+        fill = args[2] if len(args) > 2 else " "
+        fn = (lambda s: s.rjust(n, fill)) if name == "lpad" \
+            else (lambda s: s.ljust(n, fill))
+        return _map1(args[0], fn)
+    if name == "abs":
+        return np.abs(args[0])
+    if name == "round":
+        if len(args) > 1:
+            return np.round(np.asarray(args[0], dtype=np.float64), int(args[1]))
+        return np.round(np.asarray(args[0], dtype=np.float64))
+    if name in ("floor", "ceil", "sqrt", "exp", "ln", "log"):
+        fn = {"floor": np.floor, "ceil": np.ceil, "sqrt": np.sqrt,
+              "exp": np.exp, "ln": np.log, "log": np.log}[name]
+        return fn(np.asarray(args[0], dtype=np.float64))
+    if name in ("power", "pow"):
+        return np.power(np.asarray(args[0], dtype=np.float64), args[1])
+    if name == "coalesce":
+        out = args[-1]
+        for a in reversed(args[:-1]):
+            isnull = _map_null(a) if isinstance(a, np.ndarray) else (a is None)
+            out = np.where(isnull, out, a)
+        return out
+    raise HostEvalError(f"function {name}")

@@ -1,0 +1,251 @@
+"""Engine differential tests — the ``cTest`` pattern (reference
+``AbstractTest.cTest:127-143``): run the same query through the TPU engine IR
+path and through pandas on the raw frame, compare sorted results."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_druid_olap_tpu.ir import expr as E
+from spark_druid_olap_tpu.ir.spec import (
+    AggregationSpec, BoundFilter, DimensionSpec, ExprFilter, Granularity,
+    GroupByQuerySpec, HavingSpec, InFilter, LimitSpec, LogicalFilter,
+    OrderByColumn, PatternFilter, PostAggregationSpec, SearchQuerySpec,
+    SelectorFilter, SelectQuerySpec, TimeseriesQuerySpec, TimeExtraction,
+    TopNQuerySpec, ExprExtraction,
+)
+
+from conftest import assert_frames_equal
+
+
+def pandas_groupby(df, keys, aggs):
+    g = df.groupby(keys, as_index=False, sort=False).agg(**aggs)
+    return g
+
+
+def test_groupby_sums(engine, sales_df):
+    q = GroupByQuerySpec(
+        datasource="sales",
+        dimensions=(DimensionSpec("flag", "flag"),
+                    DimensionSpec("status", "status")),
+        aggregations=(AggregationSpec("longsum", "sum_qty", field="qty"),
+                      AggregationSpec("doublesum", "sum_price", field="price"),
+                      AggregationSpec("count", "cnt"),
+                      AggregationSpec("doublemin", "min_price", field="price"),
+                      AggregationSpec("doublemax", "max_price", field="price")))
+    got = engine.execute(q).to_pandas()
+    want = sales_df.groupby(["flag", "status"], as_index=False).agg(
+        sum_qty=("qty", "sum"), sum_price=("price", "sum"),
+        cnt=("qty", "size"), min_price=("price", "min"),
+        max_price=("price", "max"))
+    assert_frames_equal(got, want, sort_by=["flag", "status"])
+
+
+def test_groupby_with_filter(engine, sales_df):
+    q = GroupByQuerySpec(
+        datasource="sales",
+        dimensions=(DimensionSpec("region", "region"),),
+        aggregations=(AggregationSpec("doublesum", "rev", field="price"),),
+        filter=LogicalFilter("and", (
+            SelectorFilter("status", "O"),
+            BoundFilter("qty", lower=10, numeric=True),
+            InFilter("flag", ("A", "N")))))
+    got = engine.execute(q).to_pandas()
+    sub = sales_df[(sales_df.status == "O") & (sales_df.qty >= 10)
+                   & sales_df.flag.isin(["A", "N"])]
+    want = sub.groupby("region", as_index=False).agg(rev=("price", "sum"))
+    assert_frames_equal(got, want, sort_by=["region"])
+
+
+def test_bound_filter_lexicographic(engine, sales_df):
+    q = GroupByQuerySpec(
+        datasource="sales",
+        dimensions=(DimensionSpec("flag", "flag"),),
+        aggregations=(AggregationSpec("count", "cnt"),),
+        filter=BoundFilter("product", lower="p010", upper="p020",
+                           upper_strict=True))
+    got = engine.execute(q).to_pandas()
+    sub = sales_df[(sales_df["product"] >= "p010") & (sales_df["product"] < "p020")]
+    want = sub.groupby("flag", as_index=False).agg(cnt=("qty", "size"))
+    assert_frames_equal(got, want, sort_by=["flag"])
+
+
+def test_pattern_and_expr_filter(engine, sales_df):
+    q = GroupByQuerySpec(
+        datasource="sales",
+        dimensions=(DimensionSpec("region", "region"),),
+        aggregations=(AggregationSpec("count", "cnt"),),
+        filter=LogicalFilter("and", (
+            PatternFilter("product", "like", "p00%"),
+            ExprFilter(E.BinaryOp("*", E.Column("price"),
+                                  E.Column("qty")).gt(5000.0)))))
+    got = engine.execute(q).to_pandas()
+    sub = sales_df[sales_df["product"].str.startswith("p00")
+                   & (sales_df.price * sales_df.qty > 5000.0)]
+    want = sub.groupby("region", as_index=False).agg(cnt=("qty", "size"))
+    assert_frames_equal(got, want, sort_by=["region"])
+
+
+def test_time_intervals_prune_and_mask(engine, sales_df):
+    q = TimeseriesQuerySpec(
+        datasource="sales",
+        aggregations=(AggregationSpec("count", "cnt"),
+                      AggregationSpec("doublesum", "rev", field="price")),
+        intervals=((np.datetime64("2015-03-01").astype("datetime64[ms]")
+                    .astype(np.int64),
+                    np.datetime64("2015-06-01").astype("datetime64[ms]")
+                    .astype(np.int64)),))
+    got = engine.execute(q).to_pandas()
+    sub = sales_df[(sales_df.ts >= "2015-03-01") & (sales_df.ts < "2015-06-01")]
+    assert int(got["cnt"][0]) == len(sub)
+    np.testing.assert_allclose(float(got["rev"][0]), sub.price.sum(),
+                               rtol=1e-6)
+
+
+def test_granularity_month(engine, sales_df):
+    q = TimeseriesQuerySpec(
+        datasource="sales",
+        aggregations=(AggregationSpec("doublesum", "rev", field="price"),),
+        granularity=Granularity("month"))
+    got = engine.execute(q).to_pandas()
+    want = sales_df.assign(
+        timestamp=sales_df.ts.dt.to_period("M").dt.start_time).groupby(
+        "timestamp", as_index=False).agg(rev=("price", "sum"))
+    assert_frames_equal(got, want, sort_by=["timestamp"])
+
+
+def test_time_extraction_year_month(engine, sales_df):
+    q = GroupByQuerySpec(
+        datasource="sales",
+        dimensions=(DimensionSpec("ts", "yr", TimeExtraction("year")),
+                    DimensionSpec("ts", "mo", TimeExtraction("month"))),
+        aggregations=(AggregationSpec("longsum", "sq", field="qty"),))
+    got = engine.execute(q).to_pandas()
+    want = sales_df.assign(yr=sales_df.ts.dt.year, mo=sales_df.ts.dt.month) \
+        .groupby(["yr", "mo"], as_index=False).agg(sq=("qty", "sum"))
+    assert_frames_equal(got, want, sort_by=["yr", "mo"])
+
+
+def test_expr_extraction_string_dim(engine, sales_df):
+    # group by substr(product, 1, 2) — dictionary-functional path
+    q = GroupByQuerySpec(
+        datasource="sales",
+        dimensions=(DimensionSpec("product", "pfx", ExprExtraction(
+            E.Func("substr", (E.Column("product"), E.Literal(1),
+                              E.Literal(2))))),),
+        aggregations=(AggregationSpec("count", "cnt"),))
+    got = engine.execute(q).to_pandas()
+    want = sales_df.assign(pfx=sales_df["product"].str[:2]).groupby(
+        "pfx", as_index=False).agg(cnt=("qty", "size"))
+    assert_frames_equal(got, want, sort_by=["pfx"])
+
+
+def test_post_aggregation_and_having(engine, sales_df):
+    q = GroupByQuerySpec(
+        datasource="sales",
+        dimensions=(DimensionSpec("region", "region"),),
+        aggregations=(AggregationSpec("doublesum", "rev", field="price"),
+                      AggregationSpec("count", "cnt"),),
+        post_aggregations=(PostAggregationSpec(
+            "avg_rev", E.BinaryOp("/", E.Column("rev"), E.Column("cnt"))),),
+        having=HavingSpec(E.Column("cnt").gt(100)))
+    got = engine.execute(q).to_pandas()
+    want = sales_df.groupby("region", as_index=False).agg(
+        rev=("price", "sum"), cnt=("qty", "size"))
+    want["avg_rev"] = want.rev / want.cnt
+    want = want[want.cnt > 100]
+    assert_frames_equal(got, want, sort_by=["region"])
+
+
+def test_limit_spec_ordering(engine, sales_df):
+    q = GroupByQuerySpec(
+        datasource="sales",
+        dimensions=(DimensionSpec("product", "product"),),
+        aggregations=(AggregationSpec("doublesum", "rev", field="price"),),
+        limit=LimitSpec((OrderByColumn("rev", ascending=False),), 5))
+    got = engine.execute(q).to_pandas()
+    want = sales_df.groupby("product", as_index=False).agg(
+        rev=("price", "sum")).sort_values("rev", ascending=False).head(5) \
+        .reset_index(drop=True)
+    np.testing.assert_allclose(got["rev"].to_numpy(),
+                               want["rev"].to_numpy(), rtol=1e-5)
+    assert list(got["product"]) == list(want["product"])
+
+
+def test_topn(engine, sales_df):
+    q = TopNQuerySpec(
+        datasource="sales", dimension=DimensionSpec("product", "product"),
+        metric="rev", threshold=3,
+        aggregations=(AggregationSpec("doublesum", "rev", field="price"),))
+    got = engine.execute(q).to_pandas()
+    want = sales_df.groupby("product", as_index=False).agg(
+        rev=("price", "sum")).sort_values("rev", ascending=False).head(3)
+    assert list(got["product"]) == list(want["product"])
+
+
+def test_filtered_aggregation(engine, sales_df):
+    q = GroupByQuerySpec(
+        datasource="sales",
+        dimensions=(DimensionSpec("region", "region"),),
+        aggregations=(
+            AggregationSpec("count", "n_open",
+                            filter=SelectorFilter("status", "O")),
+            AggregationSpec("count", "cnt")))
+    got = engine.execute(q).to_pandas()
+    want = sales_df.groupby("region", as_index=False).agg(cnt=("qty", "size"))
+    open_counts = sales_df[sales_df.status == "O"].groupby(
+        "region", as_index=False).agg(n_open=("qty", "size"))
+    want = want.merge(open_counts, on="region")
+    assert_frames_equal(got, want, sort_by=["region"])
+
+
+def test_hll_cardinality(engine, sales_df):
+    q = GroupByQuerySpec(
+        datasource="sales",
+        dimensions=(DimensionSpec("region", "region"),),
+        aggregations=(AggregationSpec("cardinality", "nprod",
+                                      field="product"),))
+    got = engine.execute(q).to_pandas()
+    want = sales_df.groupby("region", as_index=False).agg(
+        nprod=("product", "nunique"))
+    got = got.sort_values("region").reset_index(drop=True)
+    want = want.sort_values("region").reset_index(drop=True)
+    # approximate: within 5% (reference HLLTest asserts approximate behavior)
+    for g, w in zip(got["nprod"], want["nprod"]):
+        assert abs(g - w) <= max(2, 0.05 * w), (g, w)
+
+
+def test_select_paging(engine, sales_df):
+    q = SelectQuerySpec(
+        datasource="sales", columns=("ts", "region", "qty"),
+        filter=SelectorFilter("region", "east"), page_size=100)
+    r1 = engine.execute(q)
+    assert len(r1) == 100
+    q2 = SelectQuerySpec(
+        datasource="sales", columns=("ts", "region", "qty"),
+        filter=SelectorFilter("region", "east"), page_size=10 ** 9,
+        page_offset=100)
+    r2 = engine.execute(q2)
+    n_east = int((sales_df.region == "east").sum())
+    assert len(r2) == n_east - 100
+    assert set(r1["region"]) == {"east"}
+
+
+def test_search(engine, sales_df):
+    q = SearchQuerySpec(datasource="sales", dimensions=("product",),
+                        query="p01")
+    r = engine.execute(q).to_pandas()
+    assert set(r["value"]) == {f"p01{i}" for i in range(10)}
+
+
+def test_sharded_matches_single(engine, mesh_engine, sales_df):
+    q = GroupByQuerySpec(
+        datasource="sales",
+        dimensions=(DimensionSpec("flag", "flag"),),
+        aggregations=(AggregationSpec("longsum", "sq", field="qty"),
+                      AggregationSpec("doublemin", "mn", field="price"),
+                      AggregationSpec("count", "cnt")))
+    a = engine.execute(q).to_pandas()
+    b = mesh_engine.execute(q).to_pandas()
+    assert mesh_engine.last_stats["sharded"] is True
+    assert_frames_equal(a, b, sort_by=["flag"])

@@ -1,0 +1,103 @@
+"""Edge-case regression tests (from code-review findings): null groups,
+absolute hour buckets, minute-of-hour extraction, OR-with-all-true,
+empty-group min/max sentinels."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_druid_olap_tpu.ir import expr as E
+from spark_druid_olap_tpu.ir.spec import (
+    AggregationSpec, DimensionSpec, Granularity, GroupByQuerySpec,
+    LogicalFilter, SelectorFilter, TimeseriesQuerySpec, TimeExtraction,
+)
+from spark_druid_olap_tpu.segment.ingest import ingest_dataframe
+from spark_druid_olap_tpu.segment.store import SegmentStore
+from spark_druid_olap_tpu.parallel.executor import QueryEngine
+
+
+@pytest.fixture(scope="module")
+def nullable_engine():
+    df = pd.DataFrame({
+        "t": pd.to_datetime(["2020-01-01 05:30:10", "2020-01-02 05:45:00",
+                             "2020-01-01 06:15:00", "2020-01-02 23:59:59",
+                             "2020-01-01 05:00:00"]),
+        "cat": pd.array(["a", None, "b", "a", None], dtype=object),
+        "v": [1.0, 2.0, 3.0, 4.0, 5.0],
+    })
+    ds = ingest_dataframe("nulls", df, time_column="t")
+    st = SegmentStore()
+    st.register(ds)
+    return QueryEngine(st), df
+
+
+def test_null_dimension_group(nullable_engine):
+    eng, df = nullable_engine
+    q = GroupByQuerySpec("nulls", (DimensionSpec("cat", "cat"),),
+                         (AggregationSpec("doublesum", "sv", field="v"),
+                          AggregationSpec("count", "c")))
+    r = eng.execute(q)
+    by = {c: (sv, n) for c, sv, n in zip(r["cat"], r["sv"], r["c"])}
+    assert by["a"] == (5.0, 2)
+    assert by["b"] == (3.0, 1)
+    assert None in by and by[None] == (7.0, 2)
+
+
+def test_hour_granularity_absolute_buckets(nullable_engine):
+    eng, df = nullable_engine
+    q = TimeseriesQuerySpec("nulls", (AggregationSpec("count", "c"),),
+                            granularity=Granularity("hour"))
+    r = eng.execute(q).to_pandas()
+    # 05:xx on Jan 1 and 05:xx on Jan 2 must be DIFFERENT buckets
+    want = df.assign(timestamp=df.t.dt.floor("h")).groupby(
+        "timestamp", as_index=False).size().rename(columns={"size": "c"})
+    got = r.sort_values("timestamp").reset_index(drop=True)
+    want = want.sort_values("timestamp").reset_index(drop=True)
+    assert len(got) == len(want) == 4
+    np.testing.assert_array_equal(got["c"], want["c"])
+    np.testing.assert_array_equal(got["timestamp"].to_numpy("datetime64[ms]"),
+                                  want["timestamp"].to_numpy("datetime64[ms]"))
+
+
+def test_minute_extraction_is_minute_of_hour(nullable_engine):
+    eng, df = nullable_engine
+    q = GroupByQuerySpec("nulls", (DimensionSpec("t", "mi",
+                                                 TimeExtraction("minute")),),
+                         (AggregationSpec("count", "c"),))
+    r = eng.execute(q).to_pandas()
+    want = df.groupby(df.t.dt.minute).size()
+    got = dict(zip(r["mi"], r["c"]))
+    assert got == dict(want)
+
+
+def test_or_with_all_true_operand(nullable_engine):
+    eng, df = nullable_engine
+    from spark_druid_olap_tpu.ir.spec import TrueFilter
+    q = TimeseriesQuerySpec(
+        "nulls", (AggregationSpec("count", "c"),),
+        filter=LogicalFilter("or", (TrueFilter,
+                                    SelectorFilter("cat", "a"))))
+    r = eng.execute(q).to_pandas()
+    assert int(r["c"][0]) == len(df)
+
+
+def test_filtered_minmax_empty_group_is_null(nullable_engine):
+    eng, df = nullable_engine
+    q = GroupByQuerySpec(
+        "nulls", (DimensionSpec("cat", "cat"),),
+        (AggregationSpec("doublemin", "mn", field="v",
+                         filter=SelectorFilter("cat", "b")),
+         AggregationSpec("count", "c")))
+    r = eng.execute(q).to_pandas()
+    by = {row["cat"]: row for row in r.to_dict("records")}
+    assert by["b"]["mn"] == 3.0
+    assert np.isnan(by["a"]["mn"])
+
+
+def test_device_cache_reused(nullable_engine):
+    eng, _ = nullable_engine
+    q = TimeseriesQuerySpec("nulls", (AggregationSpec("count", "c"),))
+    eng.execute(q)
+    n1 = len(eng._device_arrays)
+    eng.execute(q)
+    assert len(eng._device_arrays) == n1  # no re-upload entries

@@ -1,0 +1,76 @@
+"""Segment store / ingest unit tests (≈ reference DataSourceTest /
+StarSchemaMetadataTest tier)."""
+
+import numpy as np
+import pandas as pd
+
+from spark_druid_olap_tpu.segment.column import (
+    ColumnKind, build_dim_column, encode_time_millis,
+)
+from spark_druid_olap_tpu.segment.ingest import ingest_dataframe
+
+
+def test_dim_column_sorted_dictionary():
+    col = build_dim_column("c", np.array(["b", "a", "c", "a", "b"], dtype=object))
+    assert list(col.dictionary) == ["a", "b", "c"]
+    assert list(col.codes) == [1, 0, 2, 0, 1]
+    assert col.validity is None
+    assert col.code_of("b") == 1
+    assert col.code_of("zz") == -1
+    # bound -> code range (half open)
+    assert col.code_range(lower="a", upper="b") == (0, 2)
+    assert col.code_range(lower="a", lower_strict=True) == (1, 3)
+
+
+def test_dim_column_nulls():
+    col = build_dim_column("c", np.array(["x", None, "y"], dtype=object))
+    assert col.validity is not None
+    assert list(col.validity) == [True, False, True]
+
+
+def test_time_split_roundtrip():
+    ms = np.array([0, 86_400_000 + 123, 5 * 86_400_000 + 999], dtype=np.int64)
+    days, rem = encode_time_millis(ms)
+    assert list(days) == [0, 1, 5]
+    assert list(rem) == [0, 123, 999]
+
+
+def test_ingest_segments_time_sorted(sales_df):
+    ds = ingest_dataframe("s", sales_df, time_column="ts", target_rows=4096)
+    assert ds.num_rows == len(sales_df)
+    assert ds.num_segments >= 2
+    # time-contiguity: segment bounds must be non-decreasing
+    mins, maxs = ds.segment_time_bounds()
+    assert all(mins[i] <= mins[i + 1] for i in range(len(mins) - 1))
+    assert all(m0 <= m1 for m0, m1 in zip(mins, maxs))
+    # column kinds inferred
+    assert ds.column_kind("region") == ColumnKind.DIM
+    assert ds.column_kind("qty") == ColumnKind.LONG
+    assert ds.column_kind("price") == ColumnKind.DOUBLE
+    assert ds.column_kind("due") == ColumnKind.DATE
+    assert ds.column_kind("ts") == ColumnKind.TIME
+
+
+def test_stacked_shapes(sales_ds):
+    s = sales_ds.stacked("region")
+    assert s.shape == (sales_ds.num_segments, sales_ds.padded_rows)
+    rv = sales_ds.stacked_row_validity()
+    assert rv.sum() == sales_ds.num_rows
+
+
+def test_interval_pruning(sales_ds):
+    lo, hi = sales_ds.interval()
+    mid = (lo + hi) // 2
+    idx = sales_ds.prune_segments([(lo, mid)])
+    assert 0 < len(idx) < sales_ds.num_segments
+    all_idx = sales_ds.prune_segments(None)
+    assert len(all_idx) == sales_ds.num_segments
+    none_idx = sales_ds.prune_segments([(hi + 10_000_000, hi + 20_000_000)])
+    assert len(none_idx) == 0
+
+
+def test_metadata_summary(sales_ds):
+    md = sales_ds.metadata()
+    assert md["numRows"] == sales_ds.num_rows
+    assert md["columns"]["region"]["cardinality"] == 4
+    assert md["columns"]["price"]["type"] == "DOUBLE"
