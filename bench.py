@@ -1,0 +1,123 @@
+"""Benchmark driver: TPC-H Q1 on the flat index, single chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.md): the reference's Druid-accelerated TPC-H Q1 at SF10 —
+59,986,052 lineitem rows in 18,340 ms avg on a 4-node cluster
+(docs/benchmark/BenchMarkDetails.org:140-163) = 3.27M rows aggregated/sec.
+vs_baseline = our rows-aggregated/sec/chip over that.
+
+Env knobs: SDOT_BENCH_SF (default 1.0), SDOT_BENCH_REPS (default 5).
+Per-query detail goes to stderr; stdout carries only the JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+DROP_COLS = [
+    "l_comment", "o_comment", "c_comment", "s_comment", "ps_comment",
+    "cn_comment", "cr_comment", "sn_comment", "sr_comment",
+    "c_address", "s_address", "o_clerk",
+]
+
+BASELINE_ROWS_PER_SEC = 59_986_052 / 18.340
+
+
+def build_flat(sf: float):
+    import pandas as pd
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".bench_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    path = os.path.join(cache_dir, f"tpch_flat_sf{sf}.parquet")
+    if os.path.exists(path):
+        log(f"loading cached flat table {path}")
+        return pd.read_parquet(path)
+    from spark_druid_olap_tpu.tools import tpch
+    t0 = time.perf_counter()
+    tables = tpch.generate(sf)
+    flat = tpch.flatten(tables)
+    flat = flat.drop(columns=[c for c in DROP_COLS if c in flat.columns])
+    log(f"generated flat SF{sf}: {len(flat):,} rows x {len(flat.columns)} "
+        f"cols in {time.perf_counter() - t0:.1f}s")
+    try:
+        flat.to_parquet(path)
+    except Exception as e:
+        log(f"cache write failed ({e}); continuing")
+    return flat
+
+
+def main():
+    sf = float(os.environ.get("SDOT_BENCH_SF", "1.0"))
+    reps = int(os.environ.get("SDOT_BENCH_REPS", "5"))
+
+    import jax
+    log(f"backend={jax.default_backend()} devices={jax.devices()}")
+
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.tools import tpch
+
+    flat = build_flat(sf)
+    n_rows = len(flat)
+
+    ctx = sdot.Context()
+    t0 = time.perf_counter()
+    ctx.ingest_dataframe("tpch_flat", flat, time_column="l_shipdate",
+                         target_rows=1 << 20)
+    ctx.register_star_schema(tpch.star_schema("tpch_flat"))
+    log(f"ingest: {time.perf_counter() - t0:.1f}s "
+        f"({ctx.store.get('tpch_flat').num_segments} segments)")
+    del flat
+
+    # rewrite star-join queries onto the flat datasource name directly:
+    # fact-only queries reference 'lineitem'; map it to the flat index
+    import re
+
+    def q_for_flat(sql: str) -> str:
+        return re.sub(r"\bfrom\s+lineitem\b", "from tpch_flat", sql)
+
+    q1 = q_for_flat(tpch.QUERIES["q1"])
+
+    # warm-up (compile)
+    t0 = time.perf_counter()
+    r = ctx.sql(q1)
+    log(f"q1 cold (compile+transfer): {time.perf_counter() - t0:.2f}s, "
+        f"{len(r)} groups")
+
+    times = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        ctx.sql(q1)
+        times.append(time.perf_counter() - t0)
+    med = float(np.median(times))
+    log(f"q1 warm: median {med * 1000:.1f}ms over {reps} reps "
+        f"(min {min(times)*1000:.1f} max {max(times)*1000:.1f})")
+
+    # extra per-query detail (stderr only)
+    for name in ("shipdate_range", "q6"):
+        sql = q_for_flat(tpch.QUERIES[name])
+        ctx.sql(sql)  # warm
+        t0 = time.perf_counter()
+        ctx.sql(sql)
+        log(f"{name}: {(time.perf_counter() - t0) * 1000:.1f}ms")
+
+    rows_per_sec = n_rows / med
+    out = {
+        "metric": f"tpch_sf{sf}_q1_rows_aggregated_per_sec_per_chip",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s/chip",
+        "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

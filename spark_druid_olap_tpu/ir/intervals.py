@@ -1,0 +1,53 @@
+"""Time-interval accumulation for pushdown.
+
+≈ ``QueryIntervals.scala``: conjunctive time predicates intersect into a
+single [lo, hi) milli-interval; a contradiction yields the empty interval.
+Disjunctive time predicates are NOT turned into intervals (they stay filters),
+matching the reference's conjunct-only extraction
+(``IntervalConditionExtractor``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from spark_druid_olap_tpu.ops.time_ops import date_literal_to_millis
+
+MIN_MS = -(1 << 62)
+MAX_MS = 1 << 62
+
+
+@dataclasses.dataclass
+class IntervalAccumulator:
+    lo: int = MIN_MS
+    hi: int = MAX_MS
+
+    def ge(self, value):            # t >= v
+        self.lo = max(self.lo, date_literal_to_millis(value))
+
+    def gt(self, value):            # t > v  (ms precision)
+        self.lo = max(self.lo, date_literal_to_millis(value) + 1)
+
+    def le(self, value):            # t <= v
+        self.hi = min(self.hi, date_literal_to_millis(value) + 1)
+
+    def lt(self, value):            # t < v
+        self.hi = min(self.hi, date_literal_to_millis(value))
+
+    def eq(self, value):
+        ms = date_literal_to_millis(value)
+        self.lo = max(self.lo, ms)
+        self.hi = min(self.hi, ms + 1)
+
+    @property
+    def empty(self) -> bool:
+        return self.lo >= self.hi
+
+    def constrained(self) -> bool:
+        return self.lo != MIN_MS or self.hi != MAX_MS
+
+    def to_intervals(self) -> Optional[Tuple[Tuple[int, int], ...]]:
+        if not self.constrained():
+            return None
+        return ((self.lo, self.hi),)

@@ -1,0 +1,90 @@
+"""Spec-level rewrite rules over generated QuerySpecs.
+
+≈ ``QuerySpecTransforms`` (reference ``druid/query/QuerySpecTransforms.scala``):
+a rule executor run on the query spec *after* the planner builds it —
+GroupBy -> TimeSeries when there are no dimensions, GroupBy -> TopN for a
+single-dim ordered-limit aggregate, add a count aggregation when a group-by
+has none (so empty groups can be dropped), merge redundant bound filters.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from spark_druid_olap_tpu.ir import spec as S
+from spark_druid_olap_tpu.utils.config import (
+    ALLOW_TOPN,
+    Config,
+    TOPN_THRESHOLD,
+)
+
+Rule = Callable[[S.QuerySpec, Config], Optional[S.QuerySpec]]
+
+
+def groupby_to_timeseries(q: S.QuerySpec, conf: Config):
+    """No dimensions -> timeseries (reference :119-142)."""
+    if not isinstance(q, S.GroupByQuerySpec):
+        return None
+    if q.dimensions or q.having is not None or q.limit is not None:
+        return None
+    return S.TimeseriesQuerySpec(
+        datasource=q.datasource, aggregations=q.aggregations,
+        post_aggregations=q.post_aggregations, filter=q.filter,
+        granularity=q.granularity, intervals=q.intervals, context=q.context)
+
+
+def groupby_to_topn(q: S.QuerySpec, conf: Config):
+    """Single dim + order-by-one-metric-desc + limit -> topN
+    (reference :279-332; gated like spark.sparklinedata.druid.allow.topn)."""
+    if not isinstance(q, S.GroupByQuerySpec):
+        return None
+    if not conf.get(ALLOW_TOPN):
+        return None
+    if (len(q.dimensions) != 1 or q.limit is None or q.limit.limit is None
+            or len(q.limit.columns) != 1 or q.having is not None
+            or not q.granularity.is_all()):
+        return None
+    oc = q.limit.columns[0]
+    if oc.ascending:
+        return None
+    agg_names = {a.name for a in q.aggregations} | \
+        {p.name for p in q.post_aggregations}
+    if oc.name not in agg_names:
+        return None
+    if q.limit.limit > conf.get(TOPN_THRESHOLD):
+        return None
+    return S.TopNQuerySpec(
+        datasource=q.datasource, dimension=q.dimensions[0], metric=oc.name,
+        threshold=q.limit.limit, aggregations=q.aggregations,
+        post_aggregations=q.post_aggregations, filter=q.filter,
+        granularity=q.granularity, intervals=q.intervals, context=q.context)
+
+
+def add_count_when_no_aggs(q: S.QuerySpec, conf: Config):
+    """GroupBy with zero aggregations (e.g. SELECT DISTINCT dims) gets a
+    hidden count (reference :104-117 adds an 'addCountAggregate')."""
+    if not isinstance(q, S.GroupByQuerySpec):
+        return None
+    if q.aggregations:
+        return None
+    import dataclasses
+    return dataclasses.replace(
+        q, aggregations=(S.AggregationSpec("count", "__count__"),))
+
+
+RULES: List[Rule] = [add_count_when_no_aggs, groupby_to_topn,
+                     groupby_to_timeseries]
+
+
+def transform(q: S.QuerySpec, conf: Config) -> S.QuerySpec:
+    """Run rules to fixpoint (bounded) — ≈ TransformExecutor batches."""
+    for _ in range(4):
+        changed = False
+        for rule in RULES:
+            r = rule(q, conf)
+            if r is not None:
+                q = r
+                changed = True
+        if not changed:
+            break
+    return q

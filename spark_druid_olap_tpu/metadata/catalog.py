@@ -26,9 +26,26 @@ class Catalog:
         self.star_schemas[star.fact_table] = star
         for t in star.tables():
             self._table_to_star[t] = star
+        if hasattr(self, "_fd_cache"):
+            self._fd_cache.pop(star.fact_table, None)
 
     def star_schema_of(self, table: str):
         return self._table_to_star.get(table)
+
+    def fd_graph_for(self, ds_name: str, store=None):
+        """FD graph applicable to a datasource (its star schema's, matched by
+        flat-datasource or member-table name); None when no star declared."""
+        store = store or self.store
+        for star in self.star_schemas.values():
+            if star.flat_datasource == ds_name or ds_name in star.tables():
+                key = star.fact_table
+                if not hasattr(self, "_fd_cache"):
+                    self._fd_cache = {}
+                if key not in self._fd_cache:
+                    from spark_druid_olap_tpu.metadata.fd import build_fd_graph
+                    self._fd_cache[key] = build_fd_graph(star, store)
+                return self._fd_cache[key]
+        return None
 
     # -- metadata views (≈ DruidMetadataViews.metadataDFs) --------------------
     def datasources_view(self) -> pd.DataFrame:

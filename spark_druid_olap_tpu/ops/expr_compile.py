@@ -355,6 +355,18 @@ def _func(e: E.Func, ctx):
         a = _coerce_time(compile_expr(e.args[0], ctx))
         b = _coerce_time(compile_expr(e.args[1], ctx))
         return NumValue(a.days - b.days, False)
+    if name == "add_months":
+        v = _coerce_time(compile_expr(e.args[0], ctx))
+        n = _as_num(compile_expr(e.args[1], ctx), ctx)
+        y, m, d = time_ops.civil_from_days(v.days)
+        mi = y * 12 + (m - 1) + n.arr.astype(jnp.int32)
+        ny = jnp.floor_divide(mi, 12)
+        nm = jnp.mod(mi, 12) + 1
+        start = _month_start(ny, nm)
+        mi2 = mi + 1
+        nstart = _month_start(jnp.floor_divide(mi2, 12), jnp.mod(mi2, 12) + 1)
+        nd = jnp.minimum(d, nstart - start)  # clamp to month length
+        return TimeValue(start + nd - 1, None)
     if name in _STR_FUNCS or name in ("substr", "substring", "concat",
                                       "replace", "lpad", "rpad"):
         return _str_func(name, e, ctx)

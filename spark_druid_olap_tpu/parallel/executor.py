@@ -312,6 +312,10 @@ class AggPlan:
 
     def build_values(self, ctx: ScanContext):
         a = self.spec
+        if a.kind == "anyvalue":
+            # FD-demoted grouping column: any row's value works (max); dims
+            # contribute their dictionary code, decoded at output
+            return ctx.col(a.field)
         if a.field is not None:
             k = ctx.kind(a.field)
             if self.kind == "hll":
@@ -374,7 +378,9 @@ def host_eval_try_float(s):
 _AGG_KIND = {"count": ("count", np.int64), "longsum": ("sum", np.int64),
              "doublesum": ("sum", np.float64), "longmin": ("min", np.int64),
              "longmax": ("max", np.int64), "doublemin": ("min", np.float64),
-             "doublemax": ("max", np.float64), "cardinality": ("hll", np.int64)}
+             "doublemax": ("max", np.float64),
+             "cardinality": ("hll", np.int64),
+             "anyvalue": ("max", np.float64)}
 
 
 def plan_aggregation(a: S.AggregationSpec, ds: Datasource) -> AggPlan:
@@ -445,49 +451,16 @@ class QueryEngine:
             names += [p.name for p in post_aggregations]
             return QueryResult.empty(names)
 
-        mins, maxs = ds.segment_time_bounds()
-        min_day = int(mins[seg_idx].min() // T.MILLIS_PER_DAY)
-        max_day = int(maxs[seg_idx].max() // T.MILLIS_PER_DAY)
-
-        # --- plan dims/aggs (raises EngineFallback on unsupported) -----------
-        dim_plans = [plan_dimension(d, ds, min_day, max_day)
-                     for d in dimensions]
-        gran_plan = None
-        if gran_kind != "all":
-            gran_plan = plan_granularity_dim(granularity, ds, min_day,
-                                             max_day)
-        all_dim_plans = ([gran_plan] if gran_plan else []) + dim_plans
-
-        agg_plans = [plan_aggregation(a, ds) for a in aggregations]
-
+        all_dim_plans, agg_plans, min_day, max_day, n_keys, names = \
+            self._plan_agg(ds, seg_idx, dimensions, aggregations,
+                           granularity, filter_spec, intervals)
         cards = [p.card for p in all_dim_plans]
-        n_keys = 1
-        for c in cards:
-            n_keys *= c
-        if n_keys > self.config.get(GROUPBY_DENSE_MAX_KEYS):
-            raise EngineFallback(
-                f"group key cardinality {n_keys} exceeds dense limit")
-
-        # --- bind arrays ------------------------------------------------------
-        needed = set()
-        for p in all_dim_plans:
-            needed |= set(p.source_cols)
-        for p in agg_plans:
-            needed |= set(p.source_cols)
-        needed |= F.columns_of_filter(filter_spec)
-        time_in_play = ds.time is not None and (
-            intervals is not None or gran_kind not in ("all",)
-            or (ds.time.name in needed))
-        if time_in_play:
-            needed.add(ds.time.name)
-        need_ms = time_in_play
 
         sharded = self._should_shard(q, ds, seg_idx)
         n_dev = mesh_size(self.mesh) if sharded else 1
         s_pad = _pad_segments(len(seg_idx), n_dev)
 
         # --- build / fetch program -------------------------------------------
-        names = array_names(ds, sorted(needed), need_ms)
         sig = ("agg", ds.name, id(ds), repr(q), s_pad, ds.padded_rows,
                min_day, max_day, sharded, n_dev, tuple(names))
         prog = self._programs.get(sig)
@@ -497,9 +470,9 @@ class QueryEngine:
                 min_day, max_day, n_keys, sharded)
             self._programs[sig] = prog
 
+        prog_fn, unpack = prog
         dev_arrays = self._bind_arrays(ds, names, seg_idx, s_pad, sharded)
-        out = prog(dev_arrays)
-        out = {k: np.asarray(v) for k, v in out.items()}
+        out = unpack(np.asarray(prog_fn(dev_arrays)))
 
         # --- decode -----------------------------------------------------------
         rows = out["__rows__"]
@@ -517,6 +490,9 @@ class QueryEngine:
                 regs = out[name]
                 est = HLL.estimate(regs)[sel]
                 data[name] = np.round(est).astype(np.int64)
+            elif p.spec.kind == "anyvalue":
+                v = out[name][sel]
+                data[name] = _decode_anyvalue(ds, p.spec.field, v)
             else:
                 v = out[name][sel]
                 if p.kind in ("min", "max"):
@@ -564,8 +540,69 @@ class QueryEngine:
             "rows_scanned": int(ds.num_rows)})
         return QueryResult(columns, data)
 
-    def _build_agg_program(self, ds, dim_plans, agg_plans, filter_spec,
-                           intervals, min_day, max_day, n_keys, sharded):
+    def _plan_agg(self, ds, seg_idx, dimensions, aggregations, granularity,
+                  filter_spec, intervals):
+        """Shared planning for agg queries (used by both the execution path
+        and build_core). Raises EngineFallback on unsupported/oversized.
+        Returns (dim_plans incl. granularity, agg_plans, min_day, max_day,
+        n_keys, array names)."""
+        gran_kind = granularity.kind if granularity else "all"
+        if len(seg_idx) == 0 or ds.num_rows == 0:
+            raise EngineFallback("no segments match the query intervals")
+        mins, maxs = ds.segment_time_bounds()
+        min_day = int(mins[seg_idx].min() // T.MILLIS_PER_DAY)
+        max_day = int(maxs[seg_idx].max() // T.MILLIS_PER_DAY)
+        dim_plans = [plan_dimension(d, ds, min_day, max_day)
+                     for d in dimensions]
+        if gran_kind != "all":
+            dim_plans = [plan_granularity_dim(granularity, ds, min_day,
+                                              max_day)] + dim_plans
+        agg_plans = [plan_aggregation(a, ds) for a in aggregations]
+        n_keys = 1
+        for p in dim_plans:
+            n_keys *= p.card
+        if n_keys > self.config.get(GROUPBY_DENSE_MAX_KEYS):
+            raise EngineFallback(
+                f"group key cardinality {n_keys} exceeds dense limit")
+        needed = set()
+        for p in dim_plans:
+            needed |= set(p.source_cols)
+        for p in agg_plans:
+            needed |= set(p.source_cols)
+        needed |= F.columns_of_filter(filter_spec)
+        time_in_play = ds.time is not None and (
+            intervals is not None or gran_kind != "all"
+            or ds.time.name in needed)
+        if time_in_play:
+            needed.add(ds.time.name)
+        names = array_names(ds, sorted(needed), time_in_play)
+        return dim_plans, agg_plans, min_day, max_day, n_keys, names
+
+    def build_core(self, q: S.QuerySpec):
+        """Build the *unjitted* scan-aggregate program for an agg query plus
+        its input arrays — the compile-check surface (flagship forward step).
+        Returns (fn, arrays) with fn pure and jittable."""
+        if isinstance(q, S.TimeseriesQuerySpec):
+            dims, aggs, gran = [], q.aggregations, q.granularity
+        elif isinstance(q, S.GroupByQuerySpec):
+            dims, aggs, gran = list(q.dimensions), q.aggregations, \
+                q.granularity
+        else:
+            raise EngineFallback("core build supports groupby/timeseries")
+        ds = self.store.get(q.datasource)
+        seg_idx = ds.prune_segments(q.intervals)
+        dim_plans, agg_plans, min_day, max_day, n_keys, names = \
+            self._plan_agg(ds, seg_idx, dims, aggs, gran, q.filter,
+                           q.intervals)
+        n_dev = mesh_size(self.mesh)
+        s_pad = _pad_segments(len(seg_idx), n_dev)
+        arrays = {k: build_array(ds, k, seg_idx, s_pad) for k in names}
+        fn = self._make_core(ds, dim_plans, agg_plans, q.filter, q.intervals,
+                             min_day, max_day, n_keys)
+        return fn, arrays
+
+    def _make_core(self, ds, dim_plans, agg_plans, filter_spec,
+                   intervals, min_day, max_day, n_keys):
         matmul_max = self.config.get(GROUPBY_MATMUL_MAX_KEYS)
         log2m = self.config.get(HLL_LOG2M)
         hll_plans = [p for p in agg_plans if p.kind == "hll"]
@@ -599,29 +636,70 @@ class QueryEngine:
                     key, m, vals, n_keys, log2m)
             return out
 
+        return core
+
+    def _build_agg_program(self, ds, dim_plans, agg_plans, filter_spec,
+                           intervals, min_day, max_day, n_keys, sharded):
+        """Returns (jit_fn, unpack): the program packs every [K] output into
+        ONE flat array so the host pays a single device->host transfer
+        (tunneled/remote chips charge full RTT per buffer)."""
+        core = self._make_core(ds, dim_plans, agg_plans, filter_spec,
+                               intervals, min_day, max_day, n_keys)
+        hll_plans = [p for p in agg_plans if p.kind == "hll"]
+        dense_plans = [p for p in agg_plans if p.kind != "hll"]
+        log2m = self.config.get(HLL_LOG2M)
+        m = 1 << log2m
+        meta = [(p.spec.name, n_keys, False) for p in dense_plans]
+        meta.append(("__rows__", n_keys, False))
+        meta += [(p.spec.name, n_keys * m, True) for p in hll_plans]
+        # match the kernels' accumulator dtype so packing never truncates
+        # f64-accumulated counts/sums (groupby acc_dtype: f64 iff x64)
+        pack_dtype = jnp.float64 if jax.config.jax_enable_x64 \
+            else jnp.float32
+
+        def pack(out):
+            return jnp.concatenate(
+                [out[name].reshape(-1).astype(pack_dtype)
+                 for name, _, _ in meta])
+
         if not sharded:
-            return jax.jit(core)
+            fn = jax.jit(lambda arrays: pack(core(arrays)))
+        else:
+            mesh = self.mesh
+            dense_inputs = [G.AggInput(p.spec.name, p.kind)
+                            for p in dense_plans]
 
-        mesh = self.mesh
-        dense_inputs = [G.AggInput(p.spec.name, p.kind) for p in dense_plans]
+            def sharded_core(arrays):
+                out = core(arrays)
+                merged = G.merge_partials(
+                    {k: v for k, v in out.items()
+                     if not any(k == p.spec.name for p in hll_plans)},
+                    dense_inputs + [G.AggInput("__rows__", "count")],
+                    SEGMENT_AXIS)
+                for p in hll_plans:
+                    merged[p.spec.name] = HLL.merge_registers(
+                        out[p.spec.name], SEGMENT_AXIS)
+                return pack(merged)
 
-        def sharded_core(arrays):
-            out = core(arrays)
-            merged = G.merge_partials(
-                {k: v for k, v in out.items()
-                 if not any(k == p.spec.name for p in hll_plans)},
-                dense_inputs + [G.AggInput("__rows__", "count")],
-                SEGMENT_AXIS)
-            for p in hll_plans:
-                merged[p.spec.name] = HLL.merge_registers(
-                    out[p.spec.name], SEGMENT_AXIS)
-            return merged
+            smfn = jax.shard_map(sharded_core, mesh=mesh,
+                                 in_specs=(P(SEGMENT_AXIS, None),),
+                                 out_specs=P(), check_vma=False)
+            fn = jax.jit(lambda arrays: smfn(arrays))
 
-        in_specs = P(SEGMENT_AXIS, None)
-        fn = jax.shard_map(sharded_core, mesh=mesh,
-                           in_specs=(in_specs,), out_specs=P(),
-                           check_vma=False)
-        return jax.jit(lambda arrays: fn(arrays))
+        def unpack(flat: np.ndarray) -> Dict[str, np.ndarray]:
+            out = {}
+            off = 0
+            for name, size, is_hll in meta:
+                chunk = flat[off: off + size]
+                off += size
+                if is_hll:
+                    out[name] = np.round(chunk).astype(np.int32) \
+                        .reshape(n_keys, m)
+                else:
+                    out[name] = chunk
+            return out
+
+        return fn, unpack
 
     # -- select path ----------------------------------------------------------
     def _run_select(self, q: S.SelectQuerySpec) -> QueryResult:
@@ -725,6 +803,31 @@ class QueryEngine:
         self._device_arrays.clear()
 
 
+def _decode_anyvalue(ds: Datasource, field: str, v: np.ndarray) -> np.ndarray:
+    """Decode an FD-demoted grouping column from its max-aggregated numeric
+    representation (dictionary code for dims, days for dates)."""
+    kind = ds.column_kind(field)
+    empty = np.abs(v) >= 3.0e38
+    if kind == ColumnKind.DIM:
+        codes = np.round(np.where(empty, 0, v)).astype(np.int64)
+        vals = ds.dims[field].dictionary[
+            np.clip(codes, 0, max(ds.dims[field].cardinality - 1, 0))]
+        if empty.any():
+            vals = np.where(empty, None, vals)
+        return vals
+    if kind == ColumnKind.DATE:
+        days = np.round(np.where(empty, 0, v)).astype(np.int64)
+        out = days.astype("datetime64[D]")
+        if empty.any():
+            out = np.where(empty, np.datetime64("NaT"), out)
+        return out
+    if kind == ColumnKind.LONG:
+        if empty.any():
+            return np.where(empty, np.nan, v).astype(np.float64)
+        return np.round(v).astype(np.int64)
+    return np.where(empty, np.nan, v).astype(np.float64)
+
+
 def _neg_key(k: np.ndarray):
     if np.issubdtype(k.dtype, np.number):
         return -k
@@ -763,12 +866,18 @@ def _host_column_values(ds: Datasource, name: str,
             return vals.astype("datetime64[D]")
         if m.kind == ColumnKind.LONG:
             out = vals.astype(np.int64)
-        else:
-            out = vals.astype(np.float64)
+            if m.validity is not None:
+                v = m.validity if idx is None else m.validity[idx]
+                out = np.where(v, out.astype(np.float64), np.nan)
+            return out
+        # keep f32 (storage dtype): python-float literals then compare
+        # under NumPy weak promotion in f32, matching the device path's
+        # comparison semantics at representation boundaries (e.g.
+        # x >= 0.05 over a stored f32 0.05); np.nan fill preserves f32
+        out = vals
         if m.validity is not None:
             v = m.validity if idx is None else m.validity[idx]
-            out = out.astype(np.float64)
-            out = np.where(v, out, np.nan)
+            out = np.where(v, out, np.float32(np.nan))
         return out
     if ds.time is not None and name == ds.time.name:
         ms = ds.time.millis if idx is None else ds.time.millis[idx]

@@ -1,0 +1,548 @@
+"""Host (pandas) execution of a full SelectStmt.
+
+The completeness safety net: whatever the device planner cannot push down
+runs here — the analog of the reference leaving non-rewritten plans to plain
+Spark execution (every DruidTransform returning Nil means Spark's own
+strategies plan the query). Also serves as the differential-test oracle.
+
+Supports joins (equi via merge + residual post-filter), scalar/IN/EXISTS
+subqueries (uncorrelated inlined once; correlated evaluated row-wise),
+aggregates, grouping sets, distinct, order/limit.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from spark_druid_olap_tpu.ir import expr as E
+from spark_druid_olap_tpu.sql import ast as A
+from spark_druid_olap_tpu.utils import host_eval
+
+
+class HostExecError(Exception):
+    pass
+
+
+def datasource_frame(ctx, name: str) -> pd.DataFrame:
+    from spark_druid_olap_tpu.parallel.executor import _host_column_values
+    ds = ctx.store.get(name)
+    data = {c: _host_column_values(ds, c, None) for c in ds.column_names()}
+    return pd.DataFrame(data)
+
+
+# -- schema resolution --------------------------------------------------------
+
+def relation_columns(ctx, rel: A.Relation) -> List[str]:
+    if isinstance(rel, A.TableRef):
+        return list(ctx.store.get(rel.name).column_names())
+    if isinstance(rel, A.SubqueryRef):
+        return select_output_names(ctx, rel.query)
+    if isinstance(rel, A.Join):
+        return relation_columns(ctx, rel.left) + relation_columns(ctx, rel.right)
+    raise HostExecError(f"relation {type(rel).__name__}")
+
+
+def select_output_names(ctx, stmt: A.SelectStmt) -> List[str]:
+    names = []
+    for i, item in enumerate(stmt.items):
+        if item.expr == "*" or (isinstance(item.expr, E.Column)
+                                and item.expr.name == "*"):
+            if stmt.relation is not None:
+                names.extend(relation_columns(ctx, stmt.relation))
+            continue
+        if item.alias:
+            names.append(item.alias)
+        elif isinstance(item.expr, E.Column):
+            names.append(item.expr.name)
+        else:
+            names.append(f"_c{i}")
+    return names
+
+
+# -- subquery handling --------------------------------------------------------
+
+def _subquery_nodes(e: E.Expr):
+    for n in E.walk(e):
+        if isinstance(n, (A.ScalarSubquery, A.InSubquery, A.Exists)):
+            yield n
+
+
+def _free_columns(ctx, stmt: A.SelectStmt) -> set:
+    """Columns referenced by ``stmt`` that its own relation doesn't provide
+    (i.e. correlation bindings)."""
+    visible = set(relation_columns(ctx, stmt.relation)) \
+        if stmt.relation is not None else set()
+    for i, item in enumerate(stmt.items):
+        if item.alias:
+            visible.add(item.alias)
+    refs = set()
+
+    def collect(e):
+        if e is None or isinstance(e, str):
+            return
+        for n in E.walk(e):
+            if isinstance(n, E.Column) and n.name != "*":
+                refs.add(n.name)
+            elif isinstance(n, (A.ScalarSubquery, A.Exists)):
+                refs.update(_free_columns(ctx, n.query))
+            elif isinstance(n, A.InSubquery):
+                refs.update(_free_columns(ctx, n.query))
+
+    for item in stmt.items:
+        collect(item.expr if item.expr != "*" else None)
+    collect(stmt.where)
+    gb = stmt.group_by
+    if isinstance(gb, tuple):
+        for g in gb:
+            collect(g)
+    elif isinstance(gb, A.GroupingSets):
+        for s in gb.sets:
+            for g in s:
+                collect(g)
+    collect(stmt.having)
+    for o in stmt.order_by:
+        collect(o.expr)
+    return refs - visible
+
+
+def resolve_subqueries(ctx, e: E.Expr, env: Dict[str, np.ndarray],
+                       outer_env: Optional[dict] = None) -> E.Expr:
+    """Replace subquery nodes with literal values/lists/flags.
+
+    Uncorrelated subqueries execute once. Correlated ones evaluate row-wise
+    against ``env`` (slow path; decorrelation is future work — the reference
+    likewise leaves these to Spark)."""
+    subs = list(_subquery_nodes(e))
+    if not subs:
+        return e
+
+    n_rows = None
+    for v in env.values():
+        n_rows = len(v)
+        break
+
+    def replace(node):
+        if isinstance(node, (A.ScalarSubquery, A.Exists, A.InSubquery)):
+            free = _free_columns(ctx, node.query)
+            free = {f for f in free if f in env or
+                    (outer_env is not None and f in outer_env)}
+            if not free:
+                val = _execute_sub_once(ctx, node, outer_env)
+                return val
+            return _execute_sub_rowwise(ctx, node, env, free, n_rows,
+                                        outer_env)
+        return node
+
+    return E.transform(e, replace)
+
+
+def _execute_sub_once(ctx, node, outer_env):
+    df = execute_select(ctx, node.query, outer_env=outer_env)
+    if isinstance(node, A.ScalarSubquery):
+        if df.shape[0] == 0:
+            return E.Literal(None)
+        return E.Literal(df.iloc[0, 0])
+    if isinstance(node, A.Exists):
+        flag = (len(df) > 0) != node.negated
+        return E.Literal(flag)
+    vals = tuple(pd.unique(df.iloc[:, 0].dropna()))
+    return E.InList(node.child, vals, negated=node.negated)
+
+
+_PrecomputedColumn = host_eval.Precomputed
+
+
+def _execute_sub_rowwise(ctx, node, env, free, n_rows, outer_env):
+    results = []
+    child_vals = None
+    if isinstance(node, A.InSubquery):
+        ch = host_eval.eval_expr(resolve_subqueries(ctx, node.child, env,
+                                                    outer_env), env)
+        child_vals = np.broadcast_to(np.asarray(ch, dtype=object), (n_rows,))
+    for i in range(n_rows):
+        row_env = dict(outer_env or {})
+        for f in free:
+            src = env if f in env else (outer_env or {})
+            v = src[f]
+            row_env[f] = v[i] if isinstance(v, np.ndarray) else v
+        df = execute_select(ctx, node.query, outer_env=row_env)
+        if isinstance(node, A.ScalarSubquery):
+            results.append(None if len(df) == 0 else df.iloc[0, 0])
+        elif isinstance(node, A.Exists):
+            results.append((len(df) > 0) != node.negated)
+        else:
+            inset = child_vals[i] in set(df.iloc[:, 0])
+            results.append(inset != node.negated)
+    arr = np.array(results, dtype=object)
+    try:
+        arr = arr.astype(np.float64)
+    except (ValueError, TypeError):
+        pass
+    return _PrecomputedColumn(arr)
+
+
+# -- relation materialization -------------------------------------------------
+
+def _split_conjuncts(e: Optional[E.Expr]) -> List[E.Expr]:
+    if e is None:
+        return []
+    if isinstance(e, E.And):
+        out = []
+        for p in e.parts:
+            out.extend(_split_conjuncts(p))
+        return out
+    return [e]
+
+
+def materialize_relation(ctx, rel: A.Relation,
+                         outer_env: Optional[dict]) -> pd.DataFrame:
+    if isinstance(rel, A.TableRef):
+        return datasource_frame(ctx, rel.name)
+    if isinstance(rel, A.SubqueryRef):
+        return execute_select(ctx, rel.query, outer_env=outer_env)
+    if isinstance(rel, A.Join):
+        left = materialize_relation(ctx, rel.left, outer_env)
+        right = materialize_relation(ctx, rel.right, outer_env)
+        conjs = _split_conjuncts(rel.condition)
+        eq_pairs = []
+        residual = []
+        for c in conjs:
+            if (isinstance(c, E.Comparison) and c.op == "=" and
+                    isinstance(c.left, E.Column) and
+                    isinstance(c.right, E.Column)):
+                l, r = c.left.name, c.right.name
+                if l in left.columns and r in right.columns:
+                    eq_pairs.append((l, r))
+                    continue
+                if r in left.columns and l in right.columns:
+                    eq_pairs.append((r, l))
+                    continue
+            residual.append(c)
+        how = {"inner": "inner", "left": "left", "cross": "cross"}[rel.kind]
+        if eq_pairs:
+            lk = [p[0] for p in eq_pairs]
+            rk = [p[1] for p in eq_pairs]
+            df = left.merge(right, left_on=lk, right_on=rk, how="inner"
+                            if how == "cross" else how)
+        else:
+            df = left.merge(right, how="cross")
+        if residual:
+            env = {c: df[c].to_numpy() for c in df.columns}
+            mask = np.ones(len(df), dtype=bool)
+            for c in residual:
+                c2 = resolve_subqueries(ctx, c, env, outer_env)
+                mask &= np.asarray(host_eval.eval_expr(c2, env), dtype=bool)
+            df = df[mask].reset_index(drop=True)
+        return df
+    raise HostExecError(f"relation {type(rel).__name__}")
+
+
+# -- aggregation --------------------------------------------------------------
+
+def _agg_key(call: E.AggCall) -> str:
+    return E.to_sql(call)
+
+
+def _grp_key(e: E.Expr) -> str:
+    return E.to_sql(e)
+
+
+def _replace_for_output(e: E.Expr, agg_cols: Dict[str, str],
+                        grp_cols: Dict[str, str]) -> E.Expr:
+    def rep(n):
+        if isinstance(n, E.AggCall) and _agg_key(n) in agg_cols:
+            return E.Column(agg_cols[_agg_key(n)])
+        return n
+
+    # replace whole group-expr subtrees first (top-down), then agg calls
+    def walk_replace(n):
+        k = _grp_key(n)
+        if k in grp_cols:
+            return E.Column(grp_cols[k])
+        if isinstance(n, E.AggCall):
+            return rep(n)
+        # rebuild children
+        return None
+
+    def go(n):
+        r = walk_replace(n)
+        if r is not None:
+            return r
+        return E.transform(n, rep)
+
+    k = _grp_key(e)
+    if k in grp_cols:
+        return E.Column(grp_cols[k])
+    return go(e)
+
+
+def _compute_agg(series_env, df, call: E.AggCall, ctx, outer_env, group_ids,
+                 n_groups):
+    """Aggregate one AggCall over group ids -> array [n_groups]."""
+    if call.arg is None:
+        vals = np.ones(len(df), dtype=np.int64)
+    else:
+        arg = resolve_subqueries(ctx, call.arg, series_env, outer_env)
+        vals = np.asarray(host_eval.eval_expr(arg, series_env))
+        vals = np.broadcast_to(vals, (len(df),)) if vals.ndim == 0 else vals
+    s = pd.Series(vals)
+    g = pd.Series(group_ids)
+    if call.fn == "count":
+        if call.distinct:
+            out = s.groupby(g).nunique()
+        elif call.arg is None:
+            out = s.groupby(g).size()
+        else:
+            out = s.groupby(g).count()
+    elif call.fn == "sum":
+        out = s.groupby(g).sum()
+    elif call.fn == "min":
+        out = s.groupby(g).min()
+    elif call.fn == "max":
+        out = s.groupby(g).max()
+    elif call.fn == "avg":
+        out = s.groupby(g).mean()
+    else:
+        raise HostExecError(f"aggregate {call.fn}")
+    full = out.reindex(range(n_groups))
+    return full.to_numpy()
+
+
+def execute_select(ctx, stmt: A.SelectStmt,
+                   outer_env: Optional[dict] = None) -> pd.DataFrame:
+    # FROM
+    if stmt.relation is None:
+        df = pd.DataFrame({"__dummy__": [0]})
+    else:
+        df = materialize_relation(ctx, stmt.relation, outer_env)
+    env = {c: df[c].to_numpy() for c in df.columns}
+    if outer_env:
+        for k, v in outer_env.items():
+            if k not in env:
+                env[k] = v
+
+    # WHERE
+    if stmt.where is not None:
+        w = resolve_subqueries(ctx, stmt.where, env, outer_env)
+        mask = np.asarray(host_eval.eval_expr(w, env))
+        mask = np.broadcast_to(mask, (len(df),)).astype(bool)
+        df = df[mask].reset_index(drop=True)
+        env = {c: df[c].to_numpy() for c in df.columns}
+        if outer_env:
+            for k, v in outer_env.items():
+                if k not in env:
+                    env[k] = v
+
+    # aggregate detection
+    agg_calls: Dict[str, E.AggCall] = {}
+
+    def collect_aggs(e):
+        if e is None or isinstance(e, str):
+            return
+        for n in E.walk(e):
+            if isinstance(n, E.AggCall):
+                agg_calls[_agg_key(n)] = n
+
+    for item in stmt.items:
+        collect_aggs(item.expr if item.expr != "*" else None)
+    collect_aggs(stmt.having)
+    for o in stmt.order_by:
+        collect_aggs(o.expr)
+
+    is_agg = bool(agg_calls) or stmt.group_by is not None
+
+    out_names = select_output_names(ctx, stmt)
+
+    if not is_agg:
+        out = {}
+        cols = []
+        for i, item in enumerate(stmt.items):
+            if item.expr == "*" or (isinstance(item.expr, E.Column)
+                                    and item.expr.name == "*"):
+                for c in df.columns:
+                    out[c] = df[c].to_numpy()
+                    cols.append(c)
+                continue
+            name = out_names[len(cols)]
+            e2 = resolve_subqueries(ctx, item.expr, env, outer_env)
+            v = host_eval.eval_expr(e2, env)
+            v = np.broadcast_to(np.asarray(v), (len(df),)) \
+                if np.ndim(v) == 0 else np.asarray(v)
+            out[name] = v
+            cols.append(name)
+        res = pd.DataFrame({c: out[c] for c in cols})
+        return _order_limit_distinct(ctx, res, stmt, env)
+
+    # group sets
+    if isinstance(stmt.group_by, A.GroupingSets):
+        group_sets = [list(s) for s in stmt.group_by.sets]
+    elif stmt.group_by is None:
+        group_sets = [[]]
+    else:
+        group_sets = [list(stmt.group_by)]
+    # resolve ordinal / alias group keys
+    alias_map = {}
+    for i, item in enumerate(stmt.items):
+        if item.alias and item.expr != "*":
+            alias_map[item.alias] = item.expr
+    resolved_sets = []
+    for gs in group_sets:
+        rs = []
+        for g in gs:
+            if isinstance(g, E.Literal) and isinstance(g.value, int):
+                rs.append(stmt.items[g.value - 1].expr)
+            elif isinstance(g, E.Column) and g.name in alias_map:
+                rs.append(alias_map[g.name])
+            else:
+                rs.append(g)
+        resolved_sets.append(rs)
+
+    all_group_exprs = []
+    seen = set()
+    for rs in resolved_sets:
+        for g in rs:
+            k = _grp_key(g)
+            if k not in seen:
+                seen.add(k)
+                all_group_exprs.append(g)
+
+    frames = []
+    for rs in resolved_sets:
+        frames.append(_one_grouping(ctx, stmt, df, env, rs, all_group_exprs,
+                                    agg_calls, outer_env, out_names))
+    res = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
+    return _order_limit_distinct(ctx, res, stmt, env)
+
+
+def _one_grouping(ctx, stmt, df, env, group_exprs, all_group_exprs, agg_calls,
+                  outer_env, out_names):
+    n = len(df)
+    grp_cols: Dict[str, str] = {}
+    key_arrays = []
+    for j, g in enumerate(group_exprs):
+        e2 = resolve_subqueries(ctx, g, env, outer_env)
+        v = np.asarray(host_eval.eval_expr(e2, env))
+        v = np.broadcast_to(v, (n,)) if v.ndim == 0 else v
+        grp_cols[_grp_key(g)] = f"__grp{j}"
+        key_arrays.append(v)
+    if key_arrays:
+        key_df = pd.DataFrame({f"__grp{j}": key_arrays[j]
+                               for j in range(len(key_arrays))})
+        codes, uniques = pd.factorize(
+            pd.MultiIndex.from_frame(key_df)) if len(key_arrays) > 1 else \
+            pd.factorize(key_df["__grp0"])
+        group_ids = codes
+        n_groups = len(uniques)
+    else:
+        group_ids = np.zeros(n, dtype=np.int64)
+        n_groups = 1 if n > 0 else 1
+    if n == 0:
+        n_groups = 0
+
+    agg_cols: Dict[str, str] = {}
+    gagg = {}
+    for j, (k, call) in enumerate(agg_calls.items()):
+        cname = f"__agg{j}"
+        agg_cols[k] = cname
+        gagg[cname] = _compute_agg(env, df, call, ctx, outer_env, group_ids,
+                                   n_groups)
+
+    # group key values per group
+    gkey = {}
+    if key_arrays and n_groups > 0:
+        first_idx = np.zeros(n_groups, dtype=np.int64)
+        seen = np.zeros(n_groups, dtype=bool)
+        for i, gid in enumerate(group_ids):
+            if not seen[gid]:
+                seen[gid] = True
+                first_idx[gid] = i
+        for j in range(len(key_arrays)):
+            gkey[f"__grp{j}"] = key_arrays[j][first_idx]
+
+    genv = {**gkey, **gagg}
+
+    # HAVING
+    keep = None
+    if stmt.having is not None:
+        h = _replace_for_output(
+            resolve_subqueries(ctx, stmt.having, env, outer_env),
+            agg_cols, grp_cols)
+        keep = np.asarray(host_eval.eval_expr(h, genv), dtype=bool)
+
+    out = {}
+    cols = []
+    for i, item in enumerate(stmt.items):
+        if item.expr == "*":
+            raise HostExecError("SELECT * with GROUP BY")
+        name = out_names[i]
+        e2 = _replace_for_output(
+            resolve_subqueries(ctx, item.expr, env, outer_env),
+            agg_cols, grp_cols)
+        # group expr not in this grouping set -> null fill (grouping sets)
+        try:
+            v = host_eval.eval_expr(e2, genv)
+        except host_eval.HostEvalError:
+            v = np.full(n_groups, None, dtype=object)
+        v = np.broadcast_to(np.asarray(v), (n_groups,)) \
+            if np.ndim(v) == 0 else np.asarray(v)
+        out[name] = v
+        cols.append(name)
+    res = pd.DataFrame({c: pd.Series(out[c]) for c in cols})
+    if keep is not None:
+        res = res[keep].reset_index(drop=True)
+    # stash order-by helper columns
+    res.attrs["agg_cols"] = agg_cols
+    res.attrs["grp_cols"] = grp_cols
+    res.attrs["genv"] = genv
+    res.attrs["keep"] = keep
+    return res
+
+
+def _order_limit_distinct(ctx, res: pd.DataFrame, stmt: A.SelectStmt, env):
+    if stmt.distinct:
+        res = res.drop_duplicates().reset_index(drop=True)
+    if stmt.order_by:
+        sort_cols = []
+        ascending = []
+        tmp = res.copy()
+        alias_map = {}
+        for i, item in enumerate(stmt.items):
+            if item.expr != "*":
+                alias_map[_grp_key(item.expr)] = res.columns[i] \
+                    if i < len(res.columns) else None
+        for j, o in enumerate(stmt.order_by):
+            e = o.expr
+            if isinstance(e, E.Literal) and isinstance(e.value, int):
+                col = res.columns[e.value - 1]
+            elif isinstance(e, E.Column) and e.name in res.columns:
+                col = e.name
+            elif _grp_key(e) in alias_map and alias_map[_grp_key(e)]:
+                col = alias_map[_grp_key(e)]
+            else:
+                # compute from result columns
+                envr = {c: res[c].to_numpy() for c in res.columns}
+                agg_cols = res.attrs.get("agg_cols", {})
+                grp_cols = res.attrs.get("grp_cols", {})
+                genv = res.attrs.get("genv", {})
+                e2 = _replace_for_output(e, agg_cols, grp_cols)
+                try:
+                    v = host_eval.eval_expr(e2, envr)
+                except host_eval.HostEvalError:
+                    keep = res.attrs.get("keep")
+                    fullenv = dict(genv)
+                    v = np.asarray(host_eval.eval_expr(e2, fullenv))
+                    if keep is not None:
+                        v = v[keep]
+                col = f"__ord{j}"
+                tmp[col] = v
+            sort_cols.append(col)
+            ascending.append(o.ascending)
+        tmp = tmp.sort_values(sort_cols, ascending=ascending,
+                              kind="mergesort")
+        res = tmp[res.columns].reset_index(drop=True)
+    if stmt.limit is not None:
+        res = res.head(stmt.limit).reset_index(drop=True)
+    return res

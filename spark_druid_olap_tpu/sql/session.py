@@ -1,0 +1,165 @@
+"""SQL session: parse -> plan -> execute.
+
+≈ the reference's end-to-end statement path: ``SPLParser`` front commands +
+Catalyst planning with ``DruidStrategy`` + falling back to plain Spark when no
+rewrite applies. Here: pushdown builder first; :class:`PlanUnsupported` or a
+runtime :class:`EngineFallback` routes to the pandas host executor.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import List, Optional
+
+import numpy as np
+import pandas as pd
+
+from spark_druid_olap_tpu.ir import spec as S
+from spark_druid_olap_tpu.parallel.executor import EngineFallback
+from spark_druid_olap_tpu.planner import builder as B
+from spark_druid_olap_tpu.planner import host_exec
+from spark_druid_olap_tpu.planner.plans import PlannedQuery, PlanUnsupported
+from spark_druid_olap_tpu.result import QueryResult
+from spark_druid_olap_tpu.sql import ast as A
+from spark_druid_olap_tpu.sql.parser import parse_statement
+
+
+def run_sql(ctx, sql: str) -> QueryResult:
+    stmt = parse_statement(sql)
+    if isinstance(stmt, A.ClearMetadata):
+        if stmt.datasource:
+            ctx.store.drop(stmt.datasource)
+        else:
+            ctx.engine.clear_caches()
+        return QueryResult(["status"], {"status": np.array(["OK"],
+                                                           dtype=object)})
+    if isinstance(stmt, A.ExecuteRawQuery):
+        from spark_druid_olap_tpu.ir.serde import query_from_json
+        q = query_from_json(stmt.query_json, default_ds=stmt.datasource)
+        r = ctx.engine.execute(q)
+        ctx.history.record(q, ctx.engine.last_stats, sql=sql)
+        return r
+    if isinstance(stmt, A.ExplainRewrite):
+        text = explain_text(ctx, stmt.query, stmt.sql)
+        return QueryResult(["plan"],
+                           {"plan": np.array(text.split("\n"), dtype=object)})
+    return _run_select(ctx, stmt, sql)
+
+
+def explain_sql(ctx, sql: str) -> str:
+    stmt = parse_statement(sql)
+    if isinstance(stmt, A.ExplainRewrite):
+        return explain_text(ctx, stmt.query, stmt.sql)
+    if isinstance(stmt, A.SelectStmt):
+        return explain_text(ctx, stmt, sql)
+    return f"command: {type(stmt).__name__}"
+
+
+def explain_text(ctx, stmt: A.SelectStmt, sql: str) -> str:
+    """≈ ``ExplainDruidRewrite`` (reference DruidMetadataCommands.scala:49-78)
+    — shows whether the query pushes down, the engine query specs, and the
+    cost-model decision."""
+    lines = [f"SQL: {sql.strip()}"]
+    try:
+        pq = B.build(ctx, stmt)
+    except PlanUnsupported as e:
+        lines.append(f"pushdown: NO ({e})")
+        lines.append("execution: host (pandas fallback)")
+        return "\n".join(lines)
+    lines.append(f"pushdown: YES -> datasource {pq.datasource!r}, "
+                 f"{len(pq.specs)} engine quer"
+                 f"{'y' if len(pq.specs) == 1 else 'ies'}")
+    from spark_druid_olap_tpu.parallel.cost import explain_cost
+    for i, q in enumerate(pq.specs):
+        lines.append(f"  [{i}] {type(q).__name__}: dims="
+                     f"{[d.output_name for d in S.query_dimensions(q)]} "
+                     f"aggs={[a.name for a in S.query_aggregations(q)]} "
+                     f"intervals={q.intervals}")
+        lines.append("      " + explain_cost(ctx, q).replace("\n", "\n      "))
+    if pq.distinct_phase2:
+        lines.append(f"  phase2: exact count-distinct over "
+                     f"{pq.distinct_phase2.group_cols}")
+    return "\n".join(lines)
+
+
+def _run_select(ctx, stmt: A.SelectStmt, sql: str) -> QueryResult:
+    t0 = _time.perf_counter()
+    try:
+        pq = B.build(ctx, stmt)
+        df = execute_planned(ctx, pq)
+        mode = "engine"
+    except (PlanUnsupported, EngineFallback) as e:
+        df = host_exec.execute_select(ctx, stmt)
+        mode = f"host ({e})"
+    stats = dict(ctx.engine.last_stats)
+    stats["mode"] = mode
+    stats["total_ms"] = (_time.perf_counter() - t0) * 1000
+    ctx.history.record(stmt, stats, sql=sql)
+    return QueryResult(list(df.columns),
+                       {c: df[c].to_numpy() for c in df.columns})
+
+
+def execute_planned(ctx, pq: PlannedQuery) -> pd.DataFrame:
+    frames: List[pd.DataFrame] = []
+    for q, set_dims in zip(pq.specs, pq.spec_dims):
+        r = ctx.engine.execute(q)
+        df = r.to_pandas()
+        if "__count__" in df.columns and "__count__" not in pq.output_columns:
+            df = df.drop(columns=["__count__"])
+        # null-fill dims missing from this grouping set
+        for d in pq.all_dims:
+            if d not in df.columns:
+                df[d] = None
+        frames.append(df)
+    df = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
+
+    if pq.distinct_phase2 is not None:
+        df = _phase2_distinct(df, pq)
+        from spark_druid_olap_tpu.utils import host_eval
+        env = {c: df[c].to_numpy() for c in df.columns}
+        for p in pq.deferred_posts:
+            v = np.asarray(host_eval.eval_expr(p.expr, env))
+            df[p.name] = np.broadcast_to(v, (len(df),)) if v.ndim == 0 else v
+            env[p.name] = df[p.name].to_numpy()
+
+    if pq.order_by and not pq.order_applied_in_spec:
+        cols = [c for c, _ in pq.order_by]
+        asc = [a for _, a in pq.order_by]
+        df = df.sort_values(cols, ascending=asc, kind="mergesort")
+    if pq.limit is not None and not pq.order_applied_in_spec:
+        df = df.head(pq.limit)
+
+    missing = [c for c in pq.output_columns if c not in df.columns]
+    if missing:
+        raise EngineFallback(f"planned outputs missing: {missing}")
+    return df[pq.output_columns].reset_index(drop=True)
+
+
+def _phase2_distinct(df: pd.DataFrame, pq: PlannedQuery) -> pd.DataFrame:
+    d2 = pq.distinct_phase2
+    gcols = d2.group_cols
+    # null arg values don't count toward count(distinct)
+    nn = df[~df[d2.distinct_dim].isna()]
+    if gcols:
+        cnt = nn.groupby(gcols, dropna=False, as_index=False).agg(
+            **{d2.distinct_out: (d2.distinct_dim, "nunique")})
+    else:
+        cnt = pd.DataFrame({d2.distinct_out: [nn[d2.distinct_dim].nunique()]})
+    aggd = {}
+    for col, fn in d2.other_aggs.items():
+        aggd[col] = (col, fn)
+    if gcols:
+        if aggd:
+            oth = df.groupby(gcols, dropna=False, as_index=False).agg(**aggd)
+            out = oth.merge(cnt, on=gcols, how="left")
+        else:
+            out = cnt
+    else:
+        if aggd:
+            oth = pd.DataFrame({c: [getattr(df[c], fn)()]
+                                for c, (c2, fn) in aggd.items()})
+            out = pd.concat([oth, cnt], axis=1)
+        else:
+            out = cnt
+    out[d2.distinct_out] = out[d2.distinct_out].fillna(0).astype(np.int64)
+    return out

@@ -67,8 +67,18 @@ def _civil(days):
     return y, m, d
 
 
+class Precomputed(E.Expr):
+    """An already-computed value injected into an expression tree (used by
+    the host executor for row-wise subquery results)."""
+
+    def __init__(self, arr):
+        self.arr = arr
+
+
 def eval_expr(e: E.Expr, env: dict):
     """Evaluate ``e``; ``env`` maps column name -> scalar or numpy array."""
+    if isinstance(e, Precomputed):
+        return e.arr
     if isinstance(e, E.Column):
         if e.name not in env:
             raise HostEvalError(f"unbound column {e.name!r}")
@@ -225,6 +235,19 @@ def _func(e: E.Func, env):
         return _to_days(args[0]) - np.asarray(args[1])
     if name == "datediff":
         return _to_days(args[0]) - _to_days(args[1])
+    if name == "add_months":
+        raw = _to_days(args[0])
+        was_scalar = np.ndim(raw) == 0
+        days = np.atleast_1d(raw)
+        n = np.asarray(args[1])
+        dates = days.astype("datetime64[D]")
+        months = dates.astype("datetime64[M]")
+        dom = (dates - months).astype(np.int64)          # 0-based day
+        nm = (months.astype(np.int64) + n).astype("datetime64[M]")
+        month_len = ((nm + 1).astype("datetime64[D]")
+                     - nm.astype("datetime64[D]")).astype(np.int64)
+        out = nm.astype("datetime64[D]") + np.minimum(dom, month_len - 1)
+        return out[0] if was_scalar else out
     if name in ("date_trunc", "trunc"):
         grain = args[0].lower()
         days = _to_days(args[1])

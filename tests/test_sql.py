@@ -1,0 +1,264 @@
+"""SQL end-to-end tests: parser, pushdown planning (plan-assertion pattern ≈
+reference DruidRewritesTest), and differential correctness engine-vs-host
+(≈ cTest)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.planner import builder as B
+from spark_druid_olap_tpu.planner.plans import PlanUnsupported
+from spark_druid_olap_tpu.sql.parser import parse_select, parse_statement
+from spark_druid_olap_tpu.sql import ast as A
+from spark_druid_olap_tpu.ir import spec as S
+
+from conftest import assert_frames_equal, make_sales_df
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = sdot.Context()
+    c.ingest_dataframe("sales", make_sales_df(), time_column="ts",
+                       target_rows=4096)
+    return c
+
+
+@pytest.fixture(scope="module")
+def sales(ctx):
+    from spark_druid_olap_tpu.planner.host_exec import datasource_frame
+    return datasource_frame(ctx, "sales")
+
+
+def plan_of(ctx, sql):
+    return B.build(ctx, parse_select(sql))
+
+
+def ctest(ctx, sales, sql, expect_pushdown=True, n_queries=None, sort=True):
+    """Differential test: engine path vs pandas host path (cTest pattern);
+    also asserts pushdown happened (plan-assertion pattern)."""
+    from spark_druid_olap_tpu.planner import host_exec
+    got = ctx.sql(sql).to_pandas()
+    stmt = parse_select(sql)
+    want = host_exec.execute_select(ctx, stmt)
+    rec = ctx.history.entries()[-1]
+    if expect_pushdown:
+        assert rec.stats["mode"] == "engine", rec.stats["mode"]
+        if n_queries is not None:
+            pq = plan_of(ctx, sql)
+            assert len(pq.specs) == n_queries
+    sort_by = [c for c in want.columns] if sort else None
+    assert_frames_equal(got, want,
+                        sort_by=sort_by if sort else None)
+    return got
+
+
+# -- parser unit tests --------------------------------------------------------
+
+def test_parse_basic():
+    s = parse_select("SELECT a, sum(b) AS sb FROM t WHERE c = 'x' "
+                     "GROUP BY a ORDER BY sb DESC LIMIT 10")
+    assert len(s.items) == 2
+    assert s.items[1].alias == "sb"
+    assert s.limit == 10
+    assert not s.order_by[0].ascending
+
+
+def test_parse_tpch_q1_shape():
+    sql = """
+    select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+           sum(l_extendedprice) as sum_base_price,
+           sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+           sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+           avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
+           avg(l_discount) as avg_disc, count(*) as count_order
+    from lineitem
+    where l_shipdate <= date '1998-12-01' - interval '90' day
+    group by l_returnflag, l_linestatus
+    order by l_returnflag, l_linestatus
+    """
+    s = parse_select(sql)
+    assert len(s.items) == 10
+    assert s.group_by is not None and len(s.group_by) == 2
+
+
+def test_parse_subqueries_and_commands():
+    s = parse_select("select a from t where b in (select b from u) and "
+                     "exists (select 1 from v where v1 = t1)")
+    assert s.where is not None
+    cmd = parse_statement("CLEAR METADATA")
+    assert isinstance(cmd, A.ClearMetadata)
+    cmd = parse_statement("EXPLAIN REWRITE SELECT count(*) FROM sales")
+    assert isinstance(cmd, A.ExplainRewrite)
+    cmd = parse_statement(
+        "ON DATASOURCE sales EXECUTE QUERY '{\"queryType\": \"timeseries\"}'")
+    assert isinstance(cmd, A.ExecuteRawQuery)
+
+
+def test_parse_grouping_sets():
+    s = parse_select("select a, b, count(*) from t "
+                     "group by grouping sets ((a, b), (a), ())")
+    assert isinstance(s.group_by, A.GroupingSets)
+    assert len(s.group_by.sets) == 3
+    s2 = parse_select("select a, b, count(*) from t group by cube(a, b)")
+    assert len(s2.group_by.sets) == 4
+    s3 = parse_select("select a, b, count(*) from t group by rollup(a, b)")
+    assert len(s3.group_by.sets) == 3
+
+
+# -- plan-assertion tests (≈ DruidRewritesTest) -------------------------------
+
+def test_plan_simple_agg_pushes(ctx):
+    pq = plan_of(ctx, "SELECT region, sum(price) FROM sales GROUP BY region")
+    assert len(pq.specs) == 1
+    assert isinstance(pq.specs[0], S.GroupByQuerySpec)
+
+
+def test_plan_no_dims_becomes_timeseries(ctx):
+    pq = plan_of(ctx, "SELECT count(*) FROM sales")
+    assert isinstance(pq.specs[0], S.TimeseriesQuerySpec)
+
+
+def test_plan_topn_rewrite(ctx):
+    pq = plan_of(ctx, "SELECT product, sum(price) AS rev FROM sales "
+                 "GROUP BY product ORDER BY rev DESC LIMIT 5")
+    assert isinstance(pq.specs[0], S.TopNQuerySpec)
+    assert pq.specs[0].threshold == 5
+
+
+def test_plan_time_filter_becomes_interval(ctx):
+    pq = plan_of(ctx, "SELECT count(*) FROM sales "
+                 "WHERE ts >= '2015-03-01' AND ts < '2015-06-01'")
+    q = pq.specs[0]
+    assert q.intervals is not None
+    assert q.filter is None
+
+
+def test_plan_subquery_falls_back(ctx):
+    with pytest.raises(PlanUnsupported):
+        plan_of(ctx, "SELECT region FROM sales WHERE qty > "
+                "(SELECT avg(qty) FROM sales)")
+
+
+# -- differential SQL tests (≈ cTest) -----------------------------------------
+
+def test_sql_q1_style(ctx, sales):
+    ctest(ctx, sales, """
+        select flag, status, sum(qty) as sum_qty, sum(price) as sum_price,
+               sum(price * (1 - discount)) as sum_disc,
+               avg(qty) as avg_qty, avg(price) as avg_price, count(*) as cnt
+        from sales
+        where ts <= date '2016-12-01' - interval '90' day
+        group by flag, status
+        order by flag, status
+    """, n_queries=1, sort=False)
+
+
+def test_sql_filters(ctx, sales):
+    ctest(ctx, sales, """
+        select region, count(*) as cnt from sales
+        where status = 'O' and qty >= 25 and product like 'p00%'
+              and flag in ('A', 'N')
+        group by region order by region
+    """, sort=False)
+
+
+def test_sql_year_month_grouping(ctx, sales):
+    ctest(ctx, sales, """
+        select year(ts) as yr, month(ts) as mo, sum(price) as rev
+        from sales group by year(ts), month(ts) order by yr, mo
+    """, sort=False)
+
+
+def test_sql_having(ctx, sales):
+    ctest(ctx, sales, """
+        select product, sum(qty) as q from sales
+        group by product having sum(qty) > 600 order by product
+    """, sort=False)
+
+
+def test_sql_case_expression_agg(ctx, sales):
+    ctest(ctx, sales, """
+        select region,
+               sum(case when status = 'O' then price else 0 end) as open_rev
+        from sales group by region order by region
+    """, sort=False)
+
+
+def test_sql_count_distinct_exact(ctx, sales):
+    got = ctx.sql("select region, count(distinct product) as np "
+                  "from sales group by region order by region").to_pandas()
+    want = sales.groupby("region", as_index=False).agg(
+        np=("product", "nunique")).sort_values("region").reset_index(drop=True)
+    assert_frames_equal(got, want, sort_by=None)
+    assert ctx.history.entries()[-1].stats["mode"] == "engine"
+
+
+def test_sql_approx_count_distinct(ctx, sales):
+    got = ctx.sql("select approx_count_distinct(product) as np from sales") \
+        .to_pandas()
+    true = sales["product"].nunique()
+    assert abs(int(got["np"][0]) - true) <= max(2, 0.05 * true)
+
+
+def test_sql_grouping_sets(ctx, sales):
+    got = ctx.sql("""
+        select flag, status, sum(qty) as q from sales
+        group by grouping sets ((flag, status), (flag), ())
+    """).to_pandas()
+    a = sales.groupby(["flag", "status"], as_index=False).agg(q=("qty", "sum"))
+    b = sales.groupby(["flag"], as_index=False).agg(q=("qty", "sum"))
+    b["status"] = None
+    c = pd.DataFrame({"flag": [None], "status": [None],
+                      "q": [sales.qty.sum()]})
+    want = pd.concat([a, b, c], ignore_index=True)[["flag", "status", "q"]]
+    assert len(got) == len(want)
+    assert int(got["q"].sum()) == int(want["q"].sum())
+    assert ctx.history.entries()[-1].stats["mode"] == "engine"
+
+
+def test_sql_select_path(ctx, sales):
+    got = ctx.sql("select ts, region, qty from sales "
+                  "where region = 'east' limit 50").to_pandas()
+    assert len(got) == 50
+    assert set(got["region"]) == {"east"}
+    assert ctx.history.entries()[-1].stats["mode"] == "engine"
+
+
+def test_sql_select_distinct(ctx, sales):
+    got = ctx.sql("select distinct region from sales order by region") \
+        .to_pandas()
+    assert list(got["region"]) == sorted(sales.region.unique())
+
+
+def test_sql_host_fallback_subquery(ctx, sales):
+    got = ctx.sql("select region, count(*) as cnt from sales "
+                  "where qty > (select avg(qty) from sales) "
+                  "group by region order by region").to_pandas()
+    thresh = sales.qty.mean()
+    want = sales[sales.qty > thresh].groupby("region", as_index=False) \
+        .agg(cnt=("qty", "size")).sort_values("region").reset_index(drop=True)
+    assert_frames_equal(got, want, sort_by=None)
+    assert ctx.history.entries()[-1].stats["mode"].startswith("host")
+
+
+def test_sql_explain(ctx):
+    text = ctx.explain("SELECT region, sum(price) FROM sales GROUP BY region")
+    assert "pushdown: YES" in text
+    text2 = ctx.explain("SELECT region FROM sales WHERE qty > "
+                        "(SELECT avg(qty) FROM sales)")
+    assert "pushdown: NO" in text2
+
+
+def test_sql_raw_query_command(ctx):
+    r = ctx.sql('ON DATASOURCE sales EXECUTE QUERY '
+                '\'{"queryType": "timeseries", "aggregations": '
+                '[{"type": "count", "name": "c"}]}\'')
+    assert int(r["c"][0]) == 20000
+
+
+def test_sql_ordinals_and_aliases(ctx, sales):
+    ctest(ctx, sales, """
+        select region, sum(price) as rev from sales
+        group by 1 order by 2 desc limit 3
+    """, sort=False)
