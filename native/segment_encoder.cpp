@@ -1,0 +1,179 @@
+// Native segment-ingest kernels.
+//
+// The ingest tier's hot loop — global sorted-dictionary encoding of string
+// columns — implemented as a CPython extension (the image has no pybind11;
+// plain C API). This is the framework's "batch index task" compute
+// (reference: Druid's indexing service, driven via
+// client/DruidOverlordClient.scala — the actual columnarization ran inside
+// Druid's JVM; here it is in-tree C++).
+//
+// Contract (see spark_druid_olap_tpu/segment/native.py):
+//   encode_utf8(data: buffer, offsets: int32 buffer[n+1])
+//     -> (codes: bytes[n*4],          # int32 little-endian
+//         dict_data: bytes,           # concatenated sorted unique strings
+//         dict_offsets: bytes[(k+1)*4])
+//
+// The GIL is released for the whole sort/unique pass, so Python-side thread
+// pools encode many columns in parallel.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <string_view>
+#include <vector>
+
+namespace {
+
+struct EncodeResult {
+  std::vector<int32_t> codes;
+  std::vector<int32_t> dict_offsets;
+  std::vector<char> dict_data;
+};
+
+EncodeResult encode_impl(const char* data, const int32_t* offsets,
+                         int64_t n) {
+  EncodeResult r;
+  r.codes.resize(static_cast<size_t>(n));
+  if (n == 0) {
+    r.dict_offsets.push_back(0);
+    return r;
+  }
+  auto view = [&](int32_t i) {
+    return std::string_view(data + offsets[i],
+                            static_cast<size_t>(offsets[i + 1] - offsets[i]));
+  };
+  std::vector<int32_t> idx(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) idx[static_cast<size_t>(i)] =
+      static_cast<int32_t>(i);
+  std::sort(idx.begin(), idx.end(),
+            [&](int32_t a, int32_t b) { return view(a) < view(b); });
+
+  std::vector<int32_t> dict_rows;  // representative source row per code
+  int32_t code = -1;
+  std::string_view prev;
+  for (int64_t k = 0; k < n; ++k) {
+    int32_t row = idx[static_cast<size_t>(k)];
+    std::string_view v = view(row);
+    if (code < 0 || v != prev) {
+      ++code;
+      dict_rows.push_back(row);
+      prev = v;
+    }
+    r.codes[static_cast<size_t>(row)] = code;
+  }
+  r.dict_offsets.reserve(dict_rows.size() + 1);
+  r.dict_offsets.push_back(0);
+  size_t total = 0;
+  for (int32_t row : dict_rows) total += view(row).size();
+  r.dict_data.reserve(total);
+  for (int32_t row : dict_rows) {
+    std::string_view v = view(row);
+    r.dict_data.insert(r.dict_data.end(), v.begin(), v.end());
+    r.dict_offsets.push_back(static_cast<int32_t>(r.dict_data.size()));
+  }
+  return r;
+}
+
+PyObject* encode_utf8(PyObject*, PyObject* args) {
+  Py_buffer data_buf, off_buf;
+  if (!PyArg_ParseTuple(args, "y*y*", &data_buf, &off_buf)) return nullptr;
+  const int64_t n = static_cast<int64_t>(off_buf.len / sizeof(int32_t)) - 1;
+  if (n < 0) {
+    PyBuffer_Release(&data_buf);
+    PyBuffer_Release(&off_buf);
+    PyErr_SetString(PyExc_ValueError, "offsets buffer too small");
+    return nullptr;
+  }
+  EncodeResult r;
+  const char* data = static_cast<const char*>(data_buf.buf);
+  const int32_t* offsets = static_cast<const int32_t*>(off_buf.buf);
+  Py_BEGIN_ALLOW_THREADS
+  r = encode_impl(data, offsets, n);
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&data_buf);
+  PyBuffer_Release(&off_buf);
+
+  PyObject* codes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(r.codes.data()),
+      static_cast<Py_ssize_t>(r.codes.size() * sizeof(int32_t)));
+  PyObject* dict_data = PyBytes_FromStringAndSize(
+      r.dict_data.data(), static_cast<Py_ssize_t>(r.dict_data.size()));
+  PyObject* dict_offsets = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(r.dict_offsets.data()),
+      static_cast<Py_ssize_t>(r.dict_offsets.size() * sizeof(int32_t)));
+  if (!codes || !dict_data || !dict_offsets) {
+    Py_XDECREF(codes);
+    Py_XDECREF(dict_data);
+    Py_XDECREF(dict_offsets);
+    return nullptr;
+  }
+  PyObject* out = PyTuple_Pack(3, codes, dict_data, dict_offsets);
+  Py_DECREF(codes);
+  Py_DECREF(dict_data);
+  Py_DECREF(dict_offsets);
+  return out;
+}
+
+// lookup codes for a batch of strings against an existing sorted dictionary
+// (incremental ingest); absent values get code -1
+PyObject* lookup_utf8(PyObject*, PyObject* args) {
+  Py_buffer data_buf, off_buf, ddata_buf, doff_buf;
+  if (!PyArg_ParseTuple(args, "y*y*y*y*", &data_buf, &off_buf, &ddata_buf,
+                        &doff_buf))
+    return nullptr;
+  const int64_t n = static_cast<int64_t>(off_buf.len / sizeof(int32_t)) - 1;
+  const int64_t k = static_cast<int64_t>(doff_buf.len / sizeof(int32_t)) - 1;
+  const char* data = static_cast<const char*>(data_buf.buf);
+  const int32_t* offsets = static_cast<const int32_t*>(off_buf.buf);
+  const char* ddata = static_cast<const char*>(ddata_buf.buf);
+  const int32_t* doffsets = static_cast<const int32_t*>(doff_buf.buf);
+  std::vector<int32_t> codes(static_cast<size_t>(n > 0 ? n : 0));
+  Py_BEGIN_ALLOW_THREADS
+  auto dview = [&](int64_t i) {
+    return std::string_view(ddata + doffsets[i],
+                            static_cast<size_t>(doffsets[i + 1] -
+                                                doffsets[i]));
+  };
+  for (int64_t i = 0; i < n; ++i) {
+    std::string_view v(data + offsets[i],
+                       static_cast<size_t>(offsets[i + 1] - offsets[i]));
+    int64_t lo = 0, hi = k;
+    while (lo < hi) {
+      int64_t mid = (lo + hi) / 2;
+      if (dview(mid) < v) lo = mid + 1; else hi = mid;
+    }
+    codes[static_cast<size_t>(i)] =
+        (lo < k && dview(lo) == v) ? static_cast<int32_t>(lo) : -1;
+  }
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&data_buf);
+  PyBuffer_Release(&off_buf);
+  PyBuffer_Release(&ddata_buf);
+  PyBuffer_Release(&doff_buf);
+  return PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(codes.data()),
+      static_cast<Py_ssize_t>(codes.size() * sizeof(int32_t)));
+}
+
+PyMethodDef kMethods[] = {
+    {"encode_utf8", encode_utf8, METH_VARARGS,
+     "Sorted-dictionary-encode a UTF-8 column (arrow-style buffers)."},
+    {"lookup_utf8", lookup_utf8, METH_VARARGS,
+     "Binary-search codes for strings against a sorted dictionary."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef kModule = {
+    PyModuleDef_HEAD_INIT, "_sdot_native",
+    "Native segment-ingest kernels for spark_druid_olap_tpu.", -1, kMethods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__sdot_native(void) {
+  return PyModule_Create(&kModule);
+}

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Start the sdot SQL server (≈ the reference's
+# scripts/start-sparklinedatathriftserver.sh, which spark-daemon-submits the
+# wrapper thriftserver class). Runs in the foreground; use systemd/nohup to
+# daemonize.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m spark_druid_olap_tpu.server "$@"

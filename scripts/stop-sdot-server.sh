@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Stop a backgrounded sdot SQL server by port (default 8082).
+set -euo pipefail
+PORT="${1:-8082}"
+PID=$(ss -tlnp 2>/dev/null | awk -v p=":$PORT" '$4 ~ p {print $6}' \
+      | sed -n 's/.*pid=\([0-9]*\).*/\1/p' | head -1)
+if [ -z "$PID" ]; then echo "no server on port $PORT"; exit 1; fi
+kill "$PID" && echo "stopped pid $PID"

@@ -64,6 +64,22 @@ class EngineFallback(Exception):
     above the Druid scan (``ProjectFilterTransfom.addUnpushedAttributes``)."""
 
 
+class QueryCancelled(RuntimeError):
+    """Raised when a registered query id is cancelled mid-flight.
+
+    ≈ the reference's cooperative cancellation: Spark task interruption
+    relayed to abort the in-flight Druid HTTP call (``TaskCancelHandler``
+    ``DruidRDD.scala:428-491``, ``CancellableHolder``
+    ``DruidClient.scala:82-124``). A dispatched XLA program itself is not
+    interruptible (neither was Druid's in-progress segment scan) — the check
+    fires at stage boundaries: before dispatch, after the device round-trip,
+    and per select page."""
+
+
+class QueryTimeout(RuntimeError):
+    """Raised when QueryContext.timeout_millis elapses at a stage boundary."""
+
+
 # =============================================================================
 # dimension planning (host side; card/decode known before tracing)
 # =============================================================================
@@ -408,25 +424,61 @@ class QueryEngine:
         self.mesh = mesh
         self._programs: Dict[tuple, object] = {}   # compile cache
         self._device_arrays: Dict[tuple, object] = {}
+        self._cancel_flags: Dict[str, object] = {}
         self.last_stats: Dict[str, object] = {}
+
+    # -- cancellation / timeout ----------------------------------------------
+    def cancel(self, query_id: str) -> bool:
+        """Mark a registered query id cancelled (cooperative; takes effect at
+        the next stage boundary)."""
+        ev = self._cancel_flags.get(query_id)
+        if ev is None:
+            return False
+        ev.set()
+        return True
+
+    def _stage_check(self, q, t0: float):
+        ctxq = getattr(q, "context", None)
+        if ctxq is None:
+            return
+        if ctxq.query_id is not None:
+            ev = self._cancel_flags.get(ctxq.query_id)
+            if ev is not None and ev.is_set():
+                raise QueryCancelled(f"query {ctxq.query_id} cancelled")
+        if ctxq.timeout_millis is not None:
+            if (_time.perf_counter() - t0) * 1000 > ctxq.timeout_millis:
+                raise QueryTimeout(
+                    f"query exceeded {ctxq.timeout_millis}ms")
 
     # -- public ---------------------------------------------------------------
     def execute(self, q: S.QuerySpec) -> QueryResult:
         t0 = _time.perf_counter()
+        qid = getattr(getattr(q, "context", None), "query_id", None)
+        if qid is not None:
+            import threading
+            self._cancel_flags.setdefault(qid, threading.Event())
+        try:
+            return self._execute_inner(q, t0)
+        finally:
+            if qid is not None:
+                self._cancel_flags.pop(qid, None)
+
+    def _execute_inner(self, q: S.QuerySpec, t0: float) -> QueryResult:
+        self._stage_check(q, t0)
         if isinstance(q, S.GroupByQuerySpec):
             r = self._run_agg(q, list(q.dimensions), q.aggregations,
                               q.post_aggregations, q.having, q.limit,
-                              q.granularity, q.filter, q.intervals)
+                              q.granularity, q.filter, q.intervals, t0)
         elif isinstance(q, S.TimeseriesQuerySpec):
             r = self._run_agg(q, [], q.aggregations, q.post_aggregations,
                               None, None, q.granularity, q.filter,
-                              q.intervals)
+                              q.intervals, t0)
         elif isinstance(q, S.TopNQuerySpec):
             limit = S.LimitSpec((S.OrderByColumn(q.metric, ascending=False),),
                                 q.threshold)
             r = self._run_agg(q, [q.dimension], q.aggregations,
                               q.post_aggregations, None, limit,
-                              q.granularity, q.filter, q.intervals)
+                              q.granularity, q.filter, q.intervals, t0)
         elif isinstance(q, S.SelectQuerySpec):
             r = self._run_select(q)
         elif isinstance(q, S.SearchQuerySpec):
@@ -439,7 +491,7 @@ class QueryEngine:
     # -- aggregation path -----------------------------------------------------
     def _run_agg(self, q, dimensions: List[S.DimensionSpec], aggregations,
                  post_aggregations, having, limit, granularity, filter_spec,
-                 intervals) -> QueryResult:
+                 intervals, t0: Optional[float] = None) -> QueryResult:
         ds = self.store.get(q.datasource)
         seg_idx = ds.prune_segments(intervals)
         gran_kind = granularity.kind if granularity else "all"
@@ -472,7 +524,11 @@ class QueryEngine:
 
         prog_fn, unpack = prog
         dev_arrays = self._bind_arrays(ds, names, seg_idx, s_pad, sharded)
+        if t0 is not None:
+            self._stage_check(q, t0)  # pre-dispatch boundary
         out = unpack(np.asarray(prog_fn(dev_arrays)))
+        if t0 is not None:
+            self._stage_check(q, t0)  # post-device boundary
 
         # --- decode -----------------------------------------------------------
         rows = out["__rows__"]

@@ -187,6 +187,11 @@ class Builder:
         specs: List[S.FilterSpec] = []
         tcol = self.ds.time.name if self.ds.time is not None else None
         for c in conjuncts:
+            if isinstance(c, E.Literal):
+                if c.value is True:
+                    continue  # inlined EXISTS etc. — constant true
+                specs.append(S.ExprFilter(E.Literal(False)))
+                continue
             if tcol is not None and self._try_interval(c, tcol, acc):
                 continue
             specs.append(self.to_filter(c))
@@ -300,6 +305,9 @@ class Builder:
             return None
         kind = self._col_kind(l.name)
         v = r.value
+        if v is None and op != "=":
+            # NULL comparison is three-valued-unknown -> matches nothing
+            return S.ExprFilter(E.Literal(False))
         if kind == ColumnKind.TIME:
             return None  # handled via intervals or ExprFilter
         numeric = kind in (ColumnKind.LONG, ColumnKind.DOUBLE)

@@ -26,8 +26,22 @@ class HostExecError(Exception):
     pass
 
 
+# SQL-queryable metadata views (≈ DruidMetadataViews.metadataDFs — the
+# reference exposes druidrelations/druidservers/druidsegments as resolvable
+# tables via a catalog hook, SPLSessionState.scala:67-74)
+SYS_VIEWS = {
+    "sys_datasources": lambda ctx: ctx.catalog.datasources_view(),
+    "sys_segments": lambda ctx: ctx.catalog.segments_view(),
+    "sys_columns": lambda ctx: ctx.catalog.columns_view(),
+    "sys_queries": lambda ctx: pd.DataFrame(
+        [r.to_dict() for r in ctx.history.entries()]),
+}
+
+
 def datasource_frame(ctx, name: str) -> pd.DataFrame:
     from spark_druid_olap_tpu.parallel.executor import _host_column_values
+    if name in SYS_VIEWS and name not in ctx.store.names():
+        return SYS_VIEWS[name](ctx)
     ds = ctx.store.get(name)
     data = {c: _host_column_values(ds, c, None) for c in ds.column_names()}
     return pd.DataFrame(data)
@@ -37,6 +51,8 @@ def datasource_frame(ctx, name: str) -> pd.DataFrame:
 
 def relation_columns(ctx, rel: A.Relation) -> List[str]:
     if isinstance(rel, A.TableRef):
+        if rel.name in SYS_VIEWS and rel.name not in ctx.store.names():
+            return list(SYS_VIEWS[rel.name](ctx).columns)
         return list(ctx.store.get(rel.name).column_names())
     if isinstance(rel, A.SubqueryRef):
         return select_output_names(ctx, rel.query)

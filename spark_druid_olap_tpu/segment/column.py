@@ -152,12 +152,22 @@ def build_dim_column(name: str, raw: np.ndarray,
 
     When ``dictionary`` is given (the datasource-global dictionary built at
     ingest), codes are looked up against it; otherwise a fresh sorted
-    dictionary is built from this chunk.
+    dictionary is built from this chunk. The no-null fresh-dictionary case
+    takes the native C++ encoder when available.
     """
+    if dictionary is None:
+        from spark_druid_olap_tpu.segment import native
+        fast = native.encode_strings(raw)
+        if fast is not None:
+            d, codes = fast
+            return DimColumn(name=name, dictionary=d, codes=codes,
+                             validity=None)
     raw = np.asarray(raw, dtype=object)
-    # pandas-style null detection: None or float nan
-    validity = np.array([not (v is None or (isinstance(v, float) and np.isnan(v)))
-                         for v in raw], dtype=bool)
+    # pandas-style null detection: None, float nan, or pd.NA
+    validity = np.array(
+        [not (v is None or (isinstance(v, float) and np.isnan(v))
+              or type(v).__name__ == "NAType")
+         for v in raw], dtype=bool)
     has_null = not validity.all()
     safe = np.where(validity, raw, "")
     safe = safe.astype(str)

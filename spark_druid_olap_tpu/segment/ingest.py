@@ -88,9 +88,8 @@ def ingest_dataframe(
 
     dims = {}
     mets = {}
-    for col in df.columns:
-        if time_column is not None and col == time_column:
-            continue
+
+    def encode_one(col):
         series = df[col]
         kind = infer_kind(series)
         if dim_names is not None and col in dim_names:
@@ -101,20 +100,38 @@ def ingest_dataframe(
         elif col in metric_kinds:
             kind = metric_kinds[col]
         if kind == ColumnKind.DIM:
-            raw = series.to_numpy(dtype=object)
             if dim_names is not None and col in dim_names and \
                     infer_kind(series) != ColumnKind.DIM:
+                raw = series.to_numpy(dtype=object)
                 raw = np.array([None if v is None else str(v) for v in raw],
                                dtype=object)
-            dims[col] = build_dim_column(col, raw)
-        elif kind == ColumnKind.DATE:
+                return col, build_dim_column(col, raw)
+            # pass the Series: the native path converts via arrow zero-copy
+            return col, build_dim_column(col, series)
+        if kind == ColumnKind.DATE:
             ms = _to_epoch_millis(series)
             days = np.floor_divide(ms, 86_400_000).astype(np.int32)
             from spark_druid_olap_tpu.segment.column import MetricColumn
-            mets[col] = MetricColumn(name=col, values=days, validity=None,
+            return col, MetricColumn(name=col, values=days, validity=None,
                                      kind=ColumnKind.DATE)
+        return col, build_metric_column(col, series.to_numpy(), kind)
+
+    columns = [c for c in df.columns
+               if not (time_column is not None and c == time_column)]
+    # the native encoder releases the GIL, so columns encode in parallel
+    from spark_druid_olap_tpu.segment import native as _native
+    if _native.load() is not None and len(columns) > 1:
+        import concurrent.futures as cf
+        with cf.ThreadPoolExecutor(max_workers=min(8, len(columns))) as ex:
+            results = list(ex.map(encode_one, columns))
+    else:
+        results = [encode_one(c) for c in columns]
+    from spark_druid_olap_tpu.segment.column import DimColumn
+    for col, built in results:
+        if isinstance(built, DimColumn):
+            dims[col] = built
         else:
-            mets[col] = build_metric_column(col, series.to_numpy(), kind)
+            mets[col] = built
 
     segments = []
     if n > 0:

@@ -1,0 +1,120 @@
+"""Loader + Python bindings for the native ingest kernels.
+
+Builds ``native/segment_encoder.cpp`` into a CPython extension on first use
+(g++, cached as a .so beside the source; rebuilt when the source is newer)
+and exposes :func:`encode_strings` — the fast path of
+``segment.column.build_dim_column``. Arrow handles object->buffer conversion
+(C++ inside pyarrow); our extension does the sort/unique/encode with the GIL
+released, so the ingest thread pool encodes columns in parallel.
+
+When the toolchain or pyarrow is unavailable, callers fall back to the numpy
+path (same results, slower) — mirroring how the framework gates every
+optional fast path.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("sdot.native")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "segment_encoder.cpp")
+_SO = os.path.join(_NATIVE_DIR, "_sdot_native.so")
+
+_lock = threading.Lock()
+_module = None
+_tried = False
+
+
+def _build() -> bool:
+    inc = sysconfig.get_path("include")
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+           f"-I{inc}", _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        log.warning("native build failed (%s); using numpy ingest path", e)
+        return False
+
+
+def load():
+    """Returns the native module or None."""
+    global _module, _tried
+    with _lock:
+        if _module is not None or _tried:
+            return _module
+        _tried = True
+        if not os.path.exists(_SRC):
+            return None
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            if not _build():
+                return None
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location("_sdot_native", _SO)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _module = mod
+        except Exception as e:  # noqa: BLE001
+            log.warning("native load failed (%s)", e)
+            _module = None
+        return _module
+
+
+def encode_strings(raw) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Fast path: string column (numpy object array or pandas Series — a
+    pandas Arrow-backed Series converts zero-copy) -> (dictionary object
+    array sorted ascending, int32 codes). None when unavailable/ineligible."""
+    mod = load()
+    if mod is None:
+        return None
+    try:
+        import pyarrow as pa
+    except ImportError:
+        return None
+    try:
+        if isinstance(raw, np.ndarray):
+            arr = pa.array(raw, type=pa.string())
+        else:  # pandas Series: zero-copy for arrow-backed string dtypes
+            arr = pa.Array.from_pandas(raw)
+            if pa.types.is_large_string(arr.type):
+                if arr.nbytes < (1 << 31) - 1:
+                    arr = arr.cast(pa.string())
+                else:
+                    return None
+            elif not pa.types.is_string(arr.type):
+                arr = arr.cast(pa.string())
+    except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
+        return None
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if arr.null_count:
+        return None
+    bufs = arr.buffers()  # [validity, offsets, data]
+    offsets = bufs[1]
+    data = bufs[2] if bufs[2] is not None else b""
+    if arr.offset != 0:
+        arr = pa.concat_arrays([arr])  # realign
+        bufs = arr.buffers()
+        offsets = bufs[1]
+        data = bufs[2] if bufs[2] is not None else b""
+    codes_b, dict_data, dict_off_b = mod.encode_utf8(data, offsets)
+    codes = np.frombuffer(codes_b, dtype=np.int32).copy()
+    dict_offsets = np.frombuffer(dict_off_b, dtype=np.int32)
+    k = len(dict_offsets) - 1
+    dict_arr = pa.StringArray.from_buffers(
+        k, pa.py_buffer(dict_off_b), pa.py_buffer(dict_data))
+    dictionary = np.asarray(dict_arr.to_pandas(), dtype=object)
+    return dictionary, codes

@@ -1,0 +1,229 @@
+"""HTTP serving layer — the thriftserver equivalent.
+
+≈ the reference's L7: ``HiveThriftServer2.scala`` fronts the engine for BI
+tools over JDBC/ODBC, with a query-history UI tab and SQL-visible metadata
+views. Here the endpoint is HTTP:
+
+- ``POST /sql``           {"sql": "...", "format": "json"|"arrow"} -> rows
+- ``POST /query``         raw engine query-spec JSON (≈ ON DATASOURCE ...
+                          EXECUTE QUERY) with {"dataSource": ...}
+- ``POST /sql/cancel``    {"queryId": "..."} -> cooperative cancel
+- ``GET  /explain?sql=``  rewrite + cost explanation (≈ EXPLAIN REWRITE)
+- ``GET  /status``        liveness + device inventory
+- ``GET  /metadata/datasources|segments|columns``  catalog views
+- ``GET  /history``       query history (≈ the Druid-queries UI tab)
+
+The Arrow IPC-stream response format is the binary wire analog of the
+reference's Jackson **Smile** protocol (``SmileJson4sScalaModule.scala``):
+same role — compact columnar results for programmatic clients — chosen
+because Arrow is the TPU-era lingua franca for columnar interchange.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import traceback
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+import pandas as pd
+
+
+def _df_to_json_rows(df: pd.DataFrame) -> bytes:
+    def conv(v):
+        if isinstance(v, (np.integer,)):
+            return int(v)
+        if isinstance(v, (np.floating,)):
+            f = float(v)
+            return None if f != f else f
+        if isinstance(v, (np.datetime64, pd.Timestamp)):
+            return pd.Timestamp(v).isoformat()
+        if v is None or v is pd.NaT:
+            return None
+        return v
+
+    rows = [{c: conv(v) for c, v in zip(df.columns, row)}
+            for row in df.itertuples(index=False, name=None)]
+    return json.dumps({"columns": list(df.columns), "rows": rows,
+                       "numRows": len(df)}).encode()
+
+
+def _df_to_arrow(df: pd.DataFrame) -> bytes:
+    import io
+    import pyarrow as pa
+    table = pa.Table.from_pandas(df, preserve_index=False)
+    buf = io.BytesIO()
+    with pa.ipc.new_stream(buf, table.schema) as w:
+        w.write_table(table)
+    return buf.getvalue()
+
+
+class SqlServer:
+    """Embeds a Context behind a threading HTTP server."""
+
+    def __init__(self, ctx, host: str = "127.0.0.1", port: int = 8082):
+        self.ctx = ctx
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        # serialize engine access: one query compiles/executes at a time
+        # (≈ the reference's coarse driver-side synchronization)
+        self._lock = threading.Lock()
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self, background: bool = True):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _error(self, code: int, exc: BaseException):
+                body = json.dumps({
+                    "error": type(exc).__name__,
+                    "message": str(exc)}).encode()
+                self._send(code, body)
+
+            def do_GET(self):
+                try:
+                    server._handle_get(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    traceback.print_exc()
+                    self._error(500, e)
+
+            def do_POST(self):
+                try:
+                    server._handle_post(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    traceback.print_exc()
+                    self._error(500, e)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        if background:
+            self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                            daemon=True)
+            self._thread.start()
+        else:
+            self._httpd.serve_forever()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    # -- handlers -------------------------------------------------------------
+    def _handle_get(self, h):
+        url = urlparse(h.path)
+        qs = parse_qs(url.query)
+        if url.path == "/status":
+            import jax
+            body = json.dumps({
+                "status": "ok",
+                "backend": jax.default_backend(),
+                "devices": [str(d) for d in jax.devices()],
+                "datasources": self.ctx.store.names(),
+            }).encode()
+            h._send(200, body)
+            return
+        if url.path == "/explain":
+            sql = qs.get("sql", [""])[0]
+            with self._lock:
+                text = self.ctx.explain(sql)
+            h._send(200, json.dumps({"plan": text.split("\n")}).encode())
+            return
+        if url.path.startswith("/metadata/"):
+            kind = url.path[len("/metadata/"):]
+            views = {"datasources": self.ctx.catalog.datasources_view,
+                     "segments": self.ctx.catalog.segments_view,
+                     "columns": self.ctx.catalog.columns_view}
+            if kind not in views:
+                h._send(404, b'{"error": "unknown metadata view"}')
+                return
+            h._send(200, _df_to_json_rows(views[kind]()))
+            return
+        if url.path == "/history":
+            rows = [r.to_dict() for r in self.ctx.history.entries()]
+            h._send(200, json.dumps({"history": rows},
+                                    default=str).encode())
+            return
+        h._send(404, b'{"error": "not found"}')
+
+    def _read_json(self, h) -> dict:
+        n = int(h.headers.get("Content-Length", "0"))
+        raw = h.rfile.read(n) if n else b"{}"
+        return json.loads(raw.decode())
+
+    def _handle_post(self, h):
+        url = urlparse(h.path)
+        if url.path == "/sql":
+            req = self._read_json(h)
+            sql = req.get("sql")
+            if not sql:
+                h._send(400, b'{"error": "missing \'sql\'"}')
+                return
+            fmt = req.get("format", "json")
+            from spark_druid_olap_tpu.sql.lexer import SqlSyntaxError
+            from spark_druid_olap_tpu.planner.plans import PlanUnsupported
+            try:
+                with self._lock:
+                    r = self.ctx.sql(sql)
+            except SqlSyntaxError as e:
+                h._error(400, e)
+                return
+            except KeyError as e:
+                h._error(404, e)
+                return
+            df = r.to_pandas()
+            if fmt == "arrow":
+                h._send(200, _df_to_arrow(df),
+                        "application/vnd.apache.arrow.stream")
+            else:
+                h._send(200, _df_to_json_rows(df))
+            return
+        if url.path == "/query":
+            req = self._read_json(h)
+            from spark_druid_olap_tpu.ir.serde import query_from_dict
+            q = query_from_dict(req)
+            with self._lock:
+                r = self.ctx.execute(q)
+            h._send(200, _df_to_json_rows(r.to_pandas()))
+            return
+        if url.path == "/sql/cancel":
+            req = self._read_json(h)
+            qid = req.get("queryId", "")
+            ok = self.ctx.engine.cancel(qid)
+            h._send(200, json.dumps({"cancelled": bool(ok)}).encode())
+            return
+        h._send(404, b'{"error": "not found"}')
+
+
+def serve(ctx=None, host="0.0.0.0", port=8082, setup=None):
+    """Blocking entry point (``python -m spark_druid_olap_tpu.server``)."""
+    if ctx is None:
+        import spark_druid_olap_tpu as sdot
+        ctx = sdot.Context()
+    if setup:
+        setup(ctx)
+    print(f"sdot SQL server listening on http://{host}:{port}")
+    SqlServer(ctx, host, port).start(background=False)

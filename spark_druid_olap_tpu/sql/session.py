@@ -85,7 +85,9 @@ def explain_text(ctx, stmt: A.SelectStmt, sql: str) -> str:
 def _run_select(ctx, stmt: A.SelectStmt, sql: str) -> QueryResult:
     t0 = _time.perf_counter()
     try:
-        pq = B.build(ctx, stmt)
+        from spark_druid_olap_tpu.planner.decorrelate import inline_subqueries
+        stmt2 = inline_subqueries(ctx, stmt)
+        pq = B.build(ctx, stmt2)
         df = execute_planned(ctx, pq)
         mode = "engine"
     except (PlanUnsupported, EngineFallback) as e:

@@ -1,0 +1,45 @@
+"""Retry with exponential backoff.
+
+≈ ``RetryUtils.scala``: ``retryOnError(ifException)(name, f)(numTries, start,
+cap)`` — exponential backoff used around flaky operations (in the reference:
+overlord task polling, cluster metadata fetches; here: server-side ingest and
+any external IO)."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Optional, TypeVar
+
+T = TypeVar("T")
+log = logging.getLogger("sdot.retry")
+
+
+def backoff(start: float, cap: float, attempt: int) -> float:
+    return min(cap, start * (2 ** attempt))
+
+
+def retry_on_error(
+    fn: Callable[[], T],
+    name: str = "operation",
+    tries: int = 5,
+    start: float = 0.2,
+    cap: float = 5.0,
+    retryable: Optional[Callable[[BaseException], bool]] = None,
+) -> T:
+    last: Optional[BaseException] = None
+    for attempt in range(tries):
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 — filtered by retryable
+            if retryable is not None and not retryable(e):
+                raise
+            last = e
+            if attempt == tries - 1:
+                break
+            delay = backoff(start, cap, attempt)
+            log.warning("%s failed (attempt %d/%d): %s; retrying in %.2fs",
+                        name, attempt + 1, tries, e, delay)
+            time.sleep(delay)
+    assert last is not None
+    raise last

@@ -1,0 +1,191 @@
+"""Serving layer + aux subsystem tests (≈ reference thriftserver/
+CancelDruidRequestTest/metadata-views suites)."""
+
+import json
+import urllib.request
+import urllib.error
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from conftest import make_sales_df
+
+
+@pytest.fixture(scope="module")
+def server():
+    from spark_druid_olap_tpu.server.http import SqlServer
+    ctx = sdot.Context()
+    ctx.ingest_dataframe("sales", make_sales_df(2000), time_column="ts")
+    s = SqlServer(ctx, port=0).start()
+    yield s
+    s.stop()
+
+
+def _get(server, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}{path}") as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def _post(server, path, payload, raw=False):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        body = r.read()
+        return r.status, body if raw else json.loads(body.decode())
+
+
+def test_status(server):
+    code, body = _get(server, "/status")
+    assert code == 200 and body["status"] == "ok"
+    assert "sales" in body["datasources"]
+
+
+def test_sql_endpoint(server):
+    code, body = _post(server, "/sql", {
+        "sql": "select region, sum(price) as rev from sales "
+               "group by region order by region"})
+    assert code == 200
+    assert body["columns"] == ["region", "rev"]
+    assert body["numRows"] == 4
+
+
+def test_sql_arrow_format(server):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/sql",
+        data=json.dumps({"sql": "select count(*) as c from sales",
+                         "format": "arrow"}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        assert r.headers["Content-Type"] == \
+            "application/vnd.apache.arrow.stream"
+        import io
+        import pyarrow as pa
+        table = pa.ipc.open_stream(io.BytesIO(r.read())).read_all()
+    assert table.num_rows == 1
+    assert table.column("c")[0].as_py() == 2000
+
+
+def test_raw_query_endpoint(server):
+    code, body = _post(server, "/query", {
+        "queryType": "topN", "dataSource": "sales",
+        "dimension": {"dimension": "region", "outputName": "region"},
+        "metric": "rev", "threshold": 2,
+        "aggregations": [{"type": "doublesum", "name": "rev",
+                          "fieldName": "price"}]})
+    assert code == 200 and body["numRows"] == 2
+
+
+def test_explain_endpoint(server):
+    code, body = _get(server, "/explain?sql=select%20count(*)%20from%20sales")
+    assert code == 200
+    assert any("pushdown: YES" in line for line in body["plan"])
+
+
+def test_metadata_and_history(server):
+    code, body = _get(server, "/metadata/datasources")
+    assert code == 200 and body["rows"][0]["name"] == "sales"
+    code, body = _get(server, "/metadata/columns")
+    assert any(r["column"] == "region" for r in body["rows"])
+    code, body = _get(server, "/history")
+    assert code == 200 and len(body["history"]) >= 1
+
+
+def test_sql_error_handling(server):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/sql",
+        data=json.dumps({"sql": "SELEC nope"}).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 400
+    body = json.loads(ei.value.read().decode())
+    assert body["error"] == "SqlSyntaxError"
+
+
+def test_sys_views_in_sql():
+    ctx = sdot.Context()
+    ctx.ingest_dataframe("sales", make_sales_df(1000), time_column="ts")
+    r = ctx.sql("select name, numRows from sys_datasources").to_pandas()
+    assert list(r["name"]) == ["sales"]
+    assert int(r["numRows"][0]) == 1000
+    ctx.sql("select count(*) as c from sales")
+    r = ctx.sql("select queryType from sys_queries").to_pandas()
+    assert len(r) >= 1
+
+
+def test_query_timeout():
+    from spark_druid_olap_tpu.ir.spec import (
+        AggregationSpec, QueryContext, TimeseriesQuerySpec,
+    )
+    from spark_druid_olap_tpu.parallel.executor import QueryTimeout
+    ctx = sdot.Context()
+    ctx.ingest_dataframe("sales", make_sales_df(1000), time_column="ts")
+    q = TimeseriesQuerySpec(
+        "sales", (AggregationSpec("count", "c"),),
+        context=QueryContext(query_id="t1", timeout_millis=0))
+    with pytest.raises(QueryTimeout):
+        ctx.engine.execute(q)
+
+
+def test_query_cancel_flag():
+    from spark_druid_olap_tpu.ir.spec import (
+        AggregationSpec, QueryContext, TimeseriesQuerySpec,
+    )
+    from spark_druid_olap_tpu.parallel.executor import QueryCancelled
+    import threading
+    ctx = sdot.Context()
+    ctx.ingest_dataframe("sales", make_sales_df(1000), time_column="ts")
+    # pre-set the cancel flag, then execute: first stage boundary raises
+    ev = threading.Event()
+    ev.set()
+    ctx.engine._cancel_flags["c1"] = ev
+    q = TimeseriesQuerySpec(
+        "sales", (AggregationSpec("count", "c"),),
+        context=QueryContext(query_id="c1"))
+    with pytest.raises(QueryCancelled):
+        ctx.engine.execute(q)
+
+
+def test_retry_utils():
+    from spark_druid_olap_tpu.utils.retry import retry_on_error
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_on_error(flaky, tries=5, start=0.001) == "ok"
+    assert len(calls) == 3
+    with pytest.raises(ValueError):
+        retry_on_error(lambda: (_ for _ in ()).throw(ValueError("no")),
+                       tries=2, start=0.001,
+                       retryable=lambda e: isinstance(e, OSError))
+
+
+def test_subquery_inlining_pushdown():
+    """Uncorrelated scalar/IN subqueries inline -> outer query still pushes
+    down (≈ TPC-H Q11/Q15 pattern)."""
+    ctx = sdot.Context()
+    df = make_sales_df(5000)
+    ctx.ingest_dataframe("sales", df, time_column="ts")
+    r = ctx.sql("select region, count(*) as cnt from sales "
+                "where qty > (select avg(qty) from sales) "
+                "group by region order by region")
+    assert ctx.history.entries()[-1].stats["mode"] == "engine"
+    thresh = df.qty.mean()
+    want = df[df.qty > thresh].groupby("region").size()
+    got = dict(zip(r["region"], r["cnt"]))
+    assert got == dict(want)
+    # IN subquery
+    r = ctx.sql("select count(*) as c from sales where product in "
+                "(select distinct product from sales where price > 990)")
+    assert ctx.history.entries()[-1].stats["mode"] == "engine"
+    prods = set(df[df.price > np.float32(990)]["product"])
+    assert int(r["c"][0]) == int(df["product"].isin(prods).sum())

@@ -231,7 +231,7 @@ def test_sql_select_distinct(ctx, sales):
     assert list(got["region"]) == sorted(sales.region.unique())
 
 
-def test_sql_host_fallback_subquery(ctx, sales):
+def test_sql_uncorrelated_subquery_inlines(ctx, sales):
     got = ctx.sql("select region, count(*) as cnt from sales "
                   "where qty > (select avg(qty) from sales) "
                   "group by region order by region").to_pandas()
@@ -239,7 +239,24 @@ def test_sql_host_fallback_subquery(ctx, sales):
     want = sales[sales.qty > thresh].groupby("region", as_index=False) \
         .agg(cnt=("qty", "size")).sort_values("region").reset_index(drop=True)
     assert_frames_equal(got, want, sort_by=None)
+    # uncorrelated scalar subquery inlines; outer query pushes down
+    assert ctx.history.entries()[-1].stats["mode"] == "engine"
+
+
+def test_sql_correlated_subquery_host(ctx, sales):
+    import pandas as pd
+    ctx.ingest_dataframe("regiondim", pd.DataFrame({
+        "region_name": ["east", "west", "north", "south"],
+        "min_qty": [10, 20, 30, 40]}))
+    got = ctx.sql(
+        "select region_name from regiondim where "
+        "(select count(*) from sales where region = region_name "
+        " and qty >= min_qty) > 1000 order by region_name").to_pandas()
     assert ctx.history.entries()[-1].stats["mode"].startswith("host")
+    want = [rn for rn, mq in [("east", 10), ("north", 30), ("south", 40),
+                              ("west", 20)]
+            if ((sales.region == rn) & (sales.qty >= mq)).sum() > 1000]
+    assert list(got["region_name"]) == want
 
 
 def test_sql_explain(ctx):
