@@ -501,6 +501,28 @@ class QueryEngine:
             names += [d.output_name for d in dimensions]
             names += [a.name for a in aggregations]
             names += [p.name for p in post_aggregations]
+            if not dimensions and gran_kind == "all":
+                # global aggregate over an empty/pruned scan still yields the
+                # one identity row (same semantics as the global_empty path
+                # below)
+                data = {}
+                for a in aggregations:
+                    kind = _AGG_KIND.get(a.kind, ("sum", None))[0]
+                    data[a.name] = np.array([0], dtype=np.int64) \
+                        if kind in ("count", "hll") else np.array([np.nan])
+                for p in post_aggregations:
+                    v = np.asarray(host_eval.eval_expr(p.expr, data))
+                    data[p.name] = np.broadcast_to(v, (1,)) if v.ndim == 0 \
+                        else v
+                if having is not None:
+                    keep = np.asarray(
+                        host_eval.eval_expr(having.expr, data), dtype=bool)
+                    data = {k: v[keep] for k, v in data.items()}
+                self.last_stats.update({
+                    "datasource": ds.name, "segments": 0, "sharded": False,
+                    "groups": int(len(next(iter(data.values()))))
+                    if data else 0, "rows_scanned": 0})
+                return QueryResult(names, data)
             return QueryResult.empty(names)
 
         all_dim_plans, agg_plans, min_day, max_day, n_keys, names = \
@@ -533,6 +555,13 @@ class QueryEngine:
         # --- decode -----------------------------------------------------------
         rows = out["__rows__"]
         sel = np.nonzero(rows > 0)[0]
+        # a GLOBAL aggregate (no dims, no time bucketing) over zero matching
+        # rows yields ONE identity row — SQL semantics (and Druid's default
+        # timeseries behavior, minus its sum-is-0 quirk: we emit NULL sums)
+        global_empty = (not all_dim_plans and gran_kind == "all"
+                        and len(sel) == 0)
+        if global_empty:
+            sel = np.zeros(1, dtype=np.int64)
         data: Dict[str, np.ndarray] = {}
         columns: List[str] = []
         if all_dim_plans:
@@ -567,6 +596,10 @@ class QueryEngine:
                 else:
                     data[name] = v.astype(np.float64)
             columns.append(name)
+        if global_empty:
+            for p in agg_plans:
+                if p.kind in ("sum", "min", "max"):
+                    data[p.spec.name] = np.array([np.nan])
 
         # --- post aggregations / having / limit (host epilogue) --------------
         for pa in post_aggregations:

@@ -163,6 +163,12 @@ class Builder:
             if c in join_conds:
                 raise PlanUnsupported(
                     f"non-star join condition {E.to_sql(c)}")
+        if star.fact_table not in tables:
+            # a dim-only join has dim-table grain; folding it onto the flat
+            # fact would change row multiplicity (the reference likewise
+            # anchors every rewrite at the fact DruidRelation leaf,
+            # JoinTransform.scala:305-385)
+            raise PlanUnsupported("join does not include the fact table")
         if not star.is_star_join(set(tables), eq_pairs):
             raise PlanUnsupported("join tree is not a sub-star of the "
                                   "declared star schema")

@@ -12,6 +12,7 @@ aggregates, grouping sets, distinct, order/limit.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -128,9 +129,10 @@ def resolve_subqueries(ctx, e: E.Expr, env: Dict[str, np.ndarray],
                        outer_env: Optional[dict] = None) -> E.Expr:
     """Replace subquery nodes with literal values/lists/flags.
 
-    Uncorrelated subqueries execute once. Correlated ones evaluate row-wise
-    against ``env`` (slow path; decorrelation is future work — the reference
-    likewise leaves these to Spark)."""
+    Uncorrelated subqueries execute once. Equality-correlated ones are
+    decorrelated into one grouped/semi-joined inner execution; the rest
+    evaluate row-wise (slow path — the reference likewise leaves these to
+    Spark)."""
     subs = list(_subquery_nodes(e))
     if not subs:
         return e
@@ -147,6 +149,10 @@ def resolve_subqueries(ctx, e: E.Expr, env: Dict[str, np.ndarray],
                     (outer_env is not None and f in outer_env)}
             if not free:
                 val = _execute_sub_once(ctx, node, outer_env)
+                return val
+            val = _execute_sub_decorrelated(ctx, node, env, free, n_rows,
+                                            outer_env)
+            if val is not None:
                 return val
             return _execute_sub_rowwise(ctx, node, env, free, n_rows,
                                         outer_env)
@@ -169,6 +175,253 @@ def _execute_sub_once(ctx, node, outer_env):
 
 
 _PrecomputedColumn = host_eval.Precomputed
+
+
+def _expr_refs(ctx, e) -> set:
+    """Column names referenced by ``e``, including the *free* columns of any
+    nested subquery (a nested subquery's own columns are not references)."""
+    refs = set()
+    for n in E.walk(e):
+        if isinstance(n, E.Column) and n.name != "*":
+            refs.add(n.name)
+        elif isinstance(n, (A.ScalarSubquery, A.Exists, A.InSubquery)):
+            refs.update(_free_columns(ctx, n.query))
+    return refs
+
+
+def _has_subquery(e) -> bool:
+    return any(True for _ in _subquery_nodes(e))
+
+
+def _relation_free_refs(ctx, rel) -> set:
+    """Free/outer references made from inside a FROM clause."""
+    if rel is None or isinstance(rel, A.TableRef):
+        return set()
+    if isinstance(rel, A.SubqueryRef):
+        return _free_columns(ctx, rel.query)
+    if isinstance(rel, A.Join):
+        r = _relation_free_refs(ctx, rel.left) | \
+            _relation_free_refs(ctx, rel.right)
+        if rel.condition is not None:
+            r |= _expr_refs(ctx, rel.condition)
+        return r
+    return set()
+
+
+def _outer_key_array(env, outer_env, name, n_rows):
+    if name in env:
+        v = np.asarray(env[name])
+        return v if v.ndim > 0 else np.broadcast_to(v, (n_rows,))
+    v = (outer_env or {}).get(name)
+    if isinstance(v, np.ndarray) and v.ndim > 0:
+        return None  # array from a different scope; length unknown — bail
+    return np.full(n_rows, v, dtype=object) if isinstance(v, str) else \
+        np.broadcast_to(np.asarray(v), (n_rows,))
+
+
+def _align_key(left: pd.Series, right: pd.Series):
+    """Promote two merge-key columns to a common dtype so pandas joins them."""
+    lk, rk = left.to_numpy(), right.to_numpy()
+    if lk.dtype == object or rk.dtype == object:
+        return left.astype(object), right.astype(object)
+    if lk.dtype != rk.dtype:
+        try:
+            t = np.result_type(lk.dtype, rk.dtype)
+            return left.astype(t), right.astype(t)
+        except TypeError:
+            return left.astype(object), right.astype(object)
+    return left, right
+
+
+def _execute_sub_decorrelated(ctx, node, env, free, n_rows, outer_env):
+    """Vectorized correlated-subquery evaluation.
+
+    Classic decorrelation: when every outer reference occurs only in
+    top-level equality conjuncts of the inner WHERE (plus, for EXISTS/IN,
+    residual predicates over plain inner columns), run the inner query ONCE —
+    grouped by (for scalar aggregates) or projected onto (for EXISTS/IN) the
+    correlation keys — then join the result back to the outer rows. The
+    reference leaves correlated subqueries to Spark, whose optimizer performs
+    the same rewrite (``RewriteCorrelatedScalarSubquery``); this is our host
+    analog. Returns a ``Precomputed`` column or ``None`` to fall back to the
+    row-wise path.
+    """
+    q = node.query
+    if q.relation is None or q.limit is not None or q.having is not None:
+        return None
+    if _relation_free_refs(ctx, q.relation) & free:
+        return None
+    aggs = []
+    for item in q.items:
+        if item.expr != "*":
+            aggs.extend(E.agg_calls_in(item.expr))
+    is_scalar = isinstance(node, A.ScalarSubquery)
+    if is_scalar:
+        if len(q.items) != 1 or q.items[0].expr == "*" or not aggs \
+                or q.group_by is not None or q.distinct:
+            return None
+        if _expr_refs(ctx, q.items[0].expr) & free:
+            return None
+    else:
+        if q.group_by is not None or aggs:
+            return None
+        if isinstance(node, A.InSubquery):
+            if not q.items or q.items[0].expr == "*" or \
+                    _expr_refs(ctx, q.items[0].expr) & free or \
+                    _has_subquery(q.items[0].expr):
+                return None
+    try:
+        inner_cols = set(relation_columns(ctx, q.relation))
+    except Exception:
+        return None
+    # classify WHERE conjuncts
+    join_pairs = []        # (free col name, inner key expr)
+    inner_conjs = []       # pushed into the single inner execution
+    residual_conjs = []    # evaluated post-join (EXISTS/IN only)
+    for c in _split_conjuncts(q.where):
+        refs = _expr_refs(ctx, c)
+        fref = refs & free
+        if not fref:
+            inner_conjs.append(c)
+            continue
+        pair = None
+        if isinstance(c, E.Comparison) and c.op == "=" and \
+                not _has_subquery(c):
+            for a, b in ((c.left, c.right), (c.right, c.left)):
+                if isinstance(a, E.Column) and a.name in free:
+                    brefs = _expr_refs(ctx, b)
+                    if not (brefs & free) and brefs <= inner_cols:
+                        pair = (a.name, b)
+                        break
+        if pair is not None:
+            join_pairs.append(pair)
+            continue
+        if is_scalar:
+            return None        # scalar aggs need pure equality correlation
+        rrefs = refs - free
+        if not (rrefs <= inner_cols) or _has_subquery(c):
+            return None
+        residual_conjs.append(c)
+    if not join_pairs:
+        return None
+
+    inner_where = None
+    for c in inner_conjs:
+        inner_where = c if inner_where is None else E.And((inner_where, c))
+
+    jk_cols = [f"__jk{j}" for j in range(len(join_pairs))]
+    items = [A.SelectItem(b, jk_cols[j])
+             for j, (_, b) in enumerate(join_pairs)]
+    residual_cols = sorted(set().union(
+        *[_expr_refs(ctx, c) - free for c in residual_conjs])) \
+        if residual_conjs else []
+    for rc in residual_cols:
+        items.append(A.SelectItem(E.Column(rc), rc))
+    if is_scalar:
+        items.append(A.SelectItem(q.items[0].expr, "__val"))
+        q2 = dataclasses.replace(
+            q, items=tuple(items), where=inner_where,
+            group_by=tuple(b for _, b in join_pairs), having=None,
+            order_by=(), limit=None)
+    else:
+        if isinstance(node, A.InSubquery):
+            items.append(A.SelectItem(q.items[0].expr, "__inval"))
+        q2 = dataclasses.replace(
+            q, items=tuple(items), where=inner_where, group_by=None,
+            having=None, order_by=(), limit=None, distinct=False)
+    try:
+        df2 = execute_select(ctx, q2, outer_env=outer_env)
+    except (HostExecError, host_eval.HostEvalError):
+        return None
+
+    # outer side
+    outer = {}
+    for j, (f, _) in enumerate(join_pairs):
+        arr = _outer_key_array(env, outer_env, f, n_rows)
+        if arr is None:
+            return None
+        outer[f"__ok{j}"] = arr
+    ok_cols = list(outer.keys())
+    if isinstance(node, A.InSubquery):
+        ch = host_eval.eval_expr(
+            resolve_subqueries(ctx, node.child, env, outer_env), env)
+        ch = np.asarray(ch)
+        outer["__okv"] = ch if ch.ndim > 0 else \
+            np.broadcast_to(ch, (n_rows,))
+        ok_cols.append("__okv")
+    res_free = set().union(
+        *[_expr_refs(ctx, c) & free for c in residual_conjs]) \
+        if residual_conjs else set()
+    for f in sorted(res_free):
+        arr = _outer_key_array(env, outer_env, f, n_rows)
+        if arr is None:
+            return None
+        outer[f"__of_{f}"] = arr
+    odf = pd.DataFrame(outer)
+    odf["__oidx"] = np.arange(n_rows)
+
+    right_keys = list(jk_cols) + (["__inval"]
+                                  if isinstance(node, A.InSubquery) else [])
+    # NULL never equi-matches (pandas merge would pair NaN with NaN): drop
+    # NULL-keyed inner rows; NULL-keyed outer rows then simply never match
+    if len(df2):
+        df2 = df2[~df2[right_keys].isna().any(axis=1)]
+    for lc, rc in zip(ok_cols, right_keys):
+        odf[lc], df2[rc] = _align_key(odf[lc], df2[rc])
+
+    if is_scalar:
+        merged = odf.merge(df2, left_on=ok_cols, right_on=right_keys,
+                           how="left", sort=False, indicator=True)
+        merged = merged.drop_duplicates("__oidx").sort_values("__oidx")
+        vals = merged["__val"].to_numpy()
+        # an outer row with no matching group still sees the inner GLOBAL
+        # aggregate's one identity row: evaluate the select expression over
+        # the empty group (count->0, sum/min/max/avg->NULL)
+        unmatched = (merged["_merge"] == "left_only").to_numpy()
+        if unmatched.any():
+            fill = _empty_group_value(q.items[0].expr)
+            vals = vals.copy()
+            vals[unmatched] = fill
+        return _PrecomputedColumn(vals)
+
+    merged = odf.merge(df2, left_on=ok_cols, right_on=right_keys,
+                       how="inner", sort=False)
+    if residual_conjs:
+        menv = {}
+        for j, (f, _) in enumerate(join_pairs):
+            menv[f] = merged[f"__ok{j}"].to_numpy()
+        for f in res_free:
+            menv[f] = merged[f"__of_{f}"].to_numpy()
+        for rc in residual_cols:
+            menv[rc] = merged[rc].to_numpy()
+        mask = np.ones(len(merged), dtype=bool)
+        for c in residual_conjs:
+            mask &= np.asarray(host_eval.eval_expr(c, menv), dtype=bool)
+        merged = merged[mask]
+    flags = np.zeros(n_rows, dtype=bool)
+    if len(merged):
+        flags[merged["__oidx"].unique()] = True
+    negated = getattr(node, "negated", False)
+    flags = flags ^ negated
+    if isinstance(node, A.InSubquery):
+        # NULL IN (...) and NULL NOT IN (...) are both UNKNOWN -> false
+        nan_child = pd.isna(pd.Series(outer["__okv"])).to_numpy()
+        flags = flags & ~nan_child
+    return _PrecomputedColumn(flags)
+
+
+def _empty_group_value(expr):
+    """Value of a scalar-aggregate select expression over zero input rows
+    (count -> 0, other aggregates -> NULL, then the surrounding arithmetic)."""
+    def rep(n):
+        if isinstance(n, E.AggCall):
+            return E.Literal(0 if n.fn == "count" else None)
+        return n
+    try:
+        v = host_eval.eval_expr(E.transform(expr, rep), {})
+        return v.item() if isinstance(v, np.generic) else v
+    except Exception:
+        return None
 
 
 def _execute_sub_rowwise(ctx, node, env, free, n_rows, outer_env):
@@ -238,11 +491,35 @@ def materialize_relation(ctx, rel: A.Relation,
                     continue
             residual.append(c)
         how = {"inner": "inner", "left": "left", "cross": "cross"}[rel.kind]
+        if how == "left" and residual:
+            # an outer join's ON residual filters the match, not the output:
+            # right-only predicates pre-filter the right side (the null
+            # extension survives); mixed-side residuals are unsupported
+            kept = []
+            for c in residual:
+                cols = E.columns_in(c)
+                if cols <= set(right.columns):
+                    renv = {k: right[k].to_numpy() for k in cols}
+                    c2 = resolve_subqueries(ctx, c, renv, outer_env)
+                    m = np.asarray(host_eval.eval_expr(c2, renv), dtype=bool)
+                    right = right[m].reset_index(drop=True)
+                else:
+                    kept.append(c)
+            if kept:
+                raise HostExecError(
+                    "LEFT JOIN with mixed-side non-equi ON condition")
+            residual = []
         if eq_pairs:
             lk = [p[0] for p in eq_pairs]
             rk = [p[1] for p in eq_pairs]
             df = left.merge(right, left_on=lk, right_on=rk, how="inner"
                             if how == "cross" else how)
+        elif how == "left" and len(right) == 0:
+            # ON condition matched nothing on the right: every left row
+            # survives null-extended
+            df = left.copy()
+            for c in right.columns:
+                df[c] = np.nan
         else:
             df = left.merge(right, how="cross")
         if residual:
@@ -324,6 +601,8 @@ def _compute_agg(series_env, df, call: E.AggCall, ctx, outer_env, group_ids,
     else:
         raise HostExecError(f"aggregate {call.fn}")
     full = out.reindex(range(n_groups))
+    if call.fn == "count":
+        full = full.fillna(0)
     return full.to_numpy()
 
 
@@ -454,9 +733,11 @@ def _one_grouping(ctx, stmt, df, env, group_exprs, all_group_exprs, agg_calls,
         n_groups = len(uniques)
     else:
         group_ids = np.zeros(n, dtype=np.int64)
-        n_groups = 1 if n > 0 else 1
+        n_groups = 1
     if n == 0:
-        n_groups = 0
+        # grouped agg over zero rows -> zero groups; GLOBAL agg over zero
+        # rows -> one row (NULL sums, 0 counts) per SQL semantics
+        n_groups = 0 if key_arrays else 1
 
     agg_cols: Dict[str, str] = {}
     gagg = {}

@@ -89,7 +89,8 @@ def generate(sf: float = 0.01, seed: int = 20260729) -> Dict[str, pd.DataFrame]:
         "p_partkey": np.arange(1, n_part + 1, dtype=np.int64),
         "p_name": [f"part {i} "
                    + " ".join(r.choice(["green", "blue", "red", "ivory",
-                                        "magenta", "plum", "puff", "powder"],
+                                        "magenta", "plum", "puff", "powder",
+                                        "forest", "lace"],
                                        3))
                    for i in range(1, n_part + 1)],
         "p_mfgr": [f"Manufacturer#{1 + i % 5}" for i in range(n_part)],
@@ -412,5 +413,213 @@ QUERIES: Dict[str, str] = {
         from lineitem l join part p on l.l_partkey = p.p_partkey
         where l_shipdate >= date '1995-09-01'
               and l_shipdate < date '1995-10-01'
+    """,
+    # -- the remaining TPC-H queries, adapted to the star dialect (ANSI
+    # joins, globally-unique column names per StarSchemaInfo.scala:127-165;
+    # self-joined tables renamed through derived tables). Correlated
+    # subqueries route through the host executor's decorrelation.
+    "q2": """
+        select s_acctbal, s_name, sn_name, p_partkey, p_mfgr, s_address,
+               s_phone, s_comment
+        from part p join partsupp ps on p.p_partkey = ps.ps_partkey
+             join supplier s on s.s_suppkey = ps.ps_suppkey
+             join suppnation n on s.s_nationkey = n.sn_nationkey
+             join suppregion r on n.sn_regionkey = r.sr_regionkey
+        where p_size = 15 and p_type like '%BRASS' and sr_name = 'EUROPE'
+              and ps_supplycost =
+                  (select min(ps_supplycost)
+                   from partsupp join supplier on s_suppkey = ps_suppkey
+                        join suppnation on s_nationkey = sn_nationkey
+                        join suppregion on sn_regionkey = sr_regionkey
+                   where p_partkey = ps_partkey and sr_name = 'EUROPE')
+        order by s_acctbal desc, sn_name, s_name, p_partkey
+        limit 100
+    """,
+    "q4": """
+        select o_orderpriority, count(*) as order_count
+        from orders
+        where o_orderdate >= date '1993-07-01'
+              and o_orderdate < date '1993-10-01'
+              and exists (select 1 from lineitem
+                          where l_orderkey = o_orderkey
+                                and l_commitdate < l_receiptdate)
+        group by o_orderpriority
+        order by o_orderpriority
+    """,
+    "q9": """
+        select sn_name as nation, year(o_orderdate) as o_year,
+               sum(l_extendedprice * (1 - l_discount)
+                   - ps_supplycost * l_quantity) as sum_profit
+        from lineitem l join part p on p.p_partkey = l.l_partkey
+             join supplier s on s.s_suppkey = l.l_suppkey
+             join partsupp ps on ps.ps_partkey = l.l_partkey
+                  and ps.ps_suppkey = l.l_suppkey
+             join orders o on o.o_orderkey = l.l_orderkey
+             join suppnation n on s.s_nationkey = n.sn_nationkey
+        where p_name like '%green%'
+        group by sn_name, year(o_orderdate)
+        order by nation, o_year desc
+    """,
+    "q11": """
+        select ps_partkey, sum(ps_supplycost * ps_availqty) as value
+        from partsupp ps join supplier s on ps.ps_suppkey = s.s_suppkey
+             join suppnation n on s.s_nationkey = n.sn_nationkey
+        where sn_name = 'GERMANY'
+        group by ps_partkey
+        having sum(ps_supplycost * ps_availqty) >
+               (select sum(ps_supplycost * ps_availqty) * 0.0001
+                from partsupp join supplier on ps_suppkey = s_suppkey
+                     join suppnation on s_nationkey = sn_nationkey
+                where sn_name = 'GERMANY')
+        order by value desc
+    """,
+    "q13": """
+        select c_count, count(*) as custdist
+        from (select c_custkey, count(o_orderkey) as c_count
+              from customer left outer join orders
+                   on c_custkey = o_custkey
+                      and o_comment not like '%special%requests%'
+              group by c_custkey) c_orders
+        group by c_count
+        order by custdist desc, c_count desc
+    """,
+    "q15": """
+        select s_suppkey, s_name, s_address, s_phone, total_revenue
+        from supplier s join
+             (select l_suppkey as supplier_no,
+                     sum(l_extendedprice * (1 - l_discount)) as total_revenue
+              from lineitem
+              where l_shipdate >= date '1996-01-01'
+                    and l_shipdate < date '1996-04-01'
+              group by l_suppkey) revenue
+             on s.s_suppkey = supplier_no
+        where total_revenue =
+              (select max(total_revenue2)
+               from (select sum(l_extendedprice * (1 - l_discount))
+                            as total_revenue2
+                     from lineitem
+                     where l_shipdate >= date '1996-01-01'
+                           and l_shipdate < date '1996-04-01'
+                     group by l_suppkey) r2)
+        order by s_suppkey
+    """,
+    "q16": """
+        select p_brand, p_type, p_size,
+               count(distinct ps_suppkey) as supplier_cnt
+        from partsupp ps join part p on p.p_partkey = ps.ps_partkey
+        where p_brand <> 'Brand#45'
+              and p_type not like 'MEDIUM POLISHED%'
+              and p_size in (49, 14, 23, 45, 19, 3, 36, 9)
+              and ps_suppkey not in
+                  (select s_suppkey from supplier
+                   where s_comment like '%Customer%Complaints%')
+        group by p_brand, p_type, p_size
+        order by supplier_cnt desc, p_brand, p_type, p_size
+    """,
+    "q17": """
+        select sum(l_extendedprice) / 7.0 as avg_yearly
+        from lineitem l join part p on p.p_partkey = l.l_partkey
+        where p_brand = 'Brand#23' and p_container = 'MED BOX'
+              and l_quantity < (select 0.2 * avg(l_quantity)
+                                from lineitem
+                                where l_partkey = p_partkey)
+    """,
+    "q18": """
+        select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+               sum(l_quantity) as total_qty
+        from customer c join orders o on c.c_custkey = o.o_custkey
+             join lineitem l on o.o_orderkey = l.l_orderkey
+        where o_orderkey in (select l_orderkey from lineitem
+                             group by l_orderkey
+                             having sum(l_quantity) > 300)
+        group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+        order by o_totalprice desc, o_orderdate
+        limit 100
+    """,
+    "q19": """
+        select sum(l_extendedprice * (1 - l_discount)) as revenue
+        from lineitem l join part p on p.p_partkey = l.l_partkey
+        where (p_brand = 'Brand#12'
+               and p_container in ('SM CASE', 'SM BOX', 'SM PACK', 'SM PKG')
+               and l_quantity >= 1 and l_quantity <= 11
+               and p_size between 1 and 5
+               and l_shipmode in ('AIR', 'REG AIR')
+               and l_shipinstruct = 'DELIVER IN PERSON')
+              or (p_brand = 'Brand#23'
+                  and p_container in ('MED BAG', 'MED BOX', 'MED PKG',
+                                      'MED PACK')
+                  and l_quantity >= 10 and l_quantity <= 20
+                  and p_size between 1 and 10
+                  and l_shipmode in ('AIR', 'REG AIR')
+                  and l_shipinstruct = 'DELIVER IN PERSON')
+              or (p_brand = 'Brand#34'
+                  and p_container in ('LG CASE', 'LG BOX', 'LG PACK',
+                                      'LG PKG')
+                  and l_quantity >= 20 and l_quantity <= 30
+                  and p_size between 1 and 15
+                  and l_shipmode in ('AIR', 'REG AIR')
+                  and l_shipinstruct = 'DELIVER IN PERSON')
+    """,
+    "q20": """
+        select s_name, s_address
+        from supplier s join suppnation n on s.s_nationkey = n.sn_nationkey
+        where sn_name = 'CANADA'
+              and s_suppkey in
+                  (select ps_suppkey from partsupp
+                   where ps_partkey in (select p_partkey from part
+                                        where p_name like '%forest%')
+                         and ps_availqty >
+                             (select 0.5 * sum(l_quantity)
+                              from lineitem
+                              where l_partkey = ps_partkey
+                                    and l_suppkey = ps_suppkey
+                                    and l_shipdate >= date '1994-01-01'
+                                    and l_shipdate < date '1995-01-01'))
+        order by s_name
+    """,
+    "q21": """
+        select s_name, count(*) as numwait
+        from supplier s join lineitem l1 on s.s_suppkey = l1.l_suppkey
+             join orders o on o.o_orderkey = l1.l_orderkey
+             join suppnation n on s.s_nationkey = n.sn_nationkey
+        where o_orderstatus = 'F'
+              and l_receiptdate > l_commitdate
+              and sn_name = 'SAUDI ARABIA'
+              and exists
+                  (select 1
+                   from (select l_orderkey as l2_orderkey,
+                                l_suppkey as l2_suppkey from lineitem) l2
+                   where l2_orderkey = l_orderkey
+                         and l2_suppkey <> l_suppkey)
+              and not exists
+                  (select 1
+                   from (select l_orderkey as l3_orderkey,
+                                l_suppkey as l3_suppkey,
+                                l_receiptdate as l3_receiptdate,
+                                l_commitdate as l3_commitdate
+                         from lineitem) l3
+                   where l3_orderkey = l_orderkey
+                         and l3_suppkey <> l_suppkey
+                         and l3_receiptdate > l3_commitdate)
+        group by s_name
+        order by numwait desc, s_name
+        limit 100
+    """,
+    "q22": """
+        select cntrycode, count(*) as numcust, sum(c_acctbal) as totacctbal
+        from (select substring(c_phone from 1 for 2) as cntrycode, c_acctbal,
+                     c_custkey
+              from customer
+              where substring(c_phone from 1 for 2) in
+                    ('13', '31', '23', '29', '30', '18', '17')
+                    and c_acctbal > (select avg(c_acctbal) from customer
+                                     where c_acctbal > 0.00
+                                           and substring(c_phone from 1 for 2)
+                                               in ('13', '31', '23', '29',
+                                                   '30', '18', '17'))
+                    and not exists (select 1 from orders
+                                    where o_custkey = c_custkey)) custsale
+        group by cntrycode
+        order by cntrycode
     """,
 }

@@ -1,0 +1,281 @@
+"""Full TPC-H 22-query suite — differential tests with independent oracles.
+
+The 13 queries beyond ``test_tpch.py``'s pushdown set exercise the host
+fallback tier: correlated subqueries (decorrelated), LEFT OUTER JOIN, NOT
+IN/NOT EXISTS, derived tables, scalar subqueries in HAVING. Each query is
+checked against a hand-written pandas oracle — a genuinely independent
+implementation, not the host executor itself — extending the reference's
+differential ``cTest`` pattern (AbstractTest.scala:127-143) to the queries
+the reference never attempted.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.tools import tpch
+
+from conftest import assert_frames_equal
+
+SF = 0.002
+
+
+@pytest.fixture(scope="module")
+def env():
+    ctx = sdot.Context()
+    tables, _flat = tpch.setup_context(ctx, sf=SF, target_rows=4096)
+    nr = tpch.nation_region_views(tables)
+    return ctx, tables, nr
+
+
+# -- oracles ------------------------------------------------------------------
+
+def oracle_q2(t, nr):
+    eu = (t["partsupp"]
+          .merge(t["supplier"], left_on="ps_suppkey", right_on="s_suppkey")
+          .merge(nr["suppnation"], left_on="s_nationkey",
+                 right_on="sn_nationkey")
+          .merge(nr["suppregion"], left_on="sn_regionkey",
+                 right_on="sr_regionkey"))
+    eu = eu[eu.sr_name == "EUROPE"]
+    df = t["part"].merge(eu, left_on="p_partkey", right_on="ps_partkey")
+    df = df[(df.p_size == 15) & df.p_type.str.endswith("BRASS")]
+    mins = eu.groupby("ps_partkey").ps_supplycost.min()
+    df = df[df.ps_supplycost == df.p_partkey.map(mins)]
+    df = df.sort_values(["s_acctbal", "sn_name", "s_name", "p_partkey"],
+                        ascending=[False, True, True, True]).head(100)
+    return df[["s_acctbal", "s_name", "sn_name", "p_partkey", "p_mfgr",
+               "s_address", "s_phone", "s_comment"]].reset_index(drop=True)
+
+
+def oracle_q4(t, nr):
+    o = t["orders"]
+    o = o[(o.o_orderdate >= pd.Timestamp("1993-07-01"))
+          & (o.o_orderdate < pd.Timestamp("1993-10-01"))]
+    li = t["lineitem"]
+    ok = li[li.l_commitdate < li.l_receiptdate].l_orderkey.unique()
+    o = o[o.o_orderkey.isin(ok)]
+    res = o.groupby("o_orderpriority").size().reset_index(name="order_count")
+    return res.sort_values("o_orderpriority").reset_index(drop=True)
+
+
+def oracle_q9(t, nr):
+    df = (t["lineitem"]
+          .merge(t["part"], left_on="l_partkey", right_on="p_partkey")
+          .merge(t["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+          .merge(t["partsupp"], left_on=["l_partkey", "l_suppkey"],
+                 right_on=["ps_partkey", "ps_suppkey"])
+          .merge(t["orders"], left_on="l_orderkey", right_on="o_orderkey")
+          .merge(nr["suppnation"], left_on="s_nationkey",
+                 right_on="sn_nationkey"))
+    df = df[df.p_name.str.contains("green")]
+    amount = (df.l_extendedprice * (1 - df.l_discount)
+              - df.ps_supplycost * df.l_quantity)
+    df = df.assign(amount=amount, o_year=df.o_orderdate.dt.year)
+    res = df.groupby(["sn_name", "o_year"], as_index=False).amount.sum()
+    res.columns = ["nation", "o_year", "sum_profit"]
+    return res.sort_values(["nation", "o_year"],
+                           ascending=[True, False]).reset_index(drop=True)
+
+
+def oracle_q11(t, nr):
+    df = (t["partsupp"]
+          .merge(t["supplier"], left_on="ps_suppkey", right_on="s_suppkey")
+          .merge(nr["suppnation"], left_on="s_nationkey",
+                 right_on="sn_nationkey"))
+    df = df[df.sn_name == "GERMANY"]
+    df = df.assign(v=df.ps_supplycost * df.ps_availqty)
+    res = df.groupby("ps_partkey", as_index=False).v.sum()
+    res = res[res.v > df.v.sum() * 0.0001]
+    res.columns = ["ps_partkey", "value"]
+    return res.sort_values("value", ascending=False).reset_index(drop=True)
+
+
+def oracle_q13(t, nr):
+    o = t["orders"]
+    o = o[~o.o_comment.str.contains("special.*requests", regex=True)]
+    m = t["customer"].merge(o, left_on="c_custkey", right_on="o_custkey",
+                            how="left")
+    cc = m.groupby("c_custkey").o_orderkey.count().reset_index(name="c_count")
+    res = cc.groupby("c_count").size().reset_index(name="custdist")
+    return res.sort_values(["custdist", "c_count"],
+                           ascending=[False, False]).reset_index(drop=True)
+
+
+def oracle_q15(t, nr):
+    li = t["lineitem"]
+    li = li[(li.l_shipdate >= pd.Timestamp("1996-01-01"))
+            & (li.l_shipdate < pd.Timestamp("1996-04-01"))]
+    rev = (li.l_extendedprice * (1 - li.l_discount)) \
+        .groupby(li.l_suppkey).sum()
+    sel = rev[rev == rev.max()].reset_index()
+    sel.columns = ["s_suppkey", "total_revenue"]
+    res = t["supplier"].merge(sel, on="s_suppkey")
+    return res[["s_suppkey", "s_name", "s_address", "s_phone",
+                "total_revenue"]].sort_values("s_suppkey") \
+        .reset_index(drop=True)
+
+
+def oracle_q16(t, nr):
+    df = t["partsupp"].merge(t["part"], left_on="ps_partkey",
+                             right_on="p_partkey")
+    df = df[(df.p_brand != "Brand#45")
+            & ~df.p_type.str.startswith("MEDIUM POLISHED")
+            & df.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])]
+    bad = t["supplier"][t["supplier"].s_comment.str.contains(
+        "Customer.*Complaints", regex=True)].s_suppkey
+    df = df[~df.ps_suppkey.isin(bad)]
+    res = df.groupby(["p_brand", "p_type", "p_size"], as_index=False) \
+        .ps_suppkey.nunique()
+    res.columns = ["p_brand", "p_type", "p_size", "supplier_cnt"]
+    return res.sort_values(["supplier_cnt", "p_brand", "p_type", "p_size"],
+                           ascending=[False, True, True, True]) \
+        .reset_index(drop=True)
+
+
+def oracle_q17(t, nr):
+    df = t["lineitem"].merge(t["part"], left_on="l_partkey",
+                             right_on="p_partkey")
+    avg02 = t["lineitem"].groupby("l_partkey").l_quantity.mean() * 0.2
+    df = df[(df.p_brand == "Brand#23") & (df.p_container == "MED BOX")]
+    df = df[df.l_quantity < df.l_partkey.map(avg02)]
+    val = df.l_extendedprice.sum() / 7.0 if len(df) else np.nan
+    return pd.DataFrame({"avg_yearly": [val]})
+
+
+def oracle_q18(t, nr, thresh=300):
+    li = t["lineitem"]
+    big = li.groupby("l_orderkey").l_quantity.sum()
+    big = big[big > thresh].index
+    df = (t["customer"]
+          .merge(t["orders"], left_on="c_custkey", right_on="o_custkey")
+          .merge(li, left_on="o_orderkey", right_on="l_orderkey"))
+    df = df[df.o_orderkey.isin(big)]
+    res = df.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                      "o_totalprice"], as_index=False).l_quantity.sum()
+    res = res.rename(columns={"l_quantity": "total_qty"})
+    return res.sort_values(["o_totalprice", "o_orderdate"],
+                           ascending=[False, True]).head(100) \
+        .reset_index(drop=True)
+
+
+def oracle_q19(t, nr):
+    df = t["lineitem"].merge(t["part"], left_on="l_partkey",
+                             right_on="p_partkey")
+    base = df.l_shipmode.isin(["AIR", "REG AIR"]) \
+        & (df.l_shipinstruct == "DELIVER IN PERSON")
+    m1 = ((df.p_brand == "Brand#12")
+          & df.p_container.isin(["SM CASE", "SM BOX", "SM PACK", "SM PKG"])
+          & (df.l_quantity >= 1) & (df.l_quantity <= 11)
+          & df.p_size.between(1, 5) & base)
+    m2 = ((df.p_brand == "Brand#23")
+          & df.p_container.isin(["MED BAG", "MED BOX", "MED PKG", "MED PACK"])
+          & (df.l_quantity >= 10) & (df.l_quantity <= 20)
+          & df.p_size.between(1, 10) & base)
+    m3 = ((df.p_brand == "Brand#34")
+          & df.p_container.isin(["LG CASE", "LG BOX", "LG PACK", "LG PKG"])
+          & (df.l_quantity >= 20) & (df.l_quantity <= 30)
+          & df.p_size.between(1, 15) & base)
+    sel = df[m1 | m2 | m3]
+    val = (sel.l_extendedprice * (1 - sel.l_discount)).sum() \
+        if len(sel) else np.nan
+    return pd.DataFrame({"revenue": [val]})
+
+
+def oracle_q20(t, nr):
+    forest = t["part"][t["part"].p_name.str.contains("forest")].p_partkey
+    ps = t["partsupp"][t["partsupp"].ps_partkey.isin(forest)]
+    li = t["lineitem"]
+    li = li[(li.l_shipdate >= pd.Timestamp("1994-01-01"))
+            & (li.l_shipdate < pd.Timestamp("1995-01-01"))]
+    half = li.groupby(["l_partkey", "l_suppkey"]).l_quantity.sum() * 0.5
+    idx = pd.MultiIndex.from_arrays([ps.ps_partkey, ps.ps_suppkey])
+    thr = half.reindex(idx).to_numpy()
+    ps = ps[ps.ps_availqty.to_numpy() > thr]
+    supp = t["supplier"].merge(nr["suppnation"], left_on="s_nationkey",
+                               right_on="sn_nationkey")
+    supp = supp[(supp.sn_name == "CANADA")
+                & supp.s_suppkey.isin(ps.ps_suppkey)]
+    return supp[["s_name", "s_address"]].sort_values("s_name") \
+        .reset_index(drop=True)
+
+
+def oracle_q21(t, nr):
+    li = t["lineitem"]
+    df = (t["supplier"]
+          .merge(li, left_on="s_suppkey", right_on="l_suppkey")
+          .merge(t["orders"], left_on="l_orderkey", right_on="o_orderkey")
+          .merge(nr["suppnation"], left_on="s_nationkey",
+                 right_on="sn_nationkey"))
+    df = df[(df.o_orderstatus == "F")
+            & (df.l_receiptdate > df.l_commitdate)
+            & (df.sn_name == "SAUDI ARABIA")]
+    nsupp = li.groupby("l_orderkey").l_suppkey.nunique()
+    late = li[li.l_receiptdate > li.l_commitdate]
+    late_n = late.groupby("l_orderkey").l_suppkey.nunique()
+    df = df[(df.l_orderkey.map(nsupp) > 1)
+            & (df.l_orderkey.map(late_n) == 1)]
+    res = df.groupby("s_name").size().reset_index(name="numwait")
+    return res.sort_values(["numwait", "s_name"],
+                           ascending=[False, True]).head(100) \
+        .reset_index(drop=True)
+
+
+def oracle_q22(t, nr):
+    codes = ["13", "31", "23", "29", "30", "18", "17"]
+    cust = t["customer"]
+    pool = cust[(cust.c_acctbal > 0.0)
+                & cust.c_phone.str[:2].isin(codes)]
+    avg = pool.c_acctbal.mean()
+    c = cust[cust.c_phone.str[:2].isin(codes)
+             & (cust.c_acctbal > avg)
+             & ~cust.c_custkey.isin(t["orders"].o_custkey)]
+    c = c.assign(cntrycode=c.c_phone.str[:2])
+    res = c.groupby("cntrycode", as_index=False).agg(
+        numcust=("c_custkey", "size"), totacctbal=("c_acctbal", "sum"))
+    return res.sort_values("cntrycode").reset_index(drop=True)
+
+
+ORACLES = {
+    "q2": oracle_q2, "q4": oracle_q4, "q9": oracle_q9, "q11": oracle_q11,
+    "q13": oracle_q13, "q15": oracle_q15, "q16": oracle_q16,
+    "q17": oracle_q17, "q18": oracle_q18, "q19": oracle_q19,
+    "q20": oracle_q20, "q21": oracle_q21, "q22": oracle_q22,
+}
+
+# queries whose ORDER BY fully determines row order (compare ordered);
+# others are compared sorted by their key columns
+ORDERED = {"q2", "q4", "q9", "q11", "q13", "q15", "q16", "q18", "q20",
+           "q21", "q22"}
+
+
+@pytest.mark.parametrize("name", sorted(ORACLES))
+def test_tpch22_differential(env, name):
+    ctx, tables, nr = env
+    got = ctx.sql(tpch.QUERIES[name]).to_pandas()
+    want = ORACLES[name](tables, nr)
+    if name in ORDERED:
+        assert_frames_equal(got, want, sort_by=[], rtol=1e-4)
+    else:
+        assert_frames_equal(got, want, rtol=1e-4)
+
+
+def test_q18_lower_threshold(env):
+    # the standard threshold (300) yields no rows at tiny scale; a lowered
+    # variant exercises the IN-subquery + triple-join path with real output
+    ctx, tables, nr = env
+    sql = tpch.QUERIES["q18"].replace("> 300", "> 150")
+    got = ctx.sql(sql).to_pandas()
+    want = oracle_q18(tables, nr, thresh=150)
+    assert len(want) > 0, "test scale too small for threshold 150"
+    assert_frames_equal(got, want, sort_by=[], rtol=1e-4)
+
+
+def test_all_22_queries_run(env):
+    """Every TPC-H query (and the reference's three benchmark alterations)
+    parses and executes through the session path."""
+    ctx, _, _ = env
+    for name, sql in tpch.QUERIES.items():
+        res = ctx.sql(sql)
+        assert res is not None, name
