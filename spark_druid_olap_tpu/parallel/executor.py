@@ -399,6 +399,15 @@ _AGG_KIND = {"count": ("count", np.int64), "longsum": ("sum", np.int64),
              "anyvalue": ("max", np.float64)}
 
 
+def _identity_row(kinds_by_name) -> Dict[str, np.ndarray]:
+    """The one identity row of a GLOBAL aggregate over zero rows — SQL
+    semantics (and Druid's default timeseries behavior, minus its sum-is-0
+    quirk): count/hll -> 0, sum/min/max -> NULL."""
+    return {name: (np.array([0], dtype=np.int64)
+                   if kind in ("count", "hll") else np.array([np.nan]))
+            for name, kind in kinds_by_name.items()}
+
+
 def plan_aggregation(a: S.AggregationSpec, ds: Datasource) -> AggPlan:
     if a.kind not in _AGG_KIND:
         raise EngineFallback(f"aggregation kind {a.kind}")
@@ -459,6 +468,11 @@ class QueryEngine:
             self._cancel_flags.setdefault(qid, threading.Event())
         try:
             return self._execute_inner(q, t0)
+        except EC.Unsupported as e:
+            # expression/filter compilation is lazy (trace time), so an
+            # unsupported node can surface only here — demote it to the
+            # fallback signal the session layer handles
+            raise EngineFallback(str(e)) from e
         finally:
             if qid is not None:
                 self._cancel_flags.pop(qid, None)
@@ -505,11 +519,9 @@ class QueryEngine:
                 # global aggregate over an empty/pruned scan still yields the
                 # one identity row (same semantics as the global_empty path
                 # below)
-                data = {}
-                for a in aggregations:
-                    kind = _AGG_KIND.get(a.kind, ("sum", None))[0]
-                    data[a.name] = np.array([0], dtype=np.int64) \
-                        if kind in ("count", "hll") else np.array([np.nan])
+                data = _identity_row(
+                    {a.name: _AGG_KIND.get(a.kind, ("sum", None))[0]
+                     for a in aggregations})
                 for p in post_aggregations:
                     v = np.asarray(host_eval.eval_expr(p.expr, data))
                     data[p.name] = np.broadcast_to(v, (1,)) if v.ndim == 0 \
@@ -597,9 +609,9 @@ class QueryEngine:
                     data[name] = v.astype(np.float64)
             columns.append(name)
         if global_empty:
-            for p in agg_plans:
-                if p.kind in ("sum", "min", "max"):
-                    data[p.spec.name] = np.array([np.nan])
+            data.update(_identity_row(
+                {p.spec.name: p.kind for p in agg_plans
+                 if p.kind in ("sum", "min", "max")}))
 
         # --- post aggregations / having / limit (host epilogue) --------------
         for pa in post_aggregations:

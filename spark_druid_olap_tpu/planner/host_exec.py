@@ -360,14 +360,17 @@ def _execute_sub_decorrelated(ctx, node, env, free, n_rows, outer_env):
     odf = pd.DataFrame(outer)
     odf["__oidx"] = np.arange(n_rows)
 
-    right_keys = list(jk_cols) + (["__inval"]
-                                  if isinstance(node, A.InSubquery) else [])
+    right_keys = list(jk_cols)
     # NULL never equi-matches (pandas merge would pair NaN with NaN): drop
     # NULL-keyed inner rows; NULL-keyed outer rows then simply never match
     if len(df2):
         df2 = df2[~df2[right_keys].isna().any(axis=1)]
-    for lc, rc in zip(ok_cols, right_keys):
+    key_ok_cols = [c for c in ok_cols if c != "__okv"]
+    for lc, rc in zip(key_ok_cols, right_keys):
         odf[lc], df2[rc] = _align_key(odf[lc], df2[rc])
+    if isinstance(node, A.InSubquery):
+        odf["__okv"], df2["__inval"] = _align_key(odf["__okv"],
+                                                  df2["__inval"])
 
     if is_scalar:
         merged = odf.merge(df2, left_on=ok_cols, right_on=right_keys,
@@ -384,7 +387,7 @@ def _execute_sub_decorrelated(ctx, node, env, free, n_rows, outer_env):
             vals[unmatched] = fill
         return _PrecomputedColumn(vals)
 
-    merged = odf.merge(df2, left_on=ok_cols, right_on=right_keys,
+    merged = odf.merge(df2, left_on=key_ok_cols, right_on=right_keys,
                        how="inner", sort=False)
     if residual_conjs:
         menv = {}
@@ -398,16 +401,32 @@ def _execute_sub_decorrelated(ctx, node, env, free, n_rows, outer_env):
         for c in residual_conjs:
             mask &= np.asarray(host_eval.eval_expr(c, menv), dtype=bool)
         merged = merged[mask]
+    negated = getattr(node, "negated", False)
+    if isinstance(node, A.InSubquery):
+        # merged rows = the row's correlated inner set; membership needs the
+        # probe to equal an inner value (NULL on either side never matches)
+        member = np.zeros(n_rows, dtype=bool)
+        has_group = np.zeros(n_rows, dtype=bool)
+        if len(merged):
+            has_group[merged["__oidx"].unique()] = True
+            hit = (merged["__okv"].notna() & merged["__inval"].notna() &
+                   (merged["__okv"] == merged["__inval"]))
+            if hit.any():
+                member[merged.loc[hit, "__oidx"].unique()] = True
+        flags = member ^ negated
+        nan_child = pd.isna(pd.Series(outer["__okv"])).to_numpy()
+        if negated:
+            # NULL NOT IN S is TRUE when S is empty, UNKNOWN (-> false)
+            # otherwise
+            flags = flags & (~nan_child | ~has_group)
+        else:
+            # NULL IN S is never TRUE
+            flags = flags & ~nan_child
+        return _PrecomputedColumn(flags)
     flags = np.zeros(n_rows, dtype=bool)
     if len(merged):
         flags[merged["__oidx"].unique()] = True
-    negated = getattr(node, "negated", False)
-    flags = flags ^ negated
-    if isinstance(node, A.InSubquery):
-        # NULL IN (...) and NULL NOT IN (...) are both UNKNOWN -> false
-        nan_child = pd.isna(pd.Series(outer["__okv"])).to_numpy()
-        flags = flags & ~nan_child
-    return _PrecomputedColumn(flags)
+    return _PrecomputedColumn(flags ^ negated)
 
 
 def _empty_group_value(expr):
@@ -497,7 +516,9 @@ def materialize_relation(ctx, rel: A.Relation,
             # extension survives); mixed-side residuals are unsupported
             kept = []
             for c in residual:
-                cols = E.columns_in(c)
+                # _expr_refs (not columns_in) so a nested subquery's free
+                # correlated columns count as references of this predicate
+                cols = _expr_refs(ctx, c)
                 if cols <= set(right.columns):
                     renv = {k: right[k].to_numpy() for k in cols}
                     c2 = resolve_subqueries(ctx, c, renv, outer_env)
@@ -602,7 +623,8 @@ def _compute_agg(series_env, df, call: E.AggCall, ctx, outer_env, group_ids,
         raise HostExecError(f"aggregate {call.fn}")
     full = out.reindex(range(n_groups))
     if call.fn == "count":
-        full = full.fillna(0)
+        # keep counts integer: fillna promotes to float64
+        full = full.fillna(0).astype(np.int64)
     return full.to_numpy()
 
 

@@ -279,3 +279,40 @@ def test_sql_ordinals_and_aliases(ctx, sales):
         select region, sum(price) as rev from sales
         group by 1 order by 2 desc limit 3
     """, sort=False)
+
+
+@pytest.fixture(scope="module")
+def probe_ctx(ctx):
+    ctx.ingest_dataframe("probe_dim", pd.DataFrame({
+        "pregion": ["east", "west", "nowhere"],
+        "probe": [np.nan, np.nan, np.nan]}))
+    return ctx
+
+
+def test_decorrelated_not_in_null_probe_empty_set(probe_ctx):
+    # NULL NOT IN (empty correlated set) is TRUE — rows with an empty inner
+    # set survive even with a NULL probe (SQL 3VL); 'price < 0' never
+    # matches, so all three rows pass.
+    got = probe_ctx.sql(
+        "select count(*) as c from probe_dim where probe not in "
+        "(select price from sales where region = pregion "
+        " and price < 0)").to_pandas()
+    assert int(got["c"][0]) == 3
+
+
+def test_decorrelated_not_in_null_probe_nonempty_set(probe_ctx):
+    # NULL NOT IN (non-empty set) is UNKNOWN -> dropped; only 'nowhere'
+    # (whose correlated set is empty) survives.
+    got = probe_ctx.sql(
+        "select count(*) as c from probe_dim where probe not in "
+        "(select price from sales where region = pregion)").to_pandas()
+    assert int(got["c"][0]) == 1
+
+
+def test_host_count_over_empty_group_is_int(ctx, sales):
+    from spark_druid_olap_tpu.planner import host_exec
+    from spark_druid_olap_tpu.sql.parser import parse_select as ps
+    df = host_exec.execute_select(
+        ctx, ps("select count(*) as c from sales where qty < 0"))
+    assert df["c"].iloc[0] == 0
+    assert np.issubdtype(df["c"].dtype, np.integer)
