@@ -83,19 +83,31 @@ def default_sum_dtype():
 
 def dense_groupby(key, mask, n_keys: int, inputs: List[AggInput],
                   matmul_max: int = 4096,
-                  sum_dtype=None) -> Dict[str, object]:
+                  sum_dtype=None, pallas_max: int = 0) -> Dict[str, object]:
     """Aggregate ``inputs`` grouped by dense ``key`` under ``mask``.
 
     key: int32 [S, R] (or any shape); mask: bool same shape (row validity &
     query filter already folded in). Returns dict name -> [n_keys] array,
     plus '__rows__' (matched-row count per group, used to drop empty groups —
     Druid groupBy only emits existing groups).
+
+    Kernel selection: fused Pallas single-pass kernel for small K on TPU
+    (``pallas_max``), MXU one-hot matmul up to ``matmul_max``, XLA
+    scatter-add above.
     """
     key = jnp.where(mask, key, jnp.int32(n_keys))
     inputs = list(inputs) + [AggInput("__rows__", "count")]
     if sum_dtype is None:
         sum_dtype = default_sum_dtype()
 
+    if pallas_max:
+        from spark_druid_olap_tpu.ops import pallas_groupby as PG
+    if pallas_max and PG.supported(n_keys, inputs, pallas_max):
+        return PG.pallas_dense_groupby(key, n_keys, [
+            dataclasses.replace(
+                a, values=None if a.values is None else a.values.reshape(-1),
+                mask=None if a.mask is None else a.mask.reshape(-1))
+            for a in inputs])
     if n_keys <= matmul_max:
         return _matmul_groupby(key.reshape(-1), mask.reshape(-1), n_keys,
                                inputs, sum_dtype)

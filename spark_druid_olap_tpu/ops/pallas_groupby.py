@@ -1,0 +1,177 @@
+"""Pallas TPU kernel for the small-K scan-filter-aggregate hot loop.
+
+This is the fused, single-HBM-pass version of :func:`groupby.dense_groupby`
+for small group cardinalities — the shape of the reference's headline
+benchmark queries (TPC-H Q1 groups by returnflag x linestatus = 6 keys;
+the basic-agg / shipdate-range queries are global or single-dim; reference
+``docs/benchmark/BenchMarkDetails.org:140-163``). The XLA one-hot-matmul
+path materializes the one-hot and several intermediates in HBM and
+serializes a ``lax.scan``; this kernel streams each row block through VMEM
+exactly once.
+
+Design:
+
+- Grid over row blocks ``[B, 128]`` (TPU grids run sequentially, so the
+  output block is a legal cross-step accumulator).
+- Per group key ``k`` (static unroll — small K only): lane-wise partial
+  reductions ``[B, 128] -> [128]`` on the VPU (sublane reduce only, no
+  scalar-unit traffic). Masked-out rows carry the sentinel key ``n_keys``
+  and match no ``k``, so filtering costs nothing.
+- Output is ``[K * M, 128]`` per-lane partials accumulated in VMEM; the
+  final 128-lane reduction is a tiny XLA epilogue outside the kernel (same
+  jit), giving exact ``[K]`` results.
+- Sums/counts accumulate in f32 (matches the XLA TPU path); min/max use the
+  same +/-F32_MAX empty-group sentinel the decoder expects.
+
+The kernel is selected by :func:`groupby.dense_groupby` when the backend is
+TPU and ``n_keys <= sdot.engine.groupby.pallas.max.keys``; tests exercise it
+on CPU via ``interpret=True``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+F32_MAX = jnp.float32(3.4e38)
+
+LANES = 128
+DEFAULT_BLOCK_ROWS = 256          # sublanes per grid step -> 32K rows/step
+
+
+def supported(n_keys: int, inputs, pallas_max: int) -> bool:
+    """Whether the pallas kernel applies: small dense K, plain agg kinds,
+    TPU backend (or interpret mode forced via SDOT_PALLAS=interpret — CPU
+    differential tests otherwise keep the f64 XLA path)."""
+    env = os.environ.get("SDOT_PALLAS", "")
+    if env == "0":
+        return False
+    if env != "interpret" and jax.default_backend() != "tpu":
+        return False
+    if n_keys > pallas_max:
+        return False
+    return all(a.kind in ("count", "sum", "min", "max") for a in inputs)
+
+
+def _interpret() -> bool:
+    if os.environ.get("SDOT_PALLAS", "") == "interpret":
+        return True
+    return jax.default_backend() != "tpu"
+
+
+_INIT = {"count": 0.0, "sum": 0.0, "min": 3.4e38, "max": -3.4e38}
+
+
+def _make_kernel(n_keys: int, specs, n_in: int):
+    """specs: list of (kind, value_ref_idx or None, mask_ref_idx or None)."""
+    m_aggs = len(specs)
+    # python-float literals only: pallas kernels may not close over jnp
+    # constants
+    fmax = 3.4e38
+
+    def kernel(key_ref, *refs):
+        out_ref = refs[n_in]
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _():
+            for m, (kind, _, _) in enumerate(specs):
+                fill = jnp.float32(_INIT[kind])
+                for k in range(n_keys):
+                    out_ref[k * m_aggs + m, :] = jnp.full(
+                        (LANES,), fill, dtype=jnp.float32)
+
+        kb = key_ref[:]                                   # [B, 128] int32
+        for k in range(n_keys):
+            mk = kb == k
+            for m, (kind, vi, mi) in enumerate(specs):
+                eff = mk if mi is None else (mk & (refs[mi][:] != 0))
+                row = k * m_aggs + m
+                if kind == "count":
+                    part = jnp.sum(eff.astype(jnp.float32), axis=0)
+                elif kind == "sum":
+                    part = jnp.sum(
+                        jnp.where(eff, refs[vi][:], 0.0), axis=0)
+                elif kind == "min":
+                    part = jnp.min(
+                        jnp.where(eff, refs[vi][:], fmax), axis=0)
+                else:
+                    part = jnp.max(
+                        jnp.where(eff, refs[vi][:], -fmax), axis=0)
+                cur = out_ref[row, :]
+                if kind in ("count", "sum"):
+                    out_ref[row, :] = cur + part
+                elif kind == "min":
+                    out_ref[row, :] = jnp.minimum(cur, part)
+                else:
+                    out_ref[row, :] = jnp.maximum(cur, part)
+
+    return kernel
+
+
+def pallas_dense_groupby(key, n_keys: int, inputs: List,
+                         block_rows: int = DEFAULT_BLOCK_ROWS):
+    """Fused scan-aggregate for dense small-K group-by.
+
+    key: int32 [N] with filtered-out rows already set to the sentinel
+    ``n_keys``; inputs: list of ``groupby.AggInput`` with flat [N] values /
+    masks. Returns dict name -> [n_keys] f32 array (same contract as the
+    XLA paths in :mod:`groupby`).
+    """
+    key = key.reshape(-1).astype(jnp.int32)
+    n = key.shape[0]
+    tile = block_rows * LANES
+    n_pad = -(-max(n, 1) // tile) * tile
+
+    def pad2d(arr, fill, dtype):
+        arr = arr.reshape(-1).astype(dtype)
+        if n_pad > n:
+            arr = jnp.pad(arr, (0, n_pad - n), constant_values=fill)
+        return arr.reshape(n_pad // LANES, LANES)
+
+    key2 = pad2d(key, n_keys, jnp.int32)
+
+    specs = []       # (kind, value_idx, mask_idx) into `operands`
+    operands = []
+    for a in inputs:
+        vi = mi = None
+        if a.kind != "count":
+            vi = len(operands)
+            operands.append(pad2d(a.values, 0, jnp.float32))
+        if a.mask is not None:
+            mi = len(operands)
+            operands.append(pad2d(a.mask, 0, jnp.int8))
+        specs.append((a.kind, vi, mi))
+
+    n_in = len(operands)
+    m_aggs = len(specs)
+    grid = (n_pad // tile,)
+    blk = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0))
+    out_blk = pl.BlockSpec((n_keys * m_aggs, LANES), lambda i: (0, 0))
+
+    out = pl.pallas_call(
+        _make_kernel(n_keys, specs, n_in),
+        grid=grid,
+        in_specs=[blk] * (1 + n_in),
+        out_specs=out_blk,
+        out_shape=jax.ShapeDtypeStruct((n_keys * m_aggs, LANES),
+                                       jnp.float32),
+        interpret=_interpret(),
+    )(key2, *operands)
+
+    # tiny XLA epilogue: reduce the 128 lane-partials per (key, agg)
+    out3 = out.reshape(n_keys, m_aggs, LANES)
+    result = {}
+    for m, (a, (kind, _, _)) in enumerate(zip(inputs, specs)):
+        col = out3[:, m, :]
+        if kind in ("count", "sum"):
+            result[a.name] = jnp.sum(col, axis=-1)
+        elif kind == "min":
+            result[a.name] = jnp.min(col, axis=-1)
+        else:
+            result[a.name] = jnp.max(col, axis=-1)
+    return result

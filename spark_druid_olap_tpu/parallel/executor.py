@@ -54,6 +54,7 @@ from spark_druid_olap_tpu.utils.config import (
     Config,
     GROUPBY_DENSE_MAX_KEYS,
     GROUPBY_MATMUL_MAX_KEYS,
+    GROUPBY_PALLAS_MAX_KEYS,
     HLL_LOG2M,
 )
 
@@ -705,6 +706,7 @@ class QueryEngine:
     def _make_core(self, ds, dim_plans, agg_plans, filter_spec,
                    intervals, min_day, max_day, n_keys):
         matmul_max = self.config.get(GROUPBY_MATMUL_MAX_KEYS)
+        pallas_max = self.config.get(GROUPBY_PALLAS_MAX_KEYS)
         log2m = self.config.get(HLL_LOG2M)
         hll_plans = [p for p in agg_plans if p.kind == "hll"]
         dense_plans = [p for p in agg_plans if p.kind != "hll"]
@@ -728,7 +730,8 @@ class QueryEngine:
                 inputs.append(G.AggInput(p.spec.name, p.kind,
                                          p.build_values(ctx),
                                          p.build_mask(ctx)))
-            out = G.dense_groupby(key, base, n_keys, inputs, matmul_max)
+            out = G.dense_groupby(key, base, n_keys, inputs, matmul_max,
+                                  pallas_max=pallas_max)
             for p in hll_plans:
                 vals = p.build_values(ctx)
                 am = p.build_mask(ctx)

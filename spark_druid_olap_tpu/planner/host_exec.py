@@ -387,6 +387,35 @@ def _execute_sub_decorrelated(ctx, node, env, free, n_rows, outer_env):
             vals[unmatched] = fill
         return _PrecomputedColumn(vals)
 
+    negated = getattr(node, "negated", False)
+    if isinstance(node, A.InSubquery) and not residual_conjs:
+        # Fast path (no residual predicates): never materialize the
+        # outer x per-key-inner-set cross product. Membership is a
+        # keys+value equi-merge; the per-group facts 3VL needs (set
+        # non-empty? contains NULL?) come from one groupby over df2.
+        member = np.zeros(n_rows, dtype=bool)
+        dfv = df2[df2["__inval"].notna()]
+        hitm = odf[pd.Series(outer["__okv"]).notna().to_numpy()].merge(
+            dfv, left_on=key_ok_cols + ["__okv"],
+            right_on=right_keys + ["__inval"], how="inner", sort=False)
+        if len(hitm):
+            member[hitm["__oidx"].unique()] = True
+        if len(df2):
+            g = df2.groupby(right_keys, sort=False, dropna=False)["__inval"] \
+                .agg([("__n", "size"),
+                      ("__nulls", lambda s: s.isna().any())]).reset_index()
+            stat = odf.merge(g, left_on=key_ok_cols, right_on=right_keys,
+                             how="left", sort=False).drop_duplicates("__oidx") \
+                .sort_values("__oidx")
+            has_group = stat["__n"].notna().to_numpy()
+            has_null_inner = stat["__nulls"].fillna(False).to_numpy(bool)
+        else:
+            has_group = np.zeros(n_rows, dtype=bool)
+            has_null_inner = has_group
+        return _PrecomputedColumn(_in_flags(
+            member, has_group, has_null_inner,
+            pd.isna(pd.Series(outer["__okv"])).to_numpy(), negated))
+
     merged = odf.merge(df2, left_on=key_ok_cols, right_on=right_keys,
                        how="inner", sort=False)
     if residual_conjs:
@@ -401,32 +430,36 @@ def _execute_sub_decorrelated(ctx, node, env, free, n_rows, outer_env):
         for c in residual_conjs:
             mask &= np.asarray(host_eval.eval_expr(c, menv), dtype=bool)
         merged = merged[mask]
-    negated = getattr(node, "negated", False)
     if isinstance(node, A.InSubquery):
-        # merged rows = the row's correlated inner set; membership needs the
-        # probe to equal an inner value (NULL on either side never matches)
+        # residual path: merged rows = each outer row's correlated inner set
         member = np.zeros(n_rows, dtype=bool)
         has_group = np.zeros(n_rows, dtype=bool)
+        has_null_inner = np.zeros(n_rows, dtype=bool)
         if len(merged):
             has_group[merged["__oidx"].unique()] = True
+            nulls = merged["__inval"].isna()
+            if nulls.any():
+                has_null_inner[merged.loc[nulls, "__oidx"].unique()] = True
             hit = (merged["__okv"].notna() & merged["__inval"].notna() &
                    (merged["__okv"] == merged["__inval"]))
             if hit.any():
                 member[merged.loc[hit, "__oidx"].unique()] = True
-        flags = member ^ negated
-        nan_child = pd.isna(pd.Series(outer["__okv"])).to_numpy()
-        if negated:
-            # NULL NOT IN S is TRUE when S is empty, UNKNOWN (-> false)
-            # otherwise
-            flags = flags & (~nan_child | ~has_group)
-        else:
-            # NULL IN S is never TRUE
-            flags = flags & ~nan_child
-        return _PrecomputedColumn(flags)
+        return _PrecomputedColumn(_in_flags(
+            member, has_group, has_null_inner,
+            pd.isna(pd.Series(outer["__okv"])).to_numpy(), negated))
     flags = np.zeros(n_rows, dtype=bool)
     if len(merged):
         flags[merged["__oidx"].unique()] = True
     return _PrecomputedColumn(flags ^ negated)
+
+
+def _in_flags(member, has_group, has_null_inner, nan_child, negated):
+    """SQL 3VL for ``x [NOT] IN S``: membership needs a non-NULL equal pair;
+    otherwise the result is UNKNOWN (-> false) when S is non-empty and x is
+    NULL or S contains NULL; NOT IN over an empty S is TRUE."""
+    if not negated:
+        return member
+    return ~member & ~(has_group & (nan_child | has_null_inner))
 
 
 def _empty_group_value(expr):

@@ -108,6 +108,11 @@ COST_COMPILE = _entry(
 SEGMENT_ROWS = _entry(
     "sdot.segment.target.rows", 1 << 20,
     "Target rows per time-sharded segment at ingest.")
+GROUPBY_PALLAS_MAX_KEYS = _entry(
+    "sdot.engine.groupby.pallas.max.keys", 64,
+    "Dense group-by uses the fused single-pass Pallas TPU kernel when the "
+    "fused key cardinality is at most this (0 disables). Also honors env "
+    "SDOT_PALLAS=0|interpret.")
 GROUPBY_MATMUL_MAX_KEYS = _entry(
     "sdot.engine.groupby.matmul.max.keys", 4096,
     "Dense group-by uses the MXU one-hot matmul path when the fused key "

@@ -316,3 +316,17 @@ def test_host_count_over_empty_group_is_int(ctx, sales):
         ctx, ps("select count(*) as c from sales where qty < 0"))
     assert df["c"].iloc[0] == 0
     assert np.issubdtype(df["c"].dtype, np.integer)
+
+
+def test_decorrelated_not_in_inner_null_is_unknown(probe_ctx):
+    # x NOT IN (set containing NULL) with x unmatched is UNKNOWN -> dropped
+    probe_ctx.ingest_dataframe("inner_t", pd.DataFrame({
+        "iregion": ["east", "east", "west", "nowhere2"],
+        "ival": [np.nan, 7.0, 8.0, 9.0]}))
+    probe_ctx.ingest_dataframe("outer_t", pd.DataFrame({
+        "oregion": ["east", "west"], "oval": [5.0, 5.0]}))
+    got = probe_ctx.sql(
+        "select count(*) as c from outer_t where oval not in "
+        "(select ival from inner_t where iregion = oregion)").to_pandas()
+    # east: {NULL, 7} -> UNKNOWN (dropped); west: {8} -> TRUE (kept)
+    assert int(got["c"][0]) == 1
