@@ -157,6 +157,13 @@ def filter_to_dict(f: Optional[S.FilterSpec]):
                 "fields": [filter_to_dict(x) for x in f.fields]}
     if isinstance(f, S.ExprFilter):
         return {"type": "expression", "expr": expr_to_dict(f.expr)}
+    if isinstance(f, S.SpatialFilter):
+        # Druid-shaped (SpatialFilterSpec/RectangularBound) plus our axes
+        return {"type": "spatial", "dimension": f.dimension,
+                "axes": list(f.axes),
+                "bound": {"type": "rectangular",
+                          "minCoords": [_jsonable(v) for v in f.min_coords],
+                          "maxCoords": [_jsonable(v) for v in f.max_coords]}}
     raise ValueError(type(f).__name__)
 
 
@@ -192,6 +199,12 @@ def filter_from_dict(d) -> Optional[S.FilterSpec]:
             t, tuple(filter_from_dict(x) for x in d["fields"]))
     if t == "expression":
         return S.ExprFilter(expr_from_dict(d["expr"]))
+    if t == "spatial":
+        b = d["bound"]
+        return S.SpatialFilter(
+            d["dimension"], tuple(d.get("axes", ())),
+            tuple(float(v) for v in b["minCoords"]),
+            tuple(float(v) for v in b["maxCoords"]))
     raise ValueError(f"unknown filter type {t!r}")
 
 

@@ -89,6 +89,22 @@ class ExprFilter(FilterSpec):
     expr: E.Expr
 
 
+@dataclasses.dataclass(frozen=True)
+class SpatialFilter(FilterSpec):
+    """Rectangular-bound filter on a declared spatial dimension (reference:
+    ``SpatialFilterSpec``/``RectangularBound`` DruidQuerySpec.scala:255-281).
+
+    ``axes`` are the resolved numeric axis columns (declared at ingest via
+    ``spatial_dims``); coordinates are inclusive on both bounds. Open sides
+    use +/-inf. Beyond the row mask, the executor prunes whole segments
+    whose per-axis bounding box misses the rectangle — the scan-era analog
+    of Druid's R-tree index."""
+    dimension: str
+    axes: Tuple[str, ...]
+    min_coords: Tuple[float, ...]
+    max_coords: Tuple[float, ...]
+
+
 TrueFilter = LogicalFilter("and", ())
 
 

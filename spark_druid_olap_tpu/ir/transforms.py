@@ -72,6 +72,59 @@ def add_count_when_no_aggs(q: S.QuerySpec, conf: Config):
         q, aggregations=(S.AggregationSpec("count", "__count__"),))
 
 
+def merge_spatial_bounds(filter_spec, ds):
+    """Collapse conjunctive numeric BoundFilters on a spatial dim's axis
+    columns into one SpatialFilter (reference: the combine-spatial-filters
+    transform, QuerySpecTransforms.scala:180-223, and the spatial rewrite in
+    ProjectFilterTransfom.scala:289-319). Enables segment bounding-box
+    pruning; open sides become +/-inf. Only rewrites when at least one axis
+    is bounded."""
+    import math
+    if filter_spec is None or not getattr(ds, "spatial", None):
+        return filter_spec
+    if isinstance(filter_spec, S.LogicalFilter) and filter_spec.op == "and":
+        conjs = list(filter_spec.fields)
+    else:
+        conjs = [filter_spec]
+    axis_to_dim = {}
+    for sname, axes in ds.spatial.items():
+        for ax in axes:
+            axis_to_dim[ax] = sname
+    # per spatial dim: accumulated [lo, hi] per axis
+    boxes = {}
+    used = []
+    rest = []
+    for c in conjs:
+        if isinstance(c, S.BoundFilter) and c.dimension in axis_to_dim \
+                and not c.lower_strict and not c.upper_strict:
+            sname = axis_to_dim[c.dimension]
+            box = boxes.setdefault(sname, {})
+            try:
+                lo = -math.inf if c.lower is None else float(c.lower)
+                hi = math.inf if c.upper is None else float(c.upper)
+            except (TypeError, ValueError):
+                rest.append(c)
+                continue
+            cur = box.get(c.dimension, (-math.inf, math.inf))
+            box[c.dimension] = (max(cur[0], lo), min(cur[1], hi))
+            used.append(c)
+        else:
+            rest.append(c)
+    if not boxes:
+        return filter_spec
+    for sname, box in boxes.items():
+        axes = ds.spatial[sname]
+        rest.append(S.SpatialFilter(
+            dimension=sname, axes=axes,
+            min_coords=tuple(box.get(ax, (-math.inf, math.inf))[0]
+                             for ax in axes),
+            max_coords=tuple(box.get(ax, (-math.inf, math.inf))[1]
+                             for ax in axes)))
+    if len(rest) == 1:
+        return rest[0]
+    return S.LogicalFilter("and", tuple(rest))
+
+
 RULES: List[Rule] = [add_count_when_no_aggs, groupby_to_topn,
                      groupby_to_timeseries]
 

@@ -50,6 +50,8 @@ def lower_filter(f: Optional[S.FilterSpec], ctx: ScanContext):
     if isinstance(f, S.ExprFilter):
         v = EC.compile_expr(f.expr, ctx)
         return EC._as_bool(v)
+    if isinstance(f, S.SpatialFilter):
+        return _spatial(f, ctx)
     raise EC.Unsupported(f"filter {type(f).__name__}")
 
 
@@ -200,6 +202,25 @@ def _pattern(f: S.PatternFilter, ctx):
                      f.dimension, ctx)
 
 
+def _spatial(f: S.SpatialFilter, ctx):
+    """Rectangular bound over the spatial dim's axis columns: fused per-axis
+    inclusive range compares (the row-mask half; segment bounding-box
+    pruning happens host-side in ``Datasource.prune_segments``)."""
+    out = None
+    for ax, lo, hi in zip(f.axes, f.min_coords, f.max_coords):
+        arr = ctx.col(ax)
+        m = None
+        if lo is not None and np.isfinite(lo):
+            m = arr >= lo
+        if hi is not None and np.isfinite(hi):
+            m2 = arr <= hi
+            m = m2 if m is None else (m & m2)
+        if m is not None:
+            m = _nullsafe(m, ax, ctx)
+            out = m if out is None else (out & m)
+    return out if out is not None else ctx.row_valid()
+
+
 def _logical(f: S.LogicalFilter, ctx):
     if f.op == "not":
         inner = lower_filter(f.fields[0], ctx)
@@ -248,6 +269,8 @@ def columns_of_filter(f: Optional[S.FilterSpec]):
     if isinstance(f, (S.SelectorFilter, S.BoundFilter, S.InFilter,
                       S.PatternFilter, S.NullFilter)):
         return {f.dimension}
+    if isinstance(f, S.SpatialFilter):
+        return set(f.axes)
     if isinstance(f, S.LogicalFilter):
         out = set()
         for x in f.fields:

@@ -508,7 +508,7 @@ class QueryEngine:
                  post_aggregations, having, limit, granularity, filter_spec,
                  intervals, t0: Optional[float] = None) -> QueryResult:
         ds = self.store.get(q.datasource)
-        seg_idx = ds.prune_segments(intervals)
+        seg_idx = ds.prune_segments(intervals, filter_spec)
         gran_kind = granularity.kind if granularity else "all"
 
         if ds.num_rows == 0 or len(seg_idx) == 0:
@@ -692,7 +692,7 @@ class QueryEngine:
         else:
             raise EngineFallback("core build supports groupby/timeseries")
         ds = self.store.get(q.datasource)
-        seg_idx = ds.prune_segments(q.intervals)
+        seg_idx = ds.prune_segments(q.intervals, q.filter)
         dim_plans, agg_plans, min_day, max_day, n_keys, names = \
             self._plan_agg(ds, seg_idx, dims, aggs, gran, q.filter,
                            q.intervals)
@@ -809,7 +809,7 @@ class QueryEngine:
     def _run_select(self, q: S.SelectQuerySpec) -> QueryResult:
         ds = self.store.get(q.datasource)
         cols = list(q.columns) or ds.column_names()
-        seg_idx = ds.prune_segments(q.intervals)
+        seg_idx = ds.prune_segments(q.intervals, q.filter)
         if len(seg_idx) == 0:
             return QueryResult.empty(cols)
         # row mask on host via numpy evaluation over raw columns (select is
@@ -1028,4 +1028,15 @@ def filter_to_expr(f: S.FilterSpec) -> E.Expr:
         return E.Not(subs[0])
     if isinstance(f, S.ExprFilter):
         return f.expr
+    if isinstance(f, S.SpatialFilter):
+        import math
+        parts = []
+        for ax, lo, hi in zip(f.axes, f.min_coords, f.max_coords):
+            c = E.Column(ax)
+            if lo is not None and math.isfinite(lo):
+                parts.append(E.Comparison(">=", c, E.Literal(lo)))
+            if hi is not None and math.isfinite(hi):
+                parts.append(E.Comparison("<=", c, E.Literal(hi)))
+        return E.And(tuple(parts)) if len(parts) != 1 else (
+            parts[0] if parts else E.Literal(True))
     raise EngineFallback(f"filter {type(f).__name__}")

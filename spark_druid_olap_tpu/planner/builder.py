@@ -476,6 +476,7 @@ class Builder:
         conjs = [c for c in _split_conjuncts(stmt.where)
                  if not any(c is k for k in consumed)]
         intervals, filter_spec = self.build_filter(conjs)
+        filter_spec = QT.merge_spatial_bounds(filter_spec, self.ds)
 
         # resolve group-by expressions
         alias_map = {item.alias: item.expr for item in stmt.items

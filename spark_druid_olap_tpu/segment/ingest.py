@@ -61,12 +61,21 @@ def ingest_dataframe(
     metrics: Optional[Iterable[str]] = None,
     target_rows: int = 1 << 20,
     metric_kinds: Optional[Dict[str, ColumnKind]] = None,
+    spatial_dims: Optional[Dict[str, Iterable[str]]] = None,
 ) -> Datasource:
     """Ingest a DataFrame as a datasource.
 
     ``dimensions``/``metrics`` override column-kind inference (a numeric
     column listed in ``dimensions`` is dictionary-encoded as a string dim,
     matching Druid's all-dims-are-strings model when desired).
+
+    ``spatial_dims`` declares spatial dimensions: name -> axis columns
+    (numeric, e.g. ``{"pickup": ["pickup_lat", "pickup_lon"]}``), the
+    analog of Druid's ingest-time spatialDimensions (reference:
+    SpatialDruidDimensionInfo, DruidRelationColumn spatial axes). Axis
+    columns stay queryable as plain metrics; conjunctive range predicates
+    on them collapse into a rectangular spatial filter with segment-level
+    bounding-box pruning.
     """
     df = df.reset_index(drop=True)
     n = len(df)
@@ -146,8 +155,18 @@ def ingest_dataframe(
                 min_millis=int(millis[s:e].min()),
                 max_millis=int(millis[s:e].max())))
 
+    spatial = {}
+    for sname, axes in (spatial_dims or {}).items():
+        axes = tuple(axes)
+        for ax in axes:
+            if ax not in mets:
+                raise ValueError(
+                    f"spatial dim {sname!r}: axis {ax!r} is not a numeric "
+                    f"column of {name!r}")
+        spatial[sname] = axes
+
     return Datasource(name=name, time=time_col, dims=dims, metrics=mets,
-                      segments=segments)
+                      segments=segments, spatial=spatial)
 
 
 def ingest_parquet(name: str, path: str, **kwargs) -> Datasource:

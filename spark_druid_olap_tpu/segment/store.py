@@ -59,13 +59,19 @@ class Datasource:
 
     def __init__(self, name: str, time: Optional[TimeColumn],
                  dims: Dict[str, DimColumn], metrics: Dict[str, MetricColumn],
-                 segments: List[Segment]):
+                 segments: List[Segment],
+                 spatial: Optional[Dict[str, Tuple[str, ...]]] = None):
         self.name = name
         self.time = time
         self.dims = dims
         self.metrics = metrics
         self.segments = segments
+        # spatial dim name -> numeric axis columns (≈ the reference's
+        # spatial-index column map, DruidRelationColumn spatial axes)
+        self.spatial: Dict[str, Tuple[str, ...]] = {
+            k: tuple(v) for k, v in (spatial or {}).items()}
         self._stacked_cache: Dict[str, np.ndarray] = {}
+        self._bounds_cache: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
         n = max((s.num_rows for s in segments), default=0)
         self.padded_rows = max(ROW_ALIGN, -(-n // ROW_ALIGN) * ROW_ALIGN)
 
@@ -196,18 +202,82 @@ class Datasource:
         maxs = np.array([s.max_millis for s in self.segments], dtype=np.int64)
         return mins, maxs
 
-    def prune_segments(self, intervals) -> np.ndarray:
-        """Indices of segments overlapping any [lo, hi) milli-interval.
+    def segment_metric_bounds(self, name: str):
+        """([S] min, [S] max) of a numeric metric column per segment (NaNs /
+        null rows ignored) — zone-map pruning metadata, and the bounding-box
+        analog of the reference's spatial index."""
+        hit = self._bounds_cache.get(name)
+        if hit is not None:
+            return hit
+        col = self.metrics[name]
+        vals = col.values.astype(np.float64, copy=False)
+        mins = np.full(self.num_segments, np.inf)
+        maxs = np.full(self.num_segments, -np.inf)
+        for i, (s, e) in enumerate(self._boundaries()):
+            v = vals[s:e]
+            if col.validity is not None:
+                v = v[col.validity[s:e]]
+            v = v[~np.isnan(v)] if v.dtype.kind == "f" else v
+            if len(v):
+                mins[i] = v.min()
+                maxs[i] = v.max()
+        self._bounds_cache[name] = (mins, maxs)
+        return mins, maxs
+
+    def prune_segments(self, intervals, filter_spec=None) -> np.ndarray:
+        """Indices of segments overlapping any [lo, hi) milli-interval AND
+        not provably excluded by the filter's numeric/spatial bounds.
 
         ≈ interval-based segment selection (reference ``QueryIntervals`` +
-        ``DruidMetadataCache.assignHistoricalServers:276``)."""
+        ``DruidMetadataCache.assignHistoricalServers:276``); the filter part
+        is zone-map pruning over per-segment column bounds (the scan-era
+        analog of Druid's spatial R-tree / bitmap indexes). Conservative:
+        only top-level AND conjuncts prune; the full row-level filter still
+        runs on device."""
         if intervals is None:
-            return np.arange(self.num_segments)
-        mins, maxs = self.segment_time_bounds()
-        keep = np.zeros(self.num_segments, dtype=bool)
-        for lo, hi in intervals:
-            keep |= (maxs >= lo) & (mins < hi)
+            keep = np.ones(self.num_segments, dtype=bool)
+        else:
+            mins, maxs = self.segment_time_bounds()
+            keep = np.zeros(self.num_segments, dtype=bool)
+            for lo, hi in intervals:
+                keep |= (maxs >= lo) & (mins < hi)
+        if filter_spec is not None and keep.any():
+            keep &= self._filter_keep_mask(filter_spec)
         return np.nonzero(keep)[0]
+
+    def _filter_keep_mask(self, f) -> np.ndarray:
+        from spark_druid_olap_tpu.ir import spec as S
+        ones = np.ones(self.num_segments, dtype=bool)
+        if isinstance(f, S.LogicalFilter) and f.op == "and":
+            keep = ones
+            for x in f.fields:
+                keep = keep & self._filter_keep_mask(x)
+            return keep
+        if isinstance(f, S.SpatialFilter):
+            keep = ones
+            for ax, lo, hi in zip(f.axes, f.min_coords, f.max_coords):
+                if ax not in self.metrics:
+                    continue
+                mins, maxs = self.segment_metric_bounds(ax)
+                keep = keep & (maxs >= lo) & (mins <= hi)
+            return keep
+        if isinstance(f, S.BoundFilter) and f.dimension in self.metrics \
+                and self.metrics[f.dimension].kind.name in ("LONG", "DOUBLE"):
+            try:
+                mins, maxs = self.segment_metric_bounds(f.dimension)
+                keep = ones
+                if f.lower is not None:
+                    lo = float(f.lower)
+                    keep = keep & ((maxs > lo) if f.lower_strict
+                                   else (maxs >= lo))
+                if f.upper is not None:
+                    hi = float(f.upper)
+                    keep = keep & ((mins < hi) if f.upper_strict
+                                   else (mins <= hi))
+                return keep
+            except (TypeError, ValueError):
+                return ones
+        return ones
 
 
 class SegmentStore:
