@@ -50,6 +50,15 @@ class Context:
         from spark_druid_olap_tpu.metadata.history import QueryHistory
         from spark_druid_olap_tpu.utils.config import QUERY_HISTORY_SIZE
         self.history = QueryHistory(self.config.get(QUERY_HISTORY_SIZE))
+        # named lookup tables for the SQL LOOKUP(col, 'name') function
+        # (≈ Druid registered lookups backing the lookup extraction fn)
+        self.lookups: Dict[str, Dict[str, Optional[str]]] = {}
+
+    def register_lookup(self, name: str, mapping: Dict) -> None:
+        """Register a named value-translation map usable as
+        ``LOOKUP(col, 'name')`` in SQL (≈ Druid lookup registration)."""
+        self.lookups[name] = {str(k): (None if v is None else str(v))
+                              for k, v in mapping.items()}
 
     # -- ingest / registration ------------------------------------------------
     def ingest_dataframe(self, name, df, **kwargs):

@@ -218,6 +218,19 @@ def dim_to_dict(d: S.DimensionSpec):
         out["extractionFn"] = {"type": "expression",
                                "expr": expr_to_dict(d.extraction.expr),
                                "cardinality": d.extraction.cardinality}
+    elif isinstance(d.extraction, S.LookupExtraction):
+        # Druid-shaped map lookup extraction fn
+        out["extractionFn"] = {
+            "type": "lookup",
+            "lookup": {"type": "map", "map": dict(d.extraction.lookup)},
+            "retainMissingValue": d.extraction.retain_missing,
+            "replaceMissingValueWith": d.extraction.replace_missing_with}
+    elif isinstance(d.extraction, S.RegexExtraction):
+        out["extractionFn"] = {
+            "type": "regex", "expr": d.extraction.pattern,
+            "index": d.extraction.index,
+            "replaceMissingValue": d.extraction.replace_missing,
+            "replaceMissingValueWith": d.extraction.replace_missing_with}
     return out
 
 
@@ -227,6 +240,16 @@ def dim_from_dict(d) -> S.DimensionSpec:
     if fn is not None:
         if fn["type"] == "time":
             ex = S.TimeExtraction(fn["field"])
+        elif fn["type"] == "lookup":
+            ex = S.LookupExtraction(
+                tuple(sorted(fn["lookup"]["map"].items())),
+                fn.get("retainMissingValue", False),
+                fn.get("replaceMissingValueWith"))
+        elif fn["type"] == "regex":
+            ex = S.RegexExtraction(
+                fn["expr"], fn.get("index", 1),
+                fn.get("replaceMissingValue", False),
+                fn.get("replaceMissingValueWith"))
         else:
             ex = S.ExprExtraction(expr_from_dict(fn["expr"]),
                                   fn.get("cardinality"))

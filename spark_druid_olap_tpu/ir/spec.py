@@ -132,6 +132,31 @@ class ExprExtraction(ExtractionSpec):
 
 
 @dataclasses.dataclass(frozen=True)
+class LookupExtraction(ExtractionSpec):
+    """Map-based dimension value translation (reference:
+    LookUpExtractionFunctionSpec / InExtractionFnSpec,
+    DruidQuerySpec.scala:66-103). Missing keys keep the original value when
+    ``retain_missing``, become ``replace_missing_with`` when set, else null.
+    Evaluated as a host transform of the (small) dictionary, then a constant
+    code-remap LUT gather on device."""
+    lookup: Tuple[Tuple[str, Optional[str]], ...]   # (from, to) pairs
+    retain_missing: bool = False
+    replace_missing_with: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class RegexExtraction(ExtractionSpec):
+    """Regex capture-group extraction (reference:
+    RegexExtractionFunctionSpec, DruidQuerySpec.scala:56-58). Non-matching
+    values pass through unchanged unless ``replace_missing``, in which case
+    they become ``replace_missing_with`` (null by default)."""
+    pattern: str
+    index: int = 1
+    replace_missing: bool = False
+    replace_missing_with: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class DimensionSpec:
     """One GROUP BY output dimension (reference: DefaultDimensionSpec /
     ExtractionDimensionSpec :108-138)."""

@@ -368,7 +368,8 @@ def _func(e: E.Func, ctx):
         nd = jnp.minimum(d, nstart - start)  # clamp to month length
         return TimeValue(start + nd - 1, None)
     if name in _STR_FUNCS or name in ("substr", "substring", "concat",
-                                      "replace", "lpad", "rpad"):
+                                      "replace", "lpad", "rpad",
+                                      "regexp_extract", "__lookup_pairs"):
         return _str_func(name, e, ctx)
     if name in ("length", "char_length"):
         v = compile_expr(e.args[0], ctx)
@@ -484,6 +485,22 @@ def _str_func(name, e: E.Func, ctx):
         fn = (lambda s: s.rjust(n, fill)) if name == "lpad" \
             else (lambda s: s.ljust(n, fill))
         newvals = np.array([fn(s) for s in v.host_values], dtype=object)
+        return StrValue(v.codes, newvals)
+    if name == "regexp_extract":
+        rx = re.compile(_literal_str(e.args[1]))
+        idx = int(_literal_num(e.args[2])) if len(e.args) > 2 else 1
+
+        def rex(s):
+            m = rx.search(s) if isinstance(s, str) else None
+            return m.group(idx) if m is not None else None
+        newvals = np.array([rex(s) for s in v.host_values], dtype=object)
+        return StrValue(v.codes, newvals)
+    if name == "__lookup_pairs":
+        if not isinstance(e.args[1], E.Literal):
+            raise Unsupported("lookup table must be a literal")
+        table = dict(e.args[1].value)
+        newvals = np.array([table.get(s) for s in v.host_values],
+                           dtype=object)
         return StrValue(v.codes, newvals)
     raise Unsupported(f"string function {name}")
 

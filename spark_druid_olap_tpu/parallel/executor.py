@@ -301,6 +301,76 @@ def _plan_expr_extraction(dspec: S.DimensionSpec, ds: Datasource,
                    lambda idx: np.asarray(idx, np.int64), tuple(cols))
 
 
+def _plan_dict_transform(dspec: S.DimensionSpec, ds: Datasource,
+                         vals_fn) -> DimPlan:
+    """Dictionary-functional extraction: apply ``vals_fn`` to the dim's
+    dictionary on host (may yield None entries = null), factorize, and remap
+    codes through a constant LUT on device. Null output (and null input
+    rows) land in slot 0."""
+    name = dspec.dimension
+    if ds.column_kind(name) != ColumnKind.DIM:
+        raise EngineFallback("lookup/regex extraction over non-string column")
+    dim = ds.dims[name]
+    vals = vals_fn(dim.dictionary)
+    null_mask = np.array([v is None for v in vals], dtype=bool)
+    uniq = np.unique(np.asarray(
+        [str(v) for v, nm in zip(vals, null_mask) if not nm], dtype=object)) \
+        if (~null_mask).any() else np.empty(0, dtype=object)
+    pos = {v: j for j, v in enumerate(uniq)}
+    lut = np.array([0 if nm else 1 + pos[str(v)]
+                    for v, nm in zip(vals, null_mask)], dtype=np.int32)
+    has_nulls = dim.validity is not None
+
+    def build(ctx):
+        mapped = EC._take_lut(lut, ctx.col(name))
+        if has_nulls:
+            nv = ctx.null_valid(name)
+            mapped = jnp.where(nv, mapped, 0)
+        return mapped
+
+    def decode(idx):
+        idx = np.asarray(idx, np.int64)
+        out = np.empty(len(idx), dtype=object)
+        out[:] = [None if i == 0 else uniq[i - 1] for i in idx]
+        return out
+
+    return DimPlan(dspec.output_name, len(uniq) + 1, build, decode, (name,))
+
+
+def _lookup_vals_fn(ex: S.LookupExtraction):
+    table = dict(ex.lookup)
+
+    def vals_fn(dictionary):
+        out = []
+        for s in dictionary:
+            if s in table:
+                out.append(table[s])
+            elif ex.retain_missing:
+                out.append(s)
+            else:
+                out.append(ex.replace_missing_with)
+        return out
+    return vals_fn
+
+
+def _regex_vals_fn(ex: S.RegexExtraction):
+    import re as _re
+    rx = _re.compile(ex.pattern)
+
+    def vals_fn(dictionary):
+        out = []
+        for s in dictionary:
+            m = rx.search(s) if s is not None else None
+            if m is not None:
+                out.append(m.group(ex.index))
+            elif ex.replace_missing:
+                out.append(ex.replace_missing_with)
+            else:
+                out.append(s)
+        return out
+    return vals_fn
+
+
 def plan_dimension(dspec: S.DimensionSpec, ds: Datasource, min_day: int,
                    max_day: int) -> DimPlan:
     try:
@@ -309,6 +379,12 @@ def plan_dimension(dspec: S.DimensionSpec, ds: Datasource, min_day: int,
                                min_day, max_day)
         if isinstance(dspec.extraction, S.TimeExtraction):
             return _plan_time_extraction(dspec, ds, min_day, max_day)
+        if isinstance(dspec.extraction, S.LookupExtraction):
+            return _plan_dict_transform(dspec, ds,
+                                        _lookup_vals_fn(dspec.extraction))
+        if isinstance(dspec.extraction, S.RegexExtraction):
+            return _plan_dict_transform(dspec, ds,
+                                        _regex_vals_fn(dspec.extraction))
         if isinstance(dspec.extraction, S.ExprExtraction):
             return _plan_expr_extraction(dspec, ds, min_day, max_day)
     except EC.Unsupported as e:

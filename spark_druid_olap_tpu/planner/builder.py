@@ -355,6 +355,19 @@ class Builder:
             if grain in ("year", "quarter", "month", "week", "day"):
                 return S.DimensionSpec(e.args[1].name, name,
                                        S.TimeExtraction("trunc_" + grain))
+        if isinstance(e, E.Func) and e.name == "__lookup_pairs" \
+                and isinstance(e.args[0], E.Column) \
+                and isinstance(e.args[1], E.Literal):
+            return S.DimensionSpec(e.args[0].name, name,
+                                   S.LookupExtraction(tuple(e.args[1].value)))
+        if isinstance(e, E.Func) and e.name.lower() == "regexp_extract" \
+                and isinstance(e.args[0], E.Column) \
+                and all(isinstance(a, E.Literal) for a in e.args[1:]):
+            idx = int(e.args[2].value) if len(e.args) > 2 else 1
+            return S.DimensionSpec(
+                e.args[0].name, name,
+                S.RegexExtraction(str(e.args[1].value), idx,
+                                  replace_missing=True))
         return S.DimensionSpec(self._expr_dim_source(e), name,
                                S.ExprExtraction(e))
 

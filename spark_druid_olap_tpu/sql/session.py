@@ -14,6 +14,7 @@ from typing import List, Optional
 import numpy as np
 import pandas as pd
 
+from spark_druid_olap_tpu.ir import expr as E
 from spark_druid_olap_tpu.ir import spec as S
 from spark_druid_olap_tpu.parallel.executor import EngineFallback
 from spark_druid_olap_tpu.planner import builder as B
@@ -22,6 +23,67 @@ from spark_druid_olap_tpu.planner.plans import PlannedQuery, PlanUnsupported
 from spark_druid_olap_tpu.result import QueryResult
 from spark_druid_olap_tpu.sql import ast as A
 from spark_druid_olap_tpu.sql.parser import parse_statement
+
+
+def resolve_lookups(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
+    """Inline registered lookup tables: ``LOOKUP(col, 'name')`` becomes
+    ``__lookup_pairs(col, <pairs literal>)`` so both the pushdown builder
+    (-> LookupExtraction) and the host evaluator see a self-contained
+    expression (≈ Druid resolving a registered lookup by name)."""
+    if not getattr(ctx, "lookups", None) or not isinstance(stmt,
+                                                           A.SelectStmt):
+        return stmt
+    import dataclasses
+
+    def fix_expr(e):
+        if e is None or e == "*":
+            return e
+
+        def rep(n):
+            if isinstance(n, E.Func) and n.name.lower() == "lookup" \
+                    and len(n.args) == 2 \
+                    and isinstance(n.args[1], E.Literal) \
+                    and isinstance(n.args[1].value, str):
+                lname = n.args[1].value
+                table = ctx.lookups.get(lname)
+                if table is None:
+                    raise KeyError(f"unknown lookup {lname!r}; registered: "
+                                   f"{sorted(ctx.lookups)}")
+                pairs = tuple(sorted(table.items()))
+                return E.Func("__lookup_pairs", (n.args[0],
+                                                 E.Literal(pairs)))
+            if isinstance(n, (A.ScalarSubquery, A.Exists, A.InSubquery)):
+                return dataclasses.replace(n,
+                                           query=resolve_lookups(ctx,
+                                                                 n.query))
+            return n
+        return E.transform(e, rep)
+
+    def fix_rel(rel):
+        if isinstance(rel, A.Join):
+            return dataclasses.replace(
+                rel, left=fix_rel(rel.left), right=fix_rel(rel.right),
+                condition=fix_expr(rel.condition))
+        if isinstance(rel, A.SubqueryRef):
+            return dataclasses.replace(rel,
+                                       query=resolve_lookups(ctx, rel.query))
+        return rel
+
+    gb = stmt.group_by
+    if isinstance(gb, A.GroupingSets):
+        gb = A.GroupingSets(tuple(tuple(fix_expr(g) for g in s)
+                                  for s in gb.sets))
+    elif gb is not None:
+        gb = tuple(fix_expr(g) for g in gb)
+    return dataclasses.replace(
+        stmt,
+        items=tuple(dataclasses.replace(it, expr=fix_expr(it.expr))
+                    for it in stmt.items),
+        relation=None if stmt.relation is None else fix_rel(stmt.relation),
+        where=fix_expr(stmt.where), group_by=gb,
+        having=fix_expr(stmt.having),
+        order_by=tuple(dataclasses.replace(o, expr=fix_expr(o.expr))
+                       for o in stmt.order_by))
 
 
 def run_sql(ctx, sql: str) -> QueryResult:
@@ -60,6 +122,7 @@ def explain_text(ctx, stmt: A.SelectStmt, sql: str) -> str:
     — shows whether the query pushes down, the engine query specs, and the
     cost-model decision."""
     lines = [f"SQL: {sql.strip()}"]
+    stmt = resolve_lookups(ctx, stmt)
     try:
         pq = B.build(ctx, stmt)
     except PlanUnsupported as e:
@@ -84,6 +147,7 @@ def explain_text(ctx, stmt: A.SelectStmt, sql: str) -> str:
 
 def _run_select(ctx, stmt: A.SelectStmt, sql: str) -> QueryResult:
     t0 = _time.perf_counter()
+    stmt = resolve_lookups(ctx, stmt)
     try:
         from spark_druid_olap_tpu.planner.decorrelate import inline_subqueries
         stmt2 = inline_subqueries(ctx, stmt)

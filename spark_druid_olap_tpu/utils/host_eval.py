@@ -307,6 +307,23 @@ def _func(e: E.Func, env):
         return fn(np.asarray(args[0], dtype=np.float64))
     if name in ("power", "pow"):
         return np.power(np.asarray(args[0], dtype=np.float64), args[1])
+    if name == "regexp_extract":
+        import re as _re
+        rx = _re.compile(str(args[1]))
+        idx = int(args[2]) if len(args) > 2 else 1
+
+        def rex(s):
+            m = rx.search(s) if isinstance(s, str) else None
+            return m.group(idx) if m is not None else None
+        return _map1(args[0], rex)
+    if name == "__lookup_pairs":
+        # LOOKUP(col, 'name') after session resolution: args[1] is the
+        # (from, to) pairs; missing keys map to null (Druid SQL LOOKUP)
+        table = dict(args[1])
+
+        def lk(s):
+            return table.get(s)
+        return _map1(args[0], lk)
     if name == "coalesce":
         out = args[-1]
         for a in reversed(args[:-1]):
