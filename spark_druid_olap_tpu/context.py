@@ -53,6 +53,23 @@ class Context:
         # named lookup tables for the SQL LOOKUP(col, 'name') function
         # (≈ Druid registered lookups backing the lookup extraction fn)
         self.lookups: Dict[str, Dict[str, Optional[str]]] = {}
+        # module extension points (≈ SparklineDataModule/ModuleLoader)
+        from spark_druid_olap_tpu.utils import host_eval as _he
+        self.functions = _he.EXTRA_FUNCTIONS
+        self.spec_rules = []
+        self.statement_handlers = []
+        self.modules = []
+        from spark_druid_olap_tpu.utils.config import MODULES
+        mods_csv = self.config.get(MODULES)
+        if mods_csv:
+            from spark_druid_olap_tpu.utils.modules import install_from_config
+            self.modules = install_from_config(self, mods_csv)
+
+    def install_module(self, module) -> None:
+        """Install an extension module programmatically (≈ adding to
+        spark.sparklinedata.modules)."""
+        module.install(self)
+        self.modules.append(module)
 
     def register_lookup(self, name: str, mapping: Dict) -> None:
         """Register a named value-translation map usable as

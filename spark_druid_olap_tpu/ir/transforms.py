@@ -129,11 +129,14 @@ RULES: List[Rule] = [add_count_when_no_aggs, groupby_to_topn,
                      groupby_to_timeseries]
 
 
-def transform(q: S.QuerySpec, conf: Config) -> S.QuerySpec:
-    """Run rules to fixpoint (bounded) — ≈ TransformExecutor batches."""
+def transform(q: S.QuerySpec, conf: Config,
+              extra_rules=()) -> S.QuerySpec:
+    """Run rules to fixpoint (bounded) — ≈ TransformExecutor batches.
+    ``extra_rules`` come from installed extension modules."""
+    rules = RULES + list(extra_rules)
     for _ in range(4):
         changed = False
-        for rule in RULES:
+        for rule in rules:
             r = rule(q, conf)
             if r is not None:
                 q = r

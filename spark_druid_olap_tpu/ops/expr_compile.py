@@ -396,6 +396,16 @@ def _func(e: E.Func, ctx):
         b = _as_num(compile_expr(e.args[1], ctx), ctx)
         return NumValue(jnp.power(a.arr.astype(jnp.float32),
                                   b.arr.astype(jnp.float32)), True)
+    from spark_druid_olap_tpu.utils.host_eval import EXTRA_FUNCTIONS
+    if name in EXTRA_FUNCTIONS and len(e.args) == 1:
+        # module-contributed scalar fn over a string dim: vectorize through
+        # the dictionary (host transform + code re-gather), so custom
+        # functions still push down
+        v = compile_expr(e.args[0], ctx)
+        if isinstance(v, StrValue):
+            fn = EXTRA_FUNCTIONS[name]
+            newvals = np.array([fn(s) for s in v.host_values], dtype=object)
+            return StrValue(v.codes, newvals)
     raise Unsupported(f"function {name}")
 
 

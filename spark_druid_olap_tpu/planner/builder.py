@@ -619,7 +619,8 @@ class Builder:
                 filter=filter_spec, having=having_spec,
                 limit=limit_spec if not multi_set else None,
                 intervals=intervals)
-            q = QT.transform(q, self.ctx.config)
+            q = QT.transform(q, self.ctx.config,
+                             getattr(self.ctx, "spec_rules", ()))
             specs.append(q)
             spec_dims.append(set_dim_names)
 

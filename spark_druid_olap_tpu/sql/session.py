@@ -87,6 +87,12 @@ def resolve_lookups(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
 
 
 def run_sql(ctx, sql: str) -> QueryResult:
+    # module-contributed front commands (≈ SPLParser trying its command
+    # grammar before the base parser)
+    for handler in getattr(ctx, "statement_handlers", ()):
+        r = handler(ctx, sql)
+        if r is not None:
+            return r
     stmt = parse_statement(sql)
     if isinstance(stmt, A.ClearMetadata):
         if stmt.datasource:

@@ -82,6 +82,11 @@ NON_AGG_PUSHDOWN = _entry(
     "Handling of non-aggregate queries: push_project_and_filters | "
     "push_filters | push_none (reference: NonAggregateQueryHandling, "
     "DruidRelationInfo.scala:27-32).")
+MODULES = _entry(
+    "sdot.modules", "",
+    "Comma-separated extension modules to install at Context creation, as "
+    "package.module:ClassName (reference: spark.sparklinedata.modules via "
+    "ModuleLoader).")
 # --- cost model knobs (reference: DruidQueryCostModel via DruidPlanner) -------
 COST_MODEL_ENABLED = _entry(
     "sdot.querycostmodel.enabled", True,

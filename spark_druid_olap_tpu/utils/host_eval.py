@@ -330,4 +330,23 @@ def _func(e: E.Func, env):
             isnull = _map_null(a) if isinstance(a, np.ndarray) else (a is None)
             out = np.where(isnull, out, a)
         return out
+    fn = EXTRA_FUNCTIONS.get(name)
+    if fn is not None:
+        arrs = [a for a in args if isinstance(a, np.ndarray)]
+        if not arrs:
+            return fn(*args)
+        n = len(arrs[0])
+        out = np.array([fn(*[(a[i] if isinstance(a, np.ndarray) else a)
+                             for a in args]) for i in range(n)],
+                       dtype=object)
+        try:
+            return out.astype(np.float64)
+        except (ValueError, TypeError):
+            return out
     raise HostEvalError(f"function {name}")
+
+
+# module-contributed SQL scalar functions (≈ the reference registering UDFs
+# into Spark's global FunctionRegistry via BaseModule.registerFunctions);
+# Context.install_module populates this
+EXTRA_FUNCTIONS: dict = {}
