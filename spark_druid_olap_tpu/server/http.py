@@ -167,7 +167,48 @@ class SqlServer:
             h._send(200, json.dumps({"history": rows},
                                     default=str).encode())
             return
+        if url.path in ("/ui", "/ui/"):
+            h._send(200, self._ui_page(), "text/html; charset=utf-8")
+            return
         h._send(404, b'{"error": "not found"}')
+
+    def _ui_page(self) -> bytes:
+        """Engine-queries page (≈ the reference's Druid-queries web-UI tab,
+        ui/DruidQueriesPage.scala): query history newest-first with mode,
+        datasource, segments, groups, timing, and the SQL text."""
+        import html as _html
+        import time as _time
+        rows = []
+        for r in reversed(self.ctx.history.entries()):
+            st = r.stats
+            ts = _time.strftime("%Y-%m-%d %H:%M:%S",
+                                _time.gmtime(r.started_at))
+            rows.append(
+                "<tr><td>{}</td><td>{}</td><td>{}</td><td>{}</td>"
+                "<td>{}</td><td>{}</td><td>{:.1f}</td>"
+                "<td class=sql>{}</td></tr>".format(
+                    ts, _html.escape(str(r.query_type or "")),
+                    _html.escape(str(r.datasource or "")),
+                    _html.escape(str(st.get("mode", ""))),
+                    st.get("segments", ""), st.get("groups", ""),
+                    float(st.get("total_ms", 0.0)),
+                    _html.escape((r.sql or "")[:500])))
+        page = (
+            "<!doctype html><html><head><title>sdot queries</title><style>"
+            "body{font-family:sans-serif;margin:1em}"
+            "table{border-collapse:collapse;width:100%}"
+            "td,th{border:1px solid #ccc;padding:4px 8px;font-size:13px;"
+            "text-align:left}th{background:#eee}"
+            ".sql{font-family:monospace;max-width:40em;overflow-wrap:"
+            "anywhere}</style></head><body>"
+            "<h2>Engine queries</h2>"
+            f"<p>{len(rows)} recorded; datasources: "
+            f"{', '.join(self.ctx.store.names()) or '(none)'}</p>"
+            "<table><tr><th>started (UTC)</th><th>type</th>"
+            "<th>datasource</th><th>mode</th><th>segments</th>"
+            "<th>groups</th><th>total ms</th><th>sql</th></tr>"
+            + "".join(rows) + "</table></body></html>")
+        return page.encode()
 
     def _read_json(self, h) -> dict:
         n = int(h.headers.get("Content-Length", "0"))

@@ -189,3 +189,16 @@ def test_subquery_inlining_pushdown():
     assert ctx.history.entries()[-1].stats["mode"] == "engine"
     prods = set(df[df.price > np.float32(990)]["product"])
     assert int(r["c"][0]) == int(df["product"].isin(prods).sum())
+
+
+def test_ui_page(server):
+    import urllib.request
+    _post(server, "/sql", {"sql": "select count(*) as c from sales"})
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/ui") as r:
+        assert r.status == 200
+        assert "text/html" in r.headers["Content-Type"]
+        body = r.read().decode()
+    assert "Engine queries" in body
+    assert "select count(*) as c from sales" in body
+    assert "sales" in body
