@@ -112,7 +112,9 @@ def setup(sf: float):
 def measure_floor(ctx, reps: int) -> float:
     """Fixed per-dispatch overhead: a compiled trivial device query, timed
     end-to-end (dominated by the host<->device round trip)."""
-    q = "select count(*) as c from supplier where s_suppkey = 1"
+    q = ("select count(*) as c from supplier where s_suppkey = 1"
+         if "supplier" in ctx.store.names()
+         else "select count(*) as c from lineorder where lo_orderkey = 1")
     ctx.sql(q)
     ts = []
     for _ in range(max(reps, 5)):
@@ -124,25 +126,46 @@ def measure_floor(ctx, reps: int) -> float:
     return floor
 
 
+def setup_ssb(sf: float):
+    """SSB suite (SDOT_BENCH_SUITE=ssb): 13 star-join queries on the
+    denormalized lineorder index (BASELINE config 3)."""
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.tools import ssb
+    ctx = sdot.Context()
+    t0 = time.perf_counter()
+    tables, flat = ssb.setup_context(ctx, sf=sf, target_rows=1 << 20)
+    n = len(flat)
+    log(f"ssb SF{sf}: {n:,} lineorder rows, ingest+gen "
+        f"{time.perf_counter() - t0:.1f}s")
+    return ctx, n, ssb.QUERIES
+
+
 def main():
     sf = float(os.environ.get("SDOT_BENCH_SF", "1.0"))
     reps = int(os.environ.get("SDOT_BENCH_REPS", "5"))
+    suite = os.environ.get("SDOT_BENCH_SUITE", "tpch")
     qsel = os.environ.get("SDOT_BENCH_QUERIES", "")
-    names = [s.strip() for s in qsel.split(",") if s.strip()] or ALL22
 
     import jax
     log(f"backend={jax.default_backend()} devices={jax.devices()}")
 
     from spark_druid_olap_tpu.tools import tpch
 
-    ctx, n_rows = setup(sf)
+    if suite == "ssb":
+        ctx, n_rows, queries = setup_ssb(sf)
+        names = [s.strip() for s in qsel.split(",") if s.strip()] \
+            or list(queries)
+    else:
+        queries = tpch.QUERIES
+        names = [s.strip() for s in qsel.split(",") if s.strip()] or ALL22
+        ctx, n_rows = setup(sf)
     floor_ms = measure_floor(ctx, reps)
 
     lat = {}
     for name in names:
         # queries run as written over the base tables; the planner's
         # star-join collapse routes fact+dim joins onto the flat index
-        sql = tpch.QUERIES[name]
+        sql = queries[name]
         try:
             t0 = time.perf_counter()
             r = ctx.sql(sql)
@@ -182,7 +205,7 @@ def main():
     vs = float(np.exp(np.mean(np.log(ratios)))) if ratios else 0.0
 
     out = {
-        "metric": f"tpch_sf{sf}_22query_geomean_latency_ms",
+        "metric": f"{suite}_sf{sf}_{len(lat)}query_geomean_latency_ms",
         "value": round(geomean, 2),
         "unit": "ms",
         "vs_baseline": round(vs, 3),

@@ -1,0 +1,90 @@
+"""SSB 13-query suite — differential tests against pandas oracles on the
+flat frame, plus plan assertions that every query collapses onto the flat
+index and pushes down (the whole point of SSB for this engine)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.tools import ssb
+
+
+@pytest.fixture(scope="module")
+def env():
+    ctx = sdot.Context()
+    tables, flat = ssb.setup_context(ctx, sf=0.003, target_rows=4096)
+    return ctx, flat
+
+
+def run(ctx, name):
+    r = ctx.sql(ssb.QUERIES[name]).to_pandas()
+    mode = ctx.history.entries()[-1].stats["mode"]
+    return r, mode
+
+
+def test_all_13_push_down_and_run(env):
+    ctx, flat = env
+    for name in ssb.QUERIES:
+        r, mode = run(ctx, name)
+        assert mode == "engine", f"{name} fell back: {mode}"
+
+
+def test_q1_1_oracle(env):
+    ctx, flat = env
+    got, mode = run(ctx, "q1.1")
+    m = (flat.d_year == 1993) & flat.lo_discount.between(1, 3) & \
+        (flat.lo_quantity < 25)
+    want = (flat.lo_extendedprice[m] * flat.lo_discount[m]).sum()
+    np.testing.assert_allclose(float(got["revenue"][0]), want, rtol=1e-6)
+
+
+def test_q2_1_oracle(env):
+    ctx, flat = env
+    got, _ = run(ctx, "q2.1")
+    m = (flat.p_category == "MFGR#12") & (flat.s_region == "AMERICA")
+    want = flat[m].groupby(["d_year", "p_brand1"]).lo_revenue.sum() \
+        .reset_index().sort_values(["d_year", "p_brand1"]) \
+        .reset_index(drop=True)
+    assert list(got["d_year"]) == list(want["d_year"])
+    assert list(got["p_brand1"]) == list(want["p_brand1"])
+    np.testing.assert_allclose(got["lo_revenue"], want["lo_revenue"],
+                               rtol=1e-5)
+
+
+def test_q3_1_oracle(env):
+    ctx, flat = env
+    got, _ = run(ctx, "q3.1")
+    m = (flat.c_region == "ASIA") & (flat.s_region == "ASIA") & \
+        flat.d_year.between(1992, 1997)
+    want = flat[m].groupby(["c_nation", "s_nation", "d_year"]) \
+        .lo_revenue.sum().reset_index()
+    assert len(got) == len(want)
+    gm = got.set_index(["c_nation", "s_nation", "d_year"]).lo_revenue
+    for _, row in want.iterrows():
+        np.testing.assert_allclose(
+            gm[(row.c_nation, row.s_nation, row.d_year)], row.lo_revenue,
+            rtol=1e-5)
+
+
+def test_q4_1_oracle(env):
+    ctx, flat = env
+    got, _ = run(ctx, "q4.1")
+    m = (flat.c_region == "AMERICA") & (flat.s_region == "AMERICA") & \
+        flat.p_mfgr.isin(["MFGR#1", "MFGR#2"])
+    want = flat[m].assign(pf=flat.lo_revenue - flat.lo_supplycost) \
+        .groupby(["d_year", "c_nation"]).pf.sum().reset_index() \
+        .sort_values(["d_year", "c_nation"]).reset_index(drop=True)
+    assert list(got["d_year"]) == list(want["d_year"])
+    assert list(got["c_nation"]) == list(want["c_nation"])
+    np.testing.assert_allclose(got["profit"], want["pf"], rtol=1e-5)
+
+
+def test_q3_4_empty_or_small(env):
+    ctx, flat = env
+    got, mode = run(ctx, "q3.4")
+    m = (flat.c_city.isin(["UNITED KI1", "UNITED KI5"])
+         & flat.s_city.isin(["UNITED KI1", "UNITED KI5"])
+         & (flat.d_yearmonth == "Dec1997"))
+    assert len(got) == len(
+        flat[m].groupby(["c_city", "s_city", "d_year"]).size())
