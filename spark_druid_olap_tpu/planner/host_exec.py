@@ -48,6 +48,35 @@ def datasource_frame(ctx, name: str) -> pd.DataFrame:
     return pd.DataFrame(data)
 
 
+def try_engine(ctx, stmt: A.SelectStmt) -> Optional[pd.DataFrame]:
+    """Engine-assisted host tier: attempt device pushdown of an
+    uncorrelated sub-statement (derived table, inner block of a subquery).
+
+    ≈ the reference's property that a non-rewritten outer plan still gets
+    Druid acceleration for rewritable *subtrees* (Catalyst plans each
+    relational subtree independently, so a derived table over the fact
+    table hits DruidStrategy even when the outer join does not). Returns
+    None when the sub-statement cannot push down.
+    """
+    from spark_druid_olap_tpu.parallel.executor import EngineFallback
+    from spark_druid_olap_tpu.planner import builder as B
+    from spark_druid_olap_tpu.planner.plans import PlanUnsupported
+    try:
+        from spark_druid_olap_tpu.planner.decorrelate import \
+            inline_subqueries
+        from spark_druid_olap_tpu.sql.session import execute_planned
+        stmt2 = inline_subqueries(ctx, stmt)
+        pq = B.build(ctx, stmt2)
+        df = execute_planned(ctx, pq)
+        ctx.history.record(stmt2, {**ctx.engine.last_stats,
+                                   "mode": "engine"},
+                           sql="(engine-assisted subtree)")
+        return df
+    except (PlanUnsupported, EngineFallback, HostExecError,
+            host_eval.HostEvalError, KeyError):
+        return None
+
+
 # -- schema resolution --------------------------------------------------------
 
 def relation_columns(ctx, rel: A.Relation) -> List[str]:
@@ -162,7 +191,11 @@ def resolve_subqueries(ctx, e: E.Expr, env: Dict[str, np.ndarray],
 
 
 def _execute_sub_once(ctx, node, outer_env):
-    df = execute_select(ctx, node.query, outer_env=outer_env)
+    df = None
+    if not outer_env and getattr(ctx, "host_engine_assist", True):
+        df = try_engine(ctx, node.query)
+    if df is None:
+        df = execute_select(ctx, node.query, outer_env=outer_env)
     if isinstance(node, A.ScalarSubquery):
         if df.shape[0] == 0:
             return E.Literal(None)
@@ -329,10 +362,14 @@ def _execute_sub_decorrelated(ctx, node, env, free, n_rows, outer_env):
         q2 = dataclasses.replace(
             q, items=tuple(items), where=inner_where, group_by=None,
             having=None, order_by=(), limit=None, distinct=False)
-    try:
-        df2 = execute_select(ctx, q2, outer_env=outer_env)
-    except (HostExecError, host_eval.HostEvalError):
-        return None
+    df2 = None
+    if not outer_env and getattr(ctx, "host_engine_assist", True):
+        df2 = try_engine(ctx, q2)
+    if df2 is None:
+        try:
+            df2 = execute_select(ctx, q2, outer_env=outer_env)
+        except (HostExecError, host_eval.HostEvalError):
+            return None
 
     # outer side
     outer = {}
@@ -523,6 +560,10 @@ def materialize_relation(ctx, rel: A.Relation,
     if isinstance(rel, A.TableRef):
         return datasource_frame(ctx, rel.name)
     if isinstance(rel, A.SubqueryRef):
+        if getattr(ctx, "host_engine_assist", True):
+            df = try_engine(ctx, rel.query)
+            if df is not None:
+                return df
         return execute_select(ctx, rel.query, outer_env=outer_env)
     if isinstance(rel, A.Join):
         left = materialize_relation(ctx, rel.left, outer_env)

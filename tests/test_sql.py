@@ -40,7 +40,12 @@ def ctest(ctx, sales, sql, expect_pushdown=True, n_queries=None, sort=True):
     from spark_druid_olap_tpu.planner import host_exec
     got = ctx.sql(sql).to_pandas()
     stmt = parse_select(sql)
-    want = host_exec.execute_select(ctx, stmt)
+    # the oracle must stay engine-free (no engine-assisted subtrees)
+    ctx.host_engine_assist = False
+    try:
+        want = host_exec.execute_select(ctx, stmt)
+    finally:
+        ctx.host_engine_assist = True
     rec = ctx.history.entries()[-1]
     if expect_pushdown:
         assert rec.stats["mode"] == "engine", rec.stats["mode"]
@@ -330,3 +335,22 @@ def test_decorrelated_not_in_inner_null_is_unknown(probe_ctx):
         "(select ival from inner_t where iregion = oregion)").to_pandas()
     # east: {NULL, 7} -> UNKNOWN (dropped); west: {8} -> TRUE (kept)
     assert int(got["c"][0]) == 1
+
+
+def test_derived_table_engine_assist(ctx, sales):
+    # the outer join is host-tier, but the derived aggregate over the fact
+    # table must run through the device engine (engine-assisted host tier)
+    n0 = len([r for r in ctx.history.entries()])
+    got = ctx.sql("""
+        select region, total from
+        (select region, sum(price) as total from sales group by region) t
+        where total > 0 order by region
+    """).to_pandas()
+    want = sales.groupby("region").price.sum()
+    assert list(got["region"]) == sorted(want.index)
+    np.testing.assert_allclose(got["total"],
+                               [want[r] for r in sorted(want.index)],
+                               rtol=1e-6)
+    # the derived block was recorded as an engine execution
+    modes = [r.stats.get("mode") for r in ctx.history.entries()[n0:]]
+    assert "engine" in modes

@@ -38,7 +38,11 @@ def test_tpch_query_differential(tctx, name):
     rec = tctx.history.entries()[-1]
     assert rec.stats["mode"] == "engine", \
         f"{name} did not push down: {rec.stats['mode']}"
-    want = host_exec.execute_select(tctx, parse_select(sql))
+    tctx.host_engine_assist = False
+    try:
+        want = host_exec.execute_select(tctx, parse_select(sql))
+    finally:
+        tctx.host_engine_assist = True
     ordered = "order by" in sql.lower()
     if ordered:
         assert_frames_equal(got, want, sort_by=None, rtol=1e-4)
