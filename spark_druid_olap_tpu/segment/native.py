@@ -118,3 +118,78 @@ def encode_strings(raw) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         k, pa.py_buffer(dict_off_b), pa.py_buffer(dict_data))
     dictionary = np.asarray(dict_arr.to_pandas(), dtype=object)
     return dictionary, codes
+
+
+def _string_col_buffers(series):
+    """object/string column -> (data, offsets, valid_u8) arrow buffers, or
+    None when not string-like."""
+    import pyarrow as pa
+    try:
+        arr = pa.array(series, type=pa.string(), from_pandas=True)
+    except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
+        return None
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if arr.offset != 0:
+        arr = pa.concat_arrays([arr])
+    valid = None
+    if arr.null_count:
+        import pyarrow.compute as pc
+        valid = np.asarray(pc.is_valid(arr)).astype(np.uint8)
+        arr = arr.fill_null("")
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        if arr.offset != 0:
+            arr = pa.concat_arrays([arr])
+    bufs = arr.buffers()
+    data = bufs[2] if bufs[2] is not None else b""
+    return data, bufs[1], valid
+
+
+def encode_json_rows(df) -> Optional[bytes]:
+    """Fast path for the serving tier: DataFrame -> JSON rows-array bytes
+    (the ``"rows": [...]`` payload), encoded in C++ with the GIL released.
+    Returns None when the native module is unavailable or a column type is
+    not supported (caller falls back to the python json path)."""
+    mod = load()
+    if mod is None or not hasattr(mod, "encode_json_rows"):
+        return None
+    import json as _json
+    names = []
+    cols = []
+    n = len(df)
+    for c in df.columns:
+        s = df[c]
+        dt = s.dtype
+        names.append((_json.dumps(str(c)) + ":").encode())
+        if dt == object or str(dt).startswith(("string", "str")):
+            r = _string_col_buffers(s)
+            if r is None:
+                return None
+            data, offsets, valid = r
+            cols.append((2, data, offsets, valid))
+            continue
+        if not isinstance(dt, np.dtype):
+            return None        # extension dtypes (categorical, nullable...)
+        if np.issubdtype(dt, np.floating):
+            cols.append((0, np.ascontiguousarray(s.to_numpy(np.float64)),
+                         None, None))
+        elif np.issubdtype(dt, np.bool_):
+            cols.append((3, np.ascontiguousarray(
+                s.to_numpy()).astype(np.uint8), None, None))
+        elif np.issubdtype(dt, np.integer):
+            cols.append((1, np.ascontiguousarray(s.to_numpy(np.int64)),
+                         None, None))
+        elif np.issubdtype(dt, np.datetime64):
+            v = s.to_numpy()
+            valid = (~np.isnat(v)).astype(np.uint8)
+            ms = v.astype("datetime64[ms]").astype(np.int64)
+            cols.append((4, np.ascontiguousarray(ms), None,
+                         valid if (valid == 0).any() else None))
+        else:
+            return None
+    try:
+        return mod.encode_json_rows(tuple(names), tuple(cols), n)
+    except Exception as e:  # noqa: BLE001
+        log.warning("native json encode failed (%s)", e)
+        return None

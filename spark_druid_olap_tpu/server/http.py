@@ -35,6 +35,14 @@ import pandas as pd
 
 
 def _df_to_json_rows(df: pd.DataFrame) -> bytes:
+    # native C++ row encoder (GIL-released) when available/eligible
+    from spark_druid_olap_tpu.segment.native import encode_json_rows
+    rows_b = encode_json_rows(df)
+    if rows_b is not None:
+        head = json.dumps({"columns": list(df.columns)})[:-1].encode()
+        return (head + b', "rows": ' + rows_b +
+                b', "numRows": %d}' % len(df))
+
     def conv(v):
         if isinstance(v, (np.integer,)):
             return int(v)
