@@ -724,6 +724,7 @@ class Builder:
             raise PlanUnsupported("non-aggregate pushdown disabled")
         stmt = self.stmt
         cols: List[str] = []
+        renames: Dict[str, str] = {}
         for item in stmt.items:
             if item.expr == "*" or (isinstance(item.expr, E.Column)
                                     and item.expr.name == "*"):
@@ -732,8 +733,12 @@ class Builder:
             if not isinstance(item.expr, E.Column):
                 raise PlanUnsupported("computed select item on select path")
             if item.alias and item.alias != item.expr.name:
-                raise PlanUnsupported("aliased select item on select path")
+                if item.expr.name in renames:
+                    raise PlanUnsupported(
+                        "column selected twice with different aliases")
+                renames[item.expr.name] = item.alias
             cols.append(item.expr.name)
+        out_cols = [renames.get(c, c) for c in cols]
         if stmt.distinct:
             # SELECT DISTINCT dims -> group-by rewrite
             dims = tuple(S.DimensionSpec(c, c) for c in cols)
@@ -745,8 +750,9 @@ class Builder:
                         for o in stmt.order_by]
             return PlannedQuery(
                 datasource=ds_name, specs=[q], spec_dims=[list(cols)],
-                all_dims=list(cols), output_columns=cols,
-                order_by=order_by, limit=stmt.limit)
+                all_dims=list(cols), output_columns=out_cols,
+                order_by=order_by, limit=stmt.limit,
+                select_renames=renames)
         order_by = [(self._select_order_col(o, cols), o.ascending)
                     for o in stmt.order_by]
         q = S.SelectQuerySpec(
@@ -756,8 +762,8 @@ class Builder:
                        else 1 << 31))
         return PlannedQuery(
             datasource=ds_name, specs=[q], spec_dims=[[]], all_dims=[],
-            output_columns=list(cols), order_by=order_by, limit=stmt.limit,
-            select_path=True)
+            output_columns=out_cols, order_by=order_by, limit=stmt.limit,
+            select_path=True, select_renames=renames)
 
     def _select_order_col(self, o: A.OrderItem, cols: List[str]) -> str:
         e = o.expr

@@ -39,13 +39,24 @@ SYS_VIEWS = {
 }
 
 
-def datasource_frame(ctx, name: str) -> pd.DataFrame:
+def datasource_frame(ctx, name: str, columns=None) -> pd.DataFrame:
+    """Materialize a datasource as pandas; ``columns`` (a set) limits the
+    materialized columns to those present in the table (callers pass the
+    statement's referenced columns — projection pushdown for the host
+    tier)."""
     from spark_druid_olap_tpu.parallel.executor import _host_column_values
     if name in SYS_VIEWS and name not in ctx.store.names():
         return SYS_VIEWS[name](ctx)
     ds = ctx.store.get(name)
-    data = {c: _host_column_values(ds, c, None) for c in ds.column_names()}
-    return pd.DataFrame(data)
+    names = ds.column_names()
+    if columns is not None:
+        names = [c for c in names if c in columns]
+    data = {c: _host_column_values(ds, c, None) for c in names}
+    out = pd.DataFrame(data)
+    if len(out.columns) == 0:
+        # no referenced columns (e.g. count(*) only): keep the row count
+        out.index = range(ds.num_rows)
+    return out
 
 
 def try_engine(ctx, stmt: A.SelectStmt) -> Optional[pd.DataFrame]:
@@ -61,6 +72,12 @@ def try_engine(ctx, stmt: A.SelectStmt) -> Optional[pd.DataFrame]:
     from spark_druid_olap_tpu.parallel.executor import EngineFallback
     from spark_druid_olap_tpu.planner import builder as B
     from spark_druid_olap_tpu.planner.plans import PlanUnsupported
+    cache = getattr(ctx, "_assist_cache", None)
+    if cache is None:
+        cache = ctx._assist_cache = {}
+    key = (ctx.store.version, repr(stmt))
+    if key in cache:
+        return cache[key]
     try:
         from spark_druid_olap_tpu.planner.decorrelate import \
             inline_subqueries
@@ -71,10 +88,13 @@ def try_engine(ctx, stmt: A.SelectStmt) -> Optional[pd.DataFrame]:
         ctx.history.record(stmt2, {**ctx.engine.last_stats,
                                    "mode": "engine"},
                            sql="(engine-assisted subtree)")
-        return df
     except (PlanUnsupported, EngineFallback, HostExecError,
             host_eval.HostEvalError, KeyError):
-        return None
+        df = None
+    if len(cache) > 64:
+        cache.clear()
+    cache[key] = df
+    return df
 
 
 # -- schema resolution --------------------------------------------------------
@@ -555,10 +575,13 @@ def _split_conjuncts(e: Optional[E.Expr]) -> List[E.Expr]:
     return [e]
 
 
-def materialize_relation(ctx, rel: A.Relation,
-                         outer_env: Optional[dict]) -> pd.DataFrame:
+def materialize_relation(ctx, rel: A.Relation, outer_env: Optional[dict],
+                         need=None) -> pd.DataFrame:
+    """``need``: optional set of columns the enclosing statement references
+    — projection pushdown for the host tier; join keys/conditions are added
+    as the walk descends. None = everything."""
     if isinstance(rel, A.TableRef):
-        return datasource_frame(ctx, rel.name)
+        return datasource_frame(ctx, rel.name, columns=need)
     if isinstance(rel, A.SubqueryRef):
         if getattr(ctx, "host_engine_assist", True):
             df = try_engine(ctx, rel.query)
@@ -566,8 +589,10 @@ def materialize_relation(ctx, rel: A.Relation,
                 return df
         return execute_select(ctx, rel.query, outer_env=outer_env)
     if isinstance(rel, A.Join):
-        left = materialize_relation(ctx, rel.left, outer_env)
-        right = materialize_relation(ctx, rel.right, outer_env)
+        if need is not None and rel.condition is not None:
+            need = need | _expr_refs(ctx, rel.condition)
+        left = materialize_relation(ctx, rel.left, outer_env, need)
+        right = materialize_relation(ctx, rel.right, outer_env, need)
         conjs = _split_conjuncts(rel.condition)
         eq_pairs = []
         residual = []
@@ -702,13 +727,48 @@ def _compute_agg(series_env, df, call: E.AggCall, ctx, outer_env, group_ids,
     return full.to_numpy()
 
 
+def _stmt_column_refs(ctx, stmt: A.SelectStmt):
+    """Columns the statement references (incl. free columns of nested
+    subqueries), or None when a '*' item needs everything."""
+    refs = set()
+
+    def add(e):
+        if e is None:
+            return
+        refs.update(_expr_refs(ctx, e))
+
+    for item in stmt.items:
+        if item.expr == "*" or (isinstance(item.expr, E.Column)
+                                and item.expr.name == "*"):
+            return None
+        add(item.expr)
+    add(stmt.where)
+    add(stmt.having)
+    gb = stmt.group_by
+    if isinstance(gb, A.GroupingSets):
+        for s in gb.sets:
+            for g in s:
+                add(g)
+    elif gb is not None:
+        for g in gb:
+            add(g)
+    for o in stmt.order_by:
+        add(o.expr)
+    return refs
+
+
 def execute_select(ctx, stmt: A.SelectStmt,
                    outer_env: Optional[dict] = None) -> pd.DataFrame:
     # FROM
     if stmt.relation is None:
         df = pd.DataFrame({"__dummy__": [0]})
     else:
-        df = materialize_relation(ctx, stmt.relation, outer_env)
+        # column-pruned materialization: only decode columns the statement
+        # (or a join condition on the way down) references — the host-tier
+        # analog of projection pushdown; decoding every string column of a
+        # fact table dwarfs the actual query work otherwise
+        need = _stmt_column_refs(ctx, stmt)
+        df = materialize_relation(ctx, stmt.relation, outer_env, need)
     env = {c: df[c].to_numpy() for c in df.columns}
     if outer_env:
         for k, v in outer_env.items():

@@ -38,6 +38,8 @@ class PlannedQuery:
     order_applied_in_spec: bool = False
     distinct_phase2: Optional[DistinctPhase2] = None
     select_path: bool = False             # non-agg raw select
+    # source column -> output alias renames (select path)
+    select_renames: Dict[str, str] = dataclasses.field(default_factory=dict)
     # post-aggregations deferred past phase 2 (only with distinct_phase2)
     deferred_posts: List[S.PostAggregationSpec] = \
         dataclasses.field(default_factory=list)

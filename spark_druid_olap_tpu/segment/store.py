@@ -288,9 +288,11 @@ class SegmentStore:
 
     def __init__(self):
         self._datasources: Dict[str, Datasource] = {}
+        self.version = 0      # bumped on any change; invalidates caches
 
     def register(self, ds: Datasource) -> None:
         self._datasources[ds.name] = ds
+        self.version += 1
 
     def get(self, name: str) -> Datasource:
         if name not in self._datasources:
@@ -300,6 +302,7 @@ class SegmentStore:
 
     def drop(self, name: str) -> None:
         self._datasources.pop(name, None)
+        self.version += 1
 
     def names(self) -> List[str]:
         return sorted(self._datasources)
@@ -308,3 +311,4 @@ class SegmentStore:
         """≈ ``CLEAR DRUID CACHE`` (reference
         ``DruidMetadataCommands.scala:30-47``)."""
         self._datasources.clear()
+        self.version += 1

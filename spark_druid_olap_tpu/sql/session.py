@@ -201,6 +201,8 @@ def execute_planned(ctx, pq: PlannedQuery) -> pd.DataFrame:
     if pq.limit is not None and not pq.order_applied_in_spec:
         df = df.head(pq.limit)
 
+    if pq.select_renames:
+        df = df.rename(columns=pq.select_renames)
     missing = [c for c in pq.output_columns if c not in df.columns]
     if missing:
         raise EngineFallback(f"planned outputs missing: {missing}")
