@@ -548,13 +548,25 @@ class Builder:
         if len(resolved_sets) == 1 and len(self._dim_specs) > 1:
             g = self.ctx.catalog.fd_graph_for(ds_name, self.ctx.store)
             if g is not None:
+                plain = [d for d in self._dim_specs if d.extraction is None]
+
+                def demoted(d, i):
+                    # any OTHER plain dim determines d -> d leaves the key;
+                    # mutually-determining pairs (1-1) keep the earlier one
+                    for j, k in enumerate(plain):
+                        if k is d or not g.determines(k.dimension,
+                                                     d.dimension):
+                            continue
+                        if g.determines(d.dimension, k.dimension) and \
+                                plain.index(d) < j:
+                            continue
+                        return True
+                    return False
+
                 kept: List[S.DimensionSpec] = []
                 attached: List[S.DimensionSpec] = []
-                for d in self._dim_specs:
-                    if d.extraction is None and any(
-                            k.extraction is None and
-                            g.determines(k.dimension, d.dimension)
-                            for k in kept):
+                for i, d in enumerate(self._dim_specs):
+                    if d.extraction is None and demoted(d, i):
                         attached.append(d)
                     else:
                         kept.append(d)
