@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Concurrent SQL load test against the HTTP server.
+
+≈ the reference's JMeter plans (docs/bi-benchmark/*.jmx,
+scripts/jmeterscripts/*.jmx) that hammer the thriftserver with concurrent
+BI queries. Spawns N client threads issuing queries round-robin for a
+duration, then reports throughput and latency percentiles per query.
+
+Usage:
+  python scripts/loadtest.py --url http://127.0.0.1:8082 \\
+      --threads 8 --duration 30 [--sql "select ..."] [--suite tpch]
+
+With --selfcontained it starts an in-process server over a synthetic
+dataset first (no external setup needed).
+"""
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.request
+from collections import defaultdict
+
+import numpy as np
+
+DEFAULT_QUERIES = [
+    "select region, sum(price) as rev from sales group by region",
+    "select region, flag, count(*) as c from sales group by region, flag",
+    "select product, sum(price) as rev from sales "
+    "group by product order by rev desc limit 5",
+    "select count(*) as c from sales where qty >= 25 and status = 'O'",
+    "select approx_count_distinct(product) as np from sales",
+]
+
+
+def post_sql(url, sql, timeout=60):
+    req = urllib.request.Request(
+        url + "/sql", data=json.dumps({"sql": sql}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def run(url, queries, n_threads, duration):
+    stop = time.monotonic() + duration
+    lat = defaultdict(list)
+    errors = [0]
+    lock = threading.Lock()
+
+    def worker(tid):
+        i = tid
+        while time.monotonic() < stop:
+            sql = queries[i % len(queries)]
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                post_sql(url, sql)
+            except Exception:
+                with lock:
+                    errors[0] += 1
+                continue
+            dt = (time.perf_counter() - t0) * 1000
+            with lock:
+                lat[sql].append(dt)
+
+    threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+               for t in range(n_threads)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    total = sum(len(v) for v in lat.values())
+    print(f"\n{total} queries in {elapsed:.1f}s = "
+          f"{total / elapsed:.1f} qps over {n_threads} threads; "
+          f"{errors[0]} errors")
+    for sql, v in lat.items():
+        a = np.array(v)
+        print(f"  p50={np.percentile(a, 50):7.1f}ms "
+              f"p95={np.percentile(a, 95):7.1f}ms "
+              f"p99={np.percentile(a, 99):7.1f}ms n={len(a):5d}  "
+              f"{sql[:70]}")
+    return total, errors[0]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--url", default="http://127.0.0.1:8082")
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--sql", action="append", default=None,
+                    help="query to run (repeatable); default: built-in mix")
+    ap.add_argument("--selfcontained", action="store_true",
+                    help="start an in-process server on a synthetic dataset")
+    args = ap.parse_args()
+
+    queries = args.sql or DEFAULT_QUERIES
+    server = None
+    if args.selfcontained:
+        sys.path.insert(0, ".")
+        import pandas as pd
+        import spark_druid_olap_tpu as sdot
+        from spark_druid_olap_tpu.server.http import SqlServer
+        rng = np.random.default_rng(7)
+        n = 200_000
+        df = pd.DataFrame({
+            "ts": (np.datetime64("2015-01-01")
+                   + rng.integers(0, 730, n).astype("timedelta64[D]")),
+            "region": rng.choice(["east", "west", "north", "south"], n),
+            "product": rng.choice([f"p{i:03d}" for i in range(50)], n),
+            "flag": rng.choice(["A", "N", "R"], n),
+            "status": rng.choice(["O", "F"], n),
+            "qty": rng.integers(1, 51, n).astype(np.int64),
+            "price": np.round(rng.uniform(1, 1000, n), 2),
+        })
+        ctx = sdot.Context()
+        ctx.ingest_dataframe("sales", df, time_column="ts")
+        server = SqlServer(ctx, port=0)
+        server.start()
+        args.url = f"http://127.0.0.1:{server.port}"
+        for q in queries:        # compile/warm before measuring
+            post_sql(args.url, q)
+
+    try:
+        total, errs = run(args.url, queries, args.threads, args.duration)
+    finally:
+        if server is not None:
+            server.stop()
+    sys.exit(1 if (total == 0 or errs > total * 0.01) else 0)
+
+
+if __name__ == "__main__":
+    main()
