@@ -25,6 +25,7 @@
 #include <cstdio>
 #include <cstring>
 #include <string>
+#include <unordered_map>
 #include <string_view>
 #include <vector>
 
@@ -38,42 +39,48 @@ struct EncodeResult {
 
 EncodeResult encode_impl(const char* data, const int32_t* offsets,
                          int64_t n) {
+  // hash-then-sort-uniques: O(n) interning + O(k log k) dictionary sort —
+  // far cheaper than sorting all n rows when cardinality k << n (the
+  // common case for BI dimensions)
   EncodeResult r;
   r.codes.resize(static_cast<size_t>(n));
   if (n == 0) {
     r.dict_offsets.push_back(0);
     return r;
   }
-  auto view = [&](int32_t i) {
+  auto view = [&](int64_t i) {
     return std::string_view(data + offsets[i],
                             static_cast<size_t>(offsets[i + 1] - offsets[i]));
   };
-  std::vector<int32_t> idx(static_cast<size_t>(n));
-  for (int64_t i = 0; i < n; ++i) idx[static_cast<size_t>(i)] =
-      static_cast<int32_t>(i);
-  std::sort(idx.begin(), idx.end(),
-            [&](int32_t a, int32_t b) { return view(a) < view(b); });
-
-  std::vector<int32_t> dict_rows;  // representative source row per code
-  int32_t code = -1;
-  std::string_view prev;
-  for (int64_t k = 0; k < n; ++k) {
-    int32_t row = idx[static_cast<size_t>(k)];
-    std::string_view v = view(row);
-    if (code < 0 || v != prev) {
-      ++code;
-      dict_rows.push_back(row);
-      prev = v;
-    }
-    r.codes[static_cast<size_t>(row)] = code;
+  std::unordered_map<std::string_view, int32_t> intern;
+  intern.reserve(static_cast<size_t>(n / 4 + 16));
+  std::vector<std::string_view> uniques;
+  for (int64_t i = 0; i < n; ++i) {
+    std::string_view v = view(i);
+    auto [it, inserted] =
+        intern.emplace(v, static_cast<int32_t>(uniques.size()));
+    if (inserted) uniques.push_back(v);
+    r.codes[static_cast<size_t>(i)] = it->second;
   }
-  r.dict_offsets.reserve(dict_rows.size() + 1);
+  const int32_t k = static_cast<int32_t>(uniques.size());
+  std::vector<int32_t> perm(static_cast<size_t>(k));
+  for (int32_t j = 0; j < k; ++j) perm[static_cast<size_t>(j)] = j;
+  std::sort(perm.begin(), perm.end(), [&](int32_t a, int32_t b) {
+    return uniques[static_cast<size_t>(a)] < uniques[static_cast<size_t>(b)];
+  });
+  std::vector<int32_t> remap(static_cast<size_t>(k));  // temp code -> sorted
+  for (int32_t pos = 0; pos < k; ++pos)
+    remap[static_cast<size_t>(perm[static_cast<size_t>(pos)])] = pos;
+  for (int64_t i = 0; i < n; ++i)
+    r.codes[static_cast<size_t>(i)] =
+        remap[static_cast<size_t>(r.codes[static_cast<size_t>(i)])];
+  r.dict_offsets.reserve(static_cast<size_t>(k) + 1);
   r.dict_offsets.push_back(0);
   size_t total = 0;
-  for (int32_t row : dict_rows) total += view(row).size();
+  for (int32_t j : perm) total += uniques[static_cast<size_t>(j)].size();
   r.dict_data.reserve(total);
-  for (int32_t row : dict_rows) {
-    std::string_view v = view(row);
+  for (int32_t j : perm) {
+    std::string_view v = uniques[static_cast<size_t>(j)];
     r.dict_data.insert(r.dict_data.end(), v.begin(), v.end());
     r.dict_offsets.push_back(static_cast<int32_t>(r.dict_data.size()));
   }

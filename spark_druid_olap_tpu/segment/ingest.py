@@ -80,11 +80,14 @@ def ingest_dataframe(
     df = df.reset_index(drop=True)
     n = len(df)
 
+    order = None
     if time_column is not None:
         millis = _to_epoch_millis(df[time_column])
         order = np.argsort(millis, kind="stable")
-        df = df.iloc[order].reset_index(drop=True)
-        millis = millis[order]
+        if np.array_equal(order, np.arange(n)):
+            order = None        # already time-sorted
+        else:
+            millis = millis[order]
         days, ms_in_day = encode_time_millis(millis)
         time_col = TimeColumn(name=time_column, days=days, ms_in_day=ms_in_day)
     else:
@@ -100,6 +103,11 @@ def ingest_dataframe(
 
     def encode_one(col):
         series = df[col]
+        if order is not None:
+            # per-column time-sort take inside the encode pool — far
+            # cheaper than materializing a row-reordered DataFrame up
+            # front, and it parallelizes
+            series = series.take(order).reset_index(drop=True)
         kind = infer_kind(series)
         if dim_names is not None and col in dim_names:
             kind = ColumnKind.DIM
