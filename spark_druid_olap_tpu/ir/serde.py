@@ -280,6 +280,14 @@ def query_to_dict(q: S.QuerySpec) -> dict:
     base = {"dataSource": q.datasource,
             "intervals": [list(i) for i in q.intervals]
             if getattr(q, "intervals", None) else None}
+    ctxq = getattr(q, "context", None)
+    if ctxq is not None and (ctxq.query_id is not None
+                             or ctxq.timeout_millis is not None
+                             or ctxq.prefer_sharded is not None):
+        # ≈ Druid's query "context" (QuerySpecContext :558-571)
+        base["context"] = {"queryId": ctxq.query_id,
+                           "timeout": ctxq.timeout_millis,
+                           "preferSharded": ctxq.prefer_sharded}
     if isinstance(q, S.GroupByQuerySpec):
         base.update({
             "queryType": "groupBy",
@@ -363,6 +371,9 @@ def query_from_dict(d: dict, default_ds: Optional[str] = None) -> S.QuerySpec:
                   for p in d.get("postAggregations", []) or [])
     aggs = tuple(agg_from_dict(a) for a in d.get("aggregations", []) or [])
     filt = filter_from_dict(d.get("filter"))
+    cd = d.get("context") or {}
+    qctx = S.QueryContext(cd.get("queryId"), cd.get("timeout"),
+                          cd.get("preferSharded")) if cd else S.QueryContext()
     if qt == "groupBy":
         limit = None
         if d.get("limitSpec"):
@@ -377,27 +388,27 @@ def query_from_dict(d: dict, default_ds: Optional[str] = None) -> S.QuerySpec:
         return S.GroupByQuerySpec(
             ds, tuple(dim_from_dict(x) for x in d.get("dimensions", [])),
             aggs, posts, filt, having, limit, _gran_from(d.get("granularity")),
-            intervals)
+            intervals, qctx)
     if qt == "timeseries":
         return S.TimeseriesQuerySpec(ds, aggs, posts, filt,
                                      _gran_from(d.get("granularity")),
-                                     intervals)
+                                     intervals, qctx)
     if qt == "topN":
         return S.TopNQuerySpec(ds, dim_from_dict(d["dimension"]),
                                d["metric"], d["threshold"], aggs, posts,
                                filt, _gran_from(d.get("granularity")),
-                               intervals)
+                               intervals, qctx)
     if qt == "select":
         ps = d.get("pagingSpec", {})
         return S.SelectQuerySpec(ds, tuple(d.get("columns", [])), filt,
                                  intervals, ps.get("pageSize", 10000),
                                  ps.get("offset", 0),
-                                 d.get("descending", False))
+                                 d.get("descending", False), qctx)
     if qt == "search":
         return S.SearchQuerySpec(ds, tuple(d.get("searchDimensions", [])),
                                  d.get("query", ""),
                                  d.get("caseSensitive", False), filt,
-                                 d.get("limit"), intervals)
+                                 d.get("limit"), intervals, qctx)
     raise ValueError(f"unknown queryType {qt!r}")
 
 
