@@ -750,6 +750,12 @@ class Builder:
                         "column selected twice with different aliases")
                 renames[item.expr.name] = item.alias
             cols.append(item.expr.name)
+        for src in renames:
+            if cols.count(src) > 1:
+                # SELECT region, region AS r would apply the rename to every
+                # occurrence; let the host tier keep both output columns.
+                raise PlanUnsupported(
+                    "column selected both bare and aliased")
         out_cols = [renames.get(c, c) for c in cols]
         if stmt.distinct:
             # SELECT DISTINCT dims -> group-by rewrite

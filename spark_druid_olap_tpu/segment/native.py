@@ -178,6 +178,10 @@ def encode_json_rows(df) -> Optional[bytes]:
             cols.append((3, np.ascontiguousarray(
                 s.to_numpy()).astype(np.uint8), None, None))
         elif np.issubdtype(dt, np.integer):
+            if np.issubdtype(dt, np.unsignedinteger) and dt.itemsize == 8:
+                v = s.to_numpy()
+                if len(v) and int(v.max()) > np.iinfo(np.int64).max:
+                    return None   # would wrap negative through int64
             cols.append((1, np.ascontiguousarray(s.to_numpy(np.int64)),
                          None, None))
         elif np.issubdtype(dt, np.datetime64):

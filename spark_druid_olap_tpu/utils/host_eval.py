@@ -339,10 +339,15 @@ def _func(e: E.Func, env):
         out = np.array([fn(*[(a[i] if isinstance(a, np.ndarray) else a)
                              for a in args]) for i in range(n)],
                        dtype=object)
-        try:
-            return out.astype(np.float64)
-        except (ValueError, TypeError):
-            return out
+        # only narrow to float64 when every non-null element is already
+        # numeric: a function returning '123' must stay a string
+        if all(v is None or isinstance(v, (int, float, bool, np.number))
+               for v in out):
+            try:
+                return out.astype(np.float64)
+            except (ValueError, TypeError):
+                return out
+        return out
     raise HostEvalError(f"function {name}")
 
 

@@ -88,3 +88,15 @@ def test_module_load_from_config():
 def test_bad_module_spec():
     with pytest.raises(ValueError):
         sdot.Context(config={"sdot.modules": "no_colon_here"})
+
+
+def test_module_function_numeric_string_result(ctx):
+    # a module fn returning numeric-looking STRINGS must not be force-cast
+    # to float64 on the host path
+    ctx.functions["qtycode"] = lambda q: str(int(q))
+    try:
+        got = ctx.sql("select qtycode(qty) as qc, count(*) as c from sales "
+                      "group by qtycode(qty) order by qc limit 3").to_pandas()
+        assert all(isinstance(v, str) for v in got["qc"])
+    finally:
+        ctx.functions.pop("qtycode", None)

@@ -87,3 +87,13 @@ def test_matches_python_path_on_query_results():
     full = json.loads(_df_to_json_rows(df))
     assert full["rows"] == oracle(df)
     assert full["numRows"] == len(df)
+
+
+def test_uint64_overflow_falls_back(mod):
+    # uint64 values >= 2**63 would wrap negative through int64; the native
+    # route must decline so the Python encoder renders them correctly
+    df = pd.DataFrame({"u": np.array([1, 2 ** 63 + 5], dtype=np.uint64)})
+    assert native.encode_json_rows(df) is None
+    small = pd.DataFrame({"u": np.array([1, 42], dtype=np.uint64)})
+    out = native.encode_json_rows(small)
+    assert out is not None and json.loads(out) == [{"u": 1}, {"u": 42}]

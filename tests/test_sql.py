@@ -354,3 +354,13 @@ def test_derived_table_engine_assist(ctx, sales):
     # the derived block was recorded as an engine execution
     modes = [r.stats.get("mode") for r in ctx.history.entries()[n0:]]
     assert "engine" in modes
+
+
+def test_sql_bare_and_aliased_column(ctx, sales):
+    # SELECT region, region AS r must keep both output columns (regression:
+    # the select-path pushdown used to apply the rename to every occurrence
+    # and crash; it must fall back to the host tier instead)
+    got = ctx.sql("select region, region as r from sales limit 5").to_pandas()
+    assert list(got.columns) == ["region", "r"]
+    assert len(got) == 5
+    assert (got["region"] == got["r"]).all()
