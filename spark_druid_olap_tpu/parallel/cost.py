@@ -35,13 +35,20 @@ class CostEstimate:
     sharded_cost: float
     n_devices: int
     recommend_sharded: bool
+    scan_bytes: int = 0            # est. device bytes the scan binds
+    segments_per_wave: int = 0     # 0 = everything in one wave
+    n_waves: int = 1
 
     def table(self) -> str:
+        wave = "" if self.n_waves <= 1 else \
+            f"  waves={self.n_waves}x{self.segments_per_wave}seg"
         return (f"rows={self.rows:,} sel={self.selectivity:.3f} "
-                f"est_groups={self.output_groups:,}\n"
+                f"est_groups={self.output_groups:,} "
+                f"scan_bytes={self.scan_bytes:,}\n"
                 f"single-chip cost={self.single_cost:.4g}  "
                 f"sharded({self.n_devices})={self.sharded_cost:.4g}  "
-                f"-> {'SHARDED' if self.recommend_sharded else 'SINGLE'}")
+                f"-> {'SHARDED' if self.recommend_sharded else 'SINGLE'}"
+                + wave)
 
 
 def _filter_selectivity(f: Optional[S.FilterSpec], ds) -> float:
@@ -94,6 +101,86 @@ def _output_groups(q: S.QuerySpec, ds) -> int:
     return out
 
 
+def array_itemsize(ds, key: str) -> int:
+    """Host itemsize of one stacked array (device canonicalization can only
+    shrink f64->f32, so this bounds device bytes from above)."""
+    from spark_druid_olap_tpu.ops.scan import (
+        NULL_VALID_PREFIX, ROW_VALID_KEY, TIME_MS_KEY)
+    if key == ROW_VALID_KEY or key.startswith(NULL_VALID_PREFIX):
+        return 1
+    if key == TIME_MS_KEY:
+        return int(ds.time.ms_in_day.dtype.itemsize)
+    if key in ds.dims:
+        return int(ds.dims[key].codes.dtype.itemsize)
+    if key in ds.metrics:
+        return int(ds.metrics[key].values.dtype.itemsize)
+    if ds.time is not None and key == ds.time.name:
+        return int(ds.time.days.dtype.itemsize)
+    return 4
+
+
+def bytes_per_segment(ds, names) -> int:
+    return int(ds.padded_rows) * sum(array_itemsize(ds, k) for k in names)
+
+
+def wave_budget_bytes(conf) -> Optional[int]:
+    """Per-device byte budget for one wave's scan arrays. Config override,
+    else 60% of the device's reported HBM limit, else None (single wave)."""
+    from spark_druid_olap_tpu.utils.config import WAVE_MAX_BYTES
+    b = conf.get(WAVE_MAX_BYTES)
+    if b:
+        return int(b)
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            return int(limit * 0.6)
+    except Exception:  # noqa: BLE001 - CPU/interpret backends have no stats
+        pass
+    return None
+
+
+def plan_waves(n_segments: int, n_dev: int, seg_bytes: int,
+               budget: Optional[int], conf, output_groups: int,
+               n_aggs: int) -> tuple:
+    """Min-cost search over segments-per-wave (≈ the reference's
+    ``druidQueryMethod`` searching 1..histSegsPerQueryLimit,
+    DruidQueryCostModel.scala:343-414). Each wave costs a dispatch plus a
+    host-side merge of the wave's [K] partials; each wave's scan arrays for
+    one device must fit ``budget`` bytes.
+
+    Returns (segments_per_wave, n_waves); segments_per_wave is a multiple of
+    n_dev.
+    """
+    n_dev = max(1, n_dev)
+    if n_segments <= 0:
+        return n_dev, 1
+    cap = n_segments
+    if budget is not None and seg_bytes > 0:
+        cap = min(cap, (budget // seg_bytes) * n_dev)
+    cap = max(n_dev, cap - cap % n_dev)
+
+    merge_c = conf.get(COST_PER_ROW_MERGE)
+    compile_c = conf.get(COST_COMPILE)
+    # candidate sizes: geometric ladder of n_dev multiples up to cap
+    cands, w = [], n_dev
+    while w < cap:
+        cands.append(w)
+        w *= 2
+    cands.append(cap)
+    best, best_cost = cap, None
+    for spw in cands:
+        waves = -(-n_segments // spw)
+        # per-wave fixed dispatch overhead + host merge of K partials;
+        # scan + transport totals are wave-count invariant
+        cost = waves * (compile_c * 0.02
+                        + output_groups * max(1, n_aggs) * merge_c)
+        if best_cost is None or cost < best_cost:
+            best, best_cost = spw, cost
+    return best, -(-n_segments // best)
+
+
 def estimate(ctx_or_engine, q: S.QuerySpec) -> CostEstimate:
     engine = getattr(ctx_or_engine, "engine", ctx_or_engine)
     ds = engine.store.get(q.datasource)
@@ -123,7 +210,28 @@ def estimate(ctx_or_engine, q: S.QuerySpec) -> CostEstimate:
     recommend = n_dev > 1 and sharded < single
     if not conf.get(COST_MODEL_ENABLED):
         recommend = n_dev > 1
-    return CostEstimate(rows, sel, groups, single, sharded, n_dev, recommend)
+
+    # approximate scan footprint + wave plan (exact names are executor-side;
+    # this mirrors them closely enough for explain)
+    names = set()
+    for d in S.query_dimensions(q):
+        names.add(d.dimension)
+    for a in S.query_aggregations(q):
+        if a.field:
+            names.add(a.field)
+    from spark_druid_olap_tpu.ops.filters import columns_of_filter
+    names |= columns_of_filter(getattr(q, "filter", None))
+    names = {c for c in names if c in ds.dims or c in ds.metrics
+             or (ds.time is not None and c == ds.time.name)}
+    seg_bytes = bytes_per_segment(
+        ds, list(names) + ["__rows__"]) if ds.num_segments else 0
+    scan_bytes = seg_bytes * len(seg_idx)
+    eff_dev = n_dev if recommend else 1
+    spw, waves = plan_waves(len(seg_idx), eff_dev, seg_bytes,
+                            wave_budget_bytes(conf), conf, groups, n_aggs)
+    return CostEstimate(rows, sel, groups, single, sharded, n_dev, recommend,
+                        scan_bytes=scan_bytes, segments_per_wave=spw,
+                        n_waves=waves)
 
 
 def explain_cost(ctx, q: S.QuerySpec) -> str:

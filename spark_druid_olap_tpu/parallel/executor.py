@@ -45,6 +45,7 @@ from spark_druid_olap_tpu.ops.scan import (
     NULL_VALID_PREFIX,
     TIME_MS_KEY,
 )
+from spark_druid_olap_tpu.parallel import cost as C
 from spark_druid_olap_tpu.parallel.mesh import SEGMENT_AXIS, mesh_size
 from spark_druid_olap_tpu.result import QueryResult
 from spark_druid_olap_tpu.segment.column import ColumnKind
@@ -707,7 +708,13 @@ class QueryEngine:
 
         sharded = self._should_shard(q, ds, seg_idx)
         n_dev = mesh_size(self.mesh) if sharded else 1
-        s_pad = _pad_segments(len(seg_idx), n_dev)
+        seg_bytes = C.bytes_per_segment(ds, names)
+        spw, n_waves = C.plan_waves(
+            len(seg_idx), n_dev, seg_bytes,
+            C.wave_budget_bytes(self.config), self.config, n_keys,
+            len(agg_plans))
+        s_pad = spw if n_waves > 1 else _pad_segments(len(seg_idx), n_dev)
+        hll_plans = [p for p in agg_plans if p.kind == "hll"]
 
         # --- build / fetch program -------------------------------------------
         sig = ("agg", ds.name, id(ds), repr(q), s_pad, ds.padded_rows,
@@ -721,15 +728,21 @@ class QueryEngine:
             self._programs[sig] = prog
 
         prog_fn, unpack = prog
-        dev_arrays = self._bind_arrays(ds, names, seg_idx, s_pad, sharded)
-        if t0 is not None:
-            self._stage_check(q, t0)  # pre-dispatch boundary
-        out = unpack(prog_fn(dev_arrays))
-        if t0 is not None:
-            self._stage_check(q, t0)  # post-device boundary
+        if n_waves == 1:
+            dev_arrays = self._bind_arrays(ds, names, seg_idx, s_pad, sharded)
+            if t0 is not None:
+                self._stage_check(q, t0)  # pre-dispatch boundary
+            out = unpack(prog_fn(dev_arrays))
+            if t0 is not None:
+                self._stage_check(q, t0)  # post-device boundary
+            finals = _finals_from_out(out, routes, n_keys, hll_plans)
+        else:
+            finals = self._run_waves(q, ds, names, seg_idx, spw, sharded,
+                                     prog_fn, unpack, routes, n_keys,
+                                     hll_plans, t0)
 
         # --- decode -----------------------------------------------------------
-        rows = np.asarray(G.combine_route(routes["__rows__"], out, n_keys))
+        rows = finals["__rows__"]
         sel = np.nonzero(rows > 0)[0]
         # a GLOBAL aggregate (no dims, no time bucketing) over zero matching
         # rows yields ONE identity row — SQL semantics (and Druid's default
@@ -748,13 +761,13 @@ class QueryEngine:
         for p in agg_plans:
             name = p.spec.name
             if p.kind == "hll":
-                regs = out[name]
+                regs = finals[name]
                 est = HLL.estimate(regs)[sel]
                 data[name] = np.round(est).astype(np.int64)
                 columns.append(name)
                 continue
             r = routes[name]
-            v = np.asarray(G.combine_route(r, out, n_keys))[sel]
+            v = finals[name][sel]
             if p.kind in ("min", "max"):
                 # groups whose (filtered) agg matched no rows keep the
                 # route sentinel -> emit null (NaN), like Druid
@@ -811,8 +824,40 @@ class QueryEngine:
         self.last_stats.update({
             "datasource": ds.name, "segments": int(len(seg_idx)),
             "sharded": sharded, "groups": int(len(sel)),
-            "rows_scanned": int(ds.num_rows)})
+            "rows_scanned": int(ds.num_rows), "waves": int(n_waves),
+            "segments_per_wave": int(spw)})
         return QueryResult(columns, data)
+
+    def _run_waves(self, q, ds, names, seg_idx, spw, sharded, prog_fn,
+                   unpack, routes, n_keys, hll_plans, t0):
+        """Execute the scan in bounded segment waves (double-buffered: the
+        next wave's host->device transfer overlaps the current wave's
+        compute), merging each wave's [K] finals on host. ≈ the reference's
+        cost-model "waves" of segments-per-query bounding per-historical
+        work (DruidQueryCostModel.scala:309-314,444)."""
+        sharding = NamedSharding(self.mesh, P(SEGMENT_AXIS, None)) \
+            if sharded else None
+        wave_segs = [seg_idx[i: i + spw]
+                     for i in range(0, len(seg_idx), spw)]
+
+        def bind(w):
+            # no caching: wave mode exists because the scan exceeds HBM
+            return {k: jax.device_put(build_array(ds, k, w, spw), sharding)
+                    for k in names}
+
+        finals = None
+        cur = bind(wave_segs[0])
+        for i in range(len(wave_segs)):
+            if t0 is not None:
+                self._stage_check(q, t0)   # per-wave boundary
+            bufs = prog_fn(cur)            # async dispatch
+            nxt = bind(wave_segs[i + 1]) if i + 1 < len(wave_segs) else None
+            out = unpack(bufs)             # blocks on the device round-trip
+            f = _finals_from_out(out, routes, n_keys, hll_plans)
+            finals = f if finals is None \
+                else _merge_wave_finals(finals, f, routes)
+            cur = nxt
+        return finals
 
     def _plan_agg(self, ds, seg_idx, dimensions, aggregations, granularity,
                   filter_spec, intervals):
@@ -1116,10 +1161,18 @@ class QueryEngine:
             return False
         pref = q.context.prefer_sharded if hasattr(q, "context") else None
         if pref is not None:
+            self.last_stats["shard_decision"] = "context"
             return bool(pref)
-        # segment padding fills the axis up to the mesh size, so any multi-
-        # device mesh can shard; the cost model may veto for tiny scans
-        return len(seg_idx) >= 1
+        try:
+            est = C.estimate(self, q)
+        except Exception:   # noqa: BLE001 — cost must never fail a query
+            self.last_stats["shard_decision"] = "default"
+            return len(seg_idx) >= 1
+        self.last_stats["shard_decision"] = (
+            f"cost:{'sharded' if est.recommend_sharded else 'single'}")
+        self.last_stats["cost_single"] = est.single_cost
+        self.last_stats["cost_sharded"] = est.sharded_cost
+        return est.recommend_sharded
 
     def _bind_arrays(self, ds, names, seg_idx, s_pad, sharded):
         """Fetch-or-build the device arrays a program binds. Cached per
@@ -1143,6 +1196,32 @@ class QueryEngine:
     def clear_caches(self):
         self._programs.clear()
         self._device_arrays.clear()
+
+
+def _finals_from_out(out, routes, n_keys, hll_plans):
+    """Route outputs -> exact final [n_keys] arrays per aggregation (plus
+    raw HLL registers), the unit that waves merge over."""
+    finals = {name: np.asarray(G.combine_route(r, out, n_keys))
+              for name, r in routes.items()}
+    for p in hll_plans:
+        finals[p.spec.name] = np.asarray(out[p.spec.name])
+    return finals
+
+
+def _merge_wave_finals(acc, new, routes):
+    """Cross-wave merge: sums/counts add exactly (i64 or f64 finals), min/max
+    keep their empty-group sentinels, HLL registers take elementwise max."""
+    for name, v in new.items():
+        r = routes.get(name)
+        if r is None:                       # HLL registers
+            acc[name] = np.maximum(acc[name], v)
+        elif r.kind == "min":
+            acc[name] = np.minimum(acc[name], v)
+        elif r.kind == "max":
+            acc[name] = np.maximum(acc[name], v)
+        else:
+            acc[name] = acc[name] + v
+    return acc
 
 
 def _decode_anyvalue(ds: Datasource, field: str, v: np.ndarray,

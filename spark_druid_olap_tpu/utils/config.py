@@ -126,6 +126,14 @@ GROUPBY_DENSE_MAX_KEYS = _entry(
     "sdot.engine.groupby.dense.max.keys", 1 << 22,
     "Max fused key cardinality for the dense device group-by; above it the "
     "planner falls back to hashed group-by.")
+WAVE_MAX_BYTES = _entry(
+    "sdot.engine.wave.max.bytes", 0,
+    "Per-device byte budget for one execution wave's scan arrays; a scan "
+    "whose bound arrays exceed it runs in multiple bounded waves over the "
+    "segment axis. 0 = auto (60% of the device's reported HBM limit, or "
+    "unbounded when the backend reports none). Reference analog: the cost "
+    "model's segments-per-query limit bounding per-historical work "
+    "(DruidQueryCostModel.scala:343-414).")
 HLL_LOG2M = _entry(
     "sdot.engine.hll.log2m", 11,
     "log2 of the HLL register count for approximate count-distinct "

@@ -82,7 +82,11 @@ def engine(store):
 def mesh_engine(store):
     from spark_druid_olap_tpu.parallel.executor import QueryEngine
     from spark_druid_olap_tpu.parallel.mesh import make_mesh
-    return QueryEngine(store, mesh=make_mesh())
+    from spark_druid_olap_tpu.utils.config import Config, COST_MODEL_ENABLED
+    # cost model off = always-shard (its documented behavior): these fixtures
+    # exist to exercise the collective paths even on tiny test data
+    cfg = Config({COST_MODEL_ENABLED.key: False})
+    return QueryEngine(store, config=cfg, mesh=make_mesh())
 
 
 def assert_frames_equal(got: pd.DataFrame, want: pd.DataFrame, sort_by=None,

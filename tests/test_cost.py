@@ -1,0 +1,172 @@
+"""Cost model + wave execution tests.
+
+≈ the reference's ``DruidQueryCostModelTest`` (synthetic CostInput driving
+``druidQueryMethod``): the decision machinery here is single-chip vs sharded
+(the broker-vs-historical analog) plus segments-per-wave (the reference's
+min-cost search over segments-per-query, DruidQueryCostModel.scala:343-414).
+Wave execution is additionally proven differentially: a budget-constrained
+engine must return bit-identical aggregates in >1 wave.
+"""
+
+from spark_druid_olap_tpu.ir.spec import (
+    AggregationSpec, DimensionSpec, GroupByQuerySpec, QueryContext,
+    SelectorFilter,
+)
+from spark_druid_olap_tpu.parallel import cost as C
+from spark_druid_olap_tpu.parallel.executor import QueryEngine
+from spark_druid_olap_tpu.parallel.mesh import make_mesh
+from spark_druid_olap_tpu.utils.config import Config
+
+from conftest import assert_frames_equal
+
+
+def _q(**kw):
+    return GroupByQuerySpec(
+        datasource="sales",
+        dimensions=(DimensionSpec("region", "region"),),
+        aggregations=(AggregationSpec("longsum", "s", field="qty"),
+                      AggregationSpec("count", "n")),
+        **kw)
+
+
+# -----------------------------------------------------------------------------
+# decision machinery
+# -----------------------------------------------------------------------------
+
+def test_estimate_small_scan_prefers_single(store):
+    eng = QueryEngine(store, mesh=make_mesh())
+    est = C.estimate(eng, _q())
+    # 20k rows: compile amortization dominates; single chip must win
+    assert est.n_devices > 1
+    assert not est.recommend_sharded
+    assert est.single_cost < est.sharded_cost
+
+
+def test_estimate_large_scan_prefers_sharded(store):
+    # zero compile amortization = the steady-state dashboard regime; the
+    # 8-way scan split then beats single-chip for any non-trivial scan
+    cfg = Config({"sdot.querycostmodel.compile.cost": 0.0})
+    eng = QueryEngine(store, config=cfg, mesh=make_mesh())
+    est = C.estimate(eng, _q())
+    assert est.recommend_sharded
+    assert est.sharded_cost < est.single_cost
+
+
+def test_executor_consumes_decision(store, sales_df):
+    eng = QueryEngine(store, mesh=make_mesh())
+    r = eng.execute(_q()).to_pandas()
+    assert eng.last_stats["sharded"] is False
+    assert eng.last_stats["shard_decision"] == "cost:single"
+    assert eng.last_stats["cost_single"] < eng.last_stats["cost_sharded"]
+
+    cfg = Config({"sdot.querycostmodel.compile.cost": 0.0})
+    eng2 = QueryEngine(store, config=cfg, mesh=make_mesh())
+    r2 = eng2.execute(_q()).to_pandas()
+    assert eng2.last_stats["sharded"] is True
+    assert eng2.last_stats["shard_decision"] == "cost:sharded"
+    assert_frames_equal(r, r2, sort_by=["region"])
+
+
+def test_context_overrides_cost_model(store):
+    eng = QueryEngine(store, mesh=make_mesh())
+    q = _q(context=QueryContext(prefer_sharded=True))
+    eng.execute(q)
+    assert eng.last_stats["sharded"] is True
+    assert eng.last_stats["shard_decision"] == "context"
+
+
+def test_explain_shows_decision(store):
+    eng = QueryEngine(store, mesh=make_mesh())
+    t = C.estimate(eng, _q()).table()
+    assert "SINGLE" in t or "SHARDED" in t
+    assert "scan_bytes=" in t
+
+
+# -----------------------------------------------------------------------------
+# segments-per-wave search
+# -----------------------------------------------------------------------------
+
+def test_plan_waves_unbounded_is_one_wave():
+    conf = Config()
+    spw, waves = C.plan_waves(6, 1, 10_000, None, conf, 100, 2)
+    assert waves == 1 and spw >= 6
+
+
+def test_plan_waves_budget_bounds_wave_size():
+    conf = Config()
+    # budget fits 2 segments per device; 8 segments, 1 device -> 4 waves
+    spw, waves = C.plan_waves(8, 1, 1000, 2500, conf, 100, 2)
+    assert spw == 2 and waves == 4
+
+
+def test_plan_waves_multiple_of_mesh():
+    conf = Config()
+    spw, waves = C.plan_waves(16, 4, 1000, 2500, conf, 100, 2)
+    assert spw % 4 == 0
+    assert waves == -(-16 // spw)
+
+
+def test_plan_waves_prefers_fewer_waves_under_budget():
+    conf = Config()
+    # generous budget: the min-cost search must take the largest wave
+    spw, waves = C.plan_waves(32, 1, 1000, 1_000_000, conf, 10_000, 3)
+    assert waves == 1 and spw == 32
+
+
+# -----------------------------------------------------------------------------
+# wave execution: differential + stats
+# -----------------------------------------------------------------------------
+
+def test_wave_execution_matches_single_wave(store, sales_df):
+    eng1 = QueryEngine(store)
+    want = eng1.execute(_q()).to_pandas()
+    assert eng1.last_stats["waves"] == 1
+
+    # 1-byte budget forces one segment per wave
+    cfg = Config({"sdot.engine.wave.max.bytes": 1})
+    engw = QueryEngine(store, config=cfg)
+    got = engw.execute(_q()).to_pandas()
+    assert engw.last_stats["waves"] == store.get("sales").num_segments
+    assert engw.last_stats["waves"] > 1
+    assert_frames_equal(got, want, sort_by=["region"])
+
+
+def test_wave_execution_filtered_min_max_hll(store, sales_df):
+    q = GroupByQuerySpec(
+        datasource="sales",
+        dimensions=(DimensionSpec("flag", "flag"),),
+        aggregations=(
+            AggregationSpec("longsum", "s", field="qty"),
+            AggregationSpec("longmin", "mn", field="qty"),
+            AggregationSpec("longmax", "mx", field="qty"),
+            AggregationSpec("cardinality", "dc", field="product"),
+            AggregationSpec("count", "n", filter=SelectorFilter(
+                "status", "O")),
+        ),
+        filter=SelectorFilter("region", "east"))
+    want = QueryEngine(store).execute(q).to_pandas()
+    cfg = Config({"sdot.engine.wave.max.bytes": 1})
+    engw = QueryEngine(store, config=cfg)
+    got = engw.execute(q).to_pandas()
+    assert engw.last_stats["waves"] > 1
+    assert_frames_equal(got, want, sort_by=["flag"])
+
+
+def test_wave_execution_sharded(sales_df):
+    # a wave on an 8-device mesh is >=8 segments, so this needs a finer
+    # segmentation than the shared store fixture
+    from spark_druid_olap_tpu.segment.ingest import ingest_dataframe
+    from spark_druid_olap_tpu.segment.store import SegmentStore
+    st = SegmentStore()
+    st.register(ingest_dataframe("sales", sales_df, time_column="ts",
+                                 target_rows=512))
+    assert st.get("sales").num_segments > 16
+    cfg = Config({"sdot.querycostmodel.enabled": False,
+                  "sdot.engine.wave.max.bytes": 1})
+    engw = QueryEngine(st, config=cfg, mesh=make_mesh())
+    got = engw.execute(_q()).to_pandas()
+    assert engw.last_stats["sharded"] is True
+    assert engw.last_stats["waves"] > 1
+    assert engw.last_stats["segments_per_wave"] % 8 == 0
+    want = QueryEngine(st).execute(_q()).to_pandas()
+    assert_frames_equal(got, want, sort_by=["region"])

@@ -129,13 +129,15 @@ def test_scatter_path_exact_ints(no_x64, big_store, big_df):
 
 
 def test_sharded_exact_ints(no_x64, big_store, big_df):
-    eng = QueryEngine(big_store, mesh=make_mesh())
+    cfg = Config({"sdot.querycostmodel.enabled": False})
+    eng = QueryEngine(big_store, mesh=make_mesh(), config=cfg)
     _check_exact(eng.execute(_spec()), big_df)
     assert eng.last_stats["sharded"] is True
 
 
 def test_sharded_scatter_exact_ints(no_x64, big_store, big_df):
-    cfg = Config({"sdot.engine.groupby.matmul.max.keys": 1})
+    cfg = Config({"sdot.engine.groupby.matmul.max.keys": 1,
+                  "sdot.querycostmodel.enabled": False})
     eng = QueryEngine(big_store, mesh=make_mesh(), config=cfg)
     _check_exact(eng.execute(_spec()), big_df)
 
