@@ -25,10 +25,16 @@ from spark_druid_olap_tpu.utils.config import Config
 
 
 def _enable_x64_once():
-    # f64 merge accumulators need x64; hot-path dtypes are all explicit
-    # f32/int32 so this does not change kernel layouts.
+    # On CPU, native 64-bit routes (i64 sums, f64 compares) are exact and
+    # cheap. TPU backends must stay 32-bit (f64 unsupported, i64 emulated):
+    # the lane/limb routes carry exactness there. SDOT_FORCE_32BIT=1 keeps
+    # 32-bit even on CPU (TPU-dtype simulation/debugging).
+    import os
+    if os.environ.get("SDOT_FORCE_32BIT"):
+        return
     try:
-        jax.config.update("jax_enable_x64", True)
+        if jax.default_backend() == "cpu":
+            jax.config.update("jax_enable_x64", True)
     except Exception:
         pass
 

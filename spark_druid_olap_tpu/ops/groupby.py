@@ -66,6 +66,8 @@ import numpy as np
 F32_MAX = jnp.float32(3.4e38)
 I32_MAX = np.int32(2**31 - 1)
 I32_MIN = np.int32(-(2**31))
+I64_MAX = np.int64(2**63 - 1)
+I64_MIN = np.int64(-(2**63))
 N_LIMBS = 4
 N_LANES = 4
 _CHUNK_ROWS = 1 << 14        # scatter-path row chunk: 2^16 * 2^14 < 2^31
@@ -97,7 +99,7 @@ class Route:
 
     name: str
     kind: str                 # count|sum|min|max
-    tag: str                  # f64|ff|lanes|limbs|i32|f32
+    tag: str                  # f64|i64|ff|lanes|limbs|i32|f32
     n_lanes: int = 1
     merged: bool = True       # device-collective merge vs per-chip host merge
 
@@ -105,6 +107,8 @@ class Route:
         """[(output_name, flat_length, dtype_str)] this route emits."""
         if self.tag == "f64":
             return [(self.name, n_keys, "f64")]
+        if self.tag == "i64":
+            return [(self.name, n_keys, "i64")]
         if self.tag == "ff":
             return [(self.name + ".acc", n_keys, "f32"),
                     (self.name + ".c", n_keys, "f32")]
@@ -120,6 +124,10 @@ class Route:
 
 def choose_path(n_keys: int, matmul_max: int) -> str:
     """'matmul' (one-hot MXU) vs 'scatter' (XLA segment ops)."""
+    if _x64():
+        # x64 only happens off-TPU; scatter keeps native-i64 sums exact at
+        # any magnitude (and CPU BLAS loses to scatter-add anyway)
+        return "scatter"
     if jax.default_backend() == "cpu" and n_keys > 64:
         # the one-hot matmul only pays off on the MXU; CPU BLAS loses badly
         # to vectorized scatter-add at moderate K (TPC-H q9 on CPU: 31x)
@@ -132,9 +140,15 @@ def plan_route(name: str, kind: str, is_int: bool, maxabs: Optional[float],
     """Decide the numeric route for one aggregation. Static — callable at
     plan time (no traced values)."""
     if kind in ("min", "max"):
+        if _x64():
+            # native-64-bit compares: i64 exact for wide ints, f64 for
+            # doubles; 32-bit backends keep the i32/f32 routes
+            return Route(name, kind, "i64" if is_int else "f64")
         return Route(name, kind, "i32" if is_int else "f32")
     if _x64():
-        return Route(name, kind, "f64")
+        # native-i64 sums are exact at any magnitude; f64 for doubles
+        return Route(name, kind, "i64" if (is_int or kind == "count")
+                     else "f64")
     if path == "scatter":
         if kind == "count" or is_int:
             return Route(name, kind, "limbs")
@@ -196,6 +210,8 @@ def combine_route(route: Route, out: Dict[str, np.ndarray],
 
     if route.tag == "f64":
         return np.asarray(out[route.name], np.float64)
+    if route.tag == "i64":
+        return np.asarray(out[route.name], np.int64)
     if route.tag == "ff":
         acc = chips(out[route.name + ".acc"]).astype(np.float64)
         c = chips(out[route.name + ".c"]).astype(np.float64)
@@ -318,6 +334,14 @@ def _pallas_to_routes(flat: Dict[str, object], inputs: List[AggInput],
             out[r.name] = jnp.where(big, jnp.int32(sent), iv)
         elif r.tag == "f64":
             out[r.name] = v.astype(jnp.float64)
+        elif r.tag == "i64":
+            if r.kind in ("min", "max"):
+                big = jnp.abs(v) >= F32_MAX     # empty-group f32 sentinel
+                sent = I64_MAX if r.kind == "min" else I64_MIN
+                out[r.name] = jnp.where(
+                    big, sent, jnp.round(v).astype(jnp.int64))
+            else:
+                out[r.name] = jnp.round(v).astype(jnp.int64)
         else:
             out[r.name] = v
     return out
@@ -380,8 +404,9 @@ def _matmul_groupby(key, mask, n_keys, inputs, routes):
     m_cols = len(sum_cols)
 
     mm_route = [routes[a.name] for a in minmax]
+    _mm_dt = {"i32": jnp.int32, "f64": jnp.float64}
     mm_vals = [prep(a.values, 0,
-                    jnp.int32 if routes[a.name].tag == "i32" else jnp.float32)
+                    _mm_dt.get(routes[a.name].tag, jnp.float32))
                for a in minmax]
     mm_masks = [prep(a.mask, False) if a.mask is not None else masks
                 for a in minmax]
@@ -417,19 +442,17 @@ def _matmul_groupby(key, mask, n_keys, inputs, routes):
             eff = am & m_blk
             sel = onehot & eff[:, None]
             if r.tag == "i32":
-                if r.kind == "min":
-                    cur = jnp.min(jnp.where(sel, v[:, None], I32_MAX), axis=0)
-                    new_min[i] = jnp.minimum(acc_min[i], cur)
-                else:
-                    cur = jnp.max(jnp.where(sel, v[:, None], I32_MIN), axis=0)
-                    new_max[i] = jnp.maximum(acc_max[i], cur)
+                lo_s, hi_s = I32_MIN, I32_MAX
+            elif r.tag == "f64":
+                lo_s, hi_s = -jnp.inf, jnp.inf
             else:
-                if r.kind == "min":
-                    cur = jnp.min(jnp.where(sel, v[:, None], F32_MAX), axis=0)
-                    new_min[i] = jnp.minimum(acc_min[i], cur)
-                else:
-                    cur = jnp.max(jnp.where(sel, v[:, None], -F32_MAX), axis=0)
-                    new_max[i] = jnp.maximum(acc_max[i], cur)
+                lo_s, hi_s = -F32_MAX, F32_MAX
+            if r.kind == "min":
+                cur = jnp.min(jnp.where(sel, v[:, None], hi_s), axis=0)
+                new_min[i] = jnp.minimum(acc_min[i], cur)
+            else:
+                cur = jnp.max(jnp.where(sel, v[:, None], lo_s), axis=0)
+                new_max[i] = jnp.maximum(acc_max[i], cur)
         return (acc_sums, comp, new_min, new_max), None
 
     sval_xs = sum_cols
@@ -438,6 +461,9 @@ def _matmul_groupby(key, mask, n_keys, inputs, routes):
         if r.tag == "i32":
             fill = I32_MAX if kind == "min" else I32_MIN
             return jnp.full((n_keys,), fill, dtype=jnp.int32)
+        if r.tag == "f64":
+            fill = jnp.inf if kind == "min" else -jnp.inf
+            return jnp.full((n_keys,), fill, dtype=jnp.float64)
         fill = F32_MAX if kind == "min" else -F32_MAX
         return jnp.full((n_keys,), fill, dtype=jnp.float32)
 
@@ -499,7 +525,29 @@ def _scatter_groupby(key, mask, n_keys, inputs, routes):
     for a in inputs:
         r = routes[a.name]
         am = mask if a.mask is None else (mask & seg2d(a.mask))
-        if r.tag == "f64":
+        if r.tag in ("f64", "i64") and r.kind in ("min", "max"):
+            if r.tag == "i64":
+                sent = I64_MAX if r.kind == "min" else I64_MIN
+                v = jnp.where(am, seg2d(a.values).astype(jnp.int64), sent)
+            else:
+                sent = jnp.inf if r.kind == "min" else -jnp.inf
+                v = jnp.where(am, seg2d(a.values).astype(jnp.float64), sent)
+            op = jax.ops.segment_min if r.kind == "min" \
+                else jax.ops.segment_max
+            per = jax.vmap(lambda x, k: op(x, k, num))(v, key)
+            red = per.min(axis=0) if r.kind == "min" else per.max(axis=0)
+            out[r.name] = red[:n_keys]
+        elif r.tag == "i64":
+            # native 64-bit sums: exact at any magnitude (x64 backends only)
+            if a.kind == "count":
+                v = am.astype(jnp.int64)
+            else:
+                v = seg2d(a.values).astype(jnp.int64) \
+                    * am.astype(jnp.int64)
+            per_seg = jax.vmap(lambda x, k: jax.ops.segment_sum(x, k, num))(
+                v, key)
+            out[r.name] = per_seg.sum(axis=0)[:n_keys]
+        elif r.tag == "f64":
             if a.kind == "count":
                 v = am.astype(jnp.float64)
             else:
@@ -619,12 +667,10 @@ def merge_partials(partials: Dict[str, object], routes: Dict[str, Route],
             out[name] = jax.lax.psum(arr, axis_name)
         elif not r.merged:
             out[name] = arr                    # caller keeps per-chip
-        elif r.tag == "limbs" or r.tag in ("f64",):
-            out[name] = jax.lax.psum(arr, axis_name)
         elif r.kind == "min":
             out[name] = jax.lax.pmin(arr, axis_name)
         elif r.kind == "max":
             out[name] = jax.lax.pmax(arr, axis_name)
-        else:
+        else:                                  # limbs / f64 / i32 sums
             out[name] = jax.lax.psum(arr, axis_name)
     return out

@@ -1,0 +1,173 @@
+"""Hashed high-cardinality group-by: fixed-size open-addressing hash table
+built from XLA scatter-min claims, probed over a static number of rounds.
+
+This is the TPU answer to Druid's groupBy v2 engine handling arbitrary key
+cardinality (reference contract: ``QuerySpecContext``
+``DruidQuerySpec.scala:558-571`` — Druid spills, never refuses): when the
+fused key space exceeds the dense-vector ceiling, we stop materializing the
+key space and instead aggregate into a table sized by the number of *actual*
+groups.
+
+Design constraints driven by XLA/TPU semantics:
+
+- **Static shapes**: the table size ``n_slots`` is a compile-time constant;
+  overflow surfaces as a scalar the host checks (retry bigger, then fall
+  back) rather than a dynamic reallocation.
+- **No atomics**: slot claiming uses a two-stage ``scatter-min`` — all rows
+  attempt a claim simultaneously, the lexicographically-smallest key wins an
+  empty slot, losers re-probe next round (double hashing). Occupied slots
+  are never overwritten (candidates for non-empty slots are the EMPTY
+  sentinel, and ``min(cur, EMPTY) == cur``).
+- **62-bit keys without i64**: the fused key is split into two int32 parts
+  (each a product of dim cardinalities < 2^31), compared as a pair.
+- **The aggregation itself** reuses the exact scatter routes
+  (``ops.groupby``: limb sums, compensated f32, i32 min/max) with the
+  claimed slot as the dense key — so hashed group-by inherits the same
+  TPU-dtype exactness guarantees.
+
+Cross-chip / cross-wave merge happens on host by *key*, not by slot (each
+chip builds its own table layout) — the direct analog of the reference's
+historical partials merged broker-side (``DruidStrategy.scala:349-360``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+EMPTY = np.int32(2**31 - 1)       # empty-slot sentinel; valid codes >= 0
+PROBE_ROUNDS = 32
+PART_LIMIT = 2**31 - 1            # max product of cardinalities per key part
+
+
+class KeySpaceTooWide(Exception):
+    """Key space cannot be packed into two int32 parts (> ~2^62)."""
+
+
+def split_parts(cards: Sequence[int]) -> List[List[int]]:
+    """Split dim indices into <=2 groups whose cardinality product stays
+    below 2^31-1 each (first-fit-decreasing two-bin packing — a contiguous
+    greedy split would reject e.g. [2^28, 2^28, 4, 4], which fits as
+    ([0,2], [1,3])). Raises KeySpaceTooWide when no 2-part packing exists."""
+    sized = []
+    for i, c in enumerate(cards):
+        c = max(1, int(c))
+        if c >= PART_LIMIT:
+            raise KeySpaceTooWide(f"dimension cardinality {c} >= 2^31")
+        sized.append((c, i))
+    sized.sort(reverse=True)
+    bins: List[List[int]] = [[], []]
+    prods = [1, 1]
+    for c, i in sized:
+        # place into the fuller bin that still fits (keeps slack for the
+        # remaining, smaller cards); fall back to the other bin
+        order = (0, 1) if prods[0] >= prods[1] else (1, 0)
+        for b in order:
+            if prods[b] * c < PART_LIMIT:
+                bins[b].append(i)
+                prods[b] *= c
+                break
+        else:
+            raise KeySpaceTooWide(
+                f"key space {cards} does not pack into two int32 parts")
+    # restore the original dim order within each part (decode relies on it
+    # only via the idxs lists, but stable order keeps keys deterministic)
+    return [sorted(b) for b in bins if b]
+
+
+def fuse_part(codes: Sequence[object], cards: Sequence[int],
+              idxs: Sequence[int]):
+    """Fuse the codes of one part's dims into a single int32 key."""
+    k = codes[idxs[0]].astype(jnp.int32)
+    for i in idxs[1:]:
+        k = k * jnp.int32(int(cards[i])) + codes[i].astype(jnp.int32)
+    return k
+
+
+def unfuse_part(vals: np.ndarray, cards: Sequence[int],
+                idxs: Sequence[int]) -> List[np.ndarray]:
+    """Host inverse of fuse_part: part value -> per-dim codes (idxs order)."""
+    out = []
+    rem = np.asarray(vals, np.int64)
+    for i in reversed(list(idxs)):
+        c = int(cards[i])
+        out.append(rem % c)
+        rem = rem // c
+    return list(reversed(out))
+
+
+def _mix(a, b):
+    """murmur3-style finalizer over a pair of int32s -> uint32 hash."""
+    h = a.astype(jnp.uint32)
+    h = (h ^ (h >> 16)) * jnp.uint32(0x85EBCA6B)
+    h = h ^ (b.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35))
+    h = (h ^ (h >> 13)) * jnp.uint32(0x27D4EB2F)
+    return h ^ (h >> 16)
+
+
+def build_slots(khi, klo, valid, n_slots: int, rounds: int = PROBE_ROUNDS):
+    """Claim one table slot per distinct (khi, klo) key.
+
+    Returns ``(slot, table_khi, table_klo, n_unresolved)``: ``slot`` has the
+    input shape (claimed slot per row; untrustworthy where unresolved or
+    ~valid — callers must mask), tables are the per-slot key parts ([n_slots]
+    int32, EMPTY where unoccupied), ``n_unresolved`` is the number of valid
+    rows that failed to claim within ``rounds`` probes (host: retry with a
+    bigger table).
+    """
+    shape = khi.shape
+    khi_f = khi.reshape(-1).astype(jnp.int32)
+    klo_f = klo.reshape(-1).astype(jnp.int32)
+    val_f = valid.reshape(-1)
+    T = int(n_slots)
+    h = _mix(khi_f, klo_f)
+    # odd step => full cycle over a power-of-two table (double hashing)
+    step = _mix(klo_f, khi_f) | jnp.uint32(1)
+    slot0 = (h % jnp.uint32(T)).astype(jnp.int32)
+
+    def body(_, state):
+        tk_hi, tk_lo, slot, claimed, res = state
+        empty = tk_hi[slot] == EMPTY
+        cand_hi = jnp.where(~claimed & empty & val_f, khi_f, EMPTY)
+        tk_hi = tk_hi.at[slot].min(cand_hi)
+        hi_ok = tk_hi[slot] == khi_f
+        cand_lo = jnp.where(~claimed & empty & val_f & hi_ok, klo_f, EMPTY)
+        tk_lo = tk_lo.at[slot].min(cand_lo)
+        owner = (~claimed & val_f & (tk_hi[slot] == khi_f)
+                 & (tk_lo[slot] == klo_f))
+        res = jnp.where(owner, slot, res)
+        claimed = claimed | owner
+        slot = ((slot.astype(jnp.uint32) + step)
+                % jnp.uint32(T)).astype(jnp.int32)
+        return tk_hi, tk_lo, slot, claimed, res
+
+    init = (jnp.full((T,), EMPTY, jnp.int32),
+            jnp.full((T,), EMPTY, jnp.int32),
+            slot0, ~val_f, jnp.zeros_like(khi_f))
+    tk_hi, tk_lo, _, claimed, res = jax.lax.fori_loop(
+        0, rounds, body, init)
+    unresolved = jnp.sum((~claimed).astype(jnp.int32))
+    return res.reshape(shape), tk_hi, tk_lo, unresolved
+
+
+def pack_key(khi: np.ndarray, klo: np.ndarray) -> np.ndarray:
+    """Host: pack two int32 parts into one comparable int64 (parts < 2^31)."""
+    return (np.asarray(khi, np.int64) << np.int64(31)) \
+        | np.asarray(klo, np.int64)
+
+
+def unpack_key(packed: np.ndarray):
+    return (packed >> np.int64(31)).astype(np.int64), \
+        (packed & np.int64(2**31 - 1)).astype(np.int64)
+
+
+def initial_slots(est_groups: int, lo: int = 1 << 14,
+                  hi: int = 1 << 23) -> int:
+    """Power-of-two table size targeting <=25% load at the estimate."""
+    t = lo
+    while t < min(max(1, est_groups) * 4, hi):
+        t <<= 1
+    return min(t, hi)

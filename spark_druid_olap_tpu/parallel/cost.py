@@ -156,29 +156,17 @@ def plan_waves(n_segments: int, n_dev: int, seg_bytes: int,
     n_dev = max(1, n_dev)
     if n_segments <= 0:
         return n_dev, 1
-    cap = n_segments
+    # every wave costs a dispatch plus a host merge of its [K] partials while
+    # scan + transport totals are wave-count invariant, so the min-cost
+    # segments-per-wave is simply the largest n_dev multiple under the HBM
+    # budget (the reference's search space has a per-wave scheduling term
+    # with the same monotone structure). Unbounded scans round UP to one
+    # wave — segment padding covers the tail.
+    cap = -(-n_segments // n_dev) * n_dev
     if budget is not None and seg_bytes > 0:
-        cap = min(cap, (budget // seg_bytes) * n_dev)
-    cap = max(n_dev, cap - cap % n_dev)
-
-    merge_c = conf.get(COST_PER_ROW_MERGE)
-    compile_c = conf.get(COST_COMPILE)
-    # candidate sizes: geometric ladder of n_dev multiples up to cap
-    cands, w = [], n_dev
-    while w < cap:
-        cands.append(w)
-        w *= 2
-    cands.append(cap)
-    best, best_cost = cap, None
-    for spw in cands:
-        waves = -(-n_segments // spw)
-        # per-wave fixed dispatch overhead + host merge of K partials;
-        # scan + transport totals are wave-count invariant
-        cost = waves * (compile_c * 0.02
-                        + output_groups * max(1, n_aggs) * merge_c)
-        if best_cost is None or cost < best_cost:
-            best, best_cost = spw, cost
-    return best, -(-n_segments // best)
+        per_dev = int(budget // seg_bytes)
+        cap = min(cap, max(1, per_dev) * n_dev)
+    return cap, -(-n_segments // cap)
 
 
 def estimate(ctx_or_engine, q: S.QuerySpec) -> CostEstimate:

@@ -35,6 +35,7 @@ from spark_druid_olap_tpu.ir import spec as S
 from spark_druid_olap_tpu.ops import expr_compile as EC
 from spark_druid_olap_tpu.ops import filters as F
 from spark_druid_olap_tpu.ops import groupby as G
+from spark_druid_olap_tpu.ops import hash_groupby as H
 from spark_druid_olap_tpu.ops import hll as HLL
 from spark_druid_olap_tpu.ops import time_ops as T
 from spark_druid_olap_tpu.ops.scan import (
@@ -54,6 +55,8 @@ from spark_druid_olap_tpu.utils import host_eval
 from spark_druid_olap_tpu.utils.config import (
     Config,
     GROUPBY_DENSE_MAX_KEYS,
+    GROUPBY_HASH_MAX_SLOTS,
+    GROUPBY_HASH_SLOTS,
     GROUPBY_MATMUL_MAX_KEYS,
     GROUPBY_PALLAS_MAX_KEYS,
     HLL_LOG2M,
@@ -141,7 +144,8 @@ def _plan_plain(name: str, ds: Datasource, out: str, min_day, max_day) -> DimPla
         m = ds.metrics[name]
         lo = int(m.min) if m.min is not None else 0
         hi = int(m.max) if m.max is not None else 0
-        if hi - lo + 1 > (1 << 22):
+        if hi - lo + 1 >= H.PART_LIMIT:
+            # beyond one int32 key part even alone; hashed path can't pack it
             raise EngineFallback(f"grouping on wide-range long {name}")
         build, decode, card = _with_null_slot(
             lambda ctx: ctx.col(name) - lo,
@@ -706,6 +710,12 @@ class QueryEngine:
                            granularity, filter_spec, intervals)
         cards = [p.card for p in all_dim_plans]
 
+        if n_keys > self.config.get(GROUPBY_DENSE_MAX_KEYS):
+            return self._run_agg_hashed(
+                q, ds, seg_idx, all_dim_plans, agg_plans, names, min_day,
+                max_day, post_aggregations, having, limit, filter_spec,
+                intervals, t0)
+
         sharded = self._should_shard(q, ds, seg_idx)
         n_dev = mesh_size(self.mesh) if sharded else 1
         seg_bytes = C.bytes_per_segment(ds, names)
@@ -768,38 +778,27 @@ class QueryEngine:
                 continue
             r = routes[name]
             v = finals[name][sel]
-            if p.kind in ("min", "max"):
-                # groups whose (filtered) agg matched no rows keep the
-                # route sentinel -> emit null (NaN), like Druid
-                if r.tag == "i32":
-                    sent = G.I32_MAX if p.kind == "min" else G.I32_MIN
-                    empty = v == np.int64(sent)
-                else:
-                    empty = np.abs(v) >= 3.0e38
-                if p.spec.kind == "anyvalue":
-                    data[name] = _decode_anyvalue(ds, p.spec.field, v, empty)
-                elif empty.any():
-                    data[name] = np.where(empty, np.nan,
-                                          v).astype(np.float64)
-                elif np.issubdtype(p.out_dtype, np.integer) \
-                        and r.tag == "i32":
-                    data[name] = v.astype(np.int64)
-                elif np.issubdtype(p.out_dtype, np.integer):
-                    data[name] = np.round(v).astype(np.int64)
-                else:
-                    data[name] = v.astype(np.float64)
-            elif np.issubdtype(p.out_dtype, np.integer):
-                # sum/count int routes combine exactly (lanes/limbs/ff)
-                data[name] = np.rint(v).astype(np.int64)
-            else:
-                data[name] = v.astype(np.float64)
+            data[name] = _decode_agg_value(ds, p, r, v)
             columns.append(name)
         if global_empty:
             data.update(_identity_row(
                 {p.spec.name: p.kind for p in agg_plans
                  if p.kind in ("sum", "min", "max")}))
 
-        # --- post aggregations / having / limit (host epilogue) --------------
+        data = self._agg_epilogue(data, columns, post_aggregations, having,
+                                  limit)
+
+        self.last_stats.update({
+            "datasource": ds.name, "segments": int(len(seg_idx)),
+            "sharded": sharded, "groups": int(len(sel)),
+            "rows_scanned": int(ds.num_rows), "waves": int(n_waves),
+            "segments_per_wave": int(spw)})
+        return QueryResult(columns, data)
+
+    def _agg_epilogue(self, data, columns, post_aggregations, having, limit):
+        """Host epilogue shared by the dense and hashed agg paths: post
+        aggregations, HAVING, ORDER BY + LIMIT (≈ the Spark-side Project /
+        Filter / Sort the reference leaves above the Druid scan)."""
         for pa in post_aggregations:
             data[pa.name] = np.asarray(host_eval.eval_expr(pa.expr, data))
             columns.append(pa.name)
@@ -820,13 +819,171 @@ class QueryEngine:
             data = {k: v[idx] for k, v in data.items()}
         elif limit is not None and limit.limit is not None:
             data = {k: v[: limit.limit] for k, v in data.items()}
+        return data
 
+    # -- hashed high-cardinality aggregation path -----------------------------
+    def _run_agg_hashed(self, q, ds, seg_idx, dim_plans, agg_plans, names,
+                        min_day, max_day, post_aggregations, having, limit,
+                        filter_spec, intervals, t0):
+        """Group-by above the dense key-space ceiling: fixed-size device hash
+        table per chip/wave (ops/hash_groupby.py), partials merged by *key*
+        on host. Table overflow retries at 4x slots, then falls back.
+        ≈ Druid groupBy v2 never refusing on cardinality
+        (DruidQuerySpec.scala:558-571)."""
+        if any(p.kind == "hll" for p in agg_plans):
+            raise EngineFallback(
+                "approximate count-distinct over hashed group-by")
+        cards = [p.card for p in dim_plans]
+        try:
+            parts = H.split_parts(cards)
+        except H.KeySpaceTooWide as e:
+            raise EngineFallback(str(e)) from e
+
+        rows_sel = int(ds.num_rows * len(seg_idx)
+                       / max(ds.num_segments, 1))
+        max_slots = int(self.config.get(GROUPBY_HASH_MAX_SLOTS))
+        n_keys_total = 1
+        for c in cards:
+            n_keys_total *= int(c)
+        T = int(self.config.get(GROUPBY_HASH_SLOTS)) or H.initial_slots(
+            min(n_keys_total, rows_sel), hi=max_slots)
+
+        sharded = self._should_shard(q, ds, seg_idx)
+        n_dev = mesh_size(self.mesh) if sharded else 1
+        seg_bytes = C.bytes_per_segment(ds, names)
+        spw, n_waves = C.plan_waves(
+            len(seg_idx), n_dev, seg_bytes,
+            C.wave_budget_bytes(self.config), self.config,
+            min(rows_sel, T), len(agg_plans))
+        s_pad = spw if n_waves > 1 else _pad_segments(len(seg_idx), n_dev)
+        wave_segs = [seg_idx[i: i + s_pad]
+                     for i in range(0, len(seg_idx), s_pad)]
+        sharding = NamedSharding(self.mesh, P(SEGMENT_AXIS, None)) \
+            if sharded else None
+
+        metas = [G.AggInput(p.spec.name, p.kind, is_int=p.is_int,
+                            maxabs=p.maxabs) for p in agg_plans]
+        metas.append(G.AggInput("__rows__", "count", is_int=True,
+                                maxabs=1.0))
+
+        while True:
+            routes = G.plan_routes(
+                metas, T, self.config.get(GROUPBY_MATMUL_MAX_KEYS))
+            sig = ("hashagg", ds.name, id(ds), repr(q), s_pad,
+                   ds.padded_rows, min_day, max_day, sharded, n_dev, T,
+                   tuple(names), jax.default_backend(),
+                   bool(jax.config.jax_enable_x64))
+            prog_fn = self._programs.get(sig)
+            if prog_fn is None:
+                prog_fn = self._build_hash_program(
+                    ds, dim_plans, parts, agg_plans, filter_spec, intervals,
+                    min_day, max_day, T, sharded, routes)
+                self._programs[sig] = prog_fn
+
+            partials, unresolved = [], 0
+
+            def bind(i):
+                return {k: jax.device_put(
+                    _build_array_checked(ds, k, wave_segs[i], s_pad),
+                    sharding) for k in names}
+
+            cur = self._bind_arrays(ds, names, seg_idx, s_pad, sharded) \
+                if n_waves == 1 else bind(0)
+            for i in range(len(wave_segs)):
+                if t0 is not None:
+                    self._stage_check(q, t0)
+                raw_dev = prog_fn(cur)              # async dispatch
+                # double buffer: next wave's transfer overlaps this compute
+                nxt = bind(i + 1) if i + 1 < len(wave_segs) else None
+                raw = {k: np.asarray(v) for k, v in raw_dev.items()}
+                cur = nxt
+                unresolved += int(raw["__unres__"].sum())
+                if unresolved:
+                    break
+                partials.extend(
+                    _hash_chip_partials(raw, routes, T, n_dev))
+            if not unresolved:
+                break
+            T *= 4
+            if T > max_slots:
+                raise EngineFallback(
+                    f"hashed group-by exceeded {max_slots} table slots")
+        if t0 is not None:
+            self._stage_check(q, t0)
+
+        keys, merged = _merge_hash_partials(partials, routes)
+        data: Dict[str, np.ndarray] = {}
+        columns: List[str] = []
+        khi, klo = H.unpack_key(keys)
+        part_vals = [khi, klo]
+        dim_codes: Dict[int, np.ndarray] = {}
+        for pi, idxs in enumerate(parts):
+            for i, c in zip(idxs, H.unfuse_part(part_vals[pi], cards, idxs)):
+                dim_codes[i] = c
+        for i, p in enumerate(dim_plans):
+            data[p.output_name] = p.decode(dim_codes[i])
+            columns.append(p.output_name)
+        for p in agg_plans:
+            name = p.spec.name
+            data[name] = _decode_agg_value(ds, p, routes[name], merged[name])
+            columns.append(name)
+
+        data = self._agg_epilogue(data, columns, post_aggregations, having,
+                                  limit)
         self.last_stats.update({
             "datasource": ds.name, "segments": int(len(seg_idx)),
-            "sharded": sharded, "groups": int(len(sel)),
-            "rows_scanned": int(ds.num_rows), "waves": int(n_waves),
-            "segments_per_wave": int(spw)})
+            "sharded": sharded, "groups": int(len(keys)),
+            "rows_scanned": int(ds.num_rows), "waves": int(len(wave_segs)),
+            "segments_per_wave": int(s_pad), "hashed": True,
+            "hash_slots": int(T)})
         return QueryResult(columns, data)
+
+    def _build_hash_program(self, ds, dim_plans, parts, agg_plans,
+                            filter_spec, intervals, min_day, max_day, T,
+                            sharded, routes):
+        """One compiled program: scan -> filter -> per-dim codes -> two-part
+        key -> slot claim -> exact scatter aggregation into [T] buffers.
+        Outputs stay per-chip in sharded mode (slot layouts differ per chip;
+        the key-wise merge is host-side)."""
+        matmul_max = self.config.get(GROUPBY_MATMUL_MAX_KEYS)
+        cards = [p.card for p in dim_plans]
+
+        def core(arrays):
+            ctx = ScanContext(ds, arrays, min_day, max_day)
+            base = ctx.row_valid()
+            fm = F.lower_filter(filter_spec, ctx)
+            if fm is not None:
+                base = base & fm
+            im = F.interval_mask(intervals, ctx)
+            if im is not None:
+                base = base & im
+            codes = [p.build(ctx) for p in dim_plans]
+            khi = H.fuse_part(codes, cards, parts[0])
+            klo = H.fuse_part(codes, cards, parts[1]) if len(parts) > 1 \
+                else jnp.zeros_like(khi)
+            slot, tk_hi, tk_lo, unresolved = H.build_slots(khi, klo, base, T)
+            inputs = []
+            for p in agg_plans:
+                inputs.append(G.AggInput(p.spec.name, p.kind,
+                                         p.build_values(ctx),
+                                         p.build_mask(ctx),
+                                         is_int=p.is_int, maxabs=p.maxabs))
+            inputs.append(G.AggInput("__rows__", "count", is_int=True,
+                                     maxabs=1.0))
+            out = G.dense_groupby(slot, base, T, inputs, routes, matmul_max,
+                                  pallas_max=0)
+            out["__tkhi__"] = tk_hi
+            out["__tklo__"] = tk_lo
+            out["__unres__"] = unresolved.reshape(1)
+            return out
+
+        if not sharded:
+            return jax.jit(core)
+        smfn = jax.shard_map(core, mesh=self.mesh,
+                             in_specs=(P(SEGMENT_AXIS, None),),
+                             out_specs=P(SEGMENT_AXIS),
+                             check_vma=False)
+        return jax.jit(smfn)
 
     def _run_waves(self, q, ds, names, seg_idx, spw, sharded, prog_fn,
                    unpack, routes, n_keys, hll_plans, t0):
@@ -842,7 +999,8 @@ class QueryEngine:
 
         def bind(w):
             # no caching: wave mode exists because the scan exceeds HBM
-            return {k: jax.device_put(build_array(ds, k, w, spw), sharding)
+            return {k: jax.device_put(
+                _build_array_checked(ds, k, w, spw), sharding)
                     for k in names}
 
         finals = None
@@ -880,9 +1038,8 @@ class QueryEngine:
         n_keys = 1
         for p in dim_plans:
             n_keys *= p.card
-        if n_keys > self.config.get(GROUPBY_DENSE_MAX_KEYS):
-            raise EngineFallback(
-                f"group key cardinality {n_keys} exceeds dense limit")
+        # no cap here: callers route n_keys above the dense limit to the
+        # hashed path (build_core enforces its own dense-only cap)
         needed = set()
         for p in dim_plans:
             needed |= set(p.source_cols)
@@ -924,9 +1081,13 @@ class QueryEngine:
         dim_plans, agg_plans, min_day, max_day, n_keys, names, routes = \
             self._plan_agg(ds, seg_idx, dims, aggs, gran, q.filter,
                            q.intervals)
+        if n_keys > self.config.get(GROUPBY_DENSE_MAX_KEYS):
+            raise EngineFallback(
+                f"core build is dense-only (key cardinality {n_keys})")
         n_dev = mesh_size(self.mesh)
         s_pad = _pad_segments(len(seg_idx), n_dev)
-        arrays = {k: build_array(ds, k, seg_idx, s_pad) for k in names}
+        arrays = {k: _build_array_checked(ds, k, seg_idx, s_pad)
+                  for k in names}
         fn = self._make_core(ds, dim_plans, agg_plans, q.filter, q.intervals,
                              min_day, max_day, n_keys, routes)
         return fn, arrays
@@ -1007,14 +1168,18 @@ class QueryEngine:
         meta += [(p.spec.name, n_keys * m, "i32", True) for p in hll_plans]
         merged_meta = [t for t in meta if t[3]]
         perchip_meta = [t for t in meta if not t[3]]
-        buf_dtype = jnp.float64 if x64 else jnp.int32
+        buf_dtype = jnp.int64 if x64 else jnp.int32
 
         def pack_group(out, metas):
             parts = []
             for oname, _, dt, _ in metas:
                 a = out[oname].reshape(-1)
                 if x64:
-                    parts.append(a.astype(jnp.float64))
+                    if dt == "f64":
+                        parts.append(jax.lax.bitcast_convert_type(
+                            a.astype(jnp.float64), jnp.int64))
+                    else:
+                        parts.append(a.astype(jnp.int64))
                 elif dt == "f32":
                     parts.append(jax.lax.bitcast_convert_type(
                         a.astype(jnp.float32), jnp.int32))
@@ -1055,9 +1220,9 @@ class QueryEngine:
 
         def restore(chunk, dt):
             if x64:
-                if dt == "i32":
-                    return np.rint(chunk).astype(np.int64)
-                return np.asarray(chunk)
+                if dt == "f64":
+                    return chunk.view(np.float64)
+                return chunk                    # i64/i32 carried in int64
             if dt == "f32":
                 return chunk.view(np.float32)
             return chunk
@@ -1187,7 +1352,7 @@ class QueryEngine:
             key = (id(ds), k, s_pad, seg_sig, bool(sharded))
             dev = self._device_arrays.get(key)
             if dev is None:
-                host = build_array(ds, k, seg_idx, s_pad)
+                host = _build_array_checked(ds, k, seg_idx, s_pad)
                 dev = jax.device_put(host, sharding)
                 self._device_arrays[key] = dev
             out[k] = dev
@@ -1196,6 +1361,109 @@ class QueryEngine:
     def clear_caches(self):
         self._programs.clear()
         self._device_arrays.clear()
+
+
+def _build_array_checked(ds, key, seg_idx, s_pad) -> np.ndarray:
+    """build_array + the wide-integer gate: a 32-bit device backend cannot
+    carry int64 values without silently wrapping, so queries binding a wide
+    LONG column demote to the host tier there (x64 backends carry them in
+    f64 routes, exact to 2^53)."""
+    arr = build_array(ds, key, seg_idx, s_pad)
+    if arr.dtype == np.int64 and not G._x64():
+        raise EngineFallback(
+            f"wide integer column {key!r} on a 32-bit backend")
+    return arr
+
+
+def _decode_agg_value(ds, p, r, v) -> np.ndarray:
+    """Final per-group route values -> output column (dtype-faithful; min/max
+    empty-group sentinels become nulls, like Druid)."""
+    if p.kind in ("min", "max"):
+        if r.tag == "i32":
+            sent = G.I32_MAX if p.kind == "min" else G.I32_MIN
+            empty = v == np.int64(sent)
+        elif r.tag == "i64":
+            sent = G.I64_MAX if p.kind == "min" else G.I64_MIN
+            empty = v == sent
+        else:
+            empty = np.abs(v) >= 3.0e38
+        if p.spec.kind == "anyvalue":
+            return _decode_anyvalue(ds, p.spec.field, v, empty)
+        if empty.any():
+            if r.tag == "i64":
+                # f64 NaN-nulls would round wide ints past 2^53; keep an
+                # object column of exact ints + None
+                out = v.astype(object)
+                out[empty] = None
+                return out
+            return np.where(empty, np.nan, v).astype(np.float64)
+        if np.issubdtype(p.out_dtype, np.integer) and r.tag in ("i32", "i64"):
+            return v.astype(np.int64)
+        if np.issubdtype(p.out_dtype, np.integer):
+            return np.round(v).astype(np.int64)
+        return v.astype(np.float64)
+    if np.issubdtype(p.out_dtype, np.integer):
+        # sum/count int routes combine exactly (lanes/limbs/ff/i64);
+        # np.rint would detour int64 through f64 and round past 2^53
+        if np.issubdtype(v.dtype, np.integer):
+            return v.astype(np.int64)
+        return np.rint(v).astype(np.int64)
+    return v.astype(np.float64)
+
+
+def _hash_chip_partials(raw, routes, T, n_dev):
+    """Split a hash program's stacked outputs into per-chip (packed-key,
+    finals) partials, dropping unoccupied slots."""
+    parts = []
+    for c in range(n_dev):
+        out_c = {}
+        for name, arr in raw.items():
+            if name == "__unres__":
+                continue
+            size = arr.size // n_dev
+            out_c[name] = arr[c * size: (c + 1) * size]
+        khi = out_c.pop("__tkhi__")
+        klo = out_c.pop("__tklo__")
+        occ = khi != H.EMPTY
+        if not occ.any():
+            continue
+        finals = {name: np.asarray(G.combine_route(r, out_c, T))[occ]
+                  for name, r in routes.items()}
+        parts.append((H.pack_key(khi[occ], klo[occ]), finals))
+    return parts
+
+
+def _merge_hash_partials(parts, routes):
+    """Merge per-chip/per-wave hash-table partials by key on host (≈ the
+    broker-side merge of historical partials). Sums/counts add exactly
+    (i64/f64 finals), min/max keep sentinels."""
+    if not parts:
+        empty = {name: np.zeros(0, np.float64) for name in routes}
+        return np.zeros(0, np.int64), empty
+    keys = np.concatenate([k for k, _ in parts])
+    uniq, inv = np.unique(keys, return_inverse=True)
+    merged = {}
+    for name, r in routes.items():
+        segs = np.concatenate([f[name] for _, f in parts])
+        int_tag = r.tag in ("i32", "i64")
+        if r.kind == "min":
+            sent = {"i32": np.int64(G.I32_MAX),
+                    "i64": G.I64_MAX}.get(r.tag, np.float64(np.inf))
+            acc = np.full(len(uniq), sent,
+                          dtype=np.int64 if int_tag else np.float64)
+            np.minimum.at(acc, inv, segs)
+        elif r.kind == "max":
+            sent = {"i32": np.int64(G.I32_MIN),
+                    "i64": G.I64_MIN}.get(r.tag, np.float64(-np.inf))
+            acc = np.full(len(uniq), sent,
+                          dtype=np.int64 if int_tag else np.float64)
+            np.maximum.at(acc, inv, segs)
+        else:
+            dt = np.int64 if segs.dtype == np.int64 else np.float64
+            acc = np.zeros(len(uniq), dtype=dt)
+            np.add.at(acc, inv, segs.astype(dt))
+        merged[name] = acc
+    return uniq, merged
 
 
 def _finals_from_out(out, routes, n_keys, hll_plans):

@@ -93,7 +93,7 @@ class MetricColumn:
     """Numeric metric column (long or double)."""
 
     name: str
-    values: np.ndarray                # float32 or int32 [n]
+    values: np.ndarray                # float32 / int32 [n]; int64 when wide
     validity: Optional[np.ndarray]    # bool [n] or None
     kind: ColumnKind = ColumnKind.DOUBLE
 
@@ -191,7 +191,16 @@ def build_metric_column(name: str, raw: np.ndarray, kind: ColumnKind) -> MetricC
         raw = np.where(validity, raw, 0)
     else:
         validity = None
-    dtype = np.float32 if kind == ColumnKind.DOUBLE else np.int32
+    if kind == ColumnKind.DOUBLE:
+        dtype = np.float32
+    else:
+        # wide longs keep int64 host-side rather than silently wrapping
+        # (Druid LONG is a 64-bit type); 32-bit device backends route
+        # queries over them to the host tier
+        i64 = raw.astype(np.int64)
+        ii = np.iinfo(np.int32)
+        wide = len(i64) > 0 and (i64.min() < ii.min or i64.max() > ii.max)
+        dtype = np.int64 if wide else np.int32
     values = raw.astype(dtype)
     has_null = validity is not None and not validity.all()
     return MetricColumn(name=name, values=values,

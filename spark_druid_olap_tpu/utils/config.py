@@ -125,7 +125,18 @@ GROUPBY_MATMUL_MAX_KEYS = _entry(
 GROUPBY_DENSE_MAX_KEYS = _entry(
     "sdot.engine.groupby.dense.max.keys", 1 << 22,
     "Max fused key cardinality for the dense device group-by; above it the "
-    "planner falls back to hashed group-by.")
+    "engine switches to the hashed group-by (ops/hash_groupby.py).")
+GROUPBY_HASH_SLOTS = _entry(
+    "sdot.engine.groupby.hash.slots", 0,
+    "Initial hash-table slot count for the hashed group-by (power of two); "
+    "0 = auto-size to 4x the estimated group count. Overflow retries at 4x "
+    "up to sdot.engine.groupby.hash.max.slots.")
+GROUPBY_HASH_MAX_SLOTS = _entry(
+    "sdot.engine.groupby.hash.max.slots", 1 << 23,
+    "Max hash-table slot count; a query whose actual group count exceeds "
+    "what this table can hold falls back to the host tier (reference "
+    "contract: Druid groupBy v2 spills, never refuses — "
+    "DruidQuerySpec.scala:558-571).")
 WAVE_MAX_BYTES = _entry(
     "sdot.engine.wave.max.bytes", 0,
     "Per-device byte budget for one execution wave's scan arrays; a scan "

@@ -170,3 +170,10 @@ def test_wave_execution_sharded(sales_df):
     assert engw.last_stats["segments_per_wave"] % 8 == 0
     want = QueryEngine(st).execute(_q()).to_pandas()
     assert_frames_equal(got, want, sort_by=["region"])
+
+
+def test_plan_waves_unbounded_rounds_up_to_mesh():
+    # 9 segments on 8 devices with no budget must stay ONE padded wave
+    conf = Config()
+    spw, waves = C.plan_waves(9, 8, 1000, None, conf, 100, 2)
+    assert waves == 1 and spw % 8 == 0 and spw >= 9

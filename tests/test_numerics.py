@@ -202,3 +202,122 @@ def test_limb_kernel_unit(no_x64):
     want = np.zeros(k, np.int64)
     np.add.at(want, key[mask], v[mask].astype(np.int64))
     np.testing.assert_array_equal(got, want)
+
+
+# -----------------------------------------------------------------------------
+# wide (beyond-int32) LONG columns
+# -----------------------------------------------------------------------------
+
+def test_wide_long_column_keeps_int64_storage():
+    from spark_druid_olap_tpu.segment.column import (
+        ColumnKind, build_metric_column)
+    wide = build_metric_column(
+        "w", np.array([1, 2**35, -5], dtype=np.int64), ColumnKind.LONG)
+    assert wide.values.dtype == np.int64
+    narrow = build_metric_column(
+        "n", np.array([1, 2**30, -5], dtype=np.int64), ColumnKind.LONG)
+    assert narrow.values.dtype == np.int32
+
+
+def _wide_df():
+    r = np.random.default_rng(5)
+    n = 8_000
+    return pd.DataFrame({
+        "ts": (np.datetime64("2020-01-01")
+               + r.integers(0, 100, n).astype("timedelta64[D]"))
+        .astype("datetime64[ns]"),
+        "g": r.choice(["a", "b", "c"], n),
+        "w": r.integers(2**33, 2**45, n),     # values far beyond int32
+    })
+
+
+def test_wide_long_exact_on_x64_engine():
+    # x64 backend carries wide values in native i64 routes: exact at any
+    # magnitude (the module-scoped no_x64 fixture may be active; force on)
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    try:
+        _wide_long_exact_check()
+    finally:
+        jax.config.update("jax_enable_x64", prev)
+
+
+def _wide_long_exact_check():
+    df = _wide_df()
+    st = SegmentStore()
+    st.register(ingest_dataframe("wfact", df, time_column="ts",
+                                 target_rows=2048))
+    eng = QueryEngine(st)
+    q = GroupByQuerySpec(
+        datasource="wfact", dimensions=(DimensionSpec("g", "g"),),
+        aggregations=(AggregationSpec("longsum", "s", field="w"),
+                      AggregationSpec("longmin", "mn", field="w"),
+                      AggregationSpec("longmax", "mx", field="w")))
+    got = eng.execute(q).to_pandas().sort_values("g").reset_index(drop=True)
+    want = df.groupby("g", as_index=False).agg(
+        s=("w", "sum"), mn=("w", "min"), mx=("w", "max"))
+    for c in ("s", "mn", "mx"):
+        np.testing.assert_array_equal(
+            got[c].to_numpy().astype(np.int64), want[c].to_numpy(),
+            err_msg=f"{c} must be exact for wide longs")
+
+
+def test_wide_long_falls_back_on_32bit_backend(no_x64):
+    # a 32-bit backend cannot carry int64 without wrapping: the engine must
+    # refuse (EngineFallback -> host tier), never return wrapped sums
+    from spark_druid_olap_tpu.parallel.executor import EngineFallback
+    df = _wide_df()
+    st = SegmentStore()
+    st.register(ingest_dataframe("wfact", df, time_column="ts",
+                                 target_rows=2048))
+    eng = QueryEngine(st)
+    q = GroupByQuerySpec(
+        datasource="wfact", dimensions=(DimensionSpec("g", "g"),),
+        aggregations=(AggregationSpec("longsum", "s", field="w"),))
+    with pytest.raises(EngineFallback):
+        eng.execute(q)
+
+
+def test_wide_long_sql_host_fallback_is_exact(no_x64, monkeypatch):
+    # SDOT_FORCE_32BIT stops Context from re-enabling x64 on CPU, so this
+    # exercises the exact TPU-dtype fallback wiring end-to-end
+    monkeypatch.setenv("SDOT_FORCE_32BIT", "1")
+    import spark_druid_olap_tpu as sdot
+    df = _wide_df()
+    ctx = sdot.Context()
+    ctx.ingest_dataframe("wfact", df, time_column="ts", target_rows=2048)
+    got = ctx.sql("select g, sum(w) as s from wfact group by g "
+                  "order by g").to_pandas()
+    assert ctx.history.entries()[-1].stats["mode"].startswith("host")
+    want = df.groupby("g")["w"].sum().sort_index()
+    np.testing.assert_array_equal(got["s"].to_numpy().astype(np.int64),
+                                  want.to_numpy())
+
+
+def test_wide_long_min_with_empty_groups_stays_exact():
+    # filtered longmin leaving some groups empty must not round the
+    # non-empty groups' wide values through f64
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    try:
+        from spark_druid_olap_tpu.ir.spec import SelectorFilter
+        df = pd.DataFrame({
+            "ts": pd.to_datetime(["2020-01-01"] * 4),
+            "g": ["a", "a", "b", "b"],
+            "f": ["y", "y", "n", "n"],
+            "w": np.array([2**60 + 1, 2**60 + 3, 2**61 + 7, 2**61 + 9],
+                          dtype=np.int64),
+        })
+        st = SegmentStore()
+        st.register(ingest_dataframe("wmin", df, time_column="ts"))
+        q = GroupByQuerySpec(
+            datasource="wmin", dimensions=(DimensionSpec("g", "g"),),
+            aggregations=(AggregationSpec(
+                "longmin", "mn", field="w",
+                filter=SelectorFilter("f", "y")),))
+        got = QueryEngine(st).execute(q).to_pandas() \
+            .sort_values("g").reset_index(drop=True)
+        assert got.loc[0, "mn"] == 2**60 + 1      # exact, not f64-rounded
+        assert got.loc[1, "mn"] is None           # empty group -> null
+    finally:
+        jax.config.update("jax_enable_x64", prev)
