@@ -206,6 +206,10 @@ def setup(sf: float):
         ctx.ingest_dataframe(name, df, time_column=tcol, target_rows=1 << 20)
     for name, df in tpch.nation_region_views(tables).items():
         ctx.ingest_dataframe(name, df)
+    # second star at partsupp grain (q2/q11/q16/q20-class pushdown)
+    ctx.ingest_dataframe("partsupp_flat", tpch.flatten_partsupp(tables),
+                         target_rows=1 << 20)
+    ctx.register_star_schema(tpch.partsupp_star_schema("partsupp_flat"))
     ctx.register_star_schema(tpch.star_schema("tpch_flat"))
     log(f"ingest: {time.perf_counter() - t0:.1f}s "
         f"({ctx.store.get('tpch_flat').num_segments} flat segments)")

@@ -20,6 +20,50 @@ import dataclasses
 from typing import Any, Optional, Sequence, Tuple
 
 
+class FrozenIntSet:
+    """Immutable sorted int64 membership set with O(1) repr/eq/hash.
+
+    Decorrelated semi/anti joins (EXISTS -> key IN <list>) produce key lists
+    reaching millions of values; carrying them as plain tuples would make
+    ``repr(query)`` (the executor's program-cache key) and structural
+    equality O(n). The digest stands in for the contents everywhere except
+    actual membership tests, which use the sorted array directly.
+    """
+
+    __slots__ = ("array", "_digest")
+
+    def __init__(self, values):
+        import numpy as np
+        arr = values if isinstance(values, np.ndarray) \
+            else np.fromiter((int(v) for v in values), dtype=np.int64)
+        arr = np.unique(arr.astype(np.int64, copy=False))
+        arr.setflags(write=False)
+        object.__setattr__(self, "array", arr)
+        import hashlib
+        object.__setattr__(
+            self, "_digest", hashlib.sha1(arr.tobytes()).hexdigest())
+
+    def __iter__(self):
+        return iter(self.array.tolist())
+
+    def __len__(self):
+        return int(len(self.array))
+
+    def __contains__(self, v):
+        import numpy as np
+        i = int(np.searchsorted(self.array, int(v)))
+        return i < len(self.array) and int(self.array[i]) == int(v)
+
+    def __repr__(self):
+        return f"FrozenIntSet(n={len(self.array)}, sha={self._digest[:16]})"
+
+    def __eq__(self, o):
+        return isinstance(o, FrozenIntSet) and self._digest == o._digest
+
+    def __hash__(self):
+        return hash(self._digest)
+
+
 class Expr:
     """Base scalar expression node."""
 
@@ -251,7 +295,8 @@ def to_sql(e: Expr) -> str:
     if isinstance(e, IsNull):
         return f"({to_sql(e.child)} IS {'NOT ' if e.negated else ''}NULL)"
     if isinstance(e, InList):
-        vals = ", ".join(repr(v) for v in e.values)
+        vals = repr(e.values) if isinstance(e.values, FrozenIntSet) \
+            else ", ".join(repr(v) for v in e.values)
         return f"({to_sql(e.child)} {'NOT ' if e.negated else ''}IN ({vals}))"
     if isinstance(e, Between):
         return (f"({to_sql(e.child)} {'NOT ' if e.negated else ''}BETWEEN "

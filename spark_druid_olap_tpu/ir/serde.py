@@ -144,6 +144,9 @@ def filter_to_dict(f: Optional[S.FilterSpec]):
                 "lowerStrict": f.lower_strict, "upperStrict": f.upper_strict,
                 "numeric": f.numeric}
     if isinstance(f, S.InFilter):
+        if isinstance(f.values, E.FrozenIntSet):
+            return {"type": "in", "dimension": f.dimension,
+                    "values": f.values.array.tolist(), "intset": True}
         return {"type": "in", "dimension": f.dimension,
                 "values": [_jsonable(v) for v in f.values]}
     if isinstance(f, S.PatternFilter):
@@ -189,6 +192,8 @@ def filter_from_dict(d) -> Optional[S.FilterSpec]:
                              d.get("upperStrict", False),
                              d.get("numeric", False))
     if t == "in":
+        if d.get("intset"):
+            return S.InFilter(d["dimension"], E.FrozenIntSet(d["values"]))
         return S.InFilter(d["dimension"], tuple(d["values"]))
     if t in ("like", "regex", "contains"):
         return S.PatternFilter(d["dimension"], t, d["pattern"])

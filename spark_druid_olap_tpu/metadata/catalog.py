@@ -20,17 +20,32 @@ class Catalog:
     def __init__(self, store: SegmentStore):
         self.store = store
         self.star_schemas: Dict[str, object] = {}   # fact table -> StarSchema
-        self._table_to_star: Dict[str, object] = {}
+        self._table_to_stars: Dict[str, list] = {}
 
     def register_star_schema(self, star) -> None:
+        prev = self.star_schemas.get(star.fact_table)
         self.star_schemas[star.fact_table] = star
+        if prev is not None:
+            # drop the superseded star everywhere, including tables the new
+            # version no longer declares
+            for lst in self._table_to_stars.values():
+                if prev in lst:
+                    lst.remove(prev)
         for t in star.tables():
-            self._table_to_star[t] = star
+            self._table_to_stars.setdefault(t, []).append(star)
         if hasattr(self, "_fd_cache"):
             self._fd_cache.pop(star.fact_table, None)
 
     def star_schema_of(self, table: str):
-        return self._table_to_star.get(table)
+        lst = self._table_to_stars.get(table)
+        return lst[0] if lst else None
+
+    def star_schemas_of(self, table: str) -> list:
+        """All stars a table participates in — shared dimension tables
+        (e.g. supplier in both a lineitem star and a partsupp star) make
+        this a list; the planner picks the candidate whose fact anchors
+        the query's join tree."""
+        return list(self._table_to_stars.get(table, ()))
 
     def fd_graph_for(self, ds_name: str, store=None):
         """FD graph applicable to a datasource (its star schema's, matched by

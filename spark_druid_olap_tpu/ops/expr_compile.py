@@ -299,6 +299,23 @@ def _as_bool(v):
 
 
 def _in_list(v, values, ctx):
+    if isinstance(values, E.FrozenIntSet):
+        vals = values.array
+        if len(vals) == 0:
+            if isinstance(v, StrValue):
+                return jnp.zeros_like(v.codes, dtype=bool)
+            n0 = _as_num(v, ctx)
+            return jnp.zeros_like(n0.arr, dtype=bool)
+        n = _as_num(v, ctx)
+        if n.is_float:
+            # f32 compares collide for keys >= 2^24; let the host evaluate
+            raise Unsupported("large integer IN set over float expression")
+        if int(vals[0]) < -(2**31) or int(vals[-1]) >= 2**31:
+            raise Unsupported("IN-set values exceed 32-bit range")
+        dev = jnp.asarray(vals.astype(np.int32))
+        arr = n.arr.astype(jnp.int32)
+        idx = jnp.clip(jnp.searchsorted(dev, arr), 0, len(vals) - 1)
+        return dev[idx] == arr
     if isinstance(v, StrValue):
         vs = set(values)
         mask = np.array([s in vs for s in v.host_values])

@@ -811,7 +811,16 @@ class QueryEngine:
             for oc in reversed(limit.columns):
                 k = data[oc.name]
                 if k.dtype == object:
-                    k = k.astype(str)
+                    # numeric-or-null object columns (wide-int min/max with
+                    # empty groups) sort numerically, nulls last; others
+                    # lexicographically
+                    import pandas as _pd
+                    num = _pd.to_numeric(_pd.Series(k), errors="coerce")
+                    if num.notna().to_numpy().sum() == \
+                            _pd.Series(k).notna().to_numpy().sum():
+                        k = num.to_numpy(np.float64)
+                    else:
+                        k = k.astype(str)
                 order_keys.append(k if oc.ascending else _neg_key(k))
             idx = np.lexsort(order_keys)
             if limit.limit is not None:
@@ -847,6 +856,9 @@ class QueryEngine:
             n_keys_total *= int(c)
         T = int(self.config.get(GROUPBY_HASH_SLOTS)) or H.initial_slots(
             min(n_keys_total, rows_sel), hi=max_slots)
+        if T & (T - 1):
+            # double hashing cycles the full table only for power-of-two T
+            T = 1 << T.bit_length()
 
         sharded = self._should_shard(q, ds, seg_idx)
         n_dev = mesh_size(self.mesh) if sharded else 1
@@ -861,10 +873,10 @@ class QueryEngine:
         sharding = NamedSharding(self.mesh, P(SEGMENT_AXIS, None)) \
             if sharded else None
 
+        # no '__rows__' occupancy count here: occupied slots are read off
+        # the key table (khi != EMPTY) directly
         metas = [G.AggInput(p.spec.name, p.kind, is_int=p.is_int,
                             maxabs=p.maxabs) for p in agg_plans]
-        metas.append(G.AggInput("__rows__", "count", is_int=True,
-                                maxabs=1.0))
 
         while True:
             routes = G.plan_routes(
@@ -968,8 +980,6 @@ class QueryEngine:
                                          p.build_values(ctx),
                                          p.build_mask(ctx),
                                          is_int=p.is_int, maxabs=p.maxabs))
-            inputs.append(G.AggInput("__rows__", "count", is_int=True,
-                                     maxabs=1.0))
             out = G.dense_groupby(slot, base, T, inputs, routes, matmul_max,
                                   pallas_max=0)
             out["__tkhi__"] = tk_hi
@@ -1390,9 +1400,9 @@ def _decode_agg_value(ds, p, r, v) -> np.ndarray:
         if p.spec.kind == "anyvalue":
             return _decode_anyvalue(ds, p.spec.field, v, empty)
         if empty.any():
-            if r.tag == "i64":
-                # f64 NaN-nulls would round wide ints past 2^53; keep an
-                # object column of exact ints + None
+            if r.tag == "i64" and \
+                    np.abs(np.where(empty, 0, v)).max(initial=0) >= 2**53:
+                # f64 NaN-nulls would round these; keep exact ints + None
                 out = v.astype(object)
                 out[empty] = None
                 return out

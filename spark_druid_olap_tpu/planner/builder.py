@@ -137,17 +137,30 @@ class Builder:
                 return star.flat_datasource, []
             raise PlanUnsupported(f"unknown table {t!r}")
 
-        # multi-table: must be a star join
-        star = None
+        # multi-table: must be a star join against SOME registered star
+        # (shared dim tables can belong to several stars — e.g. supplier in
+        # both the lineitem and partsupp stars; try each candidate and keep
+        # the one whose fact anchors this join tree)
+        cands: List[StarSchema] = []
         for t in tables:
-            s = self.ctx.catalog.star_schema_of(t)
-            if s is not None:
-                star = s
-                break
-        if star is None:
+            for s in self.ctx.catalog.star_schemas_of(t):
+                if s not in cands:
+                    cands.append(s)
+        if not cands:
             raise PlanUnsupported("join without a registered star schema")
-        # join predicates may live in WHERE (comma joins)
         where_conjs = _split_conjuncts(self.stmt.where)
+        errors: List[str] = []
+        for star in cands:
+            r = self._try_star(star, tables, join_conds, where_conjs, store)
+            if isinstance(r, tuple):
+                return r
+            errors.append(r)
+        raise PlanUnsupported("; ".join(dict.fromkeys(errors)))
+
+    def _try_star(self, star: StarSchema, tables, join_conds, where_conjs,
+                  store):
+        """Validate the join tree against one candidate star; returns
+        (flat_datasource, consumed_predicates) or an error string."""
         eq_pairs: List[Tuple[str, str]] = []
         consumed: List[E.Expr] = []
         star_cols = self._star_key_columns(star)
@@ -161,19 +174,17 @@ class Builder:
                     consumed.append(c)
                     continue
             if c in join_conds:
-                raise PlanUnsupported(
-                    f"non-star join condition {E.to_sql(c)}")
+                return f"non-star join condition ({E.to_sql(c)})"
         if star.fact_table not in tables:
             # a dim-only join has dim-table grain; folding it onto the flat
             # fact would change row multiplicity (the reference likewise
             # anchors every rewrite at the fact DruidRelation leaf,
             # JoinTransform.scala:305-385)
-            raise PlanUnsupported("join does not include the fact table")
+            return "join does not include the fact table"
         if not star.is_star_join(set(tables), eq_pairs):
-            raise PlanUnsupported("join tree is not a sub-star of the "
-                                  "declared star schema")
+            return "join tree is not a sub-star of the declared star schema"
         if star.flat_datasource not in store.names():
-            raise PlanUnsupported("star schema flat datasource not ingested")
+            return "star schema flat datasource not ingested"
         return star.flat_datasource, consumed
 
     @staticmethod
@@ -273,10 +284,16 @@ class Builder:
                 return S.NullFilter(e.child.name, negated=e.negated)
             return S.ExprFilter(e)
         if isinstance(e, E.InList) and isinstance(e.child, E.Column):
-            f = S.InFilter(e.child.name,
-                           tuple(str(v) for v in e.values))
             kind = self._col_kind(e.child.name)
-            if kind not in (ColumnKind.DIM,):
+            if isinstance(e.values, E.FrozenIntSet):
+                if kind not in (ColumnKind.LONG, ColumnKind.DATE):
+                    raise PlanUnsupported(
+                        "large integer IN set over non-integer column")
+                f = S.InFilter(e.child.name, e.values)
+            elif kind == ColumnKind.DIM:
+                f = S.InFilter(e.child.name,
+                               tuple(str(v) for v in e.values))
+            else:
                 f = S.InFilter(e.child.name, tuple(e.values))
             return S.LogicalFilter("not", (f,)) if e.negated else f
         if isinstance(e, E.Between) and isinstance(e.child, E.Column):

@@ -39,6 +39,128 @@ def _is_correlated(ctx, q: A.SelectStmt) -> bool:
         return True  # unknown tables etc. — leave it to the host path
 
 
+def _split_and(e: Optional[E.Expr]):
+    if e is None:
+        return []
+    if isinstance(e, E.And):
+        out = []
+        for p in e.parts:
+            out.extend(_split_and(p))
+        return out
+    return [e]
+
+
+def _and_all(parts):
+    if not parts:
+        return None
+    return parts[0] if len(parts) == 1 else E.And(tuple(parts))
+
+
+def _column_non_null(ctx, rel, name: str) -> bool:
+    """True when ``name`` resolves to a provably non-nullable column of a
+    base table in ``rel``."""
+    tables = []
+
+    def walk(r):
+        if isinstance(r, A.TableRef):
+            tables.append(r.name)
+        elif isinstance(r, A.Join):
+            walk(r.left)
+            walk(r.right)
+    if rel is not None:
+        walk(rel)
+    for t in tables:
+        try:
+            ds = ctx.store.get(t)
+        except KeyError:
+            continue
+        if name in ds.dims:
+            return ds.dims[name].validity is None
+        if name in ds.metrics:
+            return ds.metrics[name].validity is None
+        if ds.time is not None and name == ds.time.name:
+            return True
+    return False
+
+
+def decorrelate_semijoins(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
+    """Correlated EXISTS / NOT EXISTS with a single equi-correlation
+    conjunct -> uncorrelated IN / NOT IN subquery over the inner key
+    (semi/anti join), which `inline_subqueries` then evaluates through the
+    engine. ≈ Spark's RewritePredicateSubquery giving the reference a
+    pushable plan on both sides of TPC-H q4/q21/q22-style predicates.
+
+    NOT EXISTS additionally requires a provably non-null probe column (a
+    NULL probe makes NOT IN unknown where the anti join keeps the row).
+    """
+    if stmt.where is None:
+        return stmt
+    changed = False
+    conjs = []
+    for c in _split_and(stmt.where):
+        r = _try_semijoin(ctx, stmt, c)
+        if r is not None:
+            changed = True
+            conjs.append(r)
+        else:
+            conjs.append(c)
+    if not changed:
+        return stmt
+    return dataclasses.replace(stmt, where=_and_all(conjs))
+
+
+def _try_semijoin(ctx, outer: A.SelectStmt, c) -> Optional[E.Expr]:
+    negated = False
+    while isinstance(c, E.Not):      # parser may emit NOT Exists(...)
+        negated = not negated
+        c = c.child
+    if not isinstance(c, A.Exists):
+        return None
+    negated = negated != c.negated
+    q = c.query
+    if q.group_by is not None or q.having is not None \
+            or q.limit is not None or q.distinct:
+        return None
+    from spark_druid_olap_tpu.planner.host_exec import _free_columns
+    try:
+        free = _free_columns(ctx, q)
+    except Exception:  # noqa: BLE001 — unknown tables etc.
+        return None
+    if len(free) != 1:
+        return None
+    (outer_col,) = free
+    inner_col = None
+    rest = []
+    for cj in _split_and(q.where):
+        if (inner_col is None and isinstance(cj, E.Comparison)
+                and cj.op == "=" and isinstance(cj.left, E.Column)
+                and isinstance(cj.right, E.Column)
+                and {cj.left.name, cj.right.name} & {outer_col}):
+            other = cj.right.name if cj.left.name == outer_col \
+                else cj.left.name
+            if other != outer_col:
+                inner_col = other
+                continue
+        rest.append(cj)
+    if inner_col is None:
+        return None
+    # the correlation must live ONLY in that conjunct
+    from spark_druid_olap_tpu.planner.host_exec import _expr_refs
+    for cj in rest:
+        try:
+            if outer_col in _expr_refs(ctx, cj):
+                return None
+        except Exception:  # noqa: BLE001
+            return None
+    if negated and not _column_non_null(ctx, outer.relation, outer_col):
+        return None
+    inner = A.SelectStmt(
+        items=(A.SelectItem(E.Column(inner_col)),),
+        relation=q.relation, where=_and_all(rest), distinct=True)
+    return A.InSubquery(child=E.Column(outer_col), query=inner,
+                        negated=negated)
+
+
 def inline_subqueries(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
     """Replace uncorrelated subquery nodes in WHERE/HAVING with literals."""
 
@@ -64,8 +186,14 @@ def inline_subqueries(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
                     not _is_correlated(ctx, n.query):
                 df = run_inner(n.query)
                 changed[0] = True
-                vals = tuple(_to_python(v)
-                             for v in pd.unique(df.iloc[:, 0].dropna()))
+                col = df.iloc[:, 0].dropna()
+                if len(col) > 1024 and \
+                        np.issubdtype(col.to_numpy().dtype, np.integer):
+                    # semi-join-scale integer key list: O(1)-repr sorted set
+                    return E.InList(n.child,
+                                    E.FrozenIntSet(col.to_numpy()),
+                                    negated=n.negated)
+                vals = tuple(_to_python(v) for v in pd.unique(col))
                 if not vals:
                     # empty IN-list: constant false (true for NOT IN)
                     return E.Literal(bool(n.negated))

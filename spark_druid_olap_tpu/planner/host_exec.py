@@ -45,6 +45,12 @@ def datasource_frame(ctx, name: str, columns=None) -> pd.DataFrame:
     statement's referenced columns — projection pushdown for the host
     tier)."""
     from spark_druid_olap_tpu.parallel.executor import _host_column_values
+    temps = getattr(ctx, "_temp_frames", None)
+    if temps and name in temps:
+        df = temps[name]
+        if columns is not None:
+            df = df[[c for c in df.columns if c in columns]]
+        return df
     if name in SYS_VIEWS and name not in ctx.store.names():
         return SYS_VIEWS[name](ctx)
     ds = ctx.store.get(name)
@@ -101,6 +107,9 @@ def try_engine(ctx, stmt: A.SelectStmt) -> Optional[pd.DataFrame]:
 
 def relation_columns(ctx, rel: A.Relation) -> List[str]:
     if isinstance(rel, A.TableRef):
+        temps = getattr(ctx, "_temp_frames", None)
+        if temps and rel.name in temps:
+            return list(temps[rel.name].columns)
         if rel.name in SYS_VIEWS and rel.name not in ctx.store.names():
             return list(SYS_VIEWS[rel.name](ctx).columns)
         return list(ctx.store.get(rel.name).column_names())

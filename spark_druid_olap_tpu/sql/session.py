@@ -130,8 +130,21 @@ def explain_text(ctx, stmt: A.SelectStmt, sql: str) -> str:
     lines = [f"SQL: {sql.strip()}"]
     stmt = resolve_lookups(ctx, stmt)
     try:
+        from spark_druid_olap_tpu.planner.decorrelate import (
+            decorrelate_semijoins)
+        from spark_druid_olap_tpu.planner.viewmerge import merge_derived
+        stmt = decorrelate_semijoins(ctx, merge_derived(ctx, stmt))
         pq = B.build(ctx, stmt)
     except PlanUnsupported as e:
+        from spark_druid_olap_tpu.planner import composite
+        try:
+            cp = composite.build_composite(ctx, stmt)
+            lines.append("pushdown: COMPOSITE (engine derived tables + "
+                         "host finish)")
+            lines.append(composite.describe(cp, "  "))
+            return "\n".join(lines)
+        except Exception:  # noqa: BLE001 — explain must never fail
+            pass
         lines.append(f"pushdown: NO ({e})")
         lines.append("execution: host (pandas fallback)")
         return "\n".join(lines)
@@ -155,14 +168,31 @@ def _run_select(ctx, stmt: A.SelectStmt, sql: str) -> QueryResult:
     t0 = _time.perf_counter()
     stmt = resolve_lookups(ctx, stmt)
     try:
-        from spark_druid_olap_tpu.planner.decorrelate import inline_subqueries
-        stmt2 = inline_subqueries(ctx, stmt)
+        from spark_druid_olap_tpu.planner.decorrelate import (
+            decorrelate_semijoins, inline_subqueries)
+        from spark_druid_olap_tpu.planner.viewmerge import merge_derived
+        stmt2 = merge_derived(ctx, stmt)
+        stmt2 = decorrelate_semijoins(ctx, stmt2)
+        stmt2 = inline_subqueries(ctx, stmt2)
         pq = B.build(ctx, stmt2)
         df = execute_planned(ctx, pq)
         mode = "engine"
     except (PlanUnsupported, EngineFallback) as e:
-        df = host_exec.execute_select(ctx, stmt)
-        mode = f"host ({e})"
+        df = mode = None
+        if isinstance(e, PlanUnsupported):
+            # engine-planned derived tables + dim-scale host finish (the
+            # reference's DruidQuery-scans-under-Spark-join shape)
+            from spark_druid_olap_tpu.planner import composite
+            try:
+                cp = composite.build_composite(ctx, stmt2)
+                df = composite.execute_composite(ctx, cp)
+                mode = "engine"
+            except (PlanUnsupported, EngineFallback,
+                    host_exec.HostExecError):
+                df = None
+        if df is None:
+            df = host_exec.execute_select(ctx, stmt)
+            mode = f"host ({e})"
     stats = dict(ctx.engine.last_stats)
     stats["mode"] = mode
     stats["total_ms"] = (_time.perf_counter() - t0) * 1000

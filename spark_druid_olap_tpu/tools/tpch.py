@@ -220,6 +220,37 @@ def flatten(tables) -> pd.DataFrame:
     return df.reset_index(drop=True)
 
 
+def flatten_partsupp(tables) -> pd.DataFrame:
+    """Denormalize the partsupp-grain star (partsupp x part x supplier x
+    supp-nation/region). TPC-H q2/q11/q16/q20 aggregate at partsupp grain,
+    where folding onto the lineitem flat index would multiply rows; Druid
+    deployments likewise index one datasource per fact grain."""
+    nr = nation_region_views(tables)
+    df = tables["partsupp"].merge(tables["part"], left_on="ps_partkey",
+                                  right_on="p_partkey")
+    df = df.merge(tables["supplier"], left_on="ps_suppkey",
+                  right_on="s_suppkey")
+    df = df.merge(nr["suppnation"], left_on="s_nationkey",
+                  right_on="sn_nationkey")
+    df = df.merge(nr["suppregion"], left_on="sn_regionkey",
+                  right_on="sr_regionkey")
+    return df.reset_index(drop=True)
+
+
+def partsupp_star_schema(
+        flat_datasource: str = "partsupp_flat") -> StarSchema:
+    """Second star: partsupp fact with part/supplier/nation/region dims."""
+    return StarSchema("partsupp", flat_datasource, [
+        StarRelation("partsupp", "part", (("ps_partkey", "p_partkey"),)),
+        StarRelation("partsupp", "supplier",
+                     (("ps_suppkey", "s_suppkey"),)),
+        StarRelation("supplier", "suppnation",
+                     (("s_nationkey", "sn_nationkey"),)),
+        StarRelation("suppnation", "suppregion",
+                     (("sn_regionkey", "sr_regionkey"),)),
+    ])
+
+
 def star_schema(flat_datasource: str = "tpch_flat") -> StarSchema:
     """The TPC-H star graph (≈ StarSchemaBaseTest's starSchema json)."""
     return StarSchema("lineitem", flat_datasource, [
@@ -260,6 +291,9 @@ def setup_context(ctx, sf: float = 0.01, seed: int = 20260729,
                                  target_rows=target_rows)
         for name, df in nation_region_views(tables).items():
             ctx.ingest_dataframe(name, df, target_rows=target_rows)
+        ctx.ingest_dataframe("partsupp_flat", flatten_partsupp(tables),
+                             target_rows=target_rows)
+        ctx.register_star_schema(partsupp_star_schema("partsupp_flat"))
     ctx.register_star_schema(star_schema("tpch_flat"))
     return tables, flat
 

@@ -126,7 +126,25 @@ def eval_expr(e: E.Expr, env: dict):
         return np.logical_not(isnull) if e.negated else isnull
     if isinstance(e, E.InList):
         v = eval_expr(e.child, env)
-        if _is_str_like(v):
+        if isinstance(e.values, E.FrozenIntSet):
+            arr = np.asarray(v)
+            if arr.dtype == object or arr.dtype.kind == "f":
+                arr = pd.to_numeric(pd.Series(arr),
+                                    errors="coerce").to_numpy()
+                # fractional probes match no integer set member
+                ok = ~np.isnan(arr) & (arr == np.floor(arr))
+                vi = np.where(ok, arr, 0).astype(np.int64)
+            else:
+                ok = None
+                vi = arr.astype(np.int64)
+            idx = np.clip(np.searchsorted(e.values.array, vi), 0,
+                          max(len(e.values.array) - 1, 0))
+            out = (len(e.values.array) > 0) \
+                & (e.values.array[idx] == vi) if len(e.values.array) \
+                else np.zeros(len(vi), dtype=bool)
+            if ok is not None:
+                out = out & ok
+        elif _is_str_like(v):
             vals = set(e.values)
             out = _map1(v, lambda x: x in vals)
         else:

@@ -1,0 +1,178 @@
+"""Planner rewrite passes: semi/anti-join decorrelation, view merging,
+FrozenIntSet membership filters, composite plans.
+
+≈ the reference relying on Spark's RewritePredicateSubquery /
+CollapseProject normalizations running before DruidStrategy; here the
+equivalents are explicit planner passes.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.ir import expr as E
+from spark_druid_olap_tpu.planner.decorrelate import decorrelate_semijoins
+from spark_druid_olap_tpu.planner.viewmerge import merge_derived
+from spark_druid_olap_tpu.sql import ast as A
+from spark_druid_olap_tpu.sql.parser import parse_select
+
+from conftest import assert_frames_equal, make_sales_df
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = sdot.Context()
+    c.ingest_dataframe("sales", make_sales_df(), time_column="ts",
+                       target_rows=4096)
+    rng = np.random.default_rng(3)
+    c.ingest_dataframe("events", pd.DataFrame({
+        "e_region": rng.choice(["east", "west", "north"], 500),
+        "e_qty": rng.integers(1, 100, 500),
+    }))
+    return c
+
+
+# -- FrozenIntSet -------------------------------------------------------------
+
+def test_frozen_int_set_semantics():
+    s = E.FrozenIntSet([5, 1, 5, 9])
+    assert len(s) == 3 and 5 in s and 2 not in s
+    assert list(s) == [1, 5, 9]
+    assert s == E.FrozenIntSet(np.array([9, 1, 5]))
+    assert s != E.FrozenIntSet([1, 5])
+    assert "sha=" in repr(s) and len(repr(s)) < 60
+
+
+def test_frozen_int_set_engine_filter_differential(ctx):
+    from spark_druid_olap_tpu.ir.spec import (
+        AggregationSpec, GroupByQuerySpec, DimensionSpec, InFilter)
+    from spark_druid_olap_tpu.planner.host_exec import datasource_frame
+    sales = datasource_frame(ctx, "sales")
+    keep = E.FrozenIntSet(range(10, 40))
+    q = GroupByQuerySpec(
+        datasource="sales",
+        dimensions=(DimensionSpec("region", "region"),),
+        aggregations=(AggregationSpec("count", "n"),),
+        filter=InFilter("qty", keep))
+    got = ctx.engine.execute(q).to_pandas()
+    want = sales[sales.qty.isin(list(keep))].groupby(
+        "region", as_index=False).agg(n=("qty", "size"))
+    assert_frames_equal(got, want, sort_by=["region"])
+
+
+def test_frozen_int_set_serde_roundtrip():
+    from spark_druid_olap_tpu.ir import serde
+    from spark_druid_olap_tpu.ir.spec import (
+        AggregationSpec, GroupByQuerySpec, InFilter)
+    q = GroupByQuerySpec(
+        datasource="d", dimensions=(),
+        aggregations=(AggregationSpec("count", "n"),),
+        filter=InFilter("k", E.FrozenIntSet([3, 1, 2])))
+    q2 = serde.query_from_json(serde.query_to_json(q))
+    assert isinstance(q2.filter.values, E.FrozenIntSet)
+    assert q2.filter.values == q.filter.values
+
+
+# -- semi/anti-join decorrelation --------------------------------------------
+
+def _exists_stmt(negated):
+    sql = ("select region, count(*) as n from sales where "
+           + ("not " if negated else "")
+           + "exists (select 1 from events where e_region = region "
+           "and e_qty > 90) group by region")
+    return parse_select(sql)
+
+
+def test_decorrelate_exists_to_semijoin(ctx):
+    s2 = decorrelate_semijoins(ctx, _exists_stmt(False))
+    ins = s2.where
+    assert isinstance(ins, A.InSubquery) and not ins.negated
+    assert ins.query.distinct
+    assert isinstance(ins.child, E.Column)
+
+
+def test_decorrelate_not_exists_needs_nonnull_probe(ctx):
+    s2 = decorrelate_semijoins(ctx, _exists_stmt(True))
+    # region (a non-null dim of sales) qualifies -> anti join
+    assert isinstance(s2.where, A.InSubquery) and s2.where.negated
+
+
+def test_decorrelated_exists_differential(ctx):
+    from spark_druid_olap_tpu.planner import host_exec
+    sql = ("select region, count(*) as n from sales where "
+           "exists (select 1 from events where e_region = region "
+           "and e_qty > 90) group by region order by region")
+    got = ctx.sql(sql).to_pandas()
+    assert ctx.history.entries()[-1].stats["mode"] == "engine"
+    ctx.host_engine_assist = False
+    try:
+        want = host_exec.execute_select(ctx, parse_select(sql))
+    finally:
+        ctx.host_engine_assist = True
+    assert_frames_equal(got, want, sort_by=None)
+
+
+# -- view merging -------------------------------------------------------------
+
+def test_merge_derived_flattens(ctx):
+    s = parse_select(
+        "select r, sum(qty) as s from "
+        "(select upper(region) as r, qty from sales where qty > 5) t "
+        "where r <> 'EAST' group by r")
+    s2 = merge_derived(ctx, s)
+    assert isinstance(s2.relation, A.TableRef)
+    assert s2.relation.name == "sales"
+    # inner + outer predicates combined
+    assert isinstance(s2.where, E.And)
+
+
+def test_merge_derived_keeps_alias(ctx):
+    s = parse_select(
+        "select r, count(*) as n from "
+        "(select upper(region) as r from sales) t group by r")
+    s2 = merge_derived(ctx, s)
+    assert s2.items[0].alias == "r"
+
+
+def test_merge_derived_skips_aggregated_inner(ctx):
+    s = parse_select(
+        "select mx from (select max(qty) as mx from sales group by region) t")
+    s2 = merge_derived(ctx, s)
+    assert isinstance(s2.relation, A.SubqueryRef)   # unchanged
+
+
+def test_merged_view_runs_on_engine(ctx):
+    from spark_druid_olap_tpu.planner import host_exec
+    sql = ("select r, sum(qty) as s from "
+           "(select upper(region) as r, qty from sales where qty > 5) t "
+           "group by r order by r")
+    got = ctx.sql(sql).to_pandas()
+    assert ctx.history.entries()[-1].stats["mode"] == "engine"
+    ctx.host_engine_assist = False
+    try:
+        want = host_exec.execute_select(ctx, parse_select(sql))
+    finally:
+        ctx.host_engine_assist = True
+    assert_frames_equal(got, want, sort_by=None)
+
+
+# -- composite plans ----------------------------------------------------------
+
+def test_composite_agg_derived_join(ctx):
+    # supplier-style outer join over an engine-planned derived aggregate
+    from spark_druid_olap_tpu.planner import host_exec
+    ctx.ingest_dataframe("regions", pd.DataFrame({
+        "r_name": ["east", "west", "north", "south"],
+        "r_zone": ["Z1", "Z1", "Z2", "Z2"]}))
+    sql = ("select r_zone, rev from regions join "
+           "(select region, sum(price) as rev from sales group by region) t "
+           "on r_name = region order by r_zone, rev")
+    got = ctx.sql(sql).to_pandas()
+    assert ctx.history.entries()[-1].stats["mode"] == "engine"
+    ctx.host_engine_assist = False
+    try:
+        want = host_exec.execute_select(ctx, parse_select(sql))
+    finally:
+        ctx.host_engine_assist = True
+    assert_frames_equal(got, want, sort_by=None)

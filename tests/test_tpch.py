@@ -79,3 +79,23 @@ def test_fact_only_query_uses_flat(tctx):
     pq = B.build(tctx, parse_select(
         "select l_returnflag, count(*) from lineitem group by l_returnflag"))
     assert pq.datasource == "lineitem"  # raw table registered, used directly
+
+
+# -----------------------------------------------------------------------------
+# pushdown census (round-2 target: >= 18 of the 22 TPC-H queries engine-mode)
+# -----------------------------------------------------------------------------
+
+ENGINE_EXPECTED = ["q1", "q3", "q4", "q5", "q6", "q7", "q8", "q9", "q10",
+                   "q11", "q12", "q13", "q14", "q15", "q16", "q18", "q19",
+                   "q22"]
+
+
+def test_pushdown_census(tctx):
+    modes = {}
+    for name in [f"q{i}" for i in range(1, 23)]:
+        tctx.sql(tpch.QUERIES[name])
+        modes[name] = tctx.history.entries()[-1].stats["mode"]
+    engine = [q for q, m in modes.items() if m == "engine"]
+    assert len(engine) >= 18, modes
+    for q in ENGINE_EXPECTED:
+        assert modes[q] == "engine", (q, modes[q])
