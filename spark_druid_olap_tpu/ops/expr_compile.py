@@ -310,10 +310,16 @@ def _in_list(v, values, ctx):
         if n.is_float:
             # f32 compares collide for keys >= 2^24; let the host evaluate
             raise Unsupported("large integer IN set over float expression")
-        if int(vals[0]) < -(2**31) or int(vals[-1]) >= 2**31:
-            raise Unsupported("IN-set values exceed 32-bit range")
-        dev = jnp.asarray(vals.astype(np.int32))
-        arr = n.arr.astype(jnp.int32)
+        if n.arr.dtype == jnp.int64:
+            dev = jnp.asarray(vals)        # both sides native 64-bit
+            arr = n.arr
+        else:
+            # a 32-bit probe can't hold out-of-range values, but the set
+            # must not wrap when narrowed
+            if int(vals[0]) < -(2**31) or int(vals[-1]) >= 2**31:
+                raise Unsupported("IN-set values exceed 32-bit range")
+            dev = jnp.asarray(vals.astype(np.int32))
+            arr = n.arr.astype(jnp.int32)
         idx = jnp.clip(jnp.searchsorted(dev, arr), 0, len(vals) - 1)
         return dev[idx] == arr
     if isinstance(v, StrValue):

@@ -82,6 +82,21 @@ def array_names(ds: Datasource, columns, need_time_ms: bool):
     return names
 
 
+def array_dtype(ds: Datasource, key: str):
+    """Host dtype of one stacked array (shape-only program tracing)."""
+    if key == ROW_VALID_KEY or key.startswith(NULL_VALID_PREFIX):
+        return np.bool_
+    if key == TIME_MS_KEY:
+        return ds.time.ms_in_day.dtype
+    if key in ds.dims:
+        return ds.dims[key].codes.dtype
+    if key in ds.metrics:
+        return ds.metrics[key].values.dtype
+    if ds.time is not None and key == ds.time.name:
+        return ds.time.days.dtype
+    return np.int32
+
+
 def build_array(ds: Datasource, key: str,
                 segment_indices: Optional[np.ndarray] = None,
                 pad_segments_to: Optional[int] = None) -> np.ndarray:

@@ -810,17 +810,20 @@ class QueryEngine:
             order_keys = []
             for oc in reversed(limit.columns):
                 k = data[oc.name]
+                if k.dtype == object and all(
+                        v is None or isinstance(v, (int, np.integer))
+                        for v in k):
+                    # wide-int min/max columns with empty groups: exact
+                    # int64 sort (f64 would collapse values past 2^53),
+                    # nulls last via a more-significant null flag
+                    nulls = np.array([v is None for v in k])
+                    vals = np.array([0 if v is None else int(v) for v in k],
+                                    dtype=np.int64)
+                    order_keys.append(vals if oc.ascending else -vals)
+                    order_keys.append(nulls)
+                    continue
                 if k.dtype == object:
-                    # numeric-or-null object columns (wide-int min/max with
-                    # empty groups) sort numerically, nulls last; others
-                    # lexicographically
-                    import pandas as _pd
-                    num = _pd.to_numeric(_pd.Series(k), errors="coerce")
-                    if num.notna().to_numpy().sum() == \
-                            _pd.Series(k).notna().to_numpy().sum():
-                        k = num.to_numpy(np.float64)
-                    else:
-                        k = k.astype(str)
+                    k = k.astype(str)
                 order_keys.append(k if oc.ascending else _neg_key(k))
             idx = np.lexsort(order_keys)
             if limit.limit is not None:

@@ -199,9 +199,20 @@ class Builder:
     # filters
     # =========================================================================
     def build_filter(self, conjuncts: List[E.Expr]):
-        """conjuncts -> (intervals, FilterSpec)."""
+        """conjuncts -> (intervals, FilterSpec, residue).
+
+        Pushable conjuncts become intervals / native filters / compiled
+        expression filters; a conjunct whose compiled form the device
+        compiler rejects (checked by a shape-only trial trace of the REAL
+        lowering) is returned as host residue instead of failing the whole
+        plan — ≈ the reference recording unpushed predicates and leaving a
+        Spark FilterExec above the Druid scan
+        (ProjectFilterTransfom.addUnpushedAttributes:36-50,
+        DruidStrategy.scala:244-270).
+        """
         acc = IntervalAccumulator()
         specs: List[S.FilterSpec] = []
+        residue: List[E.Expr] = []
         tcol = self.ds.time.name if self.ds.time is not None else None
         for c in conjuncts:
             if isinstance(c, E.Literal):
@@ -211,11 +222,50 @@ class Builder:
                 continue
             if tcol is not None and self._try_interval(c, tcol, acc):
                 continue
-            specs.append(self.to_filter(c))
+            try:
+                spec = self.to_filter(c)
+            except PlanUnsupported:
+                residue.append(c)
+                continue
+            if self._has_expr_filter(spec) and \
+                    not self._spec_pushable(spec):
+                residue.append(c)
+                continue
+            specs.append(spec)
         if acc.empty:
             # contradiction: empty interval (executor prunes everything)
-            return ((0, 0),), S.filter_and(specs)
-        return acc.to_intervals(), S.filter_and(specs)
+            return ((0, 0),), S.filter_and(specs), residue
+        return acc.to_intervals(), S.filter_and(specs), residue
+
+    @staticmethod
+    def _has_expr_filter(spec: S.FilterSpec) -> bool:
+        if isinstance(spec, S.ExprFilter):
+            return True
+        if isinstance(spec, S.LogicalFilter):
+            return any(Builder._has_expr_filter(x) for x in spec.fields)
+        return False
+
+    def _spec_pushable(self, spec: S.FilterSpec) -> bool:
+        """Shape-only trial trace of the real filter lowering: no coverage
+        drift, no data movement."""
+        import jax
+        from spark_druid_olap_tpu.ops import filters as F
+        from spark_druid_olap_tpu.ops.scan import (
+            ScanContext, array_dtype, array_names)
+        ds = self.ds
+        try:
+            cols = sorted(c for c in F.columns_of_filter(spec)
+                          if c in ds.dims or c in ds.metrics
+                          or (ds.time is not None and c == ds.time.name))
+            names = array_names(ds, cols, ds.time is not None)
+            shapes = {k: jax.ShapeDtypeStruct((1, 8), array_dtype(ds, k))
+                      for k in names}
+            jax.eval_shape(
+                lambda arrays: F.lower_filter(
+                    spec, ScanContext(ds, arrays, 0, 0)), shapes)
+            return True
+        except Exception:  # noqa: BLE001 — any rejection means host residue
+            return False
 
     def _try_interval(self, c: E.Expr, tcol: str,
                       acc: IntervalAccumulator) -> bool:
@@ -505,8 +555,9 @@ class Builder:
         # WHERE minus consumed join conjuncts
         conjs = [c for c in _split_conjuncts(stmt.where)
                  if not any(c is k for k in consumed)]
-        intervals, filter_spec = self.build_filter(conjs)
+        intervals, filter_spec, residue = self.build_filter(conjs)
         filter_spec = QT.merge_spatial_bounds(filter_spec, self.ds)
+        self._residue = residue
 
         # resolve group-by expressions
         alias_map = {item.alias: item.expr for item in stmt.items
@@ -537,7 +588,8 @@ class Builder:
             is_agg = True
 
         if not is_agg:
-            return self._build_select_path(ds_name, intervals, filter_spec)
+            return self._build_select_path(ds_name, intervals, filter_spec,
+                                           residue)
 
         # dims for the union of group exprs
         for s_ in resolved_sets:
@@ -592,6 +644,33 @@ class Builder:
                         "anyvalue", d.output_name, field=d.dimension)
                 self._dim_specs = kept
 
+        # WHERE residue over an aggregate: sound only when every residue
+        # column is a grouping column present in EVERY grouping set (then
+        # filtering result groups == filtering source rows); map source
+        # names onto dim output names for the host-side evaluation
+        residual_expr = None
+        if self._residue:
+            out_of = {}
+            for c in set().union(*(E.columns_in(r) for r in self._residue)):
+                k = E.to_sql(E.Column(c))
+                if k not in self._dim_by_expr:
+                    raise PlanUnsupported(
+                        f"unpushable predicate over non-grouped column {c}")
+                out_of[c] = self._dim_by_expr[k]
+                for s_ in resolved_sets:
+                    if not any(E.to_sql(g) == k for g in s_):
+                        raise PlanUnsupported(
+                            "unpushable predicate over a column absent "
+                            "from one grouping set")
+            combined = self._residue[0] if len(self._residue) == 1 \
+                else E.And(tuple(self._residue))
+
+            def ren(n):
+                if isinstance(n, E.Column) and n.name in out_of:
+                    return E.Column(out_of[n.name])
+                return n
+            residual_expr = E.transform(combined, ren)
+
         # select outputs
         output_columns: List[str] = []
         for i, item in enumerate(stmt.items):
@@ -614,8 +693,9 @@ class Builder:
         multi_set = len(resolved_sets) > 1
         limit_spec = None
         order_in_spec = False
-        if not multi_set and self.distinct2 is None and (order_by or
-                                                         stmt.limit):
+        if not multi_set and self.distinct2 is None \
+                and residual_expr is None and (order_by or stmt.limit):
+            # an in-spec limit would truncate before the host residue runs
             limit_spec = S.LimitSpec(
                 tuple(S.OrderByColumn(n, asc) for n, asc in order_by),
                 stmt.limit)
@@ -675,7 +755,8 @@ class Builder:
             order_by=order_by, limit=stmt.limit,
             order_applied_in_spec=order_in_spec,
             distinct_phase2=self.distinct2,
-            deferred_posts=deferred_posts)
+            deferred_posts=deferred_posts,
+            residual=residual_expr)
 
     def _plan_output_item(self, item: A.SelectItem, idx: int) -> str:
         e = item.expr
@@ -746,12 +827,23 @@ class Builder:
     # =========================================================================
     # non-aggregate (select) path
     # =========================================================================
-    def _build_select_path(self, ds_name, intervals, filter_spec):
+    def _build_select_path(self, ds_name, intervals, filter_spec,
+                           residue=None):
         from spark_druid_olap_tpu.utils.config import SELECT_PAGE_SIZE
         mode = self.ctx.config.get(NON_AGG_PUSHDOWN)
         if mode == "push_none":
             raise PlanUnsupported("non-aggregate pushdown disabled")
         stmt = self.stmt
+        residual_expr = None
+        residue_cols: List[str] = []
+        if residue:
+            residual_expr = residue[0] if len(residue) == 1 \
+                else E.And(tuple(residue))
+            residue_cols = sorted(E.columns_in(residual_expr))
+            for c in residue_cols:
+                if c not in self.ds.column_names():
+                    raise PlanUnsupported(
+                        f"unpushable predicate over unknown column {c}")
         cols: List[str] = []
         renames: Dict[str, str] = {}
         for item in stmt.items:
@@ -775,6 +867,9 @@ class Builder:
                     "column selected both bare and aliased")
         out_cols = [renames.get(c, c) for c in cols]
         if stmt.distinct:
+            if residual_expr is not None:
+                raise PlanUnsupported(
+                    "unpushable predicate with SELECT DISTINCT")
             # SELECT DISTINCT dims -> group-by rewrite
             dims = tuple(S.DimensionSpec(c, c) for c in cols)
             q = S.GroupByQuerySpec(
@@ -790,15 +885,22 @@ class Builder:
                 select_renames=renames)
         order_by = [(self._select_order_col(o, cols), o.ascending)
                     for o in stmt.order_by]
+        fetch = list(cols)
+        for c in residue_cols:           # hidden columns the residue needs
+            if c not in fetch:
+                fetch.append(c)
         q = S.SelectQuerySpec(
-            datasource=ds_name, columns=tuple(cols), filter=filter_spec,
+            datasource=ds_name, columns=tuple(fetch), filter=filter_spec,
             intervals=intervals,
-            page_size=(stmt.limit if stmt.limit is not None and not order_by
+            page_size=(stmt.limit
+                       if stmt.limit is not None and not order_by
+                       and residual_expr is None
                        else 1 << 31))
         return PlannedQuery(
             datasource=ds_name, specs=[q], spec_dims=[[]], all_dims=[],
             output_columns=out_cols, order_by=order_by, limit=stmt.limit,
-            select_path=True, select_renames=renames)
+            select_path=True, select_renames=renames,
+            residual=residual_expr)
 
     def _select_order_col(self, o: A.OrderItem, cols: List[str]) -> str:
         e = o.expr

@@ -124,24 +124,16 @@ def _build_leftjoin_agg(ctx, stmt: A.SelectStmt) -> LeftJoinAggPlan:
             and isinstance(rel.left, A.TableRef)
             and isinstance(rel.right, A.TableRef)):
         raise PlanUnsupported("not a left-join aggregate")
-    if stmt.where is not None or stmt.having is not None or stmt.distinct:
-        raise PlanUnsupported("left-join aggregate with WHERE/HAVING")
+    if stmt.where is not None or stmt.having is not None or stmt.distinct \
+            or stmt.limit is not None:
+        raise PlanUnsupported("left-join aggregate with WHERE/HAVING/LIMIT")
     left_cols = set(relation_columns(ctx, rel.left))
     right_cols = set(relation_columns(ctx, rel.right))
-
-    def split_and(e):
-        if e is None:
-            return []
-        if isinstance(e, E.And):
-            out = []
-            for p in e.parts:
-                out.extend(split_and(p))
-            return out
-        return [e]
+    from spark_druid_olap_tpu.planner.decorrelate import _split_and
 
     key = fk = None
     right_preds = []
-    for c in split_and(rel.condition):
+    for c in _split_and(rel.condition):
         if (key is None and isinstance(c, E.Comparison) and c.op == "="
                 and isinstance(c.left, E.Column)
                 and isinstance(c.right, E.Column)):
@@ -152,7 +144,7 @@ def _build_leftjoin_agg(ctx, stmt: A.SelectStmt) -> LeftJoinAggPlan:
             if b in left_cols and a in right_cols:
                 key, fk = b, a
                 continue
-        refs = {n.name for n in _columns_in(c)}
+        refs = E.columns_in(c)
         if refs <= right_cols:
             right_preds.append(c)
         else:
@@ -174,7 +166,7 @@ def _build_leftjoin_agg(ctx, stmt: A.SelectStmt) -> LeftJoinAggPlan:
         if not isinstance(it.expr, E.AggCall):
             raise PlanUnsupported("non-aggregate output in left-join agg")
         call = it.expr
-        refs = {n.name for n in _columns_in(call)}
+        refs = E.columns_in(call)
         if not refs or not refs <= right_cols:
             # count(*) counts the null extension (1 per unmatched left
             # row); only right-side aggregates translate
@@ -197,18 +189,6 @@ def _build_leftjoin_agg(ctx, stmt: A.SelectStmt) -> LeftJoinAggPlan:
                            agg_cols=agg_cols)
 
 
-def _columns_in(e):
-    out = []
-
-    def walk(n):
-        if isinstance(n, E.Column):
-            out.append(n)
-        for c in n.children():
-            walk(c)
-    walk(e)
-    return out
-
-
 def execute_composite(ctx, plan: SubPlan) -> pd.DataFrame:
     from spark_druid_olap_tpu.planner import host_exec
     from spark_druid_olap_tpu.sql.session import execute_planned
@@ -218,6 +198,12 @@ def execute_composite(ctx, plan: SubPlan) -> pd.DataFrame:
         inner = execute_planned(ctx, plan.inner)
         left = host_exec.datasource_frame(ctx, plan.left_table,
                                           columns={plan.left_key})
+        if left[plan.left_key].duplicated().any():
+            # duplicate left keys mean one output row per left ROW with
+            # per-key counts repeated; that is a plain host join, not this
+            # rewrite
+            raise host_exec.HostExecError(
+                f"left join key {plan.left_key!r} is not unique")
         df = left.merge(inner, left_on=plan.left_key, right_on=plan.fk_col,
                         how="left")
         out = pd.DataFrame({plan.out_key: df[plan.left_key]})

@@ -43,3 +43,7 @@ class PlannedQuery:
     # post-aggregations deferred past phase 2 (only with distinct_phase2)
     deferred_posts: List[S.PostAggregationSpec] = \
         dataclasses.field(default_factory=list)
+    # unpushable WHERE conjuncts evaluated on the (small) engine result —
+    # over dim OUTPUT names (agg path) or source columns (select path);
+    # ≈ the Spark FilterExec the reference leaves above the Druid scan
+    residual: Optional[object] = None

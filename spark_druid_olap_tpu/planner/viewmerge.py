@@ -54,6 +54,12 @@ def merge_derived(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
                 or inner.limit is not None or inner.distinct \
                 or inner.order_by:
             break
+        if any(it.expr == "*" or (isinstance(it.expr, E.Column)
+                                  and it.expr.name == "*")
+               for it in stmt.items):
+            # outer '*' means "the derived table's columns"; merging would
+            # widen it to every base-table column
+            break
         mapping = _mapping(inner)
         if mapping is None:
             break

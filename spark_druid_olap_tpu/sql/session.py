@@ -215,6 +215,12 @@ def execute_planned(ctx, pq: PlannedQuery) -> pd.DataFrame:
         frames.append(df)
     df = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
 
+    if pq.residual is not None:
+        from spark_druid_olap_tpu.utils import host_eval
+        env = {c: df[c].to_numpy() for c in df.columns}
+        mask = np.asarray(host_eval.eval_expr(pq.residual, env), dtype=bool)
+        df = df[mask].reset_index(drop=True)
+
     if pq.distinct_phase2 is not None:
         df = _phase2_distinct(df, pq)
         from spark_druid_olap_tpu.utils import host_eval

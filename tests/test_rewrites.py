@@ -176,3 +176,126 @@ def test_composite_agg_derived_join(ctx):
     finally:
         ctx.host_engine_assist = True
     assert_frames_equal(got, want, sort_by=None)
+
+
+# -- residual predicates above the device scan --------------------------------
+# (≈ ProjectFilterTransfom.addUnpushedAttributes + the FilterExec the
+# reference leaves above the Druid scan, DruidStrategy.scala:244-270)
+
+def _host_oracle(ctx, sql):
+    from spark_druid_olap_tpu.planner import host_exec
+    ctx.host_engine_assist = False
+    try:
+        return host_exec.execute_select(ctx, parse_select(sql))
+    finally:
+        ctx.host_engine_assist = True
+
+
+@pytest.fixture()
+def tag2(ctx):
+    # two-arg module functions have no device compilation path, so filters
+    # over them are genuinely unpushable (host residue material)
+    ctx.functions["tag2"] = lambda s, suffix: str(s) + str(suffix)
+    yield
+    ctx.functions.pop("tag2", None)
+
+
+def test_residual_predicate_on_grouped_dim(ctx, tag2):
+    sql = ("select region, sum(qty) as s from sales "
+           "where qty > 5 and tag2(region, '!') in ('east!', 'west!') "
+           "group by region order by region")
+    got = ctx.sql(sql).to_pandas()
+    assert ctx.history.entries()[-1].stats["mode"] == "engine"
+    assert set(got["region"]) == {"east", "west"}
+    assert_frames_equal(got, _host_oracle(ctx, sql), sort_by=None)
+
+
+def test_residual_predicate_with_order_limit(ctx, tag2):
+    sql = ("select region, sum(qty) as s from sales "
+           "where tag2(region, '!') <> 'east!' "
+           "group by region order by s desc limit 2")
+    got = ctx.sql(sql).to_pandas()
+    assert ctx.history.entries()[-1].stats["mode"] == "engine"
+    assert "east" not in set(got["region"]) and len(got) == 2
+    assert_frames_equal(got, _host_oracle(ctx, sql), sort_by=None)
+
+
+def test_residual_on_nongrouped_column_falls_back(ctx):
+    # a row-level residue over a non-grouped column cannot be applied to
+    # the aggregated result: whole query demotes (correctness > speed).
+    # (two-arg module functions have no device compilation path)
+    ctx.functions["fuzz2"] = lambda a, b: float(a) * 3 + float(b)
+    try:
+        sql = ("select region, count(*) as n from sales "
+               "where fuzz2(qty, discount) > 100 "
+               "group by region order by region")
+        got = ctx.sql(sql).to_pandas()
+        assert ctx.history.entries()[-1].stats["mode"].startswith("host")
+        assert_frames_equal(got, _host_oracle(ctx, sql), sort_by=None)
+    finally:
+        ctx.functions.pop("fuzz2", None)
+
+
+def test_residual_select_path_hidden_column(ctx, tag2):
+    # residue references qty, which is NOT selected: fetched hidden,
+    # dropped from the output
+    sql = ("select ts, region from sales "
+           "where region = 'east' and tag2(qty, '') = '49' "
+           "limit 7")
+    got = ctx.sql(sql).to_pandas()
+    assert ctx.history.entries()[-1].stats["mode"] == "engine"
+    assert list(got.columns) == ["ts", "region"]
+    assert len(got) == 7
+    want = _host_oracle(ctx, sql)
+    assert len(want) == 7
+
+
+def test_residual_select_path_differential(ctx, tag2):
+    sql = ("select region, qty from sales "
+           "where qty > 40 and tag2(region, '') = 'west' order by qty desc "
+           "limit 20")
+    got = ctx.sql(sql).to_pandas()
+    assert ctx.history.entries()[-1].stats["mode"] == "engine"
+    want = _host_oracle(ctx, sql)
+    assert_frames_equal(got.sort_values(["region", "qty"]).reset_index(drop=True),
+                        want.sort_values(["region", "qty"]).reset_index(drop=True),
+                        sort_by=None)
+
+
+def test_merge_derived_skips_outer_star(ctx):
+    got = ctx.sql("select * from (select region from sales) t limit 3") \
+        .to_pandas()
+    assert list(got.columns) == ["region"]
+
+
+def test_leftjoin_agg_nonunique_key_falls_back(ctx):
+    ctx.ingest_dataframe("dupkeys", pd.DataFrame({
+        "k": ["east", "east", "west"], "tag": ["a", "b", "c"]}))
+    sql = ("select k, n from (select k, count(qty) as n from dupkeys "
+           "left outer join sales on k = region group by k) t order by k")
+    got = ctx.sql(sql).to_pandas()
+    assert ctx.history.entries()[-1].stats["mode"].startswith("host")
+    want = _host_oracle(ctx, sql)
+    assert_frames_equal(got, want, sort_by=None)
+
+
+def test_leftjoin_agg_inner_limit_falls_back(ctx):
+    ctx.ingest_dataframe("ukeys", pd.DataFrame({
+        "k": ["east", "west", "north", "south"]}))
+    sql = ("select k, n from (select k, count(qty) as n from ukeys "
+           "left outer join sales on k = region group by k "
+           "order by n desc limit 2) t order by k")
+    got = ctx.sql(sql).to_pandas()
+    want = _host_oracle(ctx, sql)
+    assert len(got) == 2
+    assert_frames_equal(got, want, sort_by=None)
+
+
+def test_leftjoin_agg_engine_differential(ctx):
+    sql = ("select k, n, s from (select k, count(qty) as n, "
+           "sum(qty) as s from ukeys left outer join sales "
+           "on k = region and qty > 25 group by k) t order by k")
+    got = ctx.sql(sql).to_pandas()
+    assert ctx.history.entries()[-1].stats["mode"] == "engine"
+    want = _host_oracle(ctx, sql)
+    assert_frames_equal(got, want, sort_by=None)
