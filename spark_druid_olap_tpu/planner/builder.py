@@ -866,6 +866,13 @@ class Builder:
                 raise PlanUnsupported(
                     "column selected both bare and aliased")
         out_cols = [renames.get(c, c) for c in cols]
+        for src, tgt in renames.items():
+            if tgt != src and (tgt in cols or tgt in residue_cols):
+                # SELECT qty AS region ... with 'region' also fetched
+                # (selected or needed by the residue) would duplicate the
+                # label after renaming
+                raise PlanUnsupported(
+                    f"alias {tgt!r} collides with a fetched column")
         if stmt.distinct:
             if residual_expr is not None:
                 raise PlanUnsupported(

@@ -195,15 +195,15 @@ def execute_composite(ctx, plan: SubPlan) -> pd.DataFrame:
     if isinstance(plan, PlannedQuery):
         return execute_planned(ctx, plan)
     if isinstance(plan, LeftJoinAggPlan):
-        inner = execute_planned(ctx, plan.inner)
         left = host_exec.datasource_frame(ctx, plan.left_table,
                                           columns={plan.left_key})
         if left[plan.left_key].duplicated().any():
             # duplicate left keys mean one output row per left ROW with
             # per-key counts repeated; that is a plain host join, not this
-            # rewrite
+            # rewrite (checked before spending the engine execution)
             raise host_exec.HostExecError(
                 f"left join key {plan.left_key!r} is not unique")
+        inner = execute_planned(ctx, plan.inner)
         df = left.merge(inner, left_on=plan.left_key, right_on=plan.fk_col,
                         how="left")
         out = pd.DataFrame({plan.out_key: df[plan.left_key]})

@@ -114,6 +114,11 @@ def assert_frames_equal(got: pd.DataFrame, want: pd.DataFrame, sort_by=None,
             np.testing.assert_array_equal(
                 g.astype("datetime64[ms]"), w.astype("datetime64[ms]"),
                 err_msg=f"column {c}")
+        elif w.dtype == object:
+            # str-normalize BOTH sides so null spellings (None/nan) compare
+            np.testing.assert_array_equal(
+                pd.Series(g).fillna("<null>").astype(str).to_numpy(),
+                pd.Series(w).fillna("<null>").astype(str).to_numpy(),
+                err_msg=f"column {c}")
         else:
-            np.testing.assert_array_equal(g.astype(str) if w.dtype == object
-                                          else g, w, err_msg=f"column {c}")
+            np.testing.assert_array_equal(g, w, err_msg=f"column {c}")
