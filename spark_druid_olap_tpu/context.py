@@ -107,16 +107,16 @@ class Context:
         """Execute a raw engine QuerySpec (≈ ``ON DRUIDDATASOURCE ... EXECUTE
         QUERY <json>``, reference ``PlanUtil.logicalPlan:49-66``)."""
         r = self.engine.execute(q)
-        self.history.record(q, self.engine.last_stats)
+        self.history.record(q, dict(self.engine.last_stats))
         return r
 
-    def sql(self, query: str) -> QueryResult:
+    def sql(self, query: str, query_id: Optional[str] = None) -> QueryResult:
         try:
             from spark_druid_olap_tpu.sql.session import run_sql
         except ImportError as e:
             raise NotImplementedError(
                 "SQL front end not available in this build") from e
-        return run_sql(self, query)
+        return run_sql(self, query, query_id=query_id)
 
     def explain(self, query: str) -> str:
         try:

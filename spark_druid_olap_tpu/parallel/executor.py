@@ -602,9 +602,43 @@ class QueryEngine:
         self._programs: Dict[tuple, object] = {}   # compile cache
         self._device_arrays: Dict[tuple, object] = {}
         self._cancel_flags: Dict[str, object] = {}
-        self.last_stats: Dict[str, object] = {}
+        self._cancel_refs: Dict[str, int] = {}
+        # concurrency: queries execute in parallel (threading server); only
+        # compile-cache population is serialized, and per-query stats are
+        # thread-local so concurrent sessions don't trample each other
+        self._compile_lock = __import__("threading").RLock()
+        self._tls = __import__("threading").local()
+
+    @property
+    def last_stats(self) -> Dict[str, object]:
+        d = getattr(self._tls, "stats", None)
+        if d is None:
+            d = self._tls.stats = {}
+        return d
 
     # -- cancellation / timeout ----------------------------------------------
+    def register_query(self, query_id: str) -> None:
+        """Register a cancellable id BEFORE planning starts, so a cancel
+        arriving at any point in the query's life is honored (≈ the
+        reference registering the Druid query id with TaskCancelHandler
+        before the HTTP call, DruidRDD.scala:175). Registrations are
+        refcounted: statements sharing an id (one cancel scope, like
+        Druid's queryId) stay cancellable until the LAST one releases."""
+        import threading
+        with self._compile_lock:
+            self._cancel_flags.setdefault(query_id, threading.Event())
+            self._cancel_refs[query_id] = \
+                self._cancel_refs.get(query_id, 0) + 1
+
+    def release_query(self, query_id: str) -> None:
+        with self._compile_lock:
+            n = self._cancel_refs.get(query_id, 1) - 1
+            if n <= 0:
+                self._cancel_refs.pop(query_id, None)
+                self._cancel_flags.pop(query_id, None)
+            else:
+                self._cancel_refs[query_id] = n
+
     def cancel(self, query_id: str) -> bool:
         """Mark a registered query id cancelled (cooperative; takes effect at
         the next stage boundary)."""
@@ -630,10 +664,11 @@ class QueryEngine:
     # -- public ---------------------------------------------------------------
     def execute(self, q: S.QuerySpec) -> QueryResult:
         t0 = _time.perf_counter()
+        self.last_stats.clear()   # per-thread; no cross-query leakage
         qid = getattr(getattr(q, "context", None), "query_id", None)
-        if qid is not None:
-            import threading
-            self._cancel_flags.setdefault(qid, threading.Event())
+        created = qid is not None and qid not in self._cancel_flags
+        if created:
+            self.register_query(qid)
         try:
             return self._execute_inner(q, t0)
         except EC.Unsupported as e:
@@ -642,8 +677,10 @@ class QueryEngine:
             # fallback signal the session layer handles
             raise EngineFallback(str(e)) from e
         finally:
-            if qid is not None:
-                self._cancel_flags.pop(qid, None)
+            # session-registered ids outlive individual spec executions
+            # (multi-spec plans stay cancellable between specs)
+            if created:
+                self.release_query(qid)
 
     def _execute_inner(self, q: S.QuerySpec, t0: float) -> QueryResult:
         self._stage_check(q, t0)
@@ -730,12 +767,17 @@ class QueryEngine:
         sig = ("agg", ds.name, id(ds), repr(q), s_pad, ds.padded_rows,
                min_day, max_day, sharded, n_dev, tuple(names),
                jax.default_backend(), bool(jax.config.jax_enable_x64))
+        # double-checked: warm queries never touch the lock
         prog = self._programs.get(sig)
         if prog is None:
-            prog = self._build_agg_program(
-                ds, all_dim_plans, agg_plans, filter_spec, intervals,
-                min_day, max_day, n_keys, sharded, routes)
-            self._programs[sig] = prog
+            with self._compile_lock:
+                prog = self._programs.get(sig)
+                if prog is None:
+                    prog = self._build_agg_program(
+                        ds, all_dim_plans, agg_plans, filter_spec,
+                        intervals, min_day, max_day, n_keys, sharded,
+                        routes)
+                    self._programs[sig] = prog
 
         prog_fn, unpack = prog
         if n_waves == 1:
@@ -890,10 +932,14 @@ class QueryEngine:
                    bool(jax.config.jax_enable_x64))
             prog_fn = self._programs.get(sig)
             if prog_fn is None:
-                prog_fn = self._build_hash_program(
-                    ds, dim_plans, parts, agg_plans, filter_spec, intervals,
-                    min_day, max_day, T, sharded, routes)
-                self._programs[sig] = prog_fn
+                with self._compile_lock:
+                    prog_fn = self._programs.get(sig)
+                    if prog_fn is None:
+                        prog_fn = self._build_hash_program(
+                            ds, dim_plans, parts, agg_plans, filter_spec,
+                            intervals, min_day, max_day, T, sharded,
+                            routes)
+                        self._programs[sig] = prog_fn
 
             partials, unresolved = [], 0
 
@@ -1363,11 +1409,14 @@ class QueryEngine:
         out = {}
         for k in names:
             key = (id(ds), k, s_pad, seg_sig, bool(sharded))
-            dev = self._device_arrays.get(key)
+            dev = self._device_arrays.get(key)   # lock-free warm path
             if dev is None:
-                host = _build_array_checked(ds, k, seg_idx, s_pad)
-                dev = jax.device_put(host, sharding)
-                self._device_arrays[key] = dev
+                with self._compile_lock:
+                    dev = self._device_arrays.get(key)
+                    if dev is None:
+                        host = _build_array_checked(ds, k, seg_idx, s_pad)
+                        dev = jax.device_put(host, sharding)
+                        self._device_arrays[key] = dev
             out[k] = dev
         return out
 

@@ -214,12 +214,13 @@ def execute_composite(ctx, plan: SubPlan) -> pd.DataFrame:
     frames = {}
     for name, sub in plan.sub_plans:
         frames[name] = execute_composite(ctx, sub)
-    prev = getattr(ctx, "_temp_frames", None)
-    ctx._temp_frames = {**(prev or {}), **frames}
+    tls = host_exec.ctx_tls(ctx)
+    prev = getattr(tls, "temp_frames", None)
+    tls.temp_frames = {**(prev or {}), **frames}
     try:
         return host_exec.execute_select(ctx, plan.outer_stmt)
     finally:
-        ctx._temp_frames = prev
+        tls.temp_frames = prev
 
 
 def describe(plan: SubPlan, indent: str = "") -> str:

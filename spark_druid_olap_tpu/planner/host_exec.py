@@ -39,13 +39,35 @@ SYS_VIEWS = {
 }
 
 
+_TLS_INIT_LOCK = __import__("threading").Lock()
+
+
+def ctx_tls(ctx):
+    """Per-context thread-local scratch (temp frames, current query id) —
+    concurrent server sessions must not see each other's state. Creation is
+    locked: an unsynchronized check-then-set could let two first requests
+    each install a threading.local and one lose its state mid-query."""
+    tls = getattr(ctx, "_tls", None)
+    if tls is None:
+        import threading
+        with _TLS_INIT_LOCK:
+            tls = getattr(ctx, "_tls", None)
+            if tls is None:
+                tls = ctx._tls = threading.local()
+    return tls
+
+
+def temp_frames(ctx):
+    return getattr(ctx_tls(ctx), "temp_frames", None)
+
+
 def datasource_frame(ctx, name: str, columns=None) -> pd.DataFrame:
     """Materialize a datasource as pandas; ``columns`` (a set) limits the
     materialized columns to those present in the table (callers pass the
     statement's referenced columns — projection pushdown for the host
     tier)."""
     from spark_druid_olap_tpu.parallel.executor import _host_column_values
-    temps = getattr(ctx, "_temp_frames", None)
+    temps = temp_frames(ctx)
     if temps and name in temps:
         df = temps[name]
         if columns is not None:
@@ -107,7 +129,7 @@ def try_engine(ctx, stmt: A.SelectStmt) -> Optional[pd.DataFrame]:
 
 def relation_columns(ctx, rel: A.Relation) -> List[str]:
     if isinstance(rel, A.TableRef):
-        temps = getattr(ctx, "_temp_frames", None)
+        temps = temp_frames(ctx)
         if temps and rel.name in temps:
             return list(temps[rel.name].columns)
         if rel.name in SYS_VIEWS and rel.name not in ctx.store.names():

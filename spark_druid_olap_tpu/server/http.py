@@ -80,9 +80,10 @@ class SqlServer:
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
-        # serialize engine access: one query compiles/executes at a time
-        # (≈ the reference's coarse driver-side synchronization)
-        self._lock = threading.Lock()
+        # queries run CONCURRENTLY (one thread per request, like the
+        # reference thriftserver's pooled sessions, DruidClient.scala:46-74);
+        # the engine serializes only compile-cache population internally,
+        # and per-query state (stats, temp frames) is thread-local
 
     # -- lifecycle ------------------------------------------------------------
     def start(self, background: bool = True):
@@ -156,8 +157,7 @@ class SqlServer:
             return
         if url.path == "/explain":
             sql = qs.get("sql", [""])[0]
-            with self._lock:
-                text = self.ctx.explain(sql)
+            text = self.ctx.explain(sql)
             h._send(200, json.dumps({"plan": text.split("\n")}).encode())
             return
         if url.path.startswith("/metadata/"):
@@ -232,30 +232,53 @@ class SqlServer:
                 h._send(400, b'{"error": "missing \'sql\'"}')
                 return
             fmt = req.get("format", "json")
+            # the client supplies (or we mint) a query id; supplying one is
+            # what makes POST /sql/cancel reachable mid-flight (≈ Druid's
+            # client-set queryId in QuerySpecContext). Restricted charset:
+            # the id is echoed into the JSON envelope and a response header
+            qid = str(req.get("queryId") or uuid.uuid4().hex)
+            import re as _re
+            if not _re.fullmatch(r"[A-Za-z0-9_.:\-]{1,128}", qid):
+                h._send(400, b'{"error": "invalid queryId"}')
+                return
             from spark_druid_olap_tpu.sql.lexer import SqlSyntaxError
-            from spark_druid_olap_tpu.planner.plans import PlanUnsupported
+            from spark_druid_olap_tpu.parallel.executor import (
+                QueryCancelled, QueryTimeout)
             try:
-                with self._lock:
-                    r = self.ctx.sql(sql)
+                r = self.ctx.sql(sql, query_id=qid)
             except SqlSyntaxError as e:
                 h._error(400, e)
                 return
             except KeyError as e:
                 h._error(404, e)
                 return
+            except (QueryCancelled, QueryTimeout) as e:
+                body = json.dumps({"error": type(e).__name__,
+                                   "message": str(e),
+                                   "queryId": qid}).encode()
+                h._send(499 if isinstance(e, QueryCancelled) else 504, body)
+                return
             df = r.to_pandas()
             if fmt == "arrow":
-                h._send(200, _df_to_arrow(df),
-                        "application/vnd.apache.arrow.stream")
+                body = _df_to_arrow(df)   # serialize BEFORE the status line
+                h.send_response(200)
+                h.send_header("Content-Type",
+                              "application/vnd.apache.arrow.stream")
+                h.send_header("Content-Length", str(len(body)))
+                h.send_header("X-Query-Id", qid)
+                h.end_headers()
+                h.wfile.write(body)
             else:
-                h._send(200, _df_to_json_rows(df))
+                body = _df_to_json_rows(df)
+                # splice the id into the JSON envelope
+                body = body[:-1] + b', "queryId": "%s"}' % qid.encode()
+                h._send(200, body)
             return
         if url.path == "/query":
             req = self._read_json(h)
             from spark_druid_olap_tpu.ir.serde import query_from_dict
             q = query_from_dict(req)
-            with self._lock:
-                r = self.ctx.execute(q)
+            r = self.ctx.execute(q)
             h._send(200, _df_to_json_rows(r.to_pandas()))
             return
         if url.path == "/sql/cancel":

@@ -86,7 +86,23 @@ def resolve_lookups(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
                        for o in stmt.order_by))
 
 
-def run_sql(ctx, sql: str) -> QueryResult:
+def run_sql(ctx, sql: str, query_id: Optional[str] = None) -> QueryResult:
+    if query_id is not None:
+        # register BEFORE planning so a cancel landing at any point in the
+        # statement's life is honored; current id rides thread-local state
+        # down to every spec this statement executes (incl. subqueries)
+        from spark_druid_olap_tpu.planner.host_exec import ctx_tls
+        ctx.engine.register_query(query_id)
+        ctx_tls(ctx).query_id = query_id
+        try:
+            return _run_sql_inner(ctx, sql)
+        finally:
+            ctx_tls(ctx).query_id = None
+            ctx.engine.release_query(query_id)
+    return _run_sql_inner(ctx, sql)
+
+
+def _run_sql_inner(ctx, sql: str) -> QueryResult:
     # module-contributed front commands (≈ SPLParser trying its command
     # grammar before the base parser)
     for handler in getattr(ctx, "statement_handlers", ()):
@@ -105,7 +121,7 @@ def run_sql(ctx, sql: str) -> QueryResult:
         from spark_druid_olap_tpu.ir.serde import query_from_json
         q = query_from_json(stmt.query_json, default_ds=stmt.datasource)
         r = ctx.engine.execute(q)
-        ctx.history.record(q, ctx.engine.last_stats, sql=sql)
+        ctx.history.record(q, dict(ctx.engine.last_stats), sql=sql)
         return r
     if isinstance(stmt, A.ExplainRewrite):
         text = explain_text(ctx, stmt.query, stmt.sql)
@@ -202,8 +218,14 @@ def _run_select(ctx, stmt: A.SelectStmt, sql: str) -> QueryResult:
 
 
 def execute_planned(ctx, pq: PlannedQuery) -> pd.DataFrame:
+    import dataclasses as _dc
+    from spark_druid_olap_tpu.planner.host_exec import ctx_tls
+    qid = getattr(ctx_tls(ctx), "query_id", None)
     frames: List[pd.DataFrame] = []
     for q, set_dims in zip(pq.specs, pq.spec_dims):
+        if qid is not None and getattr(q.context, "query_id", None) is None:
+            qctx = q.context or S.QueryContext()
+            q = _dc.replace(q, context=_dc.replace(qctx, query_id=qid))
         r = ctx.engine.execute(q)
         df = r.to_pandas()
         if "__count__" in df.columns and "__count__" not in pq.output_columns:

@@ -202,3 +202,149 @@ def test_ui_page(server):
     assert "Engine queries" in body
     assert "select count(*) as c from sales" in body
     assert "sales" in body
+
+
+# -----------------------------------------------------------------------------
+# cancellation + concurrency (≈ CancelDruidRequestTest + jmeter concurrency)
+# -----------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def slow_server():
+    """Server over a many-segment store with a 1-byte wave budget: engine
+    queries run tens of waves with a stage-boundary check per wave, giving
+    cancellation a real mid-flight window."""
+    from spark_druid_olap_tpu.server.http import SqlServer
+    ctx = sdot.Context(config={"sdot.engine.wave.max.bytes": 1})
+    ctx.ingest_dataframe("sales", make_sales_df(150_000), time_column="ts",
+                         target_rows=256)
+    s = SqlServer(ctx, port=0).start()
+    # warm every shape the tests use, so they measure execution (the
+    # per-wave loop) rather than compilation
+    _post(s, "/sql", {"sql": SLOW_SQL})
+    _post(s, "/sql", {
+        "sql": "select count(*) as n from sales where region = 'east'"})
+    yield s
+    s.stop()
+
+
+SLOW_SQL = ("select region, product, sum(price) as rev, min(qty) as mn, "
+            "max(qty) as mx, count(*) as n from sales "
+            "group by region, product")
+
+
+def test_sql_returns_query_id(server):
+    code, body = _post(server, "/sql", {
+        "sql": "select count(*) as n from sales", "queryId": "my-query-1"})
+    assert code == 200 and body["queryId"] == "my-query-1"
+    code, body = _post(server, "/sql", {
+        "sql": "select count(*) as n from sales"})
+    assert code == 200 and len(body["queryId"]) >= 16   # minted
+
+
+def test_cancel_unknown_id(server):
+    code, body = _post(server, "/sql/cancel", {"queryId": "nope"})
+    assert code == 200 and body["cancelled"] is False
+
+
+def test_sql_cancel_mid_flight(slow_server):
+    import threading
+    import time
+
+    qid = "cancel-me-1"
+    result = {}
+
+    def run():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{slow_server.port}/sql",
+            data=json.dumps({"sql": SLOW_SQL, "queryId": qid}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req) as r:
+                result["status"] = r.status
+                result["body"] = json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            result["status"] = e.code
+            result["body"] = json.loads(e.read().decode())
+
+    t = threading.Thread(target=run)
+    t.start()
+    # wait until the query is registered, then cancel it mid-flight
+    deadline = time.time() + 30
+    cancelled = False
+    while time.time() < deadline:
+        code, body = _post(slow_server, "/sql/cancel", {"queryId": qid})
+        if body.get("cancelled"):
+            cancelled = True
+            break
+        time.sleep(0.002)
+    t.join(timeout=60)
+    assert cancelled, "query id never became cancellable"
+    assert result.get("status") == 499, result
+    assert result["body"]["error"] == "QueryCancelled"
+    assert result["body"]["queryId"] == qid
+
+
+def test_concurrent_queries_overlap(slow_server):
+    """A fast query must complete while a slow one is still executing —
+    the server no longer serializes queries behind one lock."""
+    import threading
+    import time
+
+    order = []
+
+    def slow():
+        _post(slow_server, "/sql", {"sql": SLOW_SQL})
+        order.append("slow")
+
+    def fast():
+        time.sleep(0.02)   # let the slow query enter execution first
+        _post(slow_server, "/sql", {
+            "sql": "select count(*) as n from sales where region = 'east'"})
+        order.append("fast")
+
+    ts = [threading.Thread(target=slow), threading.Thread(target=fast)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert order and order[0] == "fast", order
+
+
+def test_concurrent_correctness_hammer(slow_server):
+    """8 threads x mixed queries against one engine: every response must
+    equal the single-threaded result (thread-local stats/temp frames, locked
+    compile cache)."""
+    import threading
+
+    queries = [
+        "select region, sum(qty) as s from sales group by region",
+        "select product, count(*) as n from sales group by product",
+        "select count(*) as n from sales where qty > 25",
+        "select region, min(price) as mn, max(price) as mx from sales "
+        "group by region",
+    ]
+    want = {}
+    for q in queries:
+        _, want[q] = _post(slow_server, "/sql", {"sql": q})
+    errors = []
+
+    def worker(i):
+        q = queries[i % len(queries)]
+        try:
+            _, body = _post(slow_server, "/sql", {"sql": q})
+            b = dict(body)
+            w = dict(want[q])
+            b.pop("queryId", None)
+            w.pop("queryId", None)
+            srt = lambda d: sorted(map(str, d["rows"]))
+            if srt(b) != srt(w):
+                errors.append((q, "mismatch"))
+        except Exception as e:  # noqa: BLE001
+            errors.append((q, repr(e)))
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert not errors, errors

@@ -18,7 +18,7 @@ from conftest import assert_frames_equal
 
 def _conf(extra=None):
     base = {"sdot.querycostmodel.enabled": False,
-            "sdot.engine.groupby.dense.max.keys": 4096}
+            "sdot.engine.groupby.dense.max.keys": 1024}
     base.update(extra or {})
     return base
 
@@ -34,7 +34,7 @@ def mesh_ctx():
 @pytest.fixture(scope="module")
 def single_ctx():
     ctx = sdot.Context(config={
-        "sdot.engine.groupby.dense.max.keys": 4096})
+        "sdot.engine.groupby.dense.max.keys": 1024})
     tpch.setup_context(ctx, sf=0.002, target_rows=1024, flat_only=True)
     return ctx
 
