@@ -160,8 +160,20 @@ def cache_dir():
     return d
 
 
+def _stream_sf() -> float:
+    """Scale factor at/above which the flat index is built and ingested
+    out-of-core (chunked flatten to Parquet + row-group streaming ingest)
+    instead of through whole-frame pandas."""
+    return float(os.environ.get("SDOT_BENCH_STREAM_SF", "3"))
+
+
 def build_tables(sf: float):
-    """Generate (or load cached) base tables + flat index."""
+    """Generate (or load cached) base tables + the flat index.
+
+    Returns (tables, flat_df_or_None, flat_path, n_flat_rows): at/above
+    SDOT_BENCH_STREAM_SF the flat index exists only as a Parquet file
+    (flat_df is None) — the out-of-core regime.
+    """
     import pandas as pd
     from spark_druid_olap_tpu.tools import tpch
     d = cache_dir()
@@ -169,35 +181,65 @@ def build_tables(sf: float):
              "customer", "nation", "region"]
     paths = {n: os.path.join(d, f"tpch_{n}_sf{sf}.parquet") for n in names}
     flat_path = os.path.join(d, f"tpch_flat_sf{sf}.parquet")
+    streaming = sf >= _stream_sf()
     if all(os.path.exists(p) for p in paths.values()) and \
             os.path.exists(flat_path):
         log(f"loading cached tables from {d}")
         tables = {n: pd.read_parquet(p) for n, p in paths.items()}
-        return tables, pd.read_parquet(flat_path)
+        if streaming:
+            import pyarrow.parquet as pq
+            n_flat = pq.ParquetFile(flat_path).metadata.num_rows
+            return tables, None, flat_path, n_flat
+        flat = pd.read_parquet(flat_path)
+        return tables, flat, flat_path, len(flat)
     t0 = time.perf_counter()
     tables = tpch.generate(sf)
-    flat = tpch.flatten(tables)
-    flat = flat.drop(columns=[c for c in DROP_COLS if c in flat.columns])
     log(f"generated SF{sf}: lineitem {len(tables['lineitem']):,} rows "
         f"in {time.perf_counter() - t0:.1f}s")
+    li_path = paths["lineitem"]
     try:
         for n, p in paths.items():
             tables[n].to_parquet(p)
+    except Exception as e:
+        log(f"cache write failed ({e}); continuing")
+        if streaming:
+            # the streamed flatten reads lineitem back from Parquet; a
+            # failed/partial cache write must not be silently reused
+            import tempfile
+            li_path = os.path.join(tempfile.mkdtemp(prefix="sdot_li_"),
+                                   "lineitem.parquet")
+            tables["lineitem"].to_parquet(li_path)
+    if streaming:
+        t0 = time.perf_counter()
+        n_flat = tpch.flatten_stream(tables, li_path, flat_path,
+                                     batch_rows=1 << 21,
+                                     drop_columns=DROP_COLS)
+        log(f"streamed flatten: {n_flat:,} rows in "
+            f"{time.perf_counter() - t0:.1f}s")
+        return tables, None, flat_path, n_flat
+    flat = tpch.flatten(tables)
+    flat = flat.drop(columns=[c for c in DROP_COLS if c in flat.columns])
+    try:
         flat.to_parquet(flat_path)
     except Exception as e:
         log(f"cache write failed ({e}); continuing")
-    return tables, flat
+    return tables, flat, flat_path, len(flat)
 
 
 def setup(sf: float):
     import spark_druid_olap_tpu as sdot
     from spark_druid_olap_tpu.tools import tpch
-    tables, flat = build_tables(sf)
-    n_rows = len(flat)
+    tables, flat, flat_path, n_rows = build_tables(sf)
     ctx = sdot.Context()
     t0 = time.perf_counter()
-    ctx.ingest_dataframe("tpch_flat", flat, time_column="l_shipdate",
-                         target_rows=1 << 20)
+    if flat is None:
+        ctx.ingest_parquet_stream("tpch_flat", flat_path,
+                                  time_column="l_shipdate",
+                                  target_rows=1 << 20,
+                                  batch_rows=1 << 21)
+    else:
+        ctx.ingest_dataframe("tpch_flat", flat, time_column="l_shipdate",
+                             target_rows=1 << 20)
     del flat
     for name, df in tables.items():
         if name in ("nation", "region"):

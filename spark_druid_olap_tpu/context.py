@@ -99,6 +99,16 @@ class Context:
         self.store.register(ds)
         return ds
 
+    def ingest_parquet_stream(self, name, path, **kwargs):
+        """Out-of-core Parquet ingest (row-group streaming; see
+        segment/stream_ingest.py) — for datasets whose raw pandas form
+        would not fit in host memory."""
+        from spark_druid_olap_tpu.segment.stream_ingest import (
+            ingest_parquet_stream)
+        ds = ingest_parquet_stream(name, path, **kwargs)
+        self.store.register(ds)
+        return ds
+
     def register_star_schema(self, star_schema) -> None:
         self.catalog.register_star_schema(star_schema)
 

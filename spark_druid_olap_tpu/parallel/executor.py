@@ -603,6 +603,7 @@ class QueryEngine:
         self._device_arrays: Dict[tuple, object] = {}
         self._cancel_flags: Dict[str, object] = {}
         self._cancel_refs: Dict[str, int] = {}
+        self._cancel_lock = __import__("threading").Lock()
         # concurrency: queries execute in parallel (threading server); only
         # compile-cache population is serialized, and per-query stats are
         # thread-local so concurrent sessions don't trample each other
@@ -625,13 +626,13 @@ class QueryEngine:
         refcounted: statements sharing an id (one cancel scope, like
         Druid's queryId) stay cancellable until the LAST one releases."""
         import threading
-        with self._compile_lock:
+        with self._cancel_lock:
             self._cancel_flags.setdefault(query_id, threading.Event())
             self._cancel_refs[query_id] = \
                 self._cancel_refs.get(query_id, 0) + 1
 
     def release_query(self, query_id: str) -> None:
-        with self._compile_lock:
+        with self._cancel_lock:
             n = self._cancel_refs.get(query_id, 1) - 1
             if n <= 0:
                 self._cancel_refs.pop(query_id, None)
@@ -666,8 +667,10 @@ class QueryEngine:
         t0 = _time.perf_counter()
         self.last_stats.clear()   # per-thread; no cross-query leakage
         qid = getattr(getattr(q, "context", None), "query_id", None)
-        created = qid is not None and qid not in self._cancel_flags
-        if created:
+        if qid is not None:
+            # refcounted: session-registered ids (and ids shared by
+            # concurrent statements) stay cancellable until the LAST
+            # holder releases
             self.register_query(qid)
         try:
             return self._execute_inner(q, t0)
@@ -677,9 +680,7 @@ class QueryEngine:
             # fallback signal the session layer handles
             raise EngineFallback(str(e)) from e
         finally:
-            # session-registered ids outlive individual spec executions
-            # (multi-spec plans stay cancellable between specs)
-            if created:
+            if qid is not None:
                 self.release_query(qid)
 
     def _execute_inner(self, q: S.QuerySpec, t0: float) -> QueryResult:

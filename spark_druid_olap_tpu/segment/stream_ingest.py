@@ -1,0 +1,308 @@
+"""Out-of-core Parquet ingest: row-group streaming under a bounded memory
+footprint.
+
+≈ the Druid batch index task the reference submits
+(``DruidOverlordClient.submitTask``, ``client/DruidOverlordClient.scala:
+65-125``; ``quickstart/tpch_index_task.json.template``): Druid's indexer
+streams the input, shuffles rows into time-partitioned segments, and builds
+per-segment dictionaries/columns without ever materializing the dataset as
+rows. The TPU translation keeps the *final columnar arrays* (what the engine
+scans) as the only O(dataset) allocation:
+
+- **Pass A (metadata)**: stream batches once to collect per-dim value sets
+  (-> sorted global dictionaries), per-metric min/max + nullability (-> i32
+  vs wide-i64 storage), and a day-granularity time histogram.
+- **Partitioning**: pack days into segments of ~target_rows (the time-axis
+  shuffle at day granularity; rows within a segment stay arrival-ordered —
+  segment pruning needs only per-segment time bounds, not row order).
+- **Pass B (encode+scatter)**: stream batches again; encode each column
+  against the global dictionaries and scatter rows directly into their
+  final preallocated destination slots via per-segment cursors.
+
+Peak memory = final store columns + one in-flight batch + dictionaries,
+versus the in-memory path's full raw DataFrame + sorted copy + encoded
+columns all coexisting.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+import pandas as pd
+
+from spark_druid_olap_tpu.segment.column import (
+    ColumnKind,
+    DimColumn,
+    MetricColumn,
+    MILLIS_PER_DAY,
+    TimeColumn,
+)
+from spark_druid_olap_tpu.segment.ingest import _to_epoch_millis, infer_kind
+from spark_druid_olap_tpu.segment.store import Datasource, Segment
+
+
+def _arrow_batches(path, batch_rows):
+    import pyarrow.parquet as pq
+    pf = pq.ParquetFile(path)
+    return pf, pf.iter_batches(batch_size=batch_rows)
+
+
+def _series_of(batch, col) -> pd.Series:
+    return batch.column(col).to_pandas()
+
+
+def _valid_mask(raw: np.ndarray) -> np.ndarray:
+    """Vectorized non-null mask over an object array (None/NaN/pd.NA)."""
+    return ~pd.isna(raw)
+
+
+def ingest_parquet_stream(
+    name: str,
+    path: str,
+    time_column: Optional[str] = None,
+    dimensions: Optional[Iterable[str]] = None,
+    metrics: Optional[Iterable[str]] = None,
+    target_rows: int = 1 << 20,
+    batch_rows: int = 1 << 20,
+    metric_kinds: Optional[Dict[str, ColumnKind]] = None,
+) -> Datasource:
+    """Stream a Parquet file into a datasource without materializing it."""
+    dim_names = set(dimensions) if dimensions is not None else None
+    metric_names = set(metrics) if metrics is not None else None
+    metric_kinds = metric_kinds or {}
+
+    # -- pass A: schema, dictionaries, time histogram, metric ranges ----------
+    pf, batches = _arrow_batches(path, batch_rows)
+    n_total = pf.metadata.num_rows
+    cols = [f.name for f in pf.schema_arrow]
+    kinds: Dict[str, ColumnKind] = {}
+    uniques: Dict[str, np.ndarray] = {}
+    has_null: Dict[str, bool] = {c: False for c in cols}
+    int_min: Dict[str, int] = {}
+    int_max: Dict[str, int] = {}
+    day_counts: Dict[int, int] = {}
+    first = True
+    for batch in batches:
+        for c in cols:
+            s = _series_of(batch, c)
+            if first:
+                k = infer_kind(s)
+                if dim_names is not None and c in dim_names:
+                    k = ColumnKind.DIM
+                elif metric_names is not None and c in metric_names:
+                    k = metric_kinds.get(c) or (
+                        k if k != ColumnKind.DIM else ColumnKind.DOUBLE)
+                elif c in metric_kinds:
+                    k = metric_kinds[c]
+                kinds[c] = k
+            k = kinds[c]
+            if c == time_column:
+                ms = _to_epoch_millis(s)
+                days = np.floor_divide(ms, MILLIS_PER_DAY)
+                d, cnt = np.unique(days, return_counts=True)
+                for di, ci in zip(d.tolist(), cnt.tolist()):
+                    day_counts[di] = day_counts.get(di, 0) + ci
+                continue
+            if k == ColumnKind.DIM:
+                raw = s.to_numpy(dtype=object)
+                valid = _valid_mask(raw)
+                if not valid.all():
+                    has_null[c] = True
+                vals = np.unique(raw[valid].astype(str))
+                prev = uniques.get(c)
+                uniques[c] = vals if prev is None \
+                    else np.union1d(prev, vals)
+            elif k in (ColumnKind.LONG,):
+                v = s.to_numpy()
+                if np.issubdtype(v.dtype, np.floating):
+                    has_null[c] |= bool(np.isnan(v).any())
+                    v = v[~np.isnan(v)]
+                if len(v):
+                    lo, hi = int(np.min(v)), int(np.max(v))
+                    int_min[c] = min(int_min.get(c, lo), lo)
+                    int_max[c] = max(int_max.get(c, hi), hi)
+            elif k == ColumnKind.DOUBLE:
+                has_null[c] |= bool(
+                    np.isnan(s.to_numpy(np.float64, na_value=np.nan)).any())
+        first = False
+
+    # -- segment partitioning over the day histogram --------------------------
+    if time_column is not None and day_counts:
+        days_sorted = sorted(day_counts)
+        seg_first_day = [days_sorted[0]]
+        acc = 0
+        for d in days_sorted:
+            if acc >= target_rows:
+                seg_first_day.append(d)
+                acc = 0
+            acc += day_counts[d]
+        seg_of_day = np.asarray(seg_first_day, dtype=np.int64)
+        seg_rows = np.zeros(len(seg_first_day), dtype=np.int64)
+        for d, cnt in day_counts.items():
+            seg_rows[np.searchsorted(seg_of_day, d, side="right") - 1] += cnt
+    else:
+        n_seg = max(1, -(-n_total // target_rows))
+        per = -(-n_total // n_seg) if n_total else 1
+        seg_rows = np.full(n_seg, per, dtype=np.int64)
+        seg_rows[-1] = n_total - per * (n_seg - 1) if n_total else 0
+        seg_of_day = None
+    seg_starts = np.concatenate([[0], np.cumsum(seg_rows)[:-1]])
+
+    # -- preallocate final columns -------------------------------------------
+    ii = np.iinfo(np.int32)
+
+    def metric_dtype(c):
+        k = kinds[c]
+        if k == ColumnKind.DOUBLE:
+            return np.float32
+        if k == ColumnKind.DATE:
+            return np.int32
+        lo, hi = int_min.get(c, 0), int_max.get(c, 0)
+        wide = lo < ii.min or hi > ii.max
+        return np.int64 if wide else np.int32
+
+    out: Dict[str, np.ndarray] = {}
+    validity: Dict[str, np.ndarray] = {}
+    dicts: Dict[str, np.ndarray] = {}
+    for c in cols:
+        if c == time_column:
+            out["__days__"] = np.zeros(n_total, np.int32)
+            out["__ms__"] = np.zeros(n_total, np.int32)
+            continue
+        if kinds[c] == ColumnKind.DIM:
+            dicts[c] = uniques.get(c, np.array([], dtype=object))
+            out[c] = np.zeros(n_total, np.int32)
+        else:
+            out[c] = np.zeros(n_total, metric_dtype(c))
+        if has_null[c]:
+            validity[c] = np.zeros(n_total, bool)
+
+    # -- pass B: encode + scatter into destination slots ----------------------
+    cursors = seg_starts.copy()
+    seg_min_ms = np.full(len(seg_rows), np.iinfo(np.int64).max)
+    seg_max_ms = np.full(len(seg_rows), np.iinfo(np.int64).min)
+    _, batches = _arrow_batches(path, batch_rows)
+    for batch in batches:
+        bn = batch.num_rows
+        if time_column is not None:
+            ms = _to_epoch_millis(_series_of(batch, time_column))
+            days = np.floor_divide(ms, MILLIS_PER_DAY)
+            seg_idx = np.searchsorted(seg_of_day, days,
+                                      side="right") - 1 \
+                if seg_of_day is not None else np.zeros(bn, np.int64)
+            dest = np.empty(bn, np.int64)
+            order = np.argsort(seg_idx, kind="stable")
+            ss = seg_idx[order]
+            uniq, starts, counts = np.unique(ss, return_index=True,
+                                             return_counts=True)
+            for s_, st, cnt in zip(uniq.tolist(), starts.tolist(),
+                                   counts.tolist()):
+                dest[order[st: st + cnt]] = cursors[s_] + np.arange(cnt)
+                cursors[s_] += cnt
+                m = ms[order[st: st + cnt]]
+                seg_min_ms[s_] = min(seg_min_ms[s_], int(m.min()))
+                seg_max_ms[s_] = max(seg_max_ms[s_], int(m.max()))
+        else:
+            # sequential fill; segment boundaries respected by construction
+            start = int(cursors[0])
+            dest = np.arange(start, start + bn)
+            cursors[0] += bn
+
+        if time_column is not None:
+            out["__days__"][dest] = days.astype(np.int32)
+            out["__ms__"][dest] = (ms - days * MILLIS_PER_DAY) \
+                .astype(np.int32)
+        for c in cols:
+            if c == time_column:
+                continue
+            s = _series_of(batch, c)
+            k = kinds[c]
+            if k == ColumnKind.DIM:
+                raw = s.to_numpy(dtype=object)
+                valid = _valid_mask(raw)
+                safe = np.where(valid, raw, "").astype(str)
+                codes = np.searchsorted(dicts[c], safe)
+                codes = np.clip(codes, 0,
+                                max(len(dicts[c]) - 1, 0)).astype(np.int32)
+                codes[~valid] = 0
+                out[c][dest] = codes
+                if c in validity:
+                    validity[c][dest] = valid
+            elif k == ColumnKind.DATE:
+                msd = _to_epoch_millis(s)
+                out[c][dest] = np.floor_divide(
+                    msd, MILLIS_PER_DAY).astype(np.int32)
+            else:
+                v = s.to_numpy()
+                if np.issubdtype(v.dtype, np.floating) and c in validity:
+                    ok = ~np.isnan(v)
+                    validity[c][dest] = ok
+                    v = np.where(ok, v, 0)
+                out[c][dest] = v.astype(out[c].dtype)
+
+    # -- assemble the datasource ----------------------------------------------
+    dims: Dict[str, DimColumn] = {}
+    mets: Dict[str, MetricColumn] = {}
+    time_col = None
+    for c in cols:
+        if c == time_column:
+            time_col = TimeColumn(name=c, days=out["__days__"],
+                                  ms_in_day=out["__ms__"])
+            continue
+        if kinds[c] == ColumnKind.DIM:
+            dims[c] = DimColumn(
+                name=c, dictionary=np.asarray(dicts[c], dtype=object),
+                codes=out[c], validity=validity.get(c))
+        else:
+            mets[c] = MetricColumn(name=c, values=out[c],
+                                   validity=validity.get(c),
+                                   kind=kinds[c])
+    segments = []
+    for i, (st, cnt) in enumerate(zip(seg_starts.tolist(),
+                                      seg_rows.tolist())):
+        if cnt <= 0:
+            continue
+        if time_column is not None:
+            lo, hi = int(seg_min_ms[i]), int(seg_max_ms[i])
+        else:
+            lo = hi = 0
+        segments.append(Segment(id=f"{name}_{i:05d}", start_row=int(st),
+                                end_row=int(st + cnt), min_millis=lo,
+                                max_millis=hi))
+    return Datasource(name=name, time=time_col, dims=dims, metrics=mets,
+                      segments=segments, spatial={})
+
+
+def flatten_join_stream(base_path: str, out_path: str, joins,
+                        batch_rows: int = 1 << 20,
+                        drop_columns=None) -> int:
+    """Chunked denormalization: stream the fact table from Parquet, merge
+    each chunk against (smaller) in-memory dimension frames, and append to
+    an output Parquet file — the full flat frame never materializes.
+
+    ``joins``: list of (dim_df, left_on, right_on). Returns rows written.
+    """
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    writer = None
+    n_out = 0
+    _, batches = _arrow_batches(base_path, batch_rows)
+    try:
+        for batch in batches:
+            chunk = batch.to_pandas()
+            for dim_df, left_on, right_on in joins:
+                chunk = chunk.merge(dim_df, left_on=left_on,
+                                    right_on=right_on)
+            if drop_columns:
+                chunk = chunk.drop(columns=[c for c in drop_columns
+                                            if c in chunk.columns])
+            table = pa.Table.from_pandas(chunk, preserve_index=False)
+            if writer is None:
+                writer = pq.ParquetWriter(out_path, table.schema)
+            writer.write_table(table)
+            n_out += len(chunk)
+    finally:
+        if writer is not None:
+            writer.close()
+    return n_out

@@ -220,6 +220,33 @@ def flatten(tables) -> pd.DataFrame:
     return df.reset_index(drop=True)
 
 
+def flatten_stream(tables, lineitem_path: str, out_path: str,
+                   batch_rows: int = 1 << 20,
+                   drop_columns=None) -> int:
+    """Out-of-core flatten: stream lineitem from Parquet and denormalize
+    chunk-by-chunk against the (smaller) dimension tables, writing the flat
+    index to Parquet incrementally — the full flat frame never
+    materializes (the pandas peak at SF>=10 would be several times the
+    ~25GB+ flat size). Returns rows written."""
+    from spark_druid_olap_tpu.segment.stream_ingest import flatten_join_stream
+    nr = nation_region_views(tables)
+    joins = [
+        (tables["orders"], "l_orderkey", "o_orderkey"),
+        (tables["customer"], "o_custkey", "c_custkey"),
+        (nr["custnation"], "c_nationkey", "cn_nationkey"),
+        (nr["custregion"], "cn_regionkey", "cr_regionkey"),
+        (tables["part"], "l_partkey", "p_partkey"),
+        (tables["supplier"], "l_suppkey", "s_suppkey"),
+        (nr["suppnation"], "s_nationkey", "sn_nationkey"),
+        (nr["suppregion"], "sn_regionkey", "sr_regionkey"),
+        (tables["partsupp"], ["l_partkey", "l_suppkey"],
+         ["ps_partkey", "ps_suppkey"]),
+    ]
+    return flatten_join_stream(lineitem_path, out_path, joins,
+                               batch_rows=batch_rows,
+                               drop_columns=drop_columns)
+
+
 def flatten_partsupp(tables) -> pd.DataFrame:
     """Denormalize the partsupp-grain star (partsupp x part x supplier x
     supp-nation/region). TPC-H q2/q11/q16/q20 aggregate at partsupp grain,

@@ -1,0 +1,185 @@
+"""Out-of-core Parquet ingest tests.
+
+Differential: a store built by streaming row groups must answer queries
+identically to one built by the in-memory path over the same data
+(segmentation may differ; results must not). Memory: the streaming path's
+peak python-allocation overhead beyond the final store must stay bounded by
+a few batches, where the in-memory path holds whole-dataset copies.
+"""
+
+import os
+import tracemalloc
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.segment.ingest import ingest_dataframe
+from spark_druid_olap_tpu.segment.stream_ingest import (
+    flatten_join_stream,
+    ingest_parquet_stream,
+)
+
+from conftest import assert_frames_equal, make_sales_df
+
+
+N = 50_000
+
+
+@pytest.fixture(scope="module")
+def sales_parquet(tmp_path_factory):
+    df = make_sales_df(N)
+    # nullable columns exercise validity handling
+    df.loc[df.index[::97], "product"] = None
+    df["maybe"] = df["price"].where(df.index % 13 != 0)
+    p = tmp_path_factory.mktemp("ing") / "sales.parquet"
+    df.to_parquet(p)
+    return str(p), df
+
+
+def _q(ctx, sql):
+    return ctx.sql(sql).to_pandas()
+
+
+@pytest.fixture(scope="module")
+def two_ctxs(sales_parquet):
+    path, df = sales_parquet
+    stream = sdot.Context()
+    ds = ingest_parquet_stream("sales", path, time_column="ts",
+                               target_rows=4096, batch_rows=8192)
+    stream.store.register(ds)
+    mem = sdot.Context()
+    mem.ingest_dataframe("sales", df, time_column="ts", target_rows=4096)
+    return stream, mem
+
+
+QUERIES = [
+    "select region, sum(qty) as s, count(*) as n from sales group by region",
+    "select product, min(price) as mn, max(price) as mx from sales "
+    "group by product",
+    "select region, sum(maybe) as sm, count(maybe) as cm from sales "
+    "group by region",
+    "select count(*) as n from sales where product is null",
+    "select year(ts) as y, count(*) as n from sales group by year(ts)",
+    "select count(*) as n from sales "
+    "where ts >= date '2015-06-01' and ts < date '2016-01-01'",
+]
+
+
+@pytest.mark.parametrize("sql", QUERIES)
+def test_stream_matches_inmemory(two_ctxs, sql):
+    stream, mem = two_ctxs
+    got = _q(stream, sql)
+    assert stream.history.entries()[-1].stats["mode"] == "engine"
+    want = _q(mem, sql)
+    assert_frames_equal(got, want, sort_by=list(want.columns), rtol=1e-5)
+
+
+def test_stream_segment_time_bounds(two_ctxs):
+    ds = two_ctxs[0].store.get("sales")
+    assert ds.num_rows == N
+    assert ds.num_segments > 4
+    mins, maxs = ds.segment_time_bounds()
+    # segments partition the day axis: bounds are non-overlapping ordered
+    assert all(maxs[i] < mins[i + 1] + 86_400_000
+               for i in range(len(mins) - 1))
+    for s in ds.segments:
+        assert s.min_millis <= s.max_millis
+
+
+def test_stream_wide_int_column(tmp_path):
+    df = pd.DataFrame({
+        "ts": pd.to_datetime(["2020-01-01"] * 5),
+        "g": ["a", "a", "b", "b", "b"],
+        "w": np.array([2**40, 2**41, 5, 2**42, 7], dtype=np.int64),
+    })
+    p = tmp_path / "wide.parquet"
+    df.to_parquet(p)
+    ds = ingest_parquet_stream("wf", str(p), time_column="ts")
+    assert ds.metrics["w"].values.dtype == np.int64
+    ctx = sdot.Context()
+    ctx.store.register(ds)
+    got = ctx.sql("select g, sum(w) as s from wf group by g order by g") \
+        .to_pandas()
+    want = df.groupby("g")["w"].sum()
+    np.testing.assert_array_equal(got["s"].to_numpy().astype(np.int64),
+                                  want.to_numpy())
+
+
+def test_stream_no_time_column(tmp_path):
+    df = pd.DataFrame({"k": ["x", "y"] * 2500,
+                       "v": np.arange(5000, dtype=np.int64)})
+    p = tmp_path / "plain.parquet"
+    df.to_parquet(p)
+    ds = ingest_parquet_stream("plain", str(p), target_rows=1000,
+                               batch_rows=768)
+    assert ds.num_rows == 5000 and ds.num_segments == 5
+    ctx = sdot.Context()
+    ctx.store.register(ds)
+    got = ctx.sql("select k, sum(v) as s from plain group by k order by k") \
+        .to_pandas()
+    want = df.groupby("k")["v"].sum()
+    np.testing.assert_array_equal(got["s"].to_numpy(), want.to_numpy())
+
+
+def test_stream_peak_memory_bounded(tmp_path):
+    """Streaming ingest must not hold whole-dataset intermediates: its peak
+    traced allocation stays well under the in-memory path's, which holds
+    the raw frame + sorted copy + encoded columns simultaneously."""
+    n = 200_000
+    r = np.random.default_rng(0)
+    df = pd.DataFrame({
+        "ts": (np.datetime64("2019-01-01")
+               + r.integers(0, 400, n).astype("timedelta64[D]"))
+        .astype("datetime64[ns]"),
+        "k": r.choice([f"k{i:03d}" for i in range(300)], n),
+        "a": r.integers(0, 1 << 30, n),
+        "b": r.uniform(0, 1e6, n),
+        "c": r.integers(0, 100, n),
+    })
+    p = tmp_path / "big.parquet"
+    df.to_parquet(p)
+    del df
+
+    tracemalloc.start()
+    ds = ingest_parquet_stream("m", str(p), time_column="ts",
+                               target_rows=1 << 16, batch_rows=1 << 14)
+    _, peak_stream = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    store_bytes = sum(c.values.nbytes for c in ds.metrics.values()) \
+        + sum(c.codes.nbytes for c in ds.dims.values()) \
+        + ds.time.days.nbytes + ds.time.ms_in_day.nbytes
+    # overhead beyond the final store: a few 16k-row batches, not O(n)
+    overhead = peak_stream - store_bytes
+    assert overhead < 6 * (1 << 14) * 8 * 5 + (1 << 22), \
+        (peak_stream, store_bytes)
+
+    df = pd.read_parquet(p)
+    tracemalloc.start()
+    ingest_dataframe("m2", df, time_column="ts", target_rows=1 << 16)
+    _, peak_mem = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert peak_stream < peak_mem * 0.7, (peak_stream, peak_mem)
+
+
+def test_flatten_join_stream(tmp_path):
+    fact = pd.DataFrame({
+        "fk": np.arange(10_000) % 100,
+        "v": np.arange(10_000, dtype=np.int64),
+    })
+    dim = pd.DataFrame({"dk": np.arange(100),
+                        "label": [f"L{i}" for i in range(100)]})
+    fp = tmp_path / "fact.parquet"
+    fact.to_parquet(fp)
+    out = tmp_path / "flat.parquet"
+    n = flatten_join_stream(str(fp), str(out),
+                            joins=[(dim, "fk", "dk")],
+                            batch_rows=1024, drop_columns=["dk"])
+    assert n == 10_000
+    flat = pd.read_parquet(out)
+    assert list(flat.columns) == ["fk", "v", "label"]
+    want = fact.merge(dim, left_on="fk", right_on="dk").drop(columns=["dk"])
+    pd.testing.assert_frame_equal(
+        flat.sort_values("v").reset_index(drop=True),
+        want.sort_values("v").reset_index(drop=True))
