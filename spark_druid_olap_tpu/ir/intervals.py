@@ -22,21 +22,32 @@ MAX_MS = 1 << 62
 class IntervalAccumulator:
     lo: int = MIN_MS
     hi: int = MAX_MS
+    tz: str = "UTC"
+
+    def _ms(self, value) -> int:
+        # a date/time literal is LOCAL wall-clock; the stored time axis is
+        # UTC (reference: tz.id driving interval extraction,
+        # DateTimeExtractor.scala)
+        ms = date_literal_to_millis(value)
+        from spark_druid_olap_tpu.ops import timezone as TZ
+        if not TZ.is_utc(self.tz):
+            ms = TZ.local_naive_to_utc_millis(self.tz, ms)
+        return ms
 
     def ge(self, value):            # t >= v
-        self.lo = max(self.lo, date_literal_to_millis(value))
+        self.lo = max(self.lo, self._ms(value))
 
     def gt(self, value):            # t > v  (ms precision)
-        self.lo = max(self.lo, date_literal_to_millis(value) + 1)
+        self.lo = max(self.lo, self._ms(value) + 1)
 
     def le(self, value):            # t <= v
-        self.hi = min(self.hi, date_literal_to_millis(value) + 1)
+        self.hi = min(self.hi, self._ms(value) + 1)
 
     def lt(self, value):            # t < v
-        self.hi = min(self.hi, date_literal_to_millis(value))
+        self.hi = min(self.hi, self._ms(value))
 
     def eq(self, value):
-        ms = date_literal_to_millis(value)
+        ms = self._ms(value)
         self.lo = max(self.lo, ms)
         self.hi = min(self.hi, ms + 1)
 

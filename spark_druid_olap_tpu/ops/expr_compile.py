@@ -31,6 +31,7 @@ import numpy as np
 
 from spark_druid_olap_tpu.ir import expr as E
 from spark_druid_olap_tpu.ops import time_ops
+from spark_druid_olap_tpu.ops import timezone as _tz
 from spark_druid_olap_tpu.ops.scan import ScanContext
 from spark_druid_olap_tpu.segment.column import ColumnKind
 
@@ -174,7 +175,14 @@ def _column_value(name: str, ctx: ScanContext):
     if kind == ColumnKind.DATE:
         return TimeValue(arr, None)
     if kind == ColumnKind.TIME:
-        return TimeValue(arr, ctx.time_ms())
+        days, ms = arr, ctx.time_ms()
+        if not _tz.is_utc(ctx.tz):
+            # expressions see the instant in session-local wall-clock time,
+            # matching the planner's tz-aware dimension extractions
+            lut = _tz.day_offset_lut(ctx.tz, ctx.min_day - 1,
+                                     ctx.max_day + 1)
+            days, ms = _tz.shift_days_ms(days, ms, lut, ctx.min_day - 1)
+        return TimeValue(days, ms)
     raise Unsupported(f"column kind {kind}")
 
 

@@ -31,6 +31,7 @@ class ScanContext:
     arrays: Dict[str, object]          # name -> traced [S, R] array
     min_day: int                       # over the selected segments
     max_day: int
+    tz: str = "UTC"                    # session timezone (instants shift)
 
     # -- device array access --------------------------------------------------
     def col(self, name: str):

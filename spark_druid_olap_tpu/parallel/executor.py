@@ -38,6 +38,7 @@ from spark_druid_olap_tpu.ops import groupby as G
 from spark_druid_olap_tpu.ops import hash_groupby as H
 from spark_druid_olap_tpu.ops import hll as HLL
 from spark_druid_olap_tpu.ops import time_ops as T
+from spark_druid_olap_tpu.ops import timezone as TZ
 from spark_druid_olap_tpu.ops.scan import (
     ScanContext,
     array_names,
@@ -54,6 +55,7 @@ from spark_druid_olap_tpu.segment.store import Datasource, SegmentStore
 from spark_druid_olap_tpu.utils import host_eval
 from spark_druid_olap_tpu.utils.config import (
     Config,
+    TZ_ID,
     GROUPBY_DENSE_MAX_KEYS,
     GROUPBY_HASH_MAX_SLOTS,
     GROUPBY_HASH_SLOTS,
@@ -164,7 +166,8 @@ _FIELD_CARDS = {"month": (1, 12), "quarter": (1, 4), "day": (1, 31),
 
 
 def _plan_time_extraction(dspec: S.DimensionSpec, ds: Datasource,
-                          min_day: int, max_day: int) -> DimPlan:
+                          min_day: int, max_day: int,
+                          tz: str = "UTC") -> DimPlan:
     ex = dspec.extraction
     assert isinstance(ex, S.TimeExtraction)
     name = dspec.dimension
@@ -173,27 +176,45 @@ def _plan_time_extraction(dspec: S.DimensionSpec, ds: Datasource,
         raise EngineFallback(f"time extraction over {kind}")
     if kind == ColumnKind.DIM:
         # date-string dim: convert through host LUT then treat as days
+        # (calendar dates — timezone-independent)
         col = ds.dims[name]
         lut = np.array([T.date_literal_to_days(s) if s else 0
                         for s in col.dictionary], dtype=np.int32)
         day_build = lambda ctx: EC._take_lut(lut, ctx.col(name))
         lo_day, hi_day = int(lut.min()), int(lut.max())
     elif kind == ColumnKind.DATE:
+        # calendar dates — timezone-independent
         m = ds.metrics[name]
         lo_day = int(m.min) if m.min is not None else 0
         hi_day = int(m.max) if m.max is not None else 0
         day_build = lambda ctx: ctx.col(name)
+    elif not TZ.is_utc(tz):
+        # instants: shift to session-local wall-clock before extraction
+        lo_day, hi_day = min_day - 1, max_day + 1
+        _tzlut = TZ.day_offset_lut(tz, lo_day, hi_day)
+
+        def dt_build(ctx):
+            return TZ.shift_days_ms(ctx.col(name), ctx.time_ms(), _tzlut,
+                                    lo_day)
+
+        day_build = lambda ctx: dt_build(ctx)[0]
     else:
         lo_day, hi_day = min_day, max_day
         day_build = lambda ctx: ctx.col(name)
+    if kind == ColumnKind.TIME and not TZ.is_utc(tz):
+        ms_build = lambda ctx: dt_build(ctx)[1]
+    elif kind == ColumnKind.TIME:
+        ms_build = lambda ctx: ctx.time_ms()
+    else:
+        ms_build = lambda ctx: None
 
     field = ex.field
     if field.startswith("trunc_"):
         grain = field[len("trunc_"):]
         def build(ctx, grain=grain):
             days = day_build(ctx)
-            ms = ctx.time_ms() if kind == ColumnKind.TIME else None
-            b, _, _ = T.bucket_and_cardinality(grain, days, ms, lo_day, hi_day)
+            b, _, _ = T.bucket_and_cardinality(grain, days, ms_build(ctx),
+                                               lo_day, hi_day)
             return b
         _, card, decode1 = T.bucket_and_cardinality(
             grain, np.zeros(1, np.int32), np.zeros(1, np.int32),
@@ -225,36 +246,42 @@ def _plan_time_extraction(dspec: S.DimensionSpec, ds: Datasource,
         if needs_ms and kind != ColumnKind.TIME:
             raise EngineFallback(f"{field} of a date column")
         def build(ctx, field=field, f_lo=f_lo):
-            days = day_build(ctx)
-            ms = ctx.time_ms() if kind == ColumnKind.TIME else None
-            return T.extract_field(field, days, ms) - f_lo
+            return T.extract_field(field, day_build(ctx),
+                                   ms_build(ctx)) - f_lo
         return DimPlan(dspec.output_name, f_hi - f_lo + 1, build,
                        lambda idx: np.asarray(idx, np.int64) + f_lo, (name,))
     raise EngineFallback(f"time extraction field {field}")
 
 
 def plan_granularity_dim(gran: S.Granularity, ds: Datasource, min_day: int,
-                         max_day: int) -> DimPlan:
+                         max_day: int, tz: str = "UTC") -> DimPlan:
     """Granularity bucketing as a leading group dimension named 'timestamp'
     (Druid result rows' timestamp field). Uses absolute time buckets for
-    every grain incl. hour/minute/duration."""
+    every grain incl. hour/minute/duration. Non-UTC sessions bucket in
+    LOCAL wall-clock time and label buckets with their local start."""
     if ds.time is None:
         raise EngineFallback("granularity on time-less datasource")
     tname = ds.time.name
     kind = gran.kind
     if kind == "none":
         raise EngineFallback("'none' granularity (row-grain) on agg path")
+    shift = not TZ.is_utc(tz)
+    lo_day, hi_day = (min_day - 1, max_day + 1) if shift \
+        else (min_day, max_day)
+    tzlut = TZ.day_offset_lut(tz, lo_day, hi_day) if shift else None
     try:
         _, card, decode1 = T.bucket_and_cardinality(
             kind, np.zeros(1, np.int32), np.zeros(1, np.int32),
-            min_day, max_day, gran.duration_millis)
+            lo_day, hi_day, gran.duration_millis)
     except ValueError as e:
         raise EngineFallback(str(e))
 
     def build(ctx):
+        days, ms = ctx.col(tname), ctx.time_ms()
+        if shift:
+            days, ms = TZ.shift_days_ms(days, ms, tzlut, lo_day)
         b, _, _ = T.bucket_and_cardinality(
-            kind, ctx.col(tname), ctx.time_ms(), min_day, max_day,
-            gran.duration_millis)
+            kind, days, ms, lo_day, hi_day, gran.duration_millis)
         return b
 
     decode = lambda idx: np.array([decode1(i) for i in np.asarray(idx)],
@@ -377,13 +404,13 @@ def _regex_vals_fn(ex: S.RegexExtraction):
 
 
 def plan_dimension(dspec: S.DimensionSpec, ds: Datasource, min_day: int,
-                   max_day: int) -> DimPlan:
+                   max_day: int, tz: str = "UTC") -> DimPlan:
     try:
         if dspec.extraction is None:
             return _plan_plain(dspec.dimension, ds, dspec.output_name,
                                min_day, max_day)
         if isinstance(dspec.extraction, S.TimeExtraction):
-            return _plan_time_extraction(dspec, ds, min_day, max_day)
+            return _plan_time_extraction(dspec, ds, min_day, max_day, tz)
         if isinstance(dspec.extraction, S.LookupExtraction):
             return _plan_dict_transform(dspec, ds,
                                         _lookup_vals_fn(dspec.extraction))
@@ -767,6 +794,7 @@ class QueryEngine:
         # --- build / fetch program -------------------------------------------
         sig = ("agg", ds.name, id(ds), repr(q), s_pad, ds.padded_rows,
                min_day, max_day, sharded, n_dev, tuple(names),
+               self.config.get(TZ_ID),
                jax.default_backend(), bool(jax.config.jax_enable_x64))
         # double-checked: warm queries never touch the lock
         prog = self._programs.get(sig)
@@ -929,8 +957,8 @@ class QueryEngine:
                 metas, T, self.config.get(GROUPBY_MATMUL_MAX_KEYS))
             sig = ("hashagg", ds.name, id(ds), repr(q), s_pad,
                    ds.padded_rows, min_day, max_day, sharded, n_dev, T,
-                   tuple(names), jax.default_backend(),
-                   bool(jax.config.jax_enable_x64))
+                   tuple(names), self.config.get(TZ_ID),
+                   jax.default_backend(), bool(jax.config.jax_enable_x64))
             prog_fn = self._programs.get(sig)
             if prog_fn is None:
                 with self._compile_lock:
@@ -1011,7 +1039,8 @@ class QueryEngine:
         cards = [p.card for p in dim_plans]
 
         def core(arrays):
-            ctx = ScanContext(ds, arrays, min_day, max_day)
+            ctx = ScanContext(ds, arrays, min_day, max_day,
+                              tz=self.config.get(TZ_ID))
             base = ctx.row_valid()
             fm = F.lower_filter(filter_spec, ctx)
             if fm is not None:
@@ -1089,11 +1118,12 @@ class QueryEngine:
         mins, maxs = ds.segment_time_bounds()
         min_day = int(mins[seg_idx].min() // T.MILLIS_PER_DAY)
         max_day = int(maxs[seg_idx].max() // T.MILLIS_PER_DAY)
-        dim_plans = [plan_dimension(d, ds, min_day, max_day)
+        tz = self.config.get(TZ_ID)
+        dim_plans = [plan_dimension(d, ds, min_day, max_day, tz)
                      for d in dimensions]
         if gran_kind != "all":
             dim_plans = [plan_granularity_dim(granularity, ds, min_day,
-                                              max_day)] + dim_plans
+                                              max_day, tz)] + dim_plans
         agg_plans = [plan_aggregation(a, ds) for a in aggregations]
         n_keys = 1
         for p in dim_plans:
@@ -1161,7 +1191,8 @@ class QueryEngine:
         dense_plans = [p for p in agg_plans if p.kind != "hll"]
 
         def core(arrays):
-            ctx = ScanContext(ds, arrays, min_day, max_day)
+            ctx = ScanContext(ds, arrays, min_day, max_day,
+                              tz=self.config.get(TZ_ID))
             base = ctx.row_valid()
             fm = F.lower_filter(filter_spec, ctx)
             if fm is not None:

@@ -210,7 +210,8 @@ class Builder:
         (ProjectFilterTransfom.addUnpushedAttributes:36-50,
         DruidStrategy.scala:244-270).
         """
-        acc = IntervalAccumulator()
+        from spark_druid_olap_tpu.utils.config import TZ_ID
+        acc = IntervalAccumulator(tz=self.ctx.config.get(TZ_ID))
         specs: List[S.FilterSpec] = []
         residue: List[E.Expr] = []
         tcol = self.ds.time.name if self.ds.time is not None else None

@@ -57,6 +57,22 @@ def _valid_mask(raw: np.ndarray) -> np.ndarray:
     return ~pd.isna(raw)
 
 
+def _kind_from_arrow(t) -> Optional[ColumnKind]:
+    """Deterministic column-kind inference from the Parquet/Arrow schema
+    (a first-batch pandas dtype would flip int->float depending on where
+    nulls fall)."""
+    import pyarrow as pa
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return ColumnKind.DIM
+    if pa.types.is_floating(t) or pa.types.is_decimal(t):
+        return ColumnKind.DOUBLE
+    if pa.types.is_integer(t) or pa.types.is_boolean(t):
+        return ColumnKind.LONG
+    if pa.types.is_timestamp(t) or pa.types.is_date(t):
+        return ColumnKind.DATE
+    return None
+
+
 def ingest_parquet_stream(
     name: str,
     path: str,
@@ -87,7 +103,8 @@ def ingest_parquet_stream(
         for c in cols:
             s = _series_of(batch, c)
             if first:
-                k = infer_kind(s)
+                k = _kind_from_arrow(
+                    pf.schema_arrow.field(c).type) or infer_kind(s)
                 if dim_names is not None and c in dim_names:
                     k = ColumnKind.DIM
                 elif metric_names is not None and c in metric_names:
@@ -235,10 +252,14 @@ def ingest_parquet_stream(
                     msd, MILLIS_PER_DAY).astype(np.int32)
             else:
                 v = s.to_numpy()
-                if np.issubdtype(v.dtype, np.floating) and c in validity:
-                    ok = ~np.isnan(v)
-                    validity[c][dest] = ok
-                    v = np.where(ok, v, 0)
+                if c in validity:
+                    # null-free batches surface as int dtype: still valid
+                    if np.issubdtype(v.dtype, np.floating):
+                        ok = ~np.isnan(v)
+                        validity[c][dest] = ok
+                        v = np.where(ok, v, 0)
+                    else:
+                        validity[c][dest] = True
                 out[c][dest] = v.astype(out[c].dtype)
 
     # -- assemble the datasource ----------------------------------------------

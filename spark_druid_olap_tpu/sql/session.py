@@ -181,6 +181,16 @@ def explain_text(ctx, stmt: A.SelectStmt, sql: str) -> str:
 
 
 def _run_select(ctx, stmt: A.SelectStmt, sql: str) -> QueryResult:
+    from spark_druid_olap_tpu.utils.config import TZ_ID
+    from spark_druid_olap_tpu.utils import host_eval as _he
+    _tz_tok = _he.SESSION_TZ.set(ctx.config.get(TZ_ID))
+    try:
+        return _run_select_tz(ctx, stmt, sql)
+    finally:
+        _he.SESSION_TZ.reset(_tz_tok)
+
+
+def _run_select_tz(ctx, stmt: A.SelectStmt, sql: str) -> QueryResult:
     t0 = _time.perf_counter()
     stmt = resolve_lookups(ctx, stmt)
     try:

@@ -46,10 +46,33 @@ def _map1(v, fn):
     return fn(v)
 
 
+import contextvars
+
+# session timezone for host-side time bucketing/extraction (set by the SQL
+# session around each statement; contextvars are per-thread, so concurrent
+# server sessions don't interfere)
+SESSION_TZ = contextvars.ContextVar("sdot_session_tz", default="UTC")
+
+
 def _to_days(v):
-    """Coerce scalar-or-array date-ish value to int days."""
+    """Coerce scalar-or-array date-ish value to int days. datetime64
+    INSTANTS shift into the session timezone's wall-clock day; calendar
+    dates and date literals never shift."""
     if isinstance(v, np.ndarray):
         if np.issubdtype(v.dtype, np.datetime64):
+            tz = SESSION_TZ.get()
+            from spark_druid_olap_tpu.ops import timezone as TZ
+            if not TZ.is_utc(tz):
+                ms = v.astype("datetime64[ms]").astype(np.int64)
+                nat = np.isnat(v)
+                if nat.any():
+                    # NaT is int64-min; shifting it would demand an
+                    # astronomically-sized offset LUT
+                    ms = ms.copy()
+                    ms[~nat] = TZ.shift_millis_np(ms[~nat], tz)
+                else:
+                    ms = TZ.shift_millis_np(ms, tz)
+                return np.floor_divide(ms, 86_400_000)
             return v.astype("datetime64[D]").astype(np.int64)
         if v.dtype == object:
             return np.array([date_literal_to_days(x) for x in v],

@@ -183,3 +183,26 @@ def test_flatten_join_stream(tmp_path):
     pd.testing.assert_frame_equal(
         flat.sort_values("v").reset_index(drop=True),
         want.sort_values("v").reset_index(drop=True))
+
+
+def test_stream_nullable_int_across_batches(tmp_path):
+    # nulls concentrated in ONE batch: rows of null-free batches must stay
+    # valid, and the column kind must come from the schema (LONG), not from
+    # whichever batch's pandas dtype happened to be float
+    n = 4000
+    df = pd.DataFrame({
+        "k": ["a", "b"] * (n // 2),
+        "v": pd.array([None if i < 11 else i for i in range(n)],
+                      dtype="Int64"),
+    })
+    p = tmp_path / "nullable.parquet"
+    df.to_parquet(p)
+    ds = ingest_parquet_stream("nb", str(p), batch_rows=1000)
+    from spark_druid_olap_tpu.segment.column import ColumnKind
+    assert ds.metrics["v"].kind == ColumnKind.LONG
+    assert int(ds.metrics["v"].validity.sum()) == n - 11
+    ctx = sdot.Context()
+    ctx.store.register(ds)
+    got = ctx.sql("select count(v) as c, sum(v) as s from nb").to_pandas()
+    assert int(got["c"][0]) == n - 11
+    assert int(got["s"][0]) == sum(i for i in range(11, n))
