@@ -349,6 +349,9 @@ def query_to_dict(q: S.QuerySpec) -> dict:
             "query": q.query, "caseSensitive": q.case_sensitive,
             "filter": filter_to_dict(q.filter), "limit": q.limit,
         })
+        if q.value_output is not None:
+            base["valueOutput"] = q.value_output
+            base["countOutput"] = q.count_output
         return base
     raise ValueError(type(q).__name__)
 
@@ -413,7 +416,8 @@ def query_from_dict(d: dict, default_ds: Optional[str] = None) -> S.QuerySpec:
         return S.SearchQuerySpec(ds, tuple(d.get("searchDimensions", [])),
                                  d.get("query", ""),
                                  d.get("caseSensitive", False), filt,
-                                 d.get("limit"), intervals, qctx)
+                                 d.get("limit"), intervals, qctx,
+                                 d.get("valueOutput"), d.get("countOutput"))
     raise ValueError(f"unknown queryType {qt!r}")
 
 

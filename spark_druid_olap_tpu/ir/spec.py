@@ -320,6 +320,11 @@ class SearchQuerySpec(QuerySpec):
     limit: Optional[int] = None
     intervals: Optional[Tuple[Interval, ...]] = None
     context: QueryContext = QueryContext()
+    # set when rewritten FROM a group-by (QuerySpecTransforms
+    # GroupBy->Search, reference :225-277): result columns become
+    # [value_output, count_output] instead of [dimension, value, count]
+    value_output: Optional[str] = None
+    count_output: Optional[str] = None
 
 
 def filter_and(parts: Sequence[Optional[FilterSpec]]) -> Optional[FilterSpec]:

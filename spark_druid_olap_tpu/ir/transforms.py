@@ -60,6 +60,47 @@ def groupby_to_topn(q: S.QuerySpec, conf: Config):
         granularity=q.granularity, intervals=q.intervals, context=q.context)
 
 
+def groupby_to_search(q: S.QuerySpec, conf: Config):
+    """GroupBy over ONE dim whose only row filter is a contains/like
+    pattern on that same dim, counting rows -> dictionary-scan Search query
+    (reference :225-277). The search tier scans the (small) dictionary
+    instead of planning a dense group-by over the full key space."""
+    if not isinstance(q, S.GroupByQuerySpec):
+        return None
+    if (len(q.dimensions) != 1 or q.having is not None
+            or q.limit is not None or q.post_aggregations
+            or not q.granularity.is_all()):
+        return None
+    d = q.dimensions[0]
+    if d.extraction is not None:
+        return None
+    a = q.aggregations[0] if len(q.aggregations) == 1 else None
+    if a is None or a.kind != "count" or a.filter is not None \
+            or a.field is not None or a.expr is not None:
+        # a filtered/field count is NOT the row count the search tier returns
+        return None
+    f = q.filter
+    if not (isinstance(f, S.PatternFilter) and f.dimension == d.dimension
+            and f.kind in ("contains", "like")):
+        return None
+    if f.kind == "like":
+        inner = f.pattern
+        if not (inner.startswith("%") and inner.endswith("%")
+                and len(inner) > 2):
+            return None
+        inner = inner[1:-1]
+        if any(ch in inner for ch in "%_"):
+            return None
+        needle = inner
+    else:
+        needle = f.pattern
+    return S.SearchQuerySpec(
+        datasource=q.datasource, dimensions=(d.dimension,), query=needle,
+        case_sensitive=True, filter=None, intervals=q.intervals,
+        context=q.context, value_output=d.output_name,
+        count_output=q.aggregations[0].name)
+
+
 def add_count_when_no_aggs(q: S.QuerySpec, conf: Config):
     """GroupBy with zero aggregations (e.g. SELECT DISTINCT dims) gets a
     hidden count (reference :104-117 adds an 'addCountAggregate')."""
@@ -125,7 +166,8 @@ def merge_spatial_bounds(filter_spec, ds):
     return S.LogicalFilter("and", tuple(rest))
 
 
-RULES: List[Rule] = [add_count_when_no_aggs, groupby_to_topn,
+RULES: List[Rule] = [add_count_when_no_aggs, groupby_to_search,
+                     groupby_to_topn,
                      groupby_to_timeseries]
 
 

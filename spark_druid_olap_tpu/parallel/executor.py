@@ -37,6 +37,7 @@ from spark_druid_olap_tpu.ops import filters as F
 from spark_druid_olap_tpu.ops import groupby as G
 from spark_druid_olap_tpu.ops import hash_groupby as H
 from spark_druid_olap_tpu.ops import hll as HLL
+from spark_druid_olap_tpu.ops import theta as TH
 from spark_druid_olap_tpu.ops import time_ops as T
 from spark_druid_olap_tpu.ops import timezone as TZ
 from spark_druid_olap_tpu.ops.scan import (
@@ -445,7 +446,7 @@ class AggPlan:
             return ctx.col(a.field)
         if a.field is not None:
             k = ctx.kind(a.field)
-            if self.kind == "hll":
+            if self.kind in ("hll", "theta"):
                 if k == ColumnKind.DIM:
                     return ctx.col(a.field)
                 if k in (ColumnKind.LONG, ColumnKind.DATE):
@@ -507,6 +508,7 @@ _AGG_KIND = {"count": ("count", np.int64), "longsum": ("sum", np.int64),
              "longmax": ("max", np.int64), "doublemin": ("min", np.float64),
              "doublemax": ("max", np.float64),
              "cardinality": ("hll", np.int64),
+             "thetasketch": ("theta", np.int64),
              "anyvalue": ("max", np.float64)}
 
 
@@ -515,7 +517,8 @@ def _identity_row(kinds_by_name) -> Dict[str, np.ndarray]:
     semantics (and Druid's default timeseries behavior, minus its sum-is-0
     quirk): count/hll -> 0, sum/min/max -> NULL."""
     return {name: (np.array([0], dtype=np.int64)
-                   if kind in ("count", "hll") else np.array([np.nan]))
+                   if kind in ("count", "hll", "theta")
+                   else np.array([np.nan]))
             for name, kind in kinds_by_name.items()}
 
 
@@ -600,7 +603,7 @@ def plan_aggregation(a: S.AggregationSpec, ds: Datasource) -> AggPlan:
     elif a.field is not None:
         cols.add(a.field)
         ck = ds.column_kind(a.field)
-        if a.kind == "anyvalue" or kind == "hll":
+        if a.kind == "anyvalue" or kind in ("hll", "theta"):
             is_int, maxabs = _col_bounds(ds, a.field)
             if ck == ColumnKind.DOUBLE:
                 is_int = False
@@ -789,7 +792,7 @@ class QueryEngine:
             C.wave_budget_bytes(self.config), self.config, n_keys,
             len(agg_plans))
         s_pad = spw if n_waves > 1 else _pad_segments(len(seg_idx), n_dev)
-        hll_plans = [p for p in agg_plans if p.kind == "hll"]
+        sketch_plans = [p for p in agg_plans if p.kind in ("hll", "theta")]
 
         # --- build / fetch program -------------------------------------------
         sig = ("agg", ds.name, id(ds), repr(q), s_pad, ds.padded_rows,
@@ -816,11 +819,11 @@ class QueryEngine:
             out = unpack(prog_fn(dev_arrays))
             if t0 is not None:
                 self._stage_check(q, t0)  # post-device boundary
-            finals = _finals_from_out(out, routes, n_keys, hll_plans)
+            finals = _finals_from_out(out, routes, n_keys, sketch_plans)
         else:
             finals = self._run_waves(q, ds, names, seg_idx, spw, sharded,
                                      prog_fn, unpack, routes, n_keys,
-                                     hll_plans, t0)
+                                     sketch_plans, t0)
 
         # --- decode -----------------------------------------------------------
         rows = finals["__rows__"]
@@ -841,9 +844,10 @@ class QueryEngine:
                 columns.append(p.output_name)
         for p in agg_plans:
             name = p.spec.name
-            if p.kind == "hll":
+            if p.kind in ("hll", "theta"):
                 regs = finals[name]
-                est = HLL.estimate(regs)[sel]
+                est = (HLL.estimate(regs) if p.kind == "hll"
+                       else TH.estimate(regs))[sel]
                 data[name] = np.round(est).astype(np.int64)
                 columns.append(name)
                 continue
@@ -913,7 +917,7 @@ class QueryEngine:
         on host. Table overflow retries at 4x slots, then falls back.
         ≈ Druid groupBy v2 never refusing on cardinality
         (DruidQuerySpec.scala:558-571)."""
-        if any(p.kind == "hll" for p in agg_plans):
+        if any(p.kind in ("hll", "theta") for p in agg_plans):
             raise EngineFallback(
                 "approximate count-distinct over hashed group-by")
         cards = [p.card for p in dim_plans]
@@ -973,7 +977,7 @@ class QueryEngine:
             partials, unresolved = [], 0
 
             def bind(i):
-                return {k: jax.device_put(
+                return {k: _device_put_retry(
                     _build_array_checked(ds, k, wave_segs[i], s_pad),
                     sharding) for k in names}
 
@@ -1075,7 +1079,7 @@ class QueryEngine:
         return jax.jit(smfn)
 
     def _run_waves(self, q, ds, names, seg_idx, spw, sharded, prog_fn,
-                   unpack, routes, n_keys, hll_plans, t0):
+                   unpack, routes, n_keys, sketch_plans, t0):
         """Execute the scan in bounded segment waves (double-buffered: the
         next wave's host->device transfer overlaps the current wave's
         compute), merging each wave's [K] finals on host. ≈ the reference's
@@ -1088,7 +1092,7 @@ class QueryEngine:
 
         def bind(w):
             # no caching: wave mode exists because the scan exceeds HBM
-            return {k: jax.device_put(
+            return {k: _device_put_retry(
                 _build_array_checked(ds, k, w, spw), sharding)
                     for k in names}
 
@@ -1100,9 +1104,9 @@ class QueryEngine:
             bufs = prog_fn(cur)            # async dispatch
             nxt = bind(wave_segs[i + 1]) if i + 1 < len(wave_segs) else None
             out = unpack(bufs)             # blocks on the device round-trip
-            f = _finals_from_out(out, routes, n_keys, hll_plans)
+            f = _finals_from_out(out, routes, n_keys, sketch_plans)
             finals = f if finals is None \
-                else _merge_wave_finals(finals, f, routes)
+                else _merge_wave_finals(finals, f, routes, sketch_plans)
             cur = nxt
         return finals
 
@@ -1150,7 +1154,7 @@ class QueryEngine:
         the '__rows__' group-occupancy count."""
         metas = [G.AggInput(p.spec.name, p.kind, is_int=p.is_int,
                             maxabs=p.maxabs)
-                 for p in agg_plans if p.kind != "hll"]
+                 for p in agg_plans if p.kind not in ("hll", "theta")]
         metas.append(G.AggInput("__rows__", "count", is_int=True, maxabs=1.0))
         return G.plan_routes(metas, n_keys,
                              self.config.get(GROUPBY_MATMUL_MAX_KEYS))
@@ -1188,7 +1192,9 @@ class QueryEngine:
         pallas_max = self.config.get(GROUPBY_PALLAS_MAX_KEYS)
         log2m = self.config.get(HLL_LOG2M)
         hll_plans = [p for p in agg_plans if p.kind == "hll"]
-        dense_plans = [p for p in agg_plans if p.kind != "hll"]
+        theta_plans = [p for p in agg_plans if p.kind == "theta"]
+        dense_plans = [p for p in agg_plans
+                       if p.kind not in ("hll", "theta")]
 
         def core(arrays):
             ctx = ScanContext(ds, arrays, min_day, max_day,
@@ -1221,6 +1227,11 @@ class QueryEngine:
                 m = base if am is None else (base & am)
                 out[p.spec.name] = HLL.hll_registers(
                     key, m, vals, n_keys, log2m)
+            for p in theta_plans:
+                vals = p.build_values(ctx)
+                am = p.build_mask(ctx)
+                m = base if am is None else (base & am)
+                out[p.spec.name] = TH.theta_registers(key, m, vals, n_keys)
             return out
 
         return core
@@ -1242,7 +1253,9 @@ class QueryEngine:
         core = self._make_core(ds, dim_plans, agg_plans, filter_spec,
                                intervals, min_day, max_day, n_keys, routes)
         hll_plans = [p for p in agg_plans if p.kind == "hll"]
-        dense_plans = [p for p in agg_plans if p.kind != "hll"]
+        theta_plans = [p for p in agg_plans if p.kind == "theta"]
+        dense_plans = [p for p in agg_plans
+                       if p.kind not in ("hll", "theta")]
         log2m = self.config.get(HLL_LOG2M)
         m = 1 << log2m
         x64 = G._x64()
@@ -1257,6 +1270,8 @@ class QueryEngine:
         for oname, size, dt in r.outputs(n_keys):
             meta.append((oname, size, dt, r.merged))
         meta += [(p.spec.name, n_keys * m, "i32", True) for p in hll_plans]
+        meta += [(p.spec.name, n_keys * TH.K_LANES,
+                  "f64" if x64 else "f32", True) for p in theta_plans]
         merged_meta = [t for t in meta if t[3]]
         perchip_meta = [t for t in meta if not t[3]]
         buf_dtype = jnp.int64 if x64 else jnp.int32
@@ -1291,12 +1306,16 @@ class QueryEngine:
 
             def sharded_core(arrays):
                 out = core(arrays)
+                sk_names = {p.spec.name for p in hll_plans} \
+                    | {p.spec.name for p in theta_plans}
                 dense_out = {k: v for k, v in out.items()
-                             if not any(k == p.spec.name
-                                        for p in hll_plans)}
+                             if k not in sk_names}
                 merged = G.merge_partials(dense_out, routes, SEGMENT_AXIS)
                 for p in hll_plans:
                     merged[p.spec.name] = HLL.merge_registers(
+                        out[p.spec.name], SEGMENT_AXIS)
+                for p in theta_plans:
+                    merged[p.spec.name] = TH.merge_registers(
                         out[p.spec.name], SEGMENT_AXIS)
                 return pack(merged)
 
@@ -1329,6 +1348,9 @@ class QueryEngine:
                 if any(oname == p.spec.name for p in hll_plans):
                     chunk = np.rint(chunk).astype(np.int32) \
                         .reshape(n_keys, m)
+                elif any(oname == p.spec.name for p in theta_plans):
+                    chunk = np.asarray(chunk, np.float32) \
+                        .reshape(n_keys, TH.K_LANES)
                 out[oname] = chunk
             if perchip_len:
                 chips = uflat.reshape(-1, perchip_len)
@@ -1377,7 +1399,13 @@ class QueryEngine:
             if not cand:
                 continue
             codes = dim.codes
-            sub = codes[mask] if mask is not None else codes
+            eff = mask if mask is not None \
+                else np.ones(len(codes), dtype=bool)
+            if dim.validity is not None:
+                # NULL rows are encoded at code 0; they are not occurrences
+                # of dictionary[0]
+                eff = eff & dim.validity
+            sub = codes[eff]
             counts = np.bincount(sub, minlength=dim.cardinality)
             for c in cand:
                 if counts[c] > 0:
@@ -1388,6 +1416,14 @@ class QueryEngine:
             dims_out = dims_out[: q.limit]
             vals_out = vals_out[: q.limit]
             counts_out = counts_out[: q.limit]
+        self.last_stats.update({"datasource": ds.name,
+                                "search_values": len(vals_out)})
+        if q.value_output is not None:
+            # rewritten from a group-by: project to its output shape
+            return QueryResult(
+                [q.value_output, q.count_output],
+                {q.value_output: np.array(vals_out, dtype=object),
+                 q.count_output: np.array(counts_out, dtype=np.int64)})
         return QueryResult(
             ["dimension", "value", "count"],
             {"dimension": np.array(dims_out, dtype=object),
@@ -1447,7 +1483,7 @@ class QueryEngine:
                     dev = self._device_arrays.get(key)
                     if dev is None:
                         host = _build_array_checked(ds, k, seg_idx, s_pad)
-                        dev = jax.device_put(host, sharding)
+                        dev = _device_put_retry(host, sharding)
                         self._device_arrays[key] = dev
             out[k] = dev
         return out
@@ -1455,6 +1491,21 @@ class QueryEngine:
     def clear_caches(self):
         self._programs.clear()
         self._device_arrays.clear()
+
+
+def _device_put_retry(host, sharding=None):
+    """device_put with backoff on transient backend errors — the tunneled
+    TPU's transfers can hiccup with UNAVAILABLE (≈ the reference wrapping
+    Druid HTTP calls in RetryUtils.retryOnError)."""
+    from spark_druid_olap_tpu.utils.retry import retry_on_error
+
+    def transient(e):
+        s = str(e)
+        return "UNAVAILABLE" in s or "DEADLINE_EXCEEDED" in s \
+            or "RESOURCE_EXHAUSTED" in s
+
+    return retry_on_error(lambda: jax.device_put(host, sharding),
+                          tries=3, start=0.5, retryable=transient)
 
 
 def _build_array_checked(ds, key, seg_idx, s_pad) -> np.ndarray:
@@ -1560,23 +1611,27 @@ def _merge_hash_partials(parts, routes):
     return uniq, merged
 
 
-def _finals_from_out(out, routes, n_keys, hll_plans):
+def _finals_from_out(out, routes, n_keys, sketch_plans):
     """Route outputs -> exact final [n_keys] arrays per aggregation (plus
-    raw HLL registers), the unit that waves merge over."""
+    raw sketch registers), the unit that waves merge over."""
     finals = {name: np.asarray(G.combine_route(r, out, n_keys))
               for name, r in routes.items()}
-    for p in hll_plans:
+    for p in sketch_plans:
         finals[p.spec.name] = np.asarray(out[p.spec.name])
     return finals
 
 
-def _merge_wave_finals(acc, new, routes):
+def _merge_wave_finals(acc, new, routes, sketch_plans=()):
     """Cross-wave merge: sums/counts add exactly (i64 or f64 finals), min/max
-    keep their empty-group sentinels, HLL registers take elementwise max."""
+    keep their empty-group sentinels, sketch registers take their union
+    (HLL: elementwise max; theta k-mins: elementwise min)."""
+    theta_names = {p.spec.name for p in sketch_plans
+                   if p.kind == "theta"}
     for name, v in new.items():
         r = routes.get(name)
-        if r is None:                       # HLL registers
-            acc[name] = np.maximum(acc[name], v)
+        if r is None:                       # sketch registers
+            acc[name] = np.minimum(acc[name], v) if name in theta_names \
+                else np.maximum(acc[name], v)
         elif r.kind == "min":
             acc[name] = np.minimum(acc[name], v)
         elif r.kind == "max":

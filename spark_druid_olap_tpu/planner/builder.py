@@ -455,6 +455,19 @@ class Builder:
         if key in self._agg_by_call:
             return self._agg_by_call[key]
         name = self._agg_output_name(call)
+        if call.fn == "sum" and isinstance(call.arg, E.Literal) \
+                and isinstance(call.arg.value, (int, float)) \
+                and not isinstance(call.arg.value, bool) \
+                and not call.distinct:
+            # sum(lit) == count(*) * lit (≈ SumOfLiteralRewrite,
+            # DruidLogicalOptimizer.scala:245-302)
+            c = self.fresh("cnt")
+            self._register_agg(E.AggCall("count", None), c)
+            self._post[name] = S.PostAggregationSpec(
+                name, E.BinaryOp("*", E.Column(c), call.arg))
+            self.hidden.add(c)
+            self._agg_by_call[key] = name
+            return name
         if call.fn == "avg":
             s = self.fresh("sum")
             c = self.fresh("cnt")
@@ -472,6 +485,13 @@ class Builder:
                 self._agg_by_call[key] = name
                 return name
             self._plan_exact_distinct(call, name)
+            self._agg_by_call[key] = name
+            return name
+        if call.fn == "theta":
+            if not isinstance(call.arg, E.Column):
+                raise PlanUnsupported("theta sketch over expression")
+            self._aggs[name] = S.AggregationSpec("thetasketch", name,
+                                                 field=call.arg.name)
             self._agg_by_call[key] = name
             return name
         if call.distinct:

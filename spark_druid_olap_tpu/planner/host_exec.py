@@ -749,10 +749,13 @@ def _compute_agg(series_env, df, call: E.AggCall, ctx, outer_env, group_ids,
         out = s.groupby(g).max()
     elif call.fn == "avg":
         out = s.groupby(g).mean()
+    elif call.fn == "theta":
+        # theta-sketch-class approx distinct: the host tier computes exact
+        out = s.dropna().groupby(g).nunique()
     else:
         raise HostExecError(f"aggregate {call.fn}")
     full = out.reindex(range(n_groups))
-    if call.fn == "count":
+    if call.fn in ("count", "theta"):
         # keep counts integer: fillna promotes to float64
         full = full.fillna(0).astype(np.int64)
     return full.to_numpy()

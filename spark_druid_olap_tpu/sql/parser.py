@@ -583,6 +583,8 @@ class Parser:
             return E.AggCall(lname, args[0], distinct=distinct)
         if lname in ("approx_count_distinct", "approx_distinct"):
             return E.AggCall("count", args[0], distinct=True, approx=True)
+        if lname in ("approx_count_distinct_theta", "theta_sketch"):
+            return E.AggCall("theta", args[0])
         return E.Func(lname, tuple(args))
 
 

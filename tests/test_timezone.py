@@ -146,3 +146,19 @@ def test_where_expression_uses_local_time(tz_ctx, local):
     want = int(grouped.set_index("m").loc[6, "n"])
     assert int(got["n"][0]) == want
     assert want == int((local.lts.dt.month == 6).sum())
+
+
+def test_between_matches_comparison_forms(tz_ctx, local):
+    # BETWEEN (native bound filter) and >=/<= (interval path) must agree on
+    # local-midnight literal semantics even inside an OR
+    a = int(tz_ctx.sql("select count(*) as n from ev where "
+                       "ts between date '2019-06-01' and date '2019-06-30' "
+                       "or g = 'zz'").to_pandas().n[0])
+    b = int(tz_ctx.sql("select count(*) as n from ev where "
+                       "(ts >= date '2019-06-01' and "
+                       " ts <= date '2019-06-30') or g = 'zz'")
+            .to_pandas().n[0])
+    assert a == b
+    want = int(((local.lts >= pd.Timestamp("2019-06-01"))
+                & (local.lts <= pd.Timestamp("2019-06-30"))).sum())
+    assert a == want
