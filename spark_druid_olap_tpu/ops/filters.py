@@ -281,6 +281,12 @@ def interval_mask(intervals, ctx: ScanContext):
     for lo, hi in intervals:
         dlo, rlo = divmod(int(lo), time_ops.MILLIS_PER_DAY)
         dhi, rhi = divmod(int(hi), time_ops.MILLIS_PER_DAY)
+        # open-ended interval bounds carry +-2^63-scale ms; their day
+        # numbers overflow the i32 lanes on a 32-bit backend. Scanned days
+        # all lie in [min_day, max_day], so clamping one day past that
+        # range preserves the mask exactly.
+        dlo = min(max(dlo, ctx.min_day - 1), ctx.max_day + 1)
+        dhi = min(max(dhi, ctx.min_day - 1), ctx.max_day + 1)
         m_lo = (days > dlo) | ((days == dlo) & (ms >= rlo))
         m_hi = (days < dhi) | ((days == dhi) & (ms < rhi))
         m = m_lo & m_hi

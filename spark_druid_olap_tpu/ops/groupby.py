@@ -653,6 +653,65 @@ def _limb_scatter_sum(values, key, n_keys: int):
     return stacked[:n_keys].reshape(-1)
 
 
+def route_score(route: Route, out: Dict[str, object], n_keys: int,
+                axis_name: Optional[str] = None):
+    """Device-side per-key value of one aggregation reconstructed from its
+    route outputs — the *selection* score for top-k epilogues.
+
+    Exact for f64/i64/i32/f32 routes; f32-rounded (~1e-7 relative) for the
+    split-representation routes (ff pairs, byte lanes, 16-bit limbs). The
+    final ordering of the selected candidates is still done with the exact
+    host combine, so rounding here only affects which keys make the
+    candidate set — callers add slack beyond the requested limit. Inside
+    shard_map pass ``axis_name``: per-chip partial routes (merged=False)
+    are psum'd to the global value; merged routes are already global.
+    """
+    t = route.tag
+    if t in ("f64", "i64"):
+        return out[route.name].astype(
+            jnp.float64 if _x64() else jnp.float32)
+    if t == "ff":
+        v = out[route.name + ".acc"] + out[route.name + ".c"]
+    elif t == "lanes":
+        acc = out[route.name + ".acc"].reshape(n_keys, route.n_lanes)
+        c = out[route.name + ".c"].reshape(n_keys, route.n_lanes)
+        scale = jnp.float32(256.0) ** jnp.arange(
+            route.n_lanes, dtype=jnp.float32)
+        v = ((acc + c) * scale[None, :]).sum(axis=1)
+    elif t == "limbs":
+        limbs = out[route.name + ".limbs"].reshape(n_keys, N_LIMBS) \
+            .astype(jnp.float32)
+        scale = jnp.float32(65536.0) ** jnp.arange(
+            N_LIMBS, dtype=jnp.float32)
+        v = (limbs * scale[None, :]).sum(axis=1)
+    elif t == "i32":
+        v = out[route.name].astype(jnp.float32)
+    else:
+        v = out[route.name]
+    if axis_name is not None and not route.merged:
+        v = jax.lax.psum(v, axis_name)
+    return v
+
+
+def route_null_mask(route: Route, out: Dict[str, object]):
+    """Device bool mask of keys whose min/max metric is NULL (the
+    empty-group sentinel survived: every contributing row was masked by
+    the per-agg filter). None for sum/count routes (their NULL identity is
+    0 — indistinguishable from a true zero sum by design)."""
+    if route.kind not in ("min", "max"):
+        return None
+    v = out[route.name]
+    if route.tag == "i32":
+        sent = I32_MAX if route.kind == "min" else I32_MIN
+    elif route.tag == "i64":
+        sent = I64_MAX if route.kind == "min" else I64_MIN
+    elif route.tag == "f64":
+        sent = jnp.inf if route.kind == "min" else -jnp.inf
+    else:
+        sent = F32_MAX if route.kind == "min" else -F32_MAX
+    return v == sent
+
+
 def merge_partials(partials: Dict[str, object], routes: Dict[str, Route],
                    axis_name: str) -> Dict[str, object]:
     """Cross-chip merge of per-chip partials via ICI collectives (inside

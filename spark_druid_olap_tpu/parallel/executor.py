@@ -63,6 +63,7 @@ from spark_druid_olap_tpu.utils.config import (
     GROUPBY_MATMUL_MAX_KEYS,
     GROUPBY_PALLAS_MAX_KEYS,
     HLL_LOG2M,
+    TOPN_DEVICE_MIN_KEYS,
 )
 
 
@@ -793,10 +794,13 @@ class QueryEngine:
             len(agg_plans))
         s_pad = spw if n_waves > 1 else _pad_segments(len(seg_idx), n_dev)
         sketch_plans = [p for p in agg_plans if p.kind in ("hll", "theta")]
+        topk = self._plan_device_topk(limit, having, agg_plans, n_keys,
+                                      n_waves) if n_waves == 1 else None
+        n_out = topk[1] if topk else n_keys
 
         # --- build / fetch program -------------------------------------------
         sig = ("agg", ds.name, id(ds), repr(q), s_pad, ds.padded_rows,
-               min_day, max_day, sharded, n_dev, tuple(names),
+               min_day, max_day, sharded, n_dev, tuple(names), topk,
                self.config.get(TZ_ID),
                jax.default_backend(), bool(jax.config.jax_enable_x64))
         # double-checked: warm queries never touch the lock
@@ -808,10 +812,11 @@ class QueryEngine:
                     prog = self._build_agg_program(
                         ds, all_dim_plans, agg_plans, filter_spec,
                         intervals, min_day, max_day, n_keys, sharded,
-                        routes)
+                        routes, topk=topk)
                     self._programs[sig] = prog
 
         prog_fn, unpack = prog
+        top_idx = None
         if n_waves == 1:
             dev_arrays = self._bind_arrays(ds, names, seg_idx, s_pad, sharded)
             if t0 is not None:
@@ -819,7 +824,9 @@ class QueryEngine:
             out = unpack(prog_fn(dev_arrays))
             if t0 is not None:
                 self._stage_check(q, t0)  # post-device boundary
-            finals = _finals_from_out(out, routes, n_keys, sketch_plans)
+            finals = _finals_from_out(out, routes, n_out, sketch_plans)
+            if topk:
+                top_idx = np.asarray(out["__topk_idx__"]).astype(np.int64)
         else:
             finals = self._run_waves(q, ds, names, seg_idx, spw, sharded,
                                      prog_fn, unpack, routes, n_keys,
@@ -838,7 +845,8 @@ class QueryEngine:
         data: Dict[str, np.ndarray] = {}
         columns: List[str] = []
         if all_dim_plans:
-            code_lists = G.unfuse_key(sel, cards)
+            key_ids = top_idx[sel] if top_idx is not None else sel
+            code_lists = G.unfuse_key(key_ids, cards)
             for p, codes in zip(all_dim_plans, code_lists):
                 data[p.output_name] = p.decode(codes)
                 columns.append(p.output_name)
@@ -867,8 +875,43 @@ class QueryEngine:
             "datasource": ds.name, "segments": int(len(seg_idx)),
             "sharded": sharded, "groups": int(len(sel)),
             "rows_scanned": int(ds.num_rows), "waves": int(n_waves),
-            "segments_per_wave": int(spw)})
+            "segments_per_wave": int(spw),
+            "topk_device": int(topk[1]) if topk else 0})
         return QueryResult(columns, data)
+
+    def _plan_device_topk(self, limit, having, agg_plans, n_keys, n_waves):
+        """Decide whether the ordered-limit epilogue can run on device:
+        select ``k_sel`` candidate keys by an f32 score over the merged
+        partials (ops.groupby.route_score) and transfer only those rows.
+        ≈ Druid's topN engine (per-key-space top-k on the data node instead
+        of shipping the full groupBy result to the broker). Returns
+        (metric, k_sel, ascending) or None.
+
+        The candidate *selection* is f32-approximate with ``k_sel - limit``
+        slack; the final ordering of candidates is exact (host combine).
+        NULL-metric groups: min/max sentinels are detected on device and
+        ranked after every real score (nulls-last, matching the host
+        epilogue); a NULL *sum* scores as 0 (indistinguishable from a true
+        zero), so it can displace a candidate only when the true top-k
+        sits below 0 AND >slack NULL-sum groups exist — still tighter
+        than Druid's documented topN approximation.
+        Skipped under HAVING (it may filter an unbounded prefix) and in
+        wave mode (waves merge by key; candidate sets differ per wave)."""
+        if having is not None or limit is None or limit.limit is None:
+            return None
+        if len(limit.columns) != 1:
+            return None
+        if n_keys < self.config.get(TOPN_DEVICE_MIN_KEYS):
+            return None
+        oc = limit.columns[0]
+        dense = {p.spec.name for p in agg_plans
+                 if p.kind not in ("hll", "theta")}
+        if oc.name not in dense:
+            return None
+        k_sel = int(min(n_keys, max(2 * limit.limit, limit.limit + 64)))
+        if k_sel * 4 >= n_keys:
+            return None              # full transfer is already cheap
+        return (oc.name, k_sel, bool(oc.ascending))
 
     def _agg_epilogue(self, data, columns, post_aggregations, having, limit):
         """Host epilogue shared by the dense and hashed agg paths: post
@@ -1238,7 +1281,7 @@ class QueryEngine:
 
     def _build_agg_program(self, ds, dim_plans, agg_plans, filter_spec,
                            intervals, min_day, max_day, n_keys, sharded,
-                           routes):
+                           routes, topk=None):
         """Returns (jit_fn, unpack).
 
         The program packs outputs into TWO flat device buffers so the host
@@ -1249,6 +1292,16 @@ class QueryEngine:
         exactly in f64 on host, ≈ the reference's historical-mode
         Spark-side final aggregate). Packing is dtype-faithful: on f32
         backends floats travel bitcast inside an i32 buffer, never rounded.
+
+        With ``topk=(metric, k_sel, ascending)`` a device top-k epilogue
+        runs after the merge: candidate keys are selected by f32 score
+        (``ops.groupby.route_score``), every output is gathered at those
+        indices, and only ``[k_sel]``-sized buffers (plus the index map
+        ``__topk_idx__``) travel to host — the TPU analog of Druid's topN
+        engine answering from the data node instead of shipping the full
+        groupBy result (reference rewrite gate:
+        ``QuerySpecTransforms.scala`` topN + ``DruidQueryCostModel``
+        topN threshold).
         """
         core = self._make_core(ds, dim_plans, agg_plans, filter_spec,
                                intervals, min_day, max_day, n_keys, routes)
@@ -1259,22 +1312,58 @@ class QueryEngine:
         log2m = self.config.get(HLL_LOG2M)
         m = 1 << log2m
         x64 = G._x64()
+        n_out = topk[1] if topk else n_keys
 
-        # (out_name, flat_len, dtype_str, merged)
+        # (out_name, flat_len, dtype_str, merged) — flat_len is the PACKED
+        # length (after the top-k gather when enabled); the per-key group
+        # width is flat_len // n_out, identical pre-gather with n_keys.
         meta = []
         for p in dense_plans:
             r = routes[p.spec.name]
-            for oname, size, dt in r.outputs(n_keys):
+            for oname, size, dt in r.outputs(n_out):
                 meta.append((oname, size, dt, r.merged))
         r = routes["__rows__"]
-        for oname, size, dt in r.outputs(n_keys):
+        for oname, size, dt in r.outputs(n_out):
             meta.append((oname, size, dt, r.merged))
-        meta += [(p.spec.name, n_keys * m, "i32", True) for p in hll_plans]
-        meta += [(p.spec.name, n_keys * TH.K_LANES,
+        meta += [(p.spec.name, n_out * m, "i32", True) for p in hll_plans]
+        meta += [(p.spec.name, n_out * TH.K_LANES,
                   "f64" if x64 else "f32", True) for p in theta_plans]
+        if topk:
+            meta.append(("__topk_idx__", n_out, "i32", True))
         merged_meta = [t for t in meta if t[3]]
         perchip_meta = [t for t in meta if not t[3]]
         buf_dtype = jnp.int64 if x64 else jnp.int32
+
+        def topk_gather(out, axis_name=None):
+            """Select k_sel candidate keys by score, gather every output."""
+            metric, k_sel, ascending = topk
+            rows_sc = G.route_score(routes["__rows__"], out, n_keys,
+                                    axis_name)
+            sc = G.route_score(routes[metric], out, n_keys, axis_name)
+            if ascending:
+                sc = -sc
+            # Rank order must match the host epilogue's: real scores,
+            # then occupied groups whose metric is NULL (min/max sentinel
+            # — under negation it would otherwise rank FIRST), then
+            # unoccupied keys at -inf (so NULL-metric groups still fill
+            # an under-subscribed LIMIT, nulls-last).
+            null_m = G.route_null_mask(routes[metric], out)
+            if null_m is not None:
+                big = jnp.finfo(sc.dtype).max
+                sc = jnp.where(null_m, jnp.asarray(-big, sc.dtype), sc)
+            sc = jnp.where(rows_sc > 0.5, sc, jnp.asarray(-jnp.inf,
+                                                          sc.dtype))
+            _, idx = jax.lax.top_k(sc, k_sel)
+            idx = idx.astype(jnp.int32)
+            g = {"__topk_idx__": idx}
+            for name, arr in out.items():
+                flat = arr.reshape(-1)
+                width = flat.shape[0] // n_keys
+                if width == 1:
+                    g[name] = flat[idx]
+                else:
+                    g[name] = flat.reshape(n_keys, width)[idx].reshape(-1)
+            return g
 
         def pack_group(out, metas):
             parts = []
@@ -1300,7 +1389,13 @@ class QueryEngine:
                 pack_group(out, perchip_meta)
 
         if not sharded:
-            fn = jax.jit(lambda arrays: pack(core(arrays)))
+            def plain(arrays):
+                out = core(arrays)
+                if topk:
+                    out = topk_gather(out)
+                return pack(out)
+
+            fn = jax.jit(plain)
         else:
             mesh = self.mesh
 
@@ -1317,6 +1412,8 @@ class QueryEngine:
                 for p in theta_plans:
                     merged[p.spec.name] = TH.merge_registers(
                         out[p.spec.name], SEGMENT_AXIS)
+                if topk:
+                    merged = topk_gather(merged, SEGMENT_AXIS)
                 return pack(merged)
 
             smfn = jax.shard_map(sharded_core, mesh=mesh,
@@ -1347,10 +1444,10 @@ class QueryEngine:
                 off += size
                 if any(oname == p.spec.name for p in hll_plans):
                     chunk = np.rint(chunk).astype(np.int32) \
-                        .reshape(n_keys, m)
+                        .reshape(n_out, m)
                 elif any(oname == p.spec.name for p in theta_plans):
                     chunk = np.asarray(chunk, np.float32) \
-                        .reshape(n_keys, TH.K_LANES)
+                        .reshape(n_out, TH.K_LANES)
                 out[oname] = chunk
             if perchip_len:
                 chips = uflat.reshape(-1, perchip_len)

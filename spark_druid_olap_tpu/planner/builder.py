@@ -460,11 +460,15 @@ class Builder:
                 and not isinstance(call.arg.value, bool) \
                 and not call.distinct:
             # sum(lit) == count(*) * lit (≈ SumOfLiteralRewrite,
-            # DruidLogicalOptimizer.scala:245-302)
+            # DruidLogicalOptimizer.scala:245-302); over zero rows SQL's
+            # SUM is NULL, not 0, so guard on the count
             c = self.fresh("cnt")
             self._register_agg(E.AggCall("count", None), c)
             self._post[name] = S.PostAggregationSpec(
-                name, E.BinaryOp("*", E.Column(c), call.arg))
+                name, E.Case(
+                    ((E.Comparison("=", E.Column(c), E.Literal(0)),
+                      E.Literal(float("nan"))),),
+                    E.BinaryOp("*", E.Column(c), call.arg)))
             self.hidden.add(c)
             self._agg_by_call[key] = name
             return name

@@ -751,7 +751,8 @@ def _compute_agg(series_env, df, call: E.AggCall, ctx, outer_env, group_ids,
         out = s.groupby(g).mean()
     elif call.fn == "theta":
         # theta-sketch-class approx distinct: the host tier computes exact
-        out = s.dropna().groupby(g).nunique()
+        # (nunique already excludes nulls, like the count-distinct branch)
+        out = s.groupby(g).nunique()
     else:
         raise HostExecError(f"aggregate {call.fn}")
     full = out.reindex(range(n_groups))

@@ -137,6 +137,12 @@ GROUPBY_HASH_MAX_SLOTS = _entry(
     "what this table can hold falls back to the host tier (reference "
     "contract: Druid groupBy v2 spills, never refuses — "
     "DruidQuerySpec.scala:558-571).")
+TOPN_DEVICE_MIN_KEYS = _entry(
+    "sdot.engine.topn.device.min.keys", 8192,
+    "Min fused key cardinality before an ordered-limit group-by / topN "
+    "runs its top-k selection on device (lax.top_k over the merged "
+    "partials, transferring only the candidate rows). Below it the full "
+    "[K] result transfers and the host sorts (cheap at small K).")
 WAVE_MAX_BYTES = _entry(
     "sdot.engine.wave.max.bytes", 0,
     "Per-device byte budget for one execution wave's scan arrays; a scan "

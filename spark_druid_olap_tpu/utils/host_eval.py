@@ -214,6 +214,10 @@ def eval_expr(e: E.Expr, env: dict):
         out = otherwise
         for c, v in reversed(e.branches):
             cond = eval_expr(c, env)
+            if not np.any(cond):
+                # dead branch: skip so e.g. a NaN (SQL NULL) arm doesn't
+                # promote an integer result to float64 when no row hits it
+                continue
             val = eval_expr(v, env)
             out = np.where(cond, val, out)
         return out

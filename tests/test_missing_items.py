@@ -43,6 +43,16 @@ def test_sum_of_literal_rewrite(ctx, sales):
     assert (got["s"] == 3 * got["n"]).all()
 
 
+def test_sum_of_literal_zero_rows_is_null(ctx, sales):
+    # SQL: SUM over zero rows is NULL, never 0 — the rewrite must not leak
+    # count's 0 identity through the count*lit post-agg
+    got = ctx.sql("select sum(3) as s from sales "
+                  "where region = 'nosuch'").to_pandas()
+    assert len(got) == 1
+    v = got["s"][0]
+    assert v is None or (isinstance(v, float) and np.isnan(v))
+
+
 def test_sum_of_float_literal(ctx, sales):
     got = ctx.sql("select sum(0.5) as s, count(*) as n from sales") \
         .to_pandas()

@@ -166,6 +166,29 @@ def test_filtered_agg_exact(no_x64, big_store, big_df):
     assert int(got["s_big"][0]) == want
 
 
+def test_open_interval_no_i32_overflow(no_x64, big_store, big_df):
+    """An open-ended time interval carries a +-2^63-scale ms bound whose
+    day number overflows i32 lanes unless interval_mask clamps it to the
+    scan's day range (TPU SF1 q3 regression: 'l_shipdate > date X' =>
+    interval (X, +inf))."""
+    lo = int(np.datetime64("2019-03-01").astype("datetime64[ms]")
+             .astype(np.int64))
+    for hi in (2**62, 2**63 - 1):
+        eng = QueryEngine(big_store)
+        r = eng.execute(_spec(intervals=((lo, hi),)))
+        got = r.to_pandas()
+        sub = big_df[big_df.ts >= np.datetime64("2019-03-01")]
+        want = _oracle(sub)
+        got = got.sort_values("g").reset_index(drop=True)
+        np.testing.assert_array_equal(
+            got["s_big"].to_numpy().astype(np.int64),
+            want.sort_values("g")["s_big"].to_numpy())
+    # empty interval entirely above the data
+    eng = QueryEngine(big_store)
+    r = eng.execute(_spec(intervals=((2**62, 2**62 + 1),)))
+    assert len(r.to_pandas()) == 0
+
+
 def test_case_expression_sum_exact(no_x64, big_store, big_df):
     # sum(case when g='a' then big else 0 end): _expr_bounds must mark the
     # expression integer-exact so the lanes route fires

@@ -1,0 +1,294 @@
+"""Device top-k epilogue tests (reference: Druid's topN engine — the data
+node answers ordered-limit queries with per-key-space top-k instead of
+shipping the full groupBy result to the broker; rewrite gate
+``QuerySpecTransforms.scala`` topN + ``DruidQueryCostModel`` topN
+threshold).
+
+The TPU analog selects ``k_sel`` candidate keys ON DEVICE by an f32 score
+over the merged partials (``ops.groupby.route_score`` + ``lax.top_k``) and
+transfers only those rows; the final ordering of candidates uses the exact
+host combine. Differential against pandas with EXACT assertions — the
+slack (k_sel >= 2*limit) makes selection exact for these distributions.
+"""
+
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_druid_olap_tpu.ir.spec import (
+    AggregationSpec, DimensionSpec, GroupByQuerySpec, LimitSpec,
+    OrderByColumn, SelectorFilter, TopNQuerySpec,
+)
+from spark_druid_olap_tpu.parallel.executor import QueryEngine
+from spark_druid_olap_tpu.parallel.mesh import make_mesh
+from spark_druid_olap_tpu.segment.ingest import ingest_dataframe
+from spark_druid_olap_tpu.segment.store import SegmentStore
+from spark_druid_olap_tpu.utils.config import Config
+
+N = 60_000
+N_CUST = 12_000          # above sdot.engine.topn.device.min.keys (8192)
+
+
+def _df():
+    rng = np.random.default_rng(23)
+    return pd.DataFrame({
+        "ts": (np.datetime64("2020-01-01")
+               + rng.integers(0, 365, N).astype("timedelta64[D]"))
+        .astype("datetime64[ns]"),
+        "cust": rng.choice([f"c{i:05d}" for i in range(N_CUST)], N),
+        "region": rng.choice(["east", "west", "north", "south"], N),
+        "qty": rng.integers(1, 100, N).astype(np.int64),
+        # straddles 2^24 so an f32 value round-trip would be caught
+        "big": rng.integers(2**25, 2**40, N),
+        "price": np.round(rng.uniform(1, 500, N), 2),
+    })
+
+
+@pytest.fixture(scope="module")
+def tdf():
+    return _df()
+
+
+@pytest.fixture(scope="module")
+def tstore(tdf):
+    st = SegmentStore()
+    st.register(ingest_dataframe("fact", tdf, time_column="ts",
+                                 target_rows=8192))
+    return st
+
+
+AGGS = (
+    AggregationSpec("longsum", "s_qty", field="qty"),
+    AggregationSpec("longsum", "s_big", field="big"),
+    AggregationSpec("longmax", "mx_big", field="big"),
+    AggregationSpec("doublesum", "s_price", field="price"),
+    AggregationSpec("count", "n"),
+)
+
+
+def _q(metric, limit, ascending=False, dims=("cust",), having=None):
+    return GroupByQuerySpec(
+        datasource="fact",
+        dimensions=tuple(DimensionSpec(d, d) for d in dims),
+        aggregations=AGGS,
+        limit=LimitSpec((OrderByColumn(metric, ascending=ascending),),
+                        limit),
+        having=having)
+
+
+def _want(df, metric, limit, ascending=False, dims=("cust",)):
+    g = df.groupby(list(dims), as_index=False).agg(
+        s_qty=("qty", "sum"), s_big=("big", "sum"), mx_big=("big", "max"),
+        s_price=("price", "sum"), n=("qty", "size"))
+    return g.sort_values(metric, ascending=ascending,
+                         kind="stable").head(limit)
+
+
+def _check(got, want, metric, int_exact=("s_qty", "s_big", "mx_big", "n")):
+    assert len(got) == len(want)
+    # compare the metric COLUMN as an ordered multiset (ties at equal
+    # metric values may legitimately pick different dims rows)
+    np.testing.assert_allclose(
+        np.sort(got[metric].to_numpy().astype(np.float64)),
+        np.sort(want[metric].to_numpy().astype(np.float64)), rtol=1e-6)
+    gs = got.sort_values(list(got.columns)).reset_index(drop=True)
+    ws = want.sort_values(list(got.columns)).reset_index(drop=True)
+    tie_free = len(set(want[metric])) == len(want)
+    if tie_free:
+        for c in int_exact:
+            np.testing.assert_array_equal(
+                gs[c].to_numpy().astype(np.int64), ws[c].to_numpy(),
+                err_msg=f"{c} must be exact")
+
+
+def test_topk_device_engaged(tstore, tdf):
+    eng = QueryEngine(tstore)
+    got = eng.execute(_q("s_big", 10)).to_pandas()
+    assert eng.last_stats["topk_device"] > 0
+    _check(got, _want(tdf, "s_big", 10), "s_big")
+
+
+def test_topk_ascending(tstore, tdf):
+    eng = QueryEngine(tstore)
+    got = eng.execute(_q("s_qty", 15, ascending=True)).to_pandas()
+    assert eng.last_stats["topk_device"] > 0
+    _check(got, _want(tdf, "s_qty", 15, ascending=True), "s_qty")
+
+
+def test_topk_max_metric(tstore, tdf):
+    eng = QueryEngine(tstore)
+    got = eng.execute(_q("mx_big", 12)).to_pandas()
+    assert eng.last_stats["topk_device"] > 0
+    _check(got, _want(tdf, "mx_big", 12), "mx_big")
+
+
+def test_topk_double_metric(tstore, tdf):
+    eng = QueryEngine(tstore)
+    got = eng.execute(_q("s_price", 10)).to_pandas()
+    assert eng.last_stats["topk_device"] > 0
+    _check(got, _want(tdf, "s_price", 10), "s_price")
+
+
+def test_topk_matches_full_sort(tstore):
+    """The device-selected result must equal the same query with the
+    device epilogue disabled (full [K] transfer + host sort)."""
+    q = _q("s_big", 25)
+    eng = QueryEngine(tstore)
+    got = eng.execute(q).to_pandas()
+    assert eng.last_stats["topk_device"] > 0
+    off = QueryEngine(tstore, config=Config(
+        {"sdot.engine.topn.device.min.keys": 1 << 30}))
+    want = off.execute(q).to_pandas()
+    assert off.last_stats["topk_device"] == 0
+    pd.testing.assert_frame_equal(got.reset_index(drop=True),
+                                  want.reset_index(drop=True))
+
+
+def test_topk_sharded(tstore, tdf):
+    eng = QueryEngine(tstore, mesh=make_mesh(), config=Config(
+        {"sdot.querycostmodel.enabled": False}))
+    got = eng.execute(_q("s_big", 10)).to_pandas()
+    assert eng.last_stats["topk_device"] > 0
+    assert eng.last_stats["sharded"] is True
+    _check(got, _want(tdf, "s_big", 10), "s_big")
+
+
+def test_topk_small_k_skips_device(tstore):
+    # limit so large that k_sel*4 >= n_keys — device selection is skipped
+    eng = QueryEngine(tstore)
+    got = eng.execute(_q("s_qty", N_CUST)).to_pandas()
+    assert eng.last_stats["topk_device"] == 0
+    assert len(got) == len(set(_df()["cust"]))
+
+
+def test_topk_having_skips_device(tstore, tdf):
+    from spark_druid_olap_tpu.ir import expr as E
+    from spark_druid_olap_tpu.ir.spec import HavingSpec
+    having_expr = E.Comparison(">", E.Column("s_qty"), E.Literal(100))
+    q = GroupByQuerySpec(
+        datasource="fact",
+        dimensions=(DimensionSpec("cust", "cust"),),
+        aggregations=AGGS,
+        limit=LimitSpec((OrderByColumn("s_qty", ascending=False),), 10),
+        having=HavingSpec(having_expr))
+    eng = QueryEngine(tstore)
+    got = eng.execute(q).to_pandas()
+    assert eng.last_stats["topk_device"] == 0
+    g = tdf.groupby("cust", as_index=False).agg(s_qty=("qty", "sum"))
+    want = g[g.s_qty > 100].sort_values("s_qty", ascending=False).head(10)
+    np.testing.assert_allclose(
+        np.sort(got["s_qty"].to_numpy().astype(np.int64)),
+        np.sort(want["s_qty"].to_numpy()))
+
+
+def test_topn_query_spec(tstore, tdf):
+    """TopNQuerySpec routes through the same device epilogue."""
+    q = TopNQuerySpec(datasource="fact",
+                      dimension=DimensionSpec("cust", "cust"),
+                      metric="s_big", threshold=10, aggregations=AGGS[:4])
+    eng = QueryEngine(tstore)
+    got = eng.execute(q).to_pandas()
+    assert eng.last_stats["topk_device"] > 0
+    _check(got, _want(tdf, "s_big", 10), "s_big",
+           int_exact=("s_qty", "s_big", "mx_big"))
+
+
+def test_topk_filtered_rows(tstore, tdf):
+    q = GroupByQuerySpec(
+        datasource="fact",
+        dimensions=(DimensionSpec("cust", "cust"),),
+        aggregations=AGGS,
+        filter=SelectorFilter("region", "east"),
+        limit=LimitSpec((OrderByColumn("s_qty", ascending=False),), 10))
+    eng = QueryEngine(tstore)
+    got = eng.execute(q).to_pandas()
+    assert eng.last_stats["topk_device"] > 0
+    _check(got, _want(tdf[tdf.region == "east"], "s_qty", 10), "s_qty")
+
+
+def test_topk_null_metric_groups_rank_last(tstore, tdf):
+    """Groups whose min/max metric is NULL (all rows masked by the per-agg
+    filter) must rank AFTER every real score — under ascending order the
+    raw sentinel would otherwise rank first and displace the true top-k."""
+    filt = SelectorFilter("region", "east")
+    aggs = (
+        AggregationSpec("longmax", "mx_east",
+                        field="qty", filter=filt),
+        AggregationSpec("longmin", "mn_east",
+                        field="qty", filter=filt),
+        AggregationSpec("count", "n"),
+    )
+    sub = tdf[tdf.region == "east"]
+    for metric, ascending in (("mx_east", True), ("mn_east", False)):
+        q = GroupByQuerySpec(
+            datasource="fact",
+            dimensions=(DimensionSpec("cust", "cust"),),
+            aggregations=aggs,
+            limit=LimitSpec((OrderByColumn(metric, ascending=ascending),),
+                            10))
+        eng = QueryEngine(tstore)
+        got = eng.execute(q).to_pandas()
+        assert eng.last_stats["topk_device"] > 0
+        agg_fn = "max" if metric == "mx_east" else "min"
+        want = sub.groupby("cust")["qty"].agg(agg_fn).sort_values(
+            ascending=ascending, kind="stable").head(10)
+        assert len(got) == 10
+        vals = got[metric].to_numpy()
+        assert not any(v is None for v in vals), \
+            f"{metric} NULL groups displaced real candidates"
+        np.testing.assert_array_equal(
+            np.sort(vals.astype(np.int64)), np.sort(want.to_numpy()))
+
+
+# -----------------------------------------------------------------------------
+# TPU dtype environment (x64 off): f32 score over ff/lanes/limbs routes
+# -----------------------------------------------------------------------------
+
+@pytest.fixture()
+def no_x64():
+    jax.config.update("jax_enable_x64", False)
+    yield
+    jax.config.update("jax_enable_x64", True)
+
+
+NARROW_AGGS = (
+    AggregationSpec("longsum", "s_qty", field="qty"),
+    AggregationSpec("doublesum", "s_price", field="price"),
+    AggregationSpec("count", "n"),
+)
+
+
+def _q_narrow(metric, limit):
+    # no 'big' column: values past 2^31 cannot bind on a 32-bit backend
+    # (they demote to host there — covered by test_numerics)
+    return GroupByQuerySpec(
+        datasource="fact",
+        dimensions=(DimensionSpec("cust", "cust"),),
+        aggregations=NARROW_AGGS,
+        limit=LimitSpec((OrderByColumn(metric, ascending=False),), limit))
+
+
+def _want_narrow(df, metric, limit):
+    g = df.groupby("cust", as_index=False).agg(
+        s_qty=("qty", "sum"), s_price=("price", "sum"), n=("qty", "size"))
+    return g.sort_values(metric, ascending=False, kind="stable").head(limit)
+
+
+def test_topk_tpu_dtypes_exact(no_x64, tstore, tdf):
+    """Selection runs on f32 scores of limb/compensated routes; the
+    gathered candidates still combine exactly on host."""
+    eng = QueryEngine(tstore)
+    got = eng.execute(_q_narrow("s_qty", 10)).to_pandas()
+    assert eng.last_stats["topk_device"] > 0
+    _check(got, _want_narrow(tdf, "s_qty", 10), "s_qty",
+           int_exact=("s_qty", "n"))
+
+
+def test_topk_tpu_dtypes_sharded(no_x64, tstore, tdf):
+    eng = QueryEngine(tstore, mesh=make_mesh(), config=Config(
+        {"sdot.querycostmodel.enabled": False}))
+    got = eng.execute(_q_narrow("s_qty", 10)).to_pandas()
+    assert eng.last_stats["topk_device"] > 0
+    _check(got, _want_narrow(tdf, "s_qty", 10), "s_qty",
+           int_exact=("s_qty", "n"))
