@@ -58,11 +58,13 @@ from spark_druid_olap_tpu.utils.config import (
     Config,
     TZ_ID,
     GROUPBY_DENSE_MAX_KEYS,
+    GROUPBY_HASH_COMPACT_MIN,
     GROUPBY_HASH_MAX_SLOTS,
     GROUPBY_HASH_SLOTS,
     GROUPBY_MATMUL_MAX_KEYS,
     GROUPBY_PALLAS_MAX_KEYS,
     HLL_LOG2M,
+    SELECT_DEVICE_MIN_ROWS,
     TOPN_DEVICE_MIN_KEYS,
 )
 
@@ -794,8 +796,8 @@ class QueryEngine:
             len(agg_plans))
         s_pad = spw if n_waves > 1 else _pad_segments(len(seg_idx), n_dev)
         sketch_plans = [p for p in agg_plans if p.kind in ("hll", "theta")]
-        topk = self._plan_device_topk(limit, having, agg_plans, n_keys,
-                                      n_waves) if n_waves == 1 else None
+        topk = self._plan_device_topk(limit, having, agg_plans, n_keys) \
+            if n_waves == 1 else None
         n_out = topk[1] if topk else n_keys
 
         # --- build / fetch program -------------------------------------------
@@ -879,7 +881,7 @@ class QueryEngine:
             "topk_device": int(topk[1]) if topk else 0})
         return QueryResult(columns, data)
 
-    def _plan_device_topk(self, limit, having, agg_plans, n_keys, n_waves):
+    def _plan_device_topk(self, limit, having, agg_plans, n_keys):
         """Decide whether the ordered-limit epilogue can run on device:
         select ``k_sel`` candidate keys by an f32 score over the merged
         partials (ops.groupby.route_score) and transfer only those rows.
@@ -899,7 +901,7 @@ class QueryEngine:
         wave mode (waves merge by key; candidate sets differ per wave)."""
         if having is not None or limit is None or limit.limit is None:
             return None
-        if len(limit.columns) != 1:
+        if not limit.columns:
             return None
         if n_keys < self.config.get(TOPN_DEVICE_MIN_KEYS):
             return None
@@ -908,7 +910,7 @@ class QueryEngine:
                  if p.kind not in ("hll", "theta")}
         if oc.name not in dense:
             return None
-        k_sel = int(min(n_keys, max(2 * limit.limit, limit.limit + 64)))
+        k_sel = min(n_keys, _topk_slack(limit))
         if k_sel * 4 >= n_keys:
             return None              # full transfer is already cheap
         return (oc.name, k_sel, bool(oc.ascending))
@@ -998,24 +1000,41 @@ class QueryEngine:
         # the key table (khi != EMPTY) directly
         metas = [G.AggInput(p.spec.name, p.kind, is_int=p.is_int,
                             maxabs=p.maxabs) for p in agg_plans]
+        topk_plan = self._plan_device_topk_hashed(limit, having, agg_plans,
+                                                  n_dev, n_waves)
 
+        kg_used = 0
         while True:
+            # k_sel*4 <= T also bounds k_sel < T, so no clamp is needed
+            topk = topk_plan if topk_plan and topk_plan[1] * 4 <= T \
+                else None
+            compact = (topk is None and T >= self.config.get(
+                GROUPBY_HASH_COMPACT_MIN))
+            k_out = topk[1] if topk else T
             routes = G.plan_routes(
                 metas, T, self.config.get(GROUPBY_MATMUL_MAX_KEYS))
             sig = ("hashagg", ds.name, id(ds), repr(q), s_pad,
                    ds.padded_rows, min_day, max_day, sharded, n_dev, T,
-                   tuple(names), self.config.get(TZ_ID),
+                   tuple(names), topk, compact, self.config.get(TZ_ID),
                    jax.default_backend(), bool(jax.config.jax_enable_x64))
-            prog_fn = self._programs.get(sig)
-            if prog_fn is None:
+
+            def build():
+                if compact:
+                    return self._build_hash_table_program(
+                        ds, dim_plans, parts, agg_plans, filter_spec,
+                        intervals, min_day, max_day, T, sharded, routes)
+                return self._build_hash_program(
+                    ds, dim_plans, parts, agg_plans, filter_spec,
+                    intervals, min_day, max_day, T, sharded, routes,
+                    topk=topk)
+
+            prog = self._programs.get(sig)
+            if prog is None:
                 with self._compile_lock:
-                    prog_fn = self._programs.get(sig)
-                    if prog_fn is None:
-                        prog_fn = self._build_hash_program(
-                            ds, dim_plans, parts, agg_plans, filter_spec,
-                            intervals, min_day, max_day, T, sharded,
-                            routes)
-                        self._programs[sig] = prog_fn
+                    prog = self._programs.get(sig)
+                    if prog is None:
+                        prog = build()
+                        self._programs[sig] = prog
 
             partials, unresolved = [], 0
 
@@ -1029,16 +1048,43 @@ class QueryEngine:
             for i in range(len(wave_segs)):
                 if t0 is not None:
                     self._stage_check(q, t0)
-                raw_dev = prog_fn(cur)              # async dispatch
-                # double buffer: next wave's transfer overlaps this compute
-                nxt = bind(i + 1) if i + 1 < len(wave_segs) else None
-                raw = {k: np.asarray(v) for k, v in raw_dev.items()}
-                cur = nxt
-                unresolved += int(raw["__unres__"].sum())
-                if unresolved:
-                    break
-                partials.extend(
-                    _hash_chip_partials(raw, routes, T, n_dev))
+                if compact:
+                    table = dict(prog(cur))         # table stays on device
+                    nxt = bind(i + 1) if i + 1 < len(wave_segs) else None
+                    stats = np.asarray(
+                        table.pop("__stats__")).reshape(-1, 2)
+                    cur = nxt
+                    unresolved += int(stats[:, 0].sum())
+                    if unresolved:
+                        break
+                    occ_max = max(1, int(stats[:, 1].max()))
+                    kg = min(T, 1 << max(6, (occ_max - 1).bit_length()))
+                    kg_used = max(kg_used, kg)
+                    sigB = (sig, "gather", kg)
+                    progB = self._programs.get(sigB)
+                    if progB is None:
+                        with self._compile_lock:
+                            progB = self._programs.get(sigB)
+                            if progB is None:
+                                progB = self._build_hash_gather_program(
+                                    agg_plans, routes, kg, T, sharded)
+                                self._programs[sigB] = progB
+                    gfn, unpackB = progB
+                    raw = unpackB(gfn(table))
+                    partials.extend(
+                        _hash_chip_partials(raw, routes, kg, n_dev))
+                else:
+                    prog_fn, unpack = prog
+                    buf = prog_fn(cur)              # async dispatch
+                    # double buffer: next wave's transfer overlaps compute
+                    nxt = bind(i + 1) if i + 1 < len(wave_segs) else None
+                    raw = unpack(buf)
+                    cur = nxt
+                    unresolved += int(raw.pop("__unres__").sum())
+                    if unresolved:
+                        break
+                    partials.extend(
+                        _hash_chip_partials(raw, routes, k_out, n_dev))
             if not unresolved:
                 break
             T *= 4
@@ -1072,16 +1118,40 @@ class QueryEngine:
             "sharded": sharded, "groups": int(len(keys)),
             "rows_scanned": int(ds.num_rows), "waves": int(len(wave_segs)),
             "segments_per_wave": int(s_pad), "hashed": True,
-            "hash_slots": int(T)})
+            "hash_slots": int(T), "hash_compact_k": int(kg_used),
+            "topk_device": int(topk[1]) if topk else 0})
         return QueryResult(columns, data)
 
-    def _build_hash_program(self, ds, dim_plans, parts, agg_plans,
-                            filter_spec, intervals, min_day, max_day, T,
-                            sharded, routes):
-        """One compiled program: scan -> filter -> per-dim codes -> two-part
-        key -> slot claim -> exact scatter aggregation into [T] buffers.
-        Outputs stay per-chip in sharded mode (slot layouts differ per chip;
-        the key-wise merge is host-side)."""
+    def _plan_device_topk_hashed(self, limit, having, agg_plans, n_dev,
+                                 n_waves):
+        """Device top-k over the hash table: transfer only the best
+        ``k_sel`` SLOTS per chip/wave instead of the full [T] table.
+
+        Single-chip single-wave ONLY: there the table is complete, so
+        per-slot scores are global and selection is exact (modulo the f32
+        score + slack, like the dense epilogue). Multi-chip/wave a key's
+        partials are split across per-chip tables — per-chip top-k both
+        misses globally-large keys AND under-counts any key selected on
+        one chip but not another (Druid's topN accepts exactly this
+        skew; we keep the full-table key-wise merge instead and stay
+        exact)."""
+        if having is not None or limit is None or limit.limit is None:
+            return None
+        if not limit.columns:
+            return None
+        oc = limit.columns[0]
+        if oc.name not in {p.spec.name for p in agg_plans}:
+            return None
+        if n_dev != 1 or n_waves != 1:
+            return None
+        return (oc.name, _topk_slack(limit), bool(oc.ascending))
+
+    def _hash_core(self, ds, dim_plans, parts, agg_plans, filter_spec,
+                   intervals, min_day, max_day, T, routes):
+        """The shared hash scan body: scan -> filter -> per-dim codes ->
+        two-part key -> slot claim -> exact scatter aggregation into [T]
+        buffers. Returns the raw out dict incl. '__tkhi__'/'__tklo__' key
+        tables and '__unres__' (shape [1])."""
         matmul_max = self.config.get(GROUPBY_MATMUL_MAX_KEYS)
         cards = [p.card for p in dim_plans]
 
@@ -1113,13 +1183,112 @@ class QueryEngine:
             out["__unres__"] = unresolved.reshape(1)
             return out
 
-        if not sharded:
-            return jax.jit(core)
-        smfn = jax.shard_map(core, mesh=self.mesh,
-                             in_specs=(P(SEGMENT_AXIS, None),),
-                             out_specs=P(SEGMENT_AXIS),
-                             check_vma=False)
+        return core
+
+    def _hash_packers(self, agg_plans, routes, k_out, with_unres: bool):
+        """(pack, unpack) over the hash outputs: ONE flat buffer — a
+        tunneled/remote chip charges a full RTT per device->host transfer,
+        so the table must not travel as 8-10 separate arrays (same packing
+        contract as the dense path)."""
+        x64 = G._x64()
+        meta = ([("__unres__", 1, "i32")] if with_unres else []) \
+            + [("__tkhi__", k_out, "i32"), ("__tklo__", k_out, "i32")]
+        for p in agg_plans:
+            meta.extend(routes[p.spec.name].outputs(k_out))
+        total = sum(m[1] for m in meta)
+
+        def pack(out):
+            return jnp.concatenate([_encode_buf(out[oname], dt, x64)
+                                    for oname, _, dt in meta])
+
+        def unpack(buf):
+            """-> {name: [n_chips*size] chip-major} (incl. '__unres__')."""
+            flat = np.asarray(buf)
+            chips = flat.reshape(-1, total)
+            out = {}
+            off = 0
+            for oname, size, dt in meta:
+                chunk = np.ascontiguousarray(
+                    chips[:, off: off + size]).reshape(-1)
+                off += size
+                out[oname] = _decode_buf(chunk, dt, x64)
+            return out
+
+        return pack, unpack
+
+    def _shard_wrap(self, fn, in_spec, out_spec):
+        if self.mesh is None:
+            return jax.jit(fn)
+        smfn = jax.shard_map(fn, mesh=self.mesh, in_specs=(in_spec,),
+                             out_specs=out_spec, check_vma=False)
         return jax.jit(smfn)
+
+    def _build_hash_program(self, ds, dim_plans, parts, agg_plans,
+                            filter_spec, intervals, min_day, max_day, T,
+                            sharded, routes, topk=None):
+        """Single-dispatch hash program (full-table or topk-gathered
+        transfer). Outputs stay per-chip in sharded mode (slot layouts
+        differ per chip; the key-wise merge is host-side). With ``topk``
+        only the top-scored ``k_sel`` slots per chip travel (see
+        _plan_device_topk_hashed)."""
+        core = self._hash_core(ds, dim_plans, parts, agg_plans, filter_spec,
+                               intervals, min_day, max_day, T, routes)
+        k_out = topk[1] if topk else T
+        pack, unpack = self._hash_packers(agg_plans, routes, k_out, True)
+
+        def run(arrays):
+            out = core(arrays)
+            if topk:
+                unres = out.pop("__unres__")
+                out = _hash_topk_gather(out, routes, topk, T)
+                out["__unres__"] = unres
+            return pack(out)
+
+        if not sharded:
+            return jax.jit(run), unpack
+        return self._shard_wrap(run, P(SEGMENT_AXIS, None),
+                                P(SEGMENT_AXIS)), unpack
+
+    def _build_hash_table_program(self, ds, dim_plans, parts, agg_plans,
+                                  filter_spec, intervals, min_day, max_day,
+                                  T, sharded, routes):
+        """Compaction dispatch 1 of 2: build the table, leave it DEVICE-
+        RESIDENT, transfer only '__stats__' = [unresolved, occupied] per
+        chip. The host sizes the gather dispatch from the occupancy."""
+        core = self._hash_core(ds, dim_plans, parts, agg_plans, filter_spec,
+                               intervals, min_day, max_day, T, routes)
+
+        def run(arrays):
+            out = core(arrays)
+            unres = out.pop("__unres__")
+            occ = jnp.sum(out["__tkhi__"] != H.EMPTY).astype(jnp.int32)
+            out["__stats__"] = jnp.concatenate(
+                [unres.astype(jnp.int32), occ.reshape(1)])
+            return out
+
+        if not sharded:
+            return jax.jit(run)
+        return self._shard_wrap(run, P(SEGMENT_AXIS, None), P(SEGMENT_AXIS))
+
+    def _build_hash_gather_program(self, agg_plans, routes, k_gather, T,
+                                   sharded):
+        """Compaction dispatch 2 of 2: gather the ``k_gather`` occupied
+        slots from the resident table (per chip) and pack them into one
+        transfer buffer — transfer scales with the ACTUAL group count, not
+        the table size (a conservatively-sized table costs HBM, not
+        wire)."""
+        pack, unpack = self._hash_packers(agg_plans, routes, k_gather,
+                                          False)
+
+        def run(table):
+            occ = (table["__tkhi__"] != H.EMPTY).astype(jnp.float32)
+            _, idx = jax.lax.top_k(occ, k_gather)
+            return pack(_gather_rows(table, idx, T))
+
+        if not sharded:
+            return jax.jit(run), unpack
+        return self._shard_wrap(run, P(SEGMENT_AXIS),
+                                P(SEGMENT_AXIS)), unpack
 
     def _run_waves(self, q, ds, names, seg_idx, spw, sharded, prog_fn,
                    unpack, routes, n_keys, sketch_plans, t0):
@@ -1339,47 +1508,17 @@ class QueryEngine:
             metric, k_sel, ascending = topk
             rows_sc = G.route_score(routes["__rows__"], out, n_keys,
                                     axis_name)
-            sc = G.route_score(routes[metric], out, n_keys, axis_name)
-            if ascending:
-                sc = -sc
-            # Rank order must match the host epilogue's: real scores,
-            # then occupied groups whose metric is NULL (min/max sentinel
-            # — under negation it would otherwise rank FIRST), then
-            # unoccupied keys at -inf (so NULL-metric groups still fill
-            # an under-subscribed LIMIT, nulls-last).
-            null_m = G.route_null_mask(routes[metric], out)
-            if null_m is not None:
-                big = jnp.finfo(sc.dtype).max
-                sc = jnp.where(null_m, jnp.asarray(-big, sc.dtype), sc)
-            sc = jnp.where(rows_sc > 0.5, sc, jnp.asarray(-jnp.inf,
-                                                          sc.dtype))
+            sc = _topk_score(routes[metric], out, n_keys, ascending,
+                             rows_sc > 0.5, axis_name)
             _, idx = jax.lax.top_k(sc, k_sel)
             idx = idx.astype(jnp.int32)
-            g = {"__topk_idx__": idx}
-            for name, arr in out.items():
-                flat = arr.reshape(-1)
-                width = flat.shape[0] // n_keys
-                if width == 1:
-                    g[name] = flat[idx]
-                else:
-                    g[name] = flat.reshape(n_keys, width)[idx].reshape(-1)
+            g = _gather_rows(out, idx, n_keys)
+            g["__topk_idx__"] = idx
             return g
 
         def pack_group(out, metas):
-            parts = []
-            for oname, _, dt, _ in metas:
-                a = out[oname].reshape(-1)
-                if x64:
-                    if dt == "f64":
-                        parts.append(jax.lax.bitcast_convert_type(
-                            a.astype(jnp.float64), jnp.int64))
-                    else:
-                        parts.append(a.astype(jnp.int64))
-                elif dt == "f32":
-                    parts.append(jax.lax.bitcast_convert_type(
-                        a.astype(jnp.float32), jnp.int32))
-                else:
-                    parts.append(a.astype(jnp.int32))
+            parts = [_encode_buf(out[oname], dt, x64)
+                     for oname, _, dt, _ in metas]
             if not parts:
                 return jnp.zeros((0,), buf_dtype)
             return jnp.concatenate(parts)
@@ -1426,13 +1565,7 @@ class QueryEngine:
         perchip_len = sum(t[1] for t in perchip_meta)
 
         def restore(chunk, dt):
-            if x64:
-                if dt == "f64":
-                    return chunk.view(np.float64)
-                return chunk                    # i64/i32 carried in int64
-            if dt == "f32":
-                return chunk.view(np.float32)
-            return chunk
+            return _decode_buf(chunk, dt, x64)
 
         def unpack(bufs) -> Dict[str, np.ndarray]:
             mflat = np.asarray(bufs[0])
@@ -1470,9 +1603,17 @@ class QueryEngine:
         seg_idx = ds.prune_segments(q.intervals, q.filter)
         if len(seg_idx) == 0:
             return QueryResult.empty(cols)
-        # row mask on host via numpy evaluation over raw columns (select is
-        # IO-bound; ≈ Druid Select query paged through the broker)
-        mask = self._host_mask(ds, q.filter, q.intervals)
+        # filter on device when the scan is big enough to beat the
+        # dispatch floor (compiled mask program, bit-packed transfer);
+        # page materialization stays host-side — select is IO-bound
+        # (≈ Druid Select paged through the broker)
+        mask = None
+        if (q.filter is not None or q.intervals is not None) \
+                and ds.num_rows >= self.config.get(SELECT_DEVICE_MIN_ROWS):
+            mask = self._device_mask(ds, q.filter, q.intervals, seg_idx)
+        if mask is None:
+            self.last_stats["select_filter"] = "host"
+            mask = self._host_mask(ds, q.filter, q.intervals)
         idx = np.nonzero(mask)[0]
         if q.descending:
             idx = idx[::-1]
@@ -1528,6 +1669,74 @@ class QueryEngine:
              "count": np.array(counts_out, dtype=np.int64)})
 
     # -- helpers --------------------------------------------------------------
+    def _device_mask(self, ds: Datasource, filter_spec, intervals,
+                     seg_idx) -> Optional[np.ndarray]:
+        """Evaluate the select filter on device: one compiled program
+        lowers the filter + interval mask over the pruned stacked scan and
+        returns a 32x bit-packed word array ([S, R/32] uint32) — the same
+        compiled filter tier aggregations use (dictionary compares, spatial,
+        regex-via-dictionary, compiled expressions), so select filters can
+        never diverge from aggregate filters. Returns the global [num_rows]
+        bool mask, or None when the filter doesn't lower (host fallback)."""
+        mins, maxs = ds.segment_time_bounds()
+        if len(seg_idx) == 0 or ds.time is None:
+            min_day = max_day = 0
+        else:
+            min_day = int(mins[seg_idx].min() // T.MILLIS_PER_DAY)
+            max_day = int(maxs[seg_idx].max() // T.MILLIS_PER_DAY)
+        needed = F.columns_of_filter(filter_spec)
+        time_in_play = ds.time is not None and (
+            intervals is not None or ds.time.name in needed)
+        if time_in_play:
+            needed.add(ds.time.name)
+        names = array_names(ds, sorted(needed), time_in_play)
+        s_pad = len(seg_idx)
+        sig = ("selmask", ds.name, id(ds), repr(filter_spec),
+               repr(intervals), s_pad, ds.padded_rows, min_day, max_day,
+               tuple(names), self.config.get(TZ_ID),
+               jax.default_backend())
+        prog = self._programs.get(sig)
+        if prog is None:
+            R = ds.padded_rows
+
+            def core(arrays):
+                ctx = ScanContext(ds, arrays, min_day, max_day,
+                                  tz=self.config.get(TZ_ID))
+                base = ctx.row_valid()
+                fm = F.lower_filter(filter_spec, ctx)
+                if fm is not None:
+                    base = base & fm
+                im = F.interval_mask(intervals, ctx)
+                if im is not None:
+                    base = base & im
+                bits = base.reshape(s_pad, R // 32, 32).astype(jnp.uint32)
+                weights = jnp.left_shift(
+                    jnp.uint32(1), jnp.arange(32, dtype=jnp.uint32))
+                return (bits * weights[None, None, :]).sum(
+                    axis=-1, dtype=jnp.uint32)
+
+            with self._compile_lock:
+                prog = self._programs.get(sig)
+                if prog is None:
+                    prog = jax.jit(core)
+                    self._programs[sig] = prog
+        try:
+            arrays = {k: _device_put_retry(
+                _build_array_checked(ds, k, seg_idx, s_pad), None)
+                for k in names}
+            words = np.asarray(prog(arrays))
+        except (EngineFallback, EC.Unsupported):
+            return None
+        shifts = np.arange(32, dtype=np.uint32)
+        bits = ((words[:, :, None] >> shifts) & 1).astype(bool) \
+            .reshape(s_pad, ds.padded_rows)
+        mask = np.zeros(ds.num_rows, dtype=bool)
+        for i, si in enumerate(seg_idx):
+            s = ds.segments[int(si)]
+            mask[s.start_row: s.end_row] = bits[i, : s.num_rows]
+        self.last_stats["select_filter"] = "device"
+        return mask
+
     def _host_mask(self, ds: Datasource, filter_spec, intervals):
         n = ds.num_rows
         mask = np.ones(n, dtype=bool)
@@ -1651,6 +1860,86 @@ def _decode_agg_value(ds, p, r, v) -> np.ndarray:
             return v.astype(np.int64)
         return np.rint(v).astype(np.int64)
     return v.astype(np.float64)
+
+
+def _encode_buf(a, dt: str, x64: bool):
+    """Dtype-faithful packing of one flat program output into the int lane
+    of the single transfer buffer: floats travel BITCAST inside the int
+    buffer, never rounded (the packing contract shared by the dense and
+    hashed programs)."""
+    a = a.reshape(-1)
+    if x64:
+        if dt == "f64":
+            return jax.lax.bitcast_convert_type(
+                a.astype(jnp.float64), jnp.int64)
+        return a.astype(jnp.int64)
+    if dt == "f32":
+        return jax.lax.bitcast_convert_type(a.astype(jnp.float32), jnp.int32)
+    return a.astype(jnp.int32)
+
+
+def _decode_buf(chunk: np.ndarray, dt: str, x64: bool) -> np.ndarray:
+    """Host inverse of _encode_buf (chunk must be contiguous for the
+    bitcast view)."""
+    if x64 and dt == "f64":
+        return chunk.view(np.float64)
+    if not x64 and dt == "f32":
+        return chunk.view(np.float32)
+    return chunk
+
+
+def _gather_rows(out, idx, n_keys):
+    """Gather every per-key output at ``idx``: each output is flat
+    [n_keys*width] key-major; rows of the [n_keys, width] view are kept."""
+    g = {}
+    for name, arr in out.items():
+        flat = arr.reshape(-1)
+        width = flat.shape[0] // n_keys
+        if width == 1:
+            g[name] = flat[idx]
+        else:
+            g[name] = flat.reshape(n_keys, width)[idx].reshape(-1)
+    return g
+
+
+def _topk_score(route, out, n_keys, ascending, valid, axis_name=None):
+    """The shared selection-score pipeline of the dense and hashed top-k
+    epilogues. Rank order must match the host epilogue's: real scores,
+    then occupied groups whose metric is NULL (min/max sentinel — under
+    ascending negation it would otherwise rank FIRST), then invalid
+    (unoccupied) keys at -inf so NULL-metric groups still fill an
+    under-subscribed LIMIT (nulls-last)."""
+    sc = G.route_score(route, out, n_keys, axis_name)
+    if ascending:
+        sc = -sc
+    nm = G.route_null_mask(route, out)
+    if nm is not None:
+        big = jnp.finfo(sc.dtype).max
+        sc = jnp.where(nm, jnp.asarray(-big, sc.dtype), sc)
+    return jnp.where(valid, sc, jnp.asarray(-jnp.inf, sc.dtype))
+
+
+def _topk_slack(limit: S.LimitSpec) -> int:
+    """Candidate count for a device top-k selection. Secondary order
+    columns (e.g. TPC-H q3/q18 'ORDER BY revenue DESC, o_orderdate') only
+    reorder ties in the PRIMARY metric, so they widen the slack (selection
+    stays exact unless >slack keys tie exactly at the cutoff value);
+    single-column selection errors additionally require f32 rounding to
+    cross a gap at the cutoff."""
+    if len(limit.columns) == 1:
+        return int(max(2 * limit.limit, limit.limit + 64))
+    return int(max(4 * limit.limit, limit.limit + 256))
+
+
+def _hash_topk_gather(out, routes, topk, T):
+    """Per-chip top-k over hash-table slots: score occupied slots, keep the
+    best k_sel (unoccupied slots at -inf fill any remainder and are
+    dropped by the host occupancy filter)."""
+    metric, k_sel, ascending = topk
+    occ = out["__tkhi__"] != H.EMPTY
+    sc = _topk_score(routes[metric], out, T, ascending, occ)
+    _, idx = jax.lax.top_k(sc, k_sel)
+    return _gather_rows(out, idx, T)
 
 
 def _hash_chip_partials(raw, routes, T, n_dev):

@@ -61,6 +61,12 @@ SELECT_PAGE_SIZE = _entry(
     "sdot.select.pagesize", 10000,
     "Rows per page for non-aggregate (select) scans (reference: "
     "spark.sparklinedata.druid.selectquery.pagesize).")
+SELECT_DEVICE_MIN_ROWS = _entry(
+    "sdot.select.device.min.rows", 1 << 17,
+    "Min datasource rows before a select (raw scan) query evaluates its "
+    "filter on device (compiled mask program, 32x bit-packed transfer); "
+    "below it the host numpy path wins (device dispatch floor). 0 forces "
+    "the device path when a device filter exists.")
 ALLOW_TOPN = _entry(
     "sdot.querycostmodel.topn.allow", True,
     "Allow rewriting single-dim ordered-limit group-bys to the approximate "
@@ -143,6 +149,13 @@ TOPN_DEVICE_MIN_KEYS = _entry(
     "runs its top-k selection on device (lax.top_k over the merged "
     "partials, transferring only the candidate rows). Below it the full "
     "[K] result transfers and the host sorts (cheap at small K).")
+GROUPBY_HASH_COMPACT_MIN = _entry(
+    "sdot.engine.groupby.hash.compact.min.slots", 1 << 18,
+    "Min hash-table slot count before the hashed group-by compacts on "
+    "device (two dispatches: build table + read occupancy count, then "
+    "gather only occupied slots) instead of transferring the full [T] "
+    "table. Worth one extra dispatch RTT whenever the table is sized "
+    "far above the actual group count.")
 WAVE_MAX_BYTES = _entry(
     "sdot.engine.wave.max.bytes", 0,
     "Per-device byte budget for one execution wave's scan arrays; a scan "

@@ -231,6 +231,37 @@ def test_select_paging(engine, sales_df):
     assert set(r1["region"]) == {"east"}
 
 
+def test_select_device_filter_matches_host(store, sales_df):
+    """The device mask path (compiled filter + bit-packed transfer) must
+    return exactly the host numpy path's rows, across paging/descending/
+    intervals."""
+    from spark_druid_olap_tpu.parallel.executor import QueryEngine
+    from spark_druid_olap_tpu.utils.config import Config
+    sales_store = store
+    lo = int(np.datetime64("2015-06-01").astype("datetime64[ms]")
+             .astype(np.int64))
+    hi = int(np.datetime64("2016-06-01").astype("datetime64[ms]")
+             .astype(np.int64))
+    filt = LogicalFilter("and", (
+        SelectorFilter("region", "east"),
+        BoundFilter("qty", lower=5, upper=None)))
+    for kw in ({}, {"descending": True}, {"page_offset": 37},
+               {"intervals": ((lo, hi),)}):
+        q = SelectQuerySpec(datasource="sales",
+                            columns=("ts", "region", "qty"),
+                            filter=filt, page_size=200, **kw)
+        dev = QueryEngine(sales_store, config=Config(
+            {"sdot.select.device.min.rows": 0}))
+        host = QueryEngine(sales_store, config=Config(
+            {"sdot.select.device.min.rows": 1 << 40}))
+        got = dev.execute(q).to_pandas()
+        assert dev.last_stats["select_filter"] == "device"
+        want = host.execute(q).to_pandas()
+        assert host.last_stats["select_filter"] == "host"
+        pd.testing.assert_frame_equal(got.reset_index(drop=True),
+                                      want.reset_index(drop=True))
+
+
 def test_search(engine, sales_df):
     q = SearchQuerySpec(datasource="sales", dimensions=("product",),
                         query="p01")

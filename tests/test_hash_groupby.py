@@ -215,6 +215,112 @@ def test_hashed_ordered_limit_topn_shape(hstore, hdf):
                                   want["s_qty"].to_numpy())
 
 
+def test_hashed_device_topk_engaged(hstore, hdf):
+    """Single-chip single-wave: device slot top-k is exact, and only
+    k_sel slots travel (stats expose the engaged k)."""
+    limit = LimitSpec((OrderByColumn("s_qty", ascending=False),), 7)
+    # table must be >= 4*k_sel for the gather to engage
+    eng = QueryEngine(hstore, config=_cfg(**{
+        "sdot.engine.groupby.hash.slots": 1 << 14}))
+    got = eng.execute(_q(["cust"], limit=limit)).to_pandas()
+    assert eng.last_stats.get("hashed") is True
+    assert eng.last_stats["topk_device"] > 0
+    want = _want(hdf, ["cust"]).sort_values(
+        ["s_qty"], ascending=False).head(7).reset_index(drop=True)
+    np.testing.assert_array_equal(got["s_qty"].to_numpy(),
+                                  want["s_qty"].to_numpy())
+    # results match the full-table transfer path bit-for-bit
+    full = QueryEngine(hstore, config=_cfg())
+    wantf = full.execute(_q(["cust"], limit=limit)).to_pandas()
+    np.testing.assert_array_equal(got["s_big"].to_numpy(),
+                                  wantf["s_big"].to_numpy())
+
+
+def test_hashed_device_topk_ascending(hstore, hdf):
+    limit = LimitSpec((OrderByColumn("s_qty", ascending=True),), 9)
+    eng = QueryEngine(hstore, config=_cfg(**{
+        "sdot.engine.groupby.hash.slots": 1 << 14}))
+    got = eng.execute(_q(["cust"], limit=limit)).to_pandas()
+    assert eng.last_stats["topk_device"] > 0
+    want = _want(hdf, ["cust"]).sort_values(
+        ["s_qty"], ascending=True).head(9).reset_index(drop=True)
+    np.testing.assert_array_equal(got["s_qty"].to_numpy(),
+                                  want["s_qty"].to_numpy())
+
+
+def test_hashed_sharded_groupby_keeps_full_table(hstore, hdf):
+    """Multi-chip GroupBy (exact contract) must NOT take per-chip top-k."""
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+    limit = LimitSpec((OrderByColumn("s_qty", ascending=False),), 7)
+    eng = QueryEngine(hstore, mesh=make_mesh(), config=_cfg(**{
+        "sdot.querycostmodel.enabled": False,
+        "sdot.engine.groupby.hash.slots": 1 << 14}))
+    got = eng.execute(_q(["cust"], limit=limit)).to_pandas()
+    assert eng.last_stats["sharded"] is True
+    assert eng.last_stats["topk_device"] == 0
+    want = _want(hdf, ["cust"]).sort_values(
+        ["s_qty"], ascending=False).head(7).reset_index(drop=True)
+    np.testing.assert_array_equal(got["s_qty"].to_numpy(),
+                                  want["s_qty"].to_numpy())
+
+
+def test_hashed_sharded_topn_spec_stays_exact(hstore, hdf):
+    """Sharded TopNQuerySpec over the hashed path: per-chip top-k would
+    under-count keys split across chips, so it must NOT engage — results
+    stay exact via the full-table key-wise merge."""
+    from spark_druid_olap_tpu.ir.spec import TopNQuerySpec
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+    q = TopNQuerySpec(
+        datasource="fact", dimension=DimensionSpec("cust", "cust"),
+        metric="s_qty", threshold=7,
+        aggregations=(AggregationSpec("longsum", "s_qty", field="qty"),))
+    eng = QueryEngine(hstore, mesh=make_mesh(), config=_cfg(**{
+        "sdot.querycostmodel.enabled": False,
+        "sdot.engine.groupby.hash.slots": 1 << 14}))
+    got = eng.execute(q).to_pandas()
+    assert eng.last_stats["topk_device"] == 0
+    want = hdf.groupby("cust", as_index=False).agg(s_qty=("qty", "sum")) \
+        .sort_values("s_qty", ascending=False).head(7)
+    np.testing.assert_array_equal(got["s_qty"].to_numpy(),
+                                  want["s_qty"].to_numpy())
+
+
+def test_hashed_device_compaction(hstore, hdf):
+    """Above the compaction threshold the table stays device-resident and
+    only occupied slots travel (two dispatches); results are identical to
+    the full-table transfer."""
+    eng = QueryEngine(hstore, config=_cfg(**{
+        "sdot.engine.groupby.hash.compact.min.slots": 1,
+        "sdot.engine.groupby.hash.slots": 1 << 16}))
+    got = eng.execute(_q(["cust"])).to_pandas()
+    assert eng.last_stats.get("hashed") is True
+    assert 0 < eng.last_stats["hash_compact_k"] < (1 << 16)
+    _check(got, _want(hdf, ["cust"]), ["cust"])
+
+
+def test_hashed_device_compaction_sharded(hstore, hdf):
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+    eng = QueryEngine(hstore, mesh=make_mesh(), config=_cfg(**{
+        "sdot.querycostmodel.enabled": False,
+        "sdot.engine.groupby.hash.compact.min.slots": 1,
+        "sdot.engine.groupby.hash.slots": 1 << 16}))
+    got = eng.execute(_q(["cust"])).to_pandas()
+    assert eng.last_stats["sharded"] is True
+    assert eng.last_stats["hash_compact_k"] > 0
+    _check(got, _want(hdf, ["cust"]), ["cust"])
+
+
+def test_hashed_compaction_overflow_retry(hstore, hdf):
+    """A too-small table in compact mode still detects overflow from the
+    stats transfer and retries at 4x."""
+    eng = QueryEngine(hstore, config=_cfg(**{
+        "sdot.engine.groupby.hash.compact.min.slots": 1,
+        "sdot.engine.groupby.hash.slots": 1 << 12}))
+    got = eng.execute(_q(["cust"])).to_pandas()
+    assert eng.last_stats["hash_slots"] > (1 << 12)
+    _check(got, _want(hdf, ["cust"]), ["cust"])
+
+
 def test_hashed_sql_pushdown(hdf):
     import spark_druid_olap_tpu as sdot
     ctx = sdot.Context({"sdot.engine.groupby.dense.max.keys": 4096})

@@ -154,12 +154,12 @@ def test_topk_sharded(tstore, tdf):
     _check(got, _want(tdf, "s_big", 10), "s_big")
 
 
-def test_topk_small_k_skips_device(tstore):
+def test_topk_small_k_skips_device(tstore, tdf):
     # limit so large that k_sel*4 >= n_keys — device selection is skipped
     eng = QueryEngine(tstore)
     got = eng.execute(_q("s_qty", N_CUST)).to_pandas()
     assert eng.last_stats["topk_device"] == 0
-    assert len(got) == len(set(_df()["cust"]))
+    assert len(got) == len(set(tdf["cust"]))
 
 
 def test_topk_having_skips_device(tstore, tdf):
@@ -205,6 +205,27 @@ def test_topk_filtered_rows(tstore, tdf):
     got = eng.execute(q).to_pandas()
     assert eng.last_stats["topk_device"] > 0
     _check(got, _want(tdf[tdf.region == "east"], "s_qty", 10), "s_qty")
+
+
+def test_topk_secondary_order_columns(tstore, tdf):
+    """Multi-column ORDER BY (TPC-H q3/q18 shape): selection runs on the
+    primary metric with 4x slack; secondary columns reorder ties exactly
+    in the host epilogue."""
+    q = GroupByQuerySpec(
+        datasource="fact",
+        dimensions=(DimensionSpec("cust", "cust"),),
+        aggregations=AGGS,
+        limit=LimitSpec((OrderByColumn("s_big", ascending=False),
+                         OrderByColumn("cust", ascending=True)), 10))
+    eng = QueryEngine(tstore)
+    got = eng.execute(q).to_pandas()
+    assert eng.last_stats["topk_device"] > 0
+    off = QueryEngine(tstore, config=Config(
+        {"sdot.engine.topn.device.min.keys": 1 << 30}))
+    want = off.execute(q).to_pandas()
+    assert off.last_stats["topk_device"] == 0
+    pd.testing.assert_frame_equal(got.reset_index(drop=True),
+                                  want.reset_index(drop=True))
 
 
 def test_topk_null_metric_groups_rank_last(tstore, tdf):
