@@ -211,6 +211,65 @@ class Case(Expr):
 
 
 # -- aggregate call (only valid inside SELECT/HAVING/ORDER trees) --------------
+class FrozenKeyedTable:
+    """Immutable sorted int64-key -> float64-value map with O(1) repr/eq/
+    hash (digest stands in for contents, like :class:`FrozenIntSet` — the
+    executor's program-cache key is ``repr(query)``)."""
+
+    __slots__ = ("keys", "values", "_digest")
+
+    def __init__(self, keys, values):
+        import numpy as np
+        k = np.asarray(keys, dtype=np.int64)
+        v = np.asarray(values, dtype=np.float64)
+        assert k.shape == v.shape and k.ndim == 1
+        order = np.argsort(k, kind="stable")
+        k = k[order]
+        v = v[order]
+        k.setflags(write=False)
+        v.setflags(write=False)
+        object.__setattr__(self, "keys", k)
+        object.__setattr__(self, "values", v)
+        import hashlib
+        h = hashlib.sha1(k.tobytes())
+        h.update(v.tobytes())
+        object.__setattr__(self, "_digest", h.hexdigest())
+
+    def __len__(self):
+        return int(len(self.keys))
+
+    def __repr__(self):
+        return f"FrozenKeyedTable(n={len(self.keys)}, " \
+               f"sha={self._digest[:16]})"
+
+    def __eq__(self, o):
+        return isinstance(o, FrozenKeyedTable) and self._digest == o._digest
+
+    def __hash__(self):
+        return hash(self._digest)
+
+
+@dataclasses.dataclass(frozen=True)
+class KeyedLookup(Expr):
+    """Scalar broadcast-join: the table value at integer ``key`` (NULL when
+    absent). Produced by correlated-scalar-subquery inlining — the
+    decorrelated per-key aggregate of ``(select agg(..) from inner where
+    inner.k = outer.k)`` becomes a device gather (binary search over the
+    sorted key array), keeping the OUTER query engine-pushable (TPC-H
+    q2/q17 shape; ≈ Spark's RewriteCorrelatedScalarSubquery followed by a
+    broadcast hash join, collapsed into the scan)."""
+
+    key: Expr
+    table: FrozenKeyedTable
+    # value for keys absent from the table: None = SQL NULL (NaN-coded);
+    # a float for aggregates with a non-NULL empty-group identity
+    # (count(*) over zero rows is 0, not NULL)
+    default: Optional[float] = None
+
+    def children(self):
+        return (self.key,)
+
+
 @dataclasses.dataclass(frozen=True)
 class AggCall(Expr):
     """sum/min/max/avg/count/count_distinct over an argument expression."""
@@ -271,6 +330,8 @@ def transform(e: Expr, fn):
     elif isinstance(e, AggCall):
         e2 = AggCall(e.fn, None if e.arg is None else transform(e.arg, fn),
                      e.distinct, e.approx)
+    elif isinstance(e, KeyedLookup):
+        e2 = KeyedLookup(transform(e.key, fn), e.table, e.default)
     else:
         e2 = e
     return fn(e2)
@@ -316,4 +377,6 @@ def to_sql(e: Expr) -> str:
         arg = "*" if e.arg is None else to_sql(e.arg)
         d = "DISTINCT " if e.distinct else ""
         return f"{e.fn}({d}{arg})"
+    if isinstance(e, KeyedLookup):
+        return f"lookup[{e.table!r}]({to_sql(e.key)})"
     return repr(e)

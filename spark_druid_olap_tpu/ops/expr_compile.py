@@ -131,15 +131,63 @@ def compile_expr(e: E.Expr, ctx: ScanContext):
     if isinstance(e, E.Not):
         return BoolValue(~_as_bool(compile_expr(e.child, ctx)))
     if isinstance(e, E.IsNull):
-        if not isinstance(e.child, E.Column):
-            raise Unsupported("IS NULL on computed expression")
-        nv = ctx.null_valid(e.child.name)
-        valid = ctx.row_valid() if nv is None else nv
-        return BoolValue(valid if e.negated else ~valid)
+        if isinstance(e.child, E.Column):
+            nv = ctx.null_valid(e.child.name)
+            valid = ctx.row_valid() if nv is None else nv
+            return BoolValue(valid if e.negated else ~valid)
+        # a computed expression's NULLs are NaN-coded ONLY when no input
+        # column is nullable (nullable column payloads are zero-FILLED in
+        # storage, invisible to isnan): KeyedLookup misses and 0/0 are
+        # NaN, column-sourced NULLs are not
+        if any(ctx.null_valid(c) is not None
+               for c in E.columns_in(e.child)):
+            raise Unsupported("IS NULL on expression over nullable columns")
+        v = compile_expr(e.child, ctx)
+        if isinstance(v, NumValue) and v.is_float:
+            isnull = jnp.isnan(v.arr)
+            return BoolValue(~isnull if e.negated else isnull)
+        raise Unsupported("IS NULL on computed expression")
     if isinstance(e, E.InList):
         v = compile_expr(e.child, ctx)
         b = _in_list(v, e.values, ctx)
         return BoolValue(~b if e.negated else b)
+    if isinstance(e, E.KeyedLookup):
+        # broadcast-join gather: binary search the sorted key array, take
+        # the value; misses read ``default`` (NaN = SQL NULL: comparisons
+        # come out false) — the device form of a decorrelated correlated
+        # scalar subquery. NULL key rows are zero-FILLED in storage, so
+        # the key column's validity must mask the gather or they would
+        # read key 0's group.
+        if not isinstance(e.key, E.Column):
+            raise Unsupported("keyed lookup over computed key")
+        n = _as_num(compile_expr(e.key, ctx), ctx)
+        tab = e.table
+        if n.is_float:
+            raise Unsupported("keyed lookup over float key expression")
+        miss = jnp.asarray(np.nan if e.default is None else e.default,
+                           jnp.float64 if n.arr.dtype == jnp.int64
+                           else jnp.float32)
+        if len(tab) == 0:
+            return NumValue(jnp.full(jnp.shape(n.arr), miss), True)
+        keys = tab.keys
+        if n.arr.dtype == jnp.int64:
+            kdev = jnp.asarray(keys)
+            arr = n.arr
+        else:
+            if int(keys[0]) < -(2**31) or int(keys[-1]) >= 2**31:
+                raise Unsupported("lookup keys exceed 32-bit range")
+            kdev = jnp.asarray(keys.astype(np.int32))
+            arr = n.arr.astype(jnp.int32)
+        vdev = jnp.asarray(tab.values)        # f32 off-x64, f64 on x64
+        idx = jnp.clip(jnp.searchsorted(kdev, arr), 0, len(keys) - 1)
+        found = kdev[idx] == arr
+        nv = ctx.null_valid(e.key.name)
+        if nv is not None:
+            # NULL key: 'inner.k = NULL' matches nothing, so the subquery
+            # aggregates the EMPTY set -> miss value (and never key 0's
+            # group, which the zero-filled storage would otherwise read)
+            found = found & nv
+        return NumValue(jnp.where(found, vdev[idx], miss), True)
     if isinstance(e, E.Between):
         v = compile_expr(e.child, ctx)
         lo = _comparison(">=", v, compile_expr(e.low, ctx), ctx)

@@ -766,8 +766,7 @@ class QueryEngine:
                     data[p.name] = np.broadcast_to(v, (1,)) if v.ndim == 0 \
                         else v
                 if having is not None:
-                    keep = np.asarray(
-                        host_eval.eval_expr(having.expr, data), dtype=bool)
+                    keep = host_eval.eval_pred3(having.expr, data)
                     data = {k: v[keep] for k, v in data.items()}
                 self.last_stats.update({
                     "datasource": ds.name, "segments": 0, "sharded": False,
@@ -923,8 +922,7 @@ class QueryEngine:
             data[pa.name] = np.asarray(host_eval.eval_expr(pa.expr, data))
             columns.append(pa.name)
         if having is not None:
-            keep = np.asarray(host_eval.eval_expr(having.expr, data),
-                              dtype=bool)
+            keep = host_eval.eval_pred3(having.expr, data)
             data = {k: v[keep] for k, v in data.items()}
         if limit is not None and limit.columns:
             order_keys = []
@@ -1751,7 +1749,7 @@ class QueryEngine:
             for c in _filter_columns_all(filter_spec):
                 env[c] = _host_column_values(ds, c, None)
             expr = filter_to_expr(filter_spec)
-            mask &= np.asarray(host_eval.eval_expr(expr, env), dtype=bool)
+            mask &= host_eval.eval_pred3(expr, env)
         return mask
 
     def _should_shard(self, q, ds, seg_idx) -> bool:

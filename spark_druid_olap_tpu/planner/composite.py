@@ -55,10 +55,12 @@ SubPlan = Union[PlannedQuery, LeftJoinAggPlan, CompositePlan]
 
 def _chain(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
     from spark_druid_olap_tpu.planner.decorrelate import (
-        decorrelate_semijoins, inline_subqueries)
+        decorrelate_semijoins, inline_correlated_scalars,
+        inline_subqueries)
     from spark_druid_olap_tpu.planner.viewmerge import merge_derived
     s = merge_derived(ctx, stmt)
     s = decorrelate_semijoins(ctx, s)
+    s = inline_correlated_scalars(ctx, s)
     return inline_subqueries(ctx, s)
 
 

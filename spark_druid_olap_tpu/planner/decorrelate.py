@@ -161,6 +161,251 @@ def _try_semijoin(ctx, outer: A.SelectStmt, c) -> Optional[E.Expr]:
                         negated=negated)
 
 
+def _classify_correlation(ctx, q, free, inner_cols, max_residuals):
+    """Split ``q.where`` into (outer_key_col, inner_key_expr, rest,
+    residuals): exactly one equality conjunct binds a free column to an
+    inner key expression; up to ``max_residuals`` further free-referencing
+    conjuncts may be min/max-decidable comparisons
+    (host_exec._residual_minmax); everything else must be inner-only.
+    Returns None when the correlation has any other shape. Shared by the
+    scalar and EXISTS inlining passes so their gating cannot diverge."""
+    from spark_druid_olap_tpu.planner.host_exec import (
+        _expr_refs, _residual_minmax)
+    inner_key = kcol = None
+    residuals = []
+    rest = []
+    for c in _split_and(q.where):
+        refs = _expr_refs(ctx, c)
+        if not (refs & free):
+            rest.append(c)
+            continue
+        if inner_key is None and isinstance(c, E.Comparison) \
+                and c.op == "=":
+            pair = None
+            for a, b in ((c.left, c.right), (c.right, c.left)):
+                if isinstance(a, E.Column) and a.name in free:
+                    brefs = _expr_refs(ctx, b)
+                    if brefs and not (brefs & free) \
+                            and brefs <= inner_cols:
+                        pair = (a.name, b)
+                        break
+            if pair is not None:
+                kcol, inner_key = pair
+                continue
+        if len(residuals) < max_residuals:
+            mm = _residual_minmax(ctx, c, free, inner_cols)
+            if mm is not None:
+                residuals.append(mm)
+                continue
+        return None
+    if inner_key is None:
+        return None
+    return kcol, inner_key, rest, residuals
+
+
+def _numeric_series(s):
+    """The engine result column as float64, or None when it is not
+    numeric (string/timestamp aggregates must NOT silently coerce to
+    NULL)."""
+    if s.dtype == object or s.dtype.kind not in "biuf":
+        return None
+    return pd.to_numeric(s, errors="coerce").to_numpy(dtype=np.float64)
+
+
+def _run_grouped_inner(ctx, q, inner_key, rest, value_items):
+    """Execute the decorrelated per-key aggregate through the full session
+    path (engine pushdown for the inner). Returns (int64 keys, [value
+    arrays]) or None."""
+    q2 = A.SelectStmt(
+        items=(A.SelectItem(inner_key, "__k"),)
+        + tuple(A.SelectItem(e, f"__v{i}")
+                for i, e in enumerate(value_items)),
+        relation=q.relation, where=_and_all(rest), group_by=(inner_key,))
+    try:
+        from spark_druid_olap_tpu.sql.session import _run_select
+        df = _run_select(ctx, q2, sql="<correlated subquery>").to_pandas()
+    except Exception:  # noqa: BLE001 — leave to the host tier
+        return None
+    keep = df["__k"].notna()
+    k = df["__k"][keep]
+    if len(k) and np.asarray(k).dtype.kind not in "iu":
+        return None
+    vals = []
+    for i in range(len(value_items)):
+        v = _numeric_series(df[f"__v{i}"][keep])
+        if v is None:
+            return None
+        vals.append(v)
+    return np.asarray(k, dtype=np.int64), vals
+
+
+_NAN_SAFE_CMP = ("=", "<", "<=", ">", ">=")
+
+
+def inline_correlated_scalars(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
+    """Correlated subqueries in WHERE -> :class:`E.KeyedLookup`
+    expressions over decorrelated per-key aggregates (executed ONCE
+    through the full session path, so the inner gets engine pushdown),
+    leaving the outer statement subquery-free and itself pushable — the
+    TPC-H q2/q17/q21 shapes run entirely on device as scan-collapsed
+    broadcast joins. ≈ Spark's RewriteCorrelatedScalarSubquery /
+    RewritePredicateSubquery followed by a broadcast hash join.
+
+    NULL discipline: a lookup miss is NaN-coded (or the aggregate's
+    non-NULL empty-group identity, e.g. count -> 0). NaN evaluates False
+    under {=, <, <=, >, >=} — exactly SQL's UNKNOWN-drops-row — but True
+    under IEEE !=, and NOT flips a spurious False into a spurious True.
+    The walker therefore tracks polarity and only inlines a scalar
+    subquery under an even number of NOTs inside one of the safe
+    comparison ops, reached through NaN-transparent arithmetic. EXISTS
+    rewrites are polarity-independent (EXISTS is never UNKNOWN; the
+    generated predicate is False on miss, which negation maps correctly).
+    """
+    if stmt.where is None:
+        return stmt
+    changed = [False]
+
+    def subst_scalar(n):
+        q = n.query
+        if q.relation is None or q.group_by is not None \
+                or q.having is not None or q.limit is not None \
+                or q.distinct or len(q.items) != 1 \
+                or q.items[0].expr == "*":
+            return None
+        from spark_druid_olap_tpu.planner.host_exec import (
+            _empty_group_value, _expr_refs, _free_columns,
+            _relation_free_refs, relation_columns)
+        try:
+            free = _free_columns(ctx, q)
+            if len(free) != 1:
+                return None
+            (fcol,) = tuple(free)
+            if _relation_free_refs(ctx, q.relation) & free:
+                return None
+            if _expr_refs(ctx, q.items[0].expr) & free:
+                return None
+            inner_cols = set(relation_columns(ctx, q.relation))
+            cl = _classify_correlation(ctx, q, free, inner_cols, 0)
+        except Exception:  # noqa: BLE001 — unknown tables/columns
+            return None
+        if cl is None or not E.agg_calls_in(q.items[0].expr):
+            return None
+        kcol, inner_key, rest, _ = cl
+        r = _run_grouped_inner(ctx, q, inner_key, rest,
+                               [q.items[0].expr])
+        if r is None:
+            return None
+        karr, (varr,) = r
+        d = _empty_group_value(q.items[0].expr)
+        default = None
+        if isinstance(d, (int, float, np.number)) \
+                and not (isinstance(d, float) and np.isnan(d)):
+            default = float(d)
+        return E.KeyedLookup(E.Column(kcol),
+                             E.FrozenKeyedTable(karr, varr), default)
+
+    def val(e, allow):
+        """Value position: inline only when ``allow`` (reached from a
+        positively-oriented safe comparison through NaN-transparent
+        arithmetic)."""
+        if isinstance(e, A.ScalarSubquery) and allow:
+            r = subst_scalar(e)
+            if r is not None:
+                changed[0] = True
+                return r
+            return e
+        if isinstance(e, E.BinaryOp):
+            return E.BinaryOp(e.op, val(e.left, allow), val(e.right, allow))
+        if isinstance(e, E.Cast):
+            return E.Cast(val(e.child, allow), e.to)
+        return e
+
+    def boolean(e, pos):
+        if isinstance(e, E.And):
+            return E.And(tuple(boolean(p, pos) for p in e.parts))
+        if isinstance(e, E.Or):
+            return E.Or(tuple(boolean(p, pos) for p in e.parts))
+        if isinstance(e, E.Not):
+            return E.Not(boolean(e.child, not pos))
+        if isinstance(e, A.Exists):
+            r = _minmax_exists(ctx, e)
+            if r is not None:
+                changed[0] = True
+                return r
+            return e
+        if isinstance(e, E.Comparison):
+            allow = pos and e.op in _NAN_SAFE_CMP
+            return E.Comparison(e.op, val(e.left, allow),
+                                val(e.right, allow))
+        if isinstance(e, E.Between):
+            allow = pos and not e.negated
+            return E.Between(val(e.child, allow), val(e.low, allow),
+                             val(e.high, allow), e.negated)
+        return e
+
+    new_where = boolean(stmt.where, True)
+    if not changed[0]:
+        return stmt
+    return dataclasses.replace(stmt, where=new_where)
+
+
+def _minmax_exists(ctx, node) -> Optional[E.Expr]:
+    """EXISTS with one integer equi-correlation AND one comparison residual
+    against a second outer column -> an expression over per-key (min, max)
+    KeyedLookups: 'exists (inner.k = outer.k and inner.c <op> outer.c)'
+    is decidable from min(c)/max(c) per k, so the inner collapses to ONE
+    grouped aggregate (engine-executed here) and the outer stays pushable
+    — q21's shape runs on device end to end. NULL semantics: a missing
+    key gives NaN lookups whose ordered comparisons are false (EXISTS'
+    UNKNOWN-drops-row rule); '<>' adds explicit NOT-NULL guards because
+    IEEE NaN != x is true."""
+    from spark_druid_olap_tpu.planner.host_exec import (
+        _free_columns, _relation_free_refs, relation_columns)
+    q = node.query
+    if q.relation is None or q.group_by is not None \
+            or q.having is not None or q.limit is not None or q.distinct:
+        return None
+    try:
+        free = _free_columns(ctx, q)
+        if not free or len(free) > 2:
+            return None
+        if _relation_free_refs(ctx, q.relation) & free:
+            return None
+        inner_cols = set(relation_columns(ctx, q.relation))
+        cl = _classify_correlation(ctx, q, free, inner_cols, 1)
+    except Exception:  # noqa: BLE001 — unknown tables/columns
+        return None
+    if cl is None or len(cl[3]) != 1:
+        return None
+    kcol, inner_key, rest, (mm,) = cl
+    op, inner_expr, ccol = mm
+    if ccol == kcol:
+        return None
+    r = _run_grouped_inner(ctx, q, inner_key, rest,
+                           [E.AggCall("min", inner_expr),
+                            E.AggCall("max", inner_expr)])
+    if r is None:
+        return None
+    karr, (mnv, mxv) = r
+    mn = E.KeyedLookup(E.Column(kcol), E.FrozenKeyedTable(karr, mnv))
+    mx = E.KeyedLookup(E.Column(kcol), E.FrozenKeyedTable(karr, mxv))
+    c = E.Column(ccol)
+    if op == "<":
+        cond = E.Comparison("<", mn, c)
+    elif op == "<=":
+        cond = E.Comparison("<=", mn, c)
+    elif op == ">":
+        cond = E.Comparison(">", mx, c)
+    elif op == ">=":
+        cond = E.Comparison(">=", mx, c)
+    else:                                  # '<>'
+        cond = E.And((E.IsNull(mn, negated=True),
+                      E.IsNull(c, negated=True),
+                      E.Or((E.Comparison("!=", mn, c),
+                            E.Comparison("!=", mx, c)))))
+    return E.Not(cond) if node.negated else cond
+
+
 def inline_subqueries(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
     """Replace uncorrelated subquery nodes in WHERE/HAVING with literals."""
 

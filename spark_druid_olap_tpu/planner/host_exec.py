@@ -109,8 +109,9 @@ def try_engine(ctx, stmt: A.SelectStmt) -> Optional[pd.DataFrame]:
     try:
         from spark_druid_olap_tpu.planner.decorrelate import \
             inline_subqueries
+        from spark_druid_olap_tpu.planner.viewmerge import merge_derived
         from spark_druid_olap_tpu.sql.session import execute_planned
-        stmt2 = inline_subqueries(ctx, stmt)
+        stmt2 = inline_subqueries(ctx, merge_derived(ctx, stmt))
         pq = B.build(ctx, stmt2)
         df = execute_planned(ctx, pq)
         ctx.history.record(stmt2, {**ctx.engine.last_stats,
@@ -317,6 +318,31 @@ def _align_key(left: pd.Series, right: pd.Series):
     return left, right
 
 
+_MINMAX_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+
+
+def _residual_minmax(ctx, c, free, inner_cols):
+    """(op, inner_expr, outer_col_name) when the residual conjunct is a
+    single comparison 'inner_expr <op> outer_col' with op in
+    {<, <=, >, >=, <>} — decidable from per-key (min, max) of the inner
+    expression. op is normalized so the inner side reads on the LEFT.
+    Returns None for any other shape."""
+    if not isinstance(c, E.Comparison) \
+            or c.op not in ("<", "<=", ">", ">=", "<>", "!="):
+        return None
+    for a, b, op in ((c.left, c.right, c.op),
+                     (c.right, c.left, _MINMAX_FLIP.get(c.op, c.op))):
+        if isinstance(b, E.Column) and b.name in free:
+            try:
+                arefs = _expr_refs(ctx, a)
+            except Exception:  # noqa: BLE001
+                return None
+            if arefs and not (arefs & free) and arefs <= inner_cols \
+                    and not _has_subquery(a):
+                return ("<>" if op == "!=" else op, a, b.name)
+    return None
+
+
 def _execute_sub_decorrelated(ctx, node, env, free, n_rows, outer_env):
     """Vectorized correlated-subquery evaluation.
 
@@ -393,20 +419,39 @@ def _execute_sub_decorrelated(ctx, node, env, free, n_rows, outer_env):
     for c in inner_conjs:
         inner_where = c if inner_where is None else E.And((inner_where, c))
 
+    # EXISTS with exactly one ordered/inequality residual against one
+    # outer column -> per-key min/max instead of the row-level join:
+    # 'exists inner.c <op> outer.c' is decidable from (min(c), max(c))
+    # per correlation key, so the inner collapses to a GROUPED aggregate
+    # (engine-pushable) and the probe is a key-merge + vector compare —
+    # never the outer x inner-set cross product (TPC-H q21 shape;
+    # Spark's RewritePredicateSubquery + agg pushdown does the same).
+    minmax = None                  # (op, inner_expr, outer_free_name)
+    if isinstance(node, A.Exists) and len(residual_conjs) == 1:
+        minmax = _residual_minmax(ctx, residual_conjs[0], free, inner_cols)
+
     jk_cols = [f"__jk{j}" for j in range(len(join_pairs))]
     items = [A.SelectItem(b, jk_cols[j])
              for j, (_, b) in enumerate(join_pairs)]
     residual_cols = sorted(set().union(
         *[_expr_refs(ctx, c) - free for c in residual_conjs])) \
         if residual_conjs else []
-    for rc in residual_cols:
-        items.append(A.SelectItem(E.Column(rc), rc))
+    if minmax is None:
+        for rc in residual_cols:
+            items.append(A.SelectItem(E.Column(rc), rc))
     if is_scalar:
         items.append(A.SelectItem(q.items[0].expr, "__val"))
         q2 = dataclasses.replace(
             q, items=tuple(items), where=inner_where,
             group_by=tuple(b for _, b in join_pairs), having=None,
             order_by=(), limit=None)
+    elif minmax is not None:
+        items.append(A.SelectItem(E.AggCall("min", minmax[1]), "__mn"))
+        items.append(A.SelectItem(E.AggCall("max", minmax[1]), "__mx"))
+        q2 = dataclasses.replace(
+            q, items=tuple(items), where=inner_where,
+            group_by=tuple(b for _, b in join_pairs), having=None,
+            order_by=(), limit=None, distinct=False)
     else:
         if isinstance(node, A.InSubquery):
             items.append(A.SelectItem(q.items[0].expr, "__inval"))
@@ -476,6 +521,33 @@ def _execute_sub_decorrelated(ctx, node, env, free, n_rows, outer_env):
         return _PrecomputedColumn(vals)
 
     negated = getattr(node, "negated", False)
+    if minmax is not None:
+        op, _, fname = minmax
+        if df2["__mn"].dtype == object or df2["__mn"].dtype.kind == "M":
+            return None    # non-numeric min/max: row-wise fallback
+        merged = odf.merge(df2, left_on=key_ok_cols, right_on=right_keys,
+                           how="left", sort=False) \
+            .drop_duplicates("__oidx").sort_values("__oidx")
+        ocv = pd.Series(merged[f"__of_{fname}"].to_numpy())
+        if ocv.dtype == object:
+            ocv = pd.to_numeric(ocv, errors="coerce")
+        mn = pd.Series(merged["__mn"].to_numpy())
+        mx = pd.Series(merged["__mx"].to_numpy())
+        # pandas ordered compares are False on NaN (no group / all-NULL
+        # inner / NULL probe), which is EXISTS' UNKNOWN-drops-row rule;
+        # '<>' needs the explicit notna guard (NaN != x is True)
+        if op == "<":
+            hit = mn < ocv
+        elif op == "<=":
+            hit = mn <= ocv
+        elif op == ">":
+            hit = mx > ocv
+        elif op == ">=":
+            hit = mx >= ocv
+        else:                      # '<>'
+            hit = mn.notna() & ocv.notna() & ((mn != ocv) | (mx != ocv))
+        flags = np.asarray(hit, dtype=bool)
+        return _PrecomputedColumn(flags ^ negated)
     if isinstance(node, A.InSubquery) and not residual_conjs:
         # Fast path (no residual predicates): never materialize the
         # outer x per-key-inner-set cross product. Membership is a
@@ -516,7 +588,7 @@ def _execute_sub_decorrelated(ctx, node, env, free, n_rows, outer_env):
             menv[rc] = merged[rc].to_numpy()
         mask = np.ones(len(merged), dtype=bool)
         for c in residual_conjs:
-            mask &= np.asarray(host_eval.eval_expr(c, menv), dtype=bool)
+            mask &= host_eval.eval_pred3(c, menv)
         merged = merged[mask]
     if isinstance(node, A.InSubquery):
         # residual path: merged rows = each outer row's correlated inner set
@@ -652,7 +724,7 @@ def materialize_relation(ctx, rel: A.Relation, outer_env: Optional[dict],
                 if cols <= set(right.columns):
                     renv = {k: right[k].to_numpy() for k in cols}
                     c2 = resolve_subqueries(ctx, c, renv, outer_env)
-                    m = np.asarray(host_eval.eval_expr(c2, renv), dtype=bool)
+                    m = host_eval.eval_pred3(c2, renv)
                     right = right[m].reset_index(drop=True)
                 else:
                     kept.append(c)
@@ -678,7 +750,7 @@ def materialize_relation(ctx, rel: A.Relation, outer_env: Optional[dict],
             mask = np.ones(len(df), dtype=bool)
             for c in residual:
                 c2 = resolve_subqueries(ctx, c, env, outer_env)
-                mask &= np.asarray(host_eval.eval_expr(c2, env), dtype=bool)
+                mask &= host_eval.eval_pred3(c2, env)
             df = df[mask].reset_index(drop=True)
         return df
     raise HostExecError(f"relation {type(rel).__name__}")
@@ -813,7 +885,7 @@ def execute_select(ctx, stmt: A.SelectStmt,
     # WHERE
     if stmt.where is not None:
         w = resolve_subqueries(ctx, stmt.where, env, outer_env)
-        mask = np.asarray(host_eval.eval_expr(w, env))
+        mask = host_eval.eval_pred3(w, env)
         mask = np.broadcast_to(mask, (len(df),)).astype(bool)
         df = df[mask].reset_index(drop=True)
         env = {c: df[c].to_numpy() for c in df.columns}
@@ -958,7 +1030,7 @@ def _one_grouping(ctx, stmt, df, env, group_exprs, all_group_exprs, agg_calls,
         h = _replace_for_output(
             resolve_subqueries(ctx, stmt.having, env, outer_env),
             agg_cols, grp_cols)
-        keep = np.asarray(host_eval.eval_expr(h, genv), dtype=bool)
+        keep = host_eval.eval_pred3(h, genv)
 
     out = {}
     cols = []

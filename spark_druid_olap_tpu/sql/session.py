@@ -195,10 +195,12 @@ def _run_select_tz(ctx, stmt: A.SelectStmt, sql: str) -> QueryResult:
     stmt = resolve_lookups(ctx, stmt)
     try:
         from spark_druid_olap_tpu.planner.decorrelate import (
-            decorrelate_semijoins, inline_subqueries)
+            decorrelate_semijoins, inline_correlated_scalars,
+            inline_subqueries)
         from spark_druid_olap_tpu.planner.viewmerge import merge_derived
         stmt2 = merge_derived(ctx, stmt)
         stmt2 = decorrelate_semijoins(ctx, stmt2)
+        stmt2 = inline_correlated_scalars(ctx, stmt2)
         stmt2 = inline_subqueries(ctx, stmt2)
         pq = B.build(ctx, stmt2)
         df = execute_planned(ctx, pq)

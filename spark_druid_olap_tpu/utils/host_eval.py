@@ -22,6 +22,7 @@ import math
 import re
 
 import numpy as np
+import pandas as pd
 
 from spark_druid_olap_tpu.ir import expr as E
 from spark_druid_olap_tpu.ops.time_ops import (
@@ -209,6 +210,27 @@ def eval_expr(e: E.Expr, env: dict):
         if to in ("date", "timestamp"):
             return _to_days(v)
         raise HostEvalError(f"cast {to}")
+    if isinstance(e, E.KeyedLookup):
+        k = np.asarray(eval_expr(e.key, env))
+        keys, vals = e.table.keys, e.table.values
+        miss = np.nan if e.default is None else float(e.default)
+        if k.dtype == object or k.dtype.kind == "f":
+            kn = pd.to_numeric(pd.Series(k.reshape(-1)),
+                               errors="coerce").to_numpy()
+            ok = ~np.isnan(kn) & (kn == np.floor(kn))
+            ki = np.where(ok, kn, 0).astype(np.int64)
+        else:
+            ok = None
+            ki = k.reshape(-1).astype(np.int64)
+        if len(keys) == 0:
+            return np.full(ki.shape, miss)
+        idx = np.clip(np.searchsorted(keys, ki), 0, len(keys) - 1)
+        found = keys[idx] == ki
+        if ok is not None:
+            # NULL key: the correlated set is empty -> miss value
+            found &= ok
+        out = np.where(found, vals[idx], miss)
+        return out.reshape(k.shape)
     if isinstance(e, E.Case):
         otherwise = eval_expr(e.otherwise, env) if e.otherwise is not None else 0
         out = otherwise
@@ -225,11 +247,88 @@ def eval_expr(e: E.Expr, env: dict):
 
 
 def _map_null(v):
+    if v is None:
+        return np.ones((), dtype=bool)
+    if isinstance(v, float) and math.isnan(v):
+        return np.ones((), dtype=bool)
     return _map1(v, lambda x: x is None or (isinstance(x, float) and math.isnan(x))) \
         if isinstance(v, np.ndarray) and v.dtype == object \
         else (np.isnan(v) if isinstance(v, np.ndarray)
               and np.issubdtype(v.dtype, np.floating) else
               np.zeros(np.shape(v), dtype=bool))
+
+
+def eval_pred3(e: E.Expr, env: dict) -> np.ndarray:
+    """SQL three-valued WHERE/HAVING mask: TRUE keeps the row; UNKNOWN
+    (NULL-involved, NaN/None-coded) folds to FALSE at the root, but
+    propagates through NOT/AND/OR with Kleene semantics first — so
+    ``NOT (x > NULL)`` and ``x <> NULL`` correctly DROP rows where a
+    plain boolean evaluation would keep them."""
+    t, u = _pred3(e, env)
+    out = np.logical_and(t, np.logical_not(u))
+    return np.asarray(out, dtype=bool)
+
+
+def _pred3(e: E.Expr, env: dict):
+    """-> (definitely_true, unknown) boolean masks (disjoint). All logic
+    via np.logical_* so scalar (builtin-bool) operands stay safe."""
+    NOT, AND, OR = np.logical_not, np.logical_and, np.logical_or
+
+    def b(x):
+        return np.asarray(x, dtype=bool)
+
+    if isinstance(e, E.Not):
+        t, u = _pred3(e.child, env)
+        return AND(NOT(t), NOT(u)), u
+    if isinstance(e, E.And):
+        parts = [_pred3(p, env) for p in e.parts]
+        t_all = parts[0][0]
+        f_any = AND(NOT(parts[0][0]), NOT(parts[0][1]))
+        for t, u in parts[1:]:
+            t_all = AND(t_all, t)
+            f_any = OR(f_any, AND(NOT(t), NOT(u)))
+        return t_all, AND(NOT(t_all), NOT(f_any))
+    if isinstance(e, E.Or):
+        parts = [_pred3(p, env) for p in e.parts]
+        t_any = parts[0][0]
+        f_all = AND(NOT(parts[0][0]), NOT(parts[0][1]))
+        for t, u in parts[1:]:
+            t_any = OR(t_any, t)
+            f_all = AND(f_all, AND(NOT(t), NOT(u)))
+        return t_any, AND(NOT(t_any), NOT(f_all))
+    if isinstance(e, E.Comparison):
+        a = eval_expr(e.left, env)
+        bb = eval_expr(e.right, env)
+        u = OR(_map_null(a), _map_null(bb))
+        res = b(eval_expr(e, env))
+        res, u = np.broadcast_arrays(res, u)
+        return AND(res, NOT(u)), u
+    if isinstance(e, E.IsNull):
+        res = b(eval_expr(e, env))
+        return res, np.zeros(res.shape, dtype=bool)
+    if isinstance(e, E.Between):
+        inner = E.And((E.Comparison(">=", e.child, e.low),
+                       E.Comparison("<=", e.child, e.high)))
+        if e.negated:
+            inner = E.Not(inner)
+        return _pred3(inner, env)
+    if isinstance(e, E.InList):
+        # membership itself implements its list-null rules; the probe
+        # being NULL makes the result UNKNOWN (never TRUE)
+        u = _map_null(eval_expr(e.child, env))
+        res = b(eval_expr(e, env))
+        res, u = np.broadcast_arrays(res, u)
+        return AND(res, NOT(u)), u
+    v = eval_expr(e, env)
+    u = _map_null(v)
+    if isinstance(v, np.ndarray) and v.dtype == object:
+        res = b(_map1(v, bool))
+    elif np.any(u):
+        res = b(np.where(u, False, np.nan_to_num(v)))
+    else:
+        res = b(v)
+    res, u = np.broadcast_arrays(res, u)
+    return AND(res, NOT(u)), u
 
 
 def _date_promote(a, b, op):

@@ -151,8 +151,10 @@ def test_stream_peak_memory_bounded(tmp_path):
         + sum(c.codes.nbytes for c in ds.dims.values()) \
         + ds.time.days.nbytes + ds.time.ms_in_day.nbytes
     # overhead beyond the final store: a few 16k-row batches, not O(n)
+    # (slack absorbs tracemalloc noise from warm caches when the whole
+    # suite shares the process; a full-frame copy would be ~40MB)
     overhead = peak_stream - store_bytes
-    assert overhead < 6 * (1 << 14) * 8 * 5 + (1 << 22), \
+    assert overhead < 6 * (1 << 14) * 8 * 5 + (1 << 23), \
         (peak_stream, store_bytes)
 
     df = pd.read_parquet(p)
