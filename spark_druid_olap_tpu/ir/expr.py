@@ -211,6 +211,12 @@ class Case(Expr):
 
 
 # -- aggregate call (only valid inside SELECT/HAVING/ORDER trees) --------------
+# comparison-operator mirror for operand swaps (a <op> b == b <flip> a);
+# the single source shared by planner/executor rewrites
+FLIP_CMP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+            "=": "=", "!=": "!=", "<>": "<>"}
+
+
 class FrozenKeyedTable:
     """Immutable sorted int64-key -> float64-value map with O(1) repr/eq/
     hash (digest stands in for contents, like :class:`FrozenIntSet` — the

@@ -599,6 +599,61 @@ def _scatter_groupby(key, mask, n_keys, inputs, routes):
     return out
 
 
+def renorm_limbs(l0, l1, l2, l3):
+    """Propagate carries so limbs 0..2 land in [0, 2^16) (top limb signed,
+    two's-complement correct for negative totals). Needed after a psum of
+    independently-renormalized per-chip limbs."""
+    c0 = l0 >> 16
+    l0 = l0 & 0xFFFF
+    l1 = l1 + c0
+    c1 = l1 >> 16
+    l1 = l1 & 0xFFFF
+    l2 = l2 + c1
+    c2 = l2 >> 16
+    l2 = l2 & 0xFFFF
+    l3 = l3 + c2
+    return l0, l1, l2, l3
+
+
+def literal_limbs(v: int):
+    """The four 16-bit limbs of a python int in the renormalized layout
+    (limbs 0..2 unsigned, top limb signed/arithmetic)."""
+    v = int(v)
+    return ((v & 0xFFFF), (v >> 16) & 0xFFFF, (v >> 32) & 0xFFFF, v >> 48)
+
+
+def limbs_compare(limbs, lit: int, op: str):
+    """Exact device comparison of renormalized limb totals vs an int
+    literal: lexicographic from the signed top limb down (lower limbs are
+    unsigned, so per-limb i32 compares are exact at any total magnitude).
+    ``limbs`` is [n_keys, 4]; returns bool [n_keys]."""
+    l = renorm_limbs(limbs[:, 0], limbs[:, 1], limbs[:, 2], limbs[:, 3])
+    t = literal_limbs(lit)
+    eq = None
+    gt = None
+    for i in (3, 2, 1, 0):
+        li = l[i]
+        ti = jnp.int32(t[i])
+        gi = li > ti
+        ei = li == ti
+        if gt is None:
+            gt, eq = gi, ei
+        else:
+            gt = gt | (eq & gi)
+            eq = eq & ei
+    if op == ">":
+        return gt
+    if op == ">=":
+        return gt | eq
+    if op == "<":
+        return ~(gt | eq)
+    if op == "<=":
+        return ~gt
+    if op == "=":
+        return eq
+    return ~eq                                     # '!='
+
+
 def _limb_scatter_sum(values, key, n_keys: int):
     """Exact 64-bit grouped integer sum without i64/f64: 16-bit value lanes,
     row-chunked i32 segment_sums, 16-bit limb accumulation over a scan.
@@ -620,19 +675,7 @@ def _limb_scatter_sum(values, key, n_keys: int):
     v = v.reshape(n_chunks, rc)
     k = k.reshape(n_chunks, rc)
 
-    def renorm(l0, l1, l2, l3):
-        # propagate carries so limbs 0..2 land in [0, 2^16); arithmetic
-        # shifts keep two's-complement correctness for negative totals
-        c0 = l0 >> 16
-        l0 = l0 & 0xFFFF
-        l1 = l1 + c0
-        c1 = l1 >> 16
-        l1 = l1 & 0xFFFF
-        l2 = l2 + c1
-        c2 = l2 >> 16
-        l2 = l2 & 0xFFFF
-        l3 = l3 + c2
-        return l0, l1, l2, l3
+    renorm = renorm_limbs
 
     def step(limbs, xs):
         vc, kc = xs

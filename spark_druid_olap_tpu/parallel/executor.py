@@ -63,6 +63,7 @@ from spark_druid_olap_tpu.utils.config import (
     GROUPBY_HASH_SLOTS,
     GROUPBY_MATMUL_MAX_KEYS,
     GROUPBY_PALLAS_MAX_KEYS,
+    HAVING_DEVICE_MIN_KEYS,
     HLL_LOG2M,
     SELECT_DEVICE_MIN_ROWS,
     TOPN_DEVICE_MIN_KEYS,
@@ -797,29 +798,49 @@ class QueryEngine:
         sketch_plans = [p for p in agg_plans if p.kind in ("hll", "theta")]
         topk = self._plan_device_topk(limit, having, agg_plans, n_keys) \
             if n_waves == 1 else None
+        having_dev = self._plan_device_having(having, routes, agg_plans,
+                                              n_keys, topk, n_waves)
         n_out = topk[1] if topk else n_keys
 
-        # --- build / fetch program -------------------------------------------
-        sig = ("agg", ds.name, id(ds), repr(q), s_pad, ds.padded_rows,
-               min_day, max_day, sharded, n_dev, tuple(names), topk,
-               self.config.get(TZ_ID),
-               jax.default_backend(), bool(jax.config.jax_enable_x64))
-        # double-checked: warm queries never touch the lock
-        prog = self._programs.get(sig)
-        if prog is None:
-            with self._compile_lock:
-                prog = self._programs.get(sig)
-                if prog is None:
-                    prog = self._build_agg_program(
-                        ds, all_dim_plans, agg_plans, filter_spec,
-                        intervals, min_day, max_day, n_keys, sharded,
-                        routes, topk=topk)
-                    self._programs[sig] = prog
-
-        prog_fn, unpack = prog
         top_idx = None
-        if n_waves == 1:
-            dev_arrays = self._bind_arrays(ds, names, seg_idx, s_pad, sharded)
+        base_sig = (ds.name, id(ds), repr(q), s_pad, ds.padded_rows,
+                    min_day, max_day, sharded, n_dev, tuple(names),
+                    self.config.get(TZ_ID), jax.default_backend(),
+                    bool(jax.config.jax_enable_x64))
+        if having_dev:
+            # two dispatches: finals stay device-resident, only the mask
+            # count then the passing groups travel
+            sigA = ("aggtable", base_sig, having_dev)
+            progA = self._cached_program(
+                sigA, lambda: self._build_agg_table_program(
+                    ds, all_dim_plans, agg_plans, filter_spec, intervals,
+                    min_day, max_day, n_keys, sharded, routes,
+                    having_dev))
+            dev_arrays = self._bind_arrays(ds, names, seg_idx, s_pad,
+                                           sharded)
+            if t0 is not None:
+                self._stage_check(q, t0)
+            table = dict(progA(dev_arrays))
+            cnt = int(np.asarray(table.pop("__stats__"))[0])
+            n_out = min(n_keys,
+                        1 << max(6, (max(cnt, 1) - 1).bit_length()))
+            gfn, unpackB = self._cached_program(
+                (sigA, "gather", n_out),
+                lambda: self._build_agg_gather_program(
+                    agg_plans, routes, n_out, n_keys, sharded))
+            out = unpackB(gfn(table))
+            if t0 is not None:
+                self._stage_check(q, t0)
+            finals = _finals_from_out(out, routes, n_out, sketch_plans)
+            top_idx = np.asarray(out["__topk_idx__"]).astype(np.int64)
+        elif n_waves == 1:
+            prog_fn, unpack = self._cached_program(
+                ("agg", base_sig, topk),
+                lambda: self._build_agg_program(
+                    ds, all_dim_plans, agg_plans, filter_spec, intervals,
+                    min_day, max_day, n_keys, sharded, routes, topk=topk))
+            dev_arrays = self._bind_arrays(ds, names, seg_idx, s_pad,
+                                           sharded)
             if t0 is not None:
                 self._stage_check(q, t0)  # pre-dispatch boundary
             out = unpack(prog_fn(dev_arrays))
@@ -829,6 +850,11 @@ class QueryEngine:
             if topk:
                 top_idx = np.asarray(out["__topk_idx__"]).astype(np.int64)
         else:
+            prog_fn, unpack = self._cached_program(
+                ("agg", base_sig, None),
+                lambda: self._build_agg_program(
+                    ds, all_dim_plans, agg_plans, filter_spec, intervals,
+                    min_day, max_day, n_keys, sharded, routes, topk=None))
             finals = self._run_waves(q, ds, names, seg_idx, spw, sharded,
                                      prog_fn, unpack, routes, n_keys,
                                      sketch_plans, t0)
@@ -877,7 +903,8 @@ class QueryEngine:
             "sharded": sharded, "groups": int(len(sel)),
             "rows_scanned": int(ds.num_rows), "waves": int(n_waves),
             "segments_per_wave": int(spw),
-            "topk_device": int(topk[1]) if topk else 0})
+            "topk_device": int(topk[1]) if topk else 0,
+            "having_device": int(n_out) if having_dev else 0})
         return QueryResult(columns, data)
 
     def _plan_device_topk(self, limit, having, agg_plans, n_keys):
@@ -1026,13 +1053,7 @@ class QueryEngine:
                     intervals, min_day, max_day, T, sharded, routes,
                     topk=topk)
 
-            prog = self._programs.get(sig)
-            if prog is None:
-                with self._compile_lock:
-                    prog = self._programs.get(sig)
-                    if prog is None:
-                        prog = build()
-                        self._programs[sig] = prog
+            prog = self._cached_program(sig, build)
 
             partials, unresolved = [], 0
 
@@ -1058,16 +1079,10 @@ class QueryEngine:
                     occ_max = max(1, int(stats[:, 1].max()))
                     kg = min(T, 1 << max(6, (occ_max - 1).bit_length()))
                     kg_used = max(kg_used, kg)
-                    sigB = (sig, "gather", kg)
-                    progB = self._programs.get(sigB)
-                    if progB is None:
-                        with self._compile_lock:
-                            progB = self._programs.get(sigB)
-                            if progB is None:
-                                progB = self._build_hash_gather_program(
-                                    agg_plans, routes, kg, T, sharded)
-                                self._programs[sigB] = progB
-                    gfn, unpackB = progB
+                    gfn, unpackB = self._cached_program(
+                        (sig, "gather", kg),
+                        lambda kg=kg: self._build_hash_gather_program(
+                            agg_plans, routes, kg, T, sharded))
                     raw = unpackB(gfn(table))
                     partials.extend(
                         _hash_chip_partials(raw, routes, kg, n_dev))
@@ -1474,32 +1489,9 @@ class QueryEngine:
                                intervals, min_day, max_day, n_keys, routes)
         hll_plans = [p for p in agg_plans if p.kind == "hll"]
         theta_plans = [p for p in agg_plans if p.kind == "theta"]
-        dense_plans = [p for p in agg_plans
-                       if p.kind not in ("hll", "theta")]
-        log2m = self.config.get(HLL_LOG2M)
-        m = 1 << log2m
-        x64 = G._x64()
-        n_out = topk[1] if topk else n_keys
-
-        # (out_name, flat_len, dtype_str, merged) — flat_len is the PACKED
-        # length (after the top-k gather when enabled); the per-key group
-        # width is flat_len // n_out, identical pre-gather with n_keys.
-        meta = []
-        for p in dense_plans:
-            r = routes[p.spec.name]
-            for oname, size, dt in r.outputs(n_out):
-                meta.append((oname, size, dt, r.merged))
-        r = routes["__rows__"]
-        for oname, size, dt in r.outputs(n_out):
-            meta.append((oname, size, dt, r.merged))
-        meta += [(p.spec.name, n_out * m, "i32", True) for p in hll_plans]
-        meta += [(p.spec.name, n_out * TH.K_LANES,
-                  "f64" if x64 else "f32", True) for p in theta_plans]
-        if topk:
-            meta.append(("__topk_idx__", n_out, "i32", True))
-        merged_meta = [t for t in meta if t[3]]
-        perchip_meta = [t for t in meta if not t[3]]
-        buf_dtype = jnp.int64 if x64 else jnp.int32
+        pack, unpack = self._agg_meta_packers(
+            agg_plans, routes, topk[1] if topk else n_keys,
+            with_idx=bool(topk))
 
         def topk_gather(out, axis_name=None):
             """Select k_sel candidate keys by score, gather every output."""
@@ -1513,17 +1505,6 @@ class QueryEngine:
             g = _gather_rows(out, idx, n_keys)
             g["__topk_idx__"] = idx
             return g
-
-        def pack_group(out, metas):
-            parts = [_encode_buf(out[oname], dt, x64)
-                     for oname, _, dt, _ in metas]
-            if not parts:
-                return jnp.zeros((0,), buf_dtype)
-            return jnp.concatenate(parts)
-
-        def pack(out):
-            return pack_group(out, merged_meta), \
-                pack_group(out, perchip_meta)
 
         if not sharded:
             def plain(arrays):
@@ -1559,19 +1540,226 @@ class QueryEngine:
                                  check_vma=False)
             fn = jax.jit(lambda arrays: smfn(arrays))
 
-        merged_len = sum(t[1] for t in merged_meta)
+        return fn, unpack
+
+    def _cached_program(self, sig, build):
+        """Double-checked program-cache fetch: warm queries never touch
+        the compile lock."""
+        prog = self._programs.get(sig)
+        if prog is None:
+            with self._compile_lock:
+                prog = self._programs.get(sig)
+                if prog is None:
+                    prog = build()
+                    self._programs[sig] = prog
+        return prog
+
+    def _plan_device_having(self, having, routes, agg_plans, n_keys,
+                            topk, n_waves):
+        """(agg_name, op, int_literal) when HAVING is a single comparison
+        of an EXACT-on-device aggregate against an integer literal and the
+        key space is big enough that shipping only passing groups pays
+        (two dispatches: finals + having mask + count, then gather).
+        Exactness: limb sums compare lexicographically at any magnitude;
+        i32/i64/f64 min/max compare in their own domain. The host epilogue
+        re-applies HAVING over the exact finals, so this is a transfer
+        filter, never the source of truth."""
+        if having is None or topk is not None or n_waves != 1:
+            return None
+        if n_keys < self.config.get(HAVING_DEVICE_MIN_KEYS):
+            return None
+        e = having.expr
+        if not isinstance(e, E.Comparison):
+            return None
+        for a, b, op in ((e.left, e.right, e.op),
+                         (e.right, e.left, E.FLIP_CMP.get(e.op, e.op))):
+            if isinstance(a, E.Column) and isinstance(b, E.Literal) \
+                    and isinstance(b.value, (int, np.integer)) \
+                    and not isinstance(b.value, bool):
+                r = routes.get(a.name)
+                if r is None:
+                    continue
+                lit = int(b.value)
+                # the literal must fit the route's comparable domain:
+                # out-of-range casts would wrap/raise on device
+                if r.tag == "i32" and not -2**31 <= lit < 2**31:
+                    continue
+                if r.tag in ("i64", "limbs") \
+                        and not -2**62 <= lit < 2**62:
+                    continue
+                if r.tag in ("limbs", "i32", "i64", "f64"):
+                    return (a.name, "!=" if op == "<>" else op, lit)
+        return None
+
+    def _having_mask(self, having_dev, out, routes, n_keys, axis_name):
+        """Device bool [n_keys]: group occupied AND HAVING passes (exact;
+        see _plan_device_having)."""
+        name, op, lit = having_dev
+        r = routes[name]
+        rows_sc = G.route_score(routes["__rows__"], out, n_keys, axis_name)
+        occ = rows_sc > 0.5
+        if r.tag == "limbs":
+            limbs = out[name + ".limbs"].reshape(n_keys, G.N_LIMBS)
+            m = G.limbs_compare(limbs, lit, op)
+        else:
+            v = out[name]
+            cmp = {"=": lambda a, b: a == b, "!=": lambda a, b: a != b,
+                   "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+                   ">": lambda a, b: a > b, ">=": lambda a, b: a >= b}
+            m = cmp[op](v, jnp.asarray(lit, v.dtype))
+            nm = G.route_null_mask(r, out)
+            if nm is not None:          # NULL metric: UNKNOWN -> drop
+                m = m & ~nm
+        return m & occ
+
+    def _build_agg_table_program(self, ds, dim_plans, agg_plans,
+                                 filter_spec, intervals, min_day, max_day,
+                                 n_keys, sharded, routes, having_dev):
+        """HAVING-compaction dispatch 1 of 2: scan + merge, leave the
+        finals DEVICE-RESIDENT, compute the exact having mask and transfer
+        only its count. ≈ Druid evaluating HavingSpec on the data node
+        instead of shipping every group to the broker."""
+        core = self._make_core(ds, dim_plans, agg_plans, filter_spec,
+                               intervals, min_day, max_day, n_keys, routes)
+        hll_plans = [p for p in agg_plans if p.kind == "hll"]
+        theta_plans = [p for p in agg_plans if p.kind == "theta"]
+
+        def finish(out, axis_name=None):
+            out = dict(out)
+            out["__hmask__"] = self._having_mask(having_dev, out, routes,
+                                                 n_keys, axis_name)
+            out["__stats__"] = jnp.sum(out["__hmask__"]) \
+                .astype(jnp.int32).reshape(1)
+            return out
+
+        if not sharded:
+            return jax.jit(lambda arrays: finish(core(arrays)))
+        mesh = self.mesh
+
+        def sharded_core(arrays):
+            out = core(arrays)
+            sk_names = {p.spec.name for p in hll_plans} \
+                | {p.spec.name for p in theta_plans}
+            dense_out = {k: v for k, v in out.items()
+                         if k not in sk_names}
+            merged = G.merge_partials(dense_out, routes, SEGMENT_AXIS)
+            for p in hll_plans:
+                merged[p.spec.name] = HLL.merge_registers(
+                    out[p.spec.name], SEGMENT_AXIS)
+            for p in theta_plans:
+                merged[p.spec.name] = TH.merge_registers(
+                    out[p.spec.name], SEGMENT_AXIS)
+            return finish(merged, SEGMENT_AXIS)
+
+        out_specs = self._agg_out_specs(agg_plans, routes)
+        smfn = jax.shard_map(sharded_core, mesh=mesh,
+                             in_specs=(P(SEGMENT_AXIS, None),),
+                             out_specs=out_specs, check_vma=False)
+        return jax.jit(lambda arrays: smfn(arrays))
+
+    def _agg_out_specs(self, agg_plans, routes, with_stats=True):
+        """Per-leaf shard specs of the post-merge finals dict: merged
+        routes and sketches are replicated, ff/lanes partial pairs stay
+        per-chip along the segment axis."""
+        specs = {}
+        for p in agg_plans:
+            if p.kind in ("hll", "theta"):
+                specs[p.spec.name] = P()
+                continue
+            r = routes[p.spec.name]
+            for oname, _, _ in r.outputs(1):
+                specs[oname] = P() if r.merged else P(SEGMENT_AXIS)
+        r = routes["__rows__"]
+        for oname, _, _ in r.outputs(1):
+            specs[oname] = P() if r.merged else P(SEGMENT_AXIS)
+        if with_stats:
+            specs["__hmask__"] = P()
+            specs["__stats__"] = P()
+        return specs
+
+    def _build_agg_gather_program(self, agg_plans, routes, k, n_keys,
+                                  sharded):
+        """HAVING-compaction dispatch 2 of 2: gather the passing groups
+        (device mask from dispatch 1) and pack into the standard
+        two-buffer transfer, sized [k] instead of [n_keys]."""
+        pack, unpack = self._agg_meta_packers(agg_plans, routes, k,
+                                              with_idx=True)
+
+        def gather(table):
+            table = dict(table)
+            table.pop("__stats__", None)
+            mask = table.pop("__hmask__")
+            _, idx = jax.lax.top_k(mask.astype(jnp.float32), k)
+            idx = idx.astype(jnp.int32)
+            g = _gather_rows(table, idx, n_keys)
+            g["__topk_idx__"] = idx
+            return pack(g)
+
+        if not sharded:
+            return jax.jit(gather), unpack
+        # '__stats__' was already popped host-side after dispatch 1
+        in_specs = self._agg_out_specs(agg_plans, routes, with_stats=False)
+        in_specs["__hmask__"] = P()
+        smfn = jax.shard_map(gather, mesh=self.mesh, in_specs=(in_specs,),
+                             out_specs=(P(), P(SEGMENT_AXIS)),
+                             check_vma=False)
+        return jax.jit(lambda table: smfn(table)), unpack
+
+    def _agg_meta_packers(self, agg_plans, routes, n_out, with_idx):
+        """(pack, unpack) for the dense path's TWO-buffer transfer:
+        collective-merged outputs in one replicated buffer, per-chip
+        ff/lanes partial pairs in one segment-sharded buffer. ``n_out``
+        is the per-key output length (n_keys, or the gather size when a
+        top-k/having epilogue selected rows; then ``with_idx`` appends
+        the '__topk_idx__' key map)."""
+        hll_plans = [p for p in agg_plans if p.kind == "hll"]
+        theta_plans = [p for p in agg_plans if p.kind == "theta"]
+        dense_plans = [p for p in agg_plans
+                       if p.kind not in ("hll", "theta")]
+        m = 1 << self.config.get(HLL_LOG2M)
+        x64 = G._x64()
+        # (out_name, flat_len, dtype_str, merged)
+        meta = []
+        for p in dense_plans:
+            r = routes[p.spec.name]
+            for oname, size, dt in r.outputs(n_out):
+                meta.append((oname, size, dt, r.merged))
+        r = routes["__rows__"]
+        for oname, size, dt in r.outputs(n_out):
+            meta.append((oname, size, dt, r.merged))
+        meta += [(p.spec.name, n_out * m, "i32", True) for p in hll_plans]
+        meta += [(p.spec.name, n_out * TH.K_LANES,
+                  "f64" if x64 else "f32", True) for p in theta_plans]
+        if with_idx:
+            meta.append(("__topk_idx__", n_out, "i32", True))
+        merged_meta = [t for t in meta if t[3]]
+        perchip_meta = [t for t in meta if not t[3]]
+        buf_dtype = jnp.int64 if x64 else jnp.int32
         perchip_len = sum(t[1] for t in perchip_meta)
 
-        def restore(chunk, dt):
-            return _decode_buf(chunk, dt, x64)
+        def pack_group(out, metas):
+            parts = [_encode_buf(out[oname], dt, x64)
+                     for oname, _, dt, _ in metas]
+            if not parts:
+                return jnp.zeros((0,), buf_dtype)
+            return jnp.concatenate(parts)
+
+        def pack(out):
+            return pack_group(out, merged_meta), \
+                pack_group(out, perchip_meta)
 
         def unpack(bufs) -> Dict[str, np.ndarray]:
+            for b in bufs:
+                try:       # overlap the two device->host round trips
+                    b.copy_to_host_async()
+                except Exception:  # noqa: BLE001 — plain np inputs in tests
+                    pass
             mflat = np.asarray(bufs[0])
             uflat = np.asarray(bufs[1])
             out = {}
             off = 0
             for oname, size, dt, _ in merged_meta:
-                chunk = restore(mflat[off: off + size], dt)
+                chunk = _decode_buf(mflat[off: off + size], dt, x64)
                 off += size
                 if any(oname == p.spec.name for p in hll_plans):
                     chunk = np.rint(chunk).astype(np.int32) \
@@ -1586,13 +1774,13 @@ class QueryEngine:
                 for oname, size, dt, _ in perchip_meta:
                     # [n_chips, size] -> flat chip-major (combine_route
                     # reshapes back)
-                    out[oname] = restore(
+                    out[oname] = _decode_buf(
                         np.ascontiguousarray(chips[:, off: off + size])
-                        .reshape(-1), dt)
+                        .reshape(-1), dt, x64)
                     off += size
             return out
 
-        return fn, unpack
+        return pack, unpack
 
     # -- select path ----------------------------------------------------------
     def _run_select(self, q: S.SelectQuerySpec) -> QueryResult:

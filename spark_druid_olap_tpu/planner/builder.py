@@ -374,7 +374,7 @@ class Builder:
         op = c.op
         if isinstance(r, E.Column) and isinstance(l, E.Literal):
             l, r = r, l
-            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+            op = E.FLIP_CMP.get(op, op)
         if not (isinstance(l, E.Column) and isinstance(r, E.Literal)):
             return None
         kind = self._col_kind(l.name)

@@ -242,6 +242,40 @@ def _run_grouped_inner(ctx, q, inner_key, rest, value_items):
 _NAN_SAFE_CMP = ("=", "<", "<=", ">", ">=")
 
 
+def _cols_outside_lookups(e) -> set:
+    """Column names referenced by ``e`` OUTSIDE KeyedLookup subtrees (a
+    lookup's key column handles its own NULLs in lowering — miss value —
+    and must not be over-guarded: a NULL key with a count-default still
+    compares meaningfully)."""
+    out = set()
+
+    def rec(n):
+        if isinstance(n, E.KeyedLookup):
+            return
+        if isinstance(n, E.Column):
+            out.add(n.name)
+        for c in n.children():
+            rec(c)
+
+    rec(e)
+    return out
+
+
+def _null_guarded(ctx, rel, cmp_expr):
+    """Device column payloads are zero-FILLED for NULL rows, so a pushed
+    comparison touching a nullable outer column needs explicit IS NOT
+    NULL guards to keep SQL's UNKNOWN-drops-row semantics (the host tier
+    gets them right via eval_pred3, the compiled path via the column
+    validity masks behind IsNull)."""
+    guards = tuple(
+        E.IsNull(E.Column(c), negated=True)
+        for c in sorted(_cols_outside_lookups(cmp_expr))
+        if not _column_non_null(ctx, rel, c))
+    if not guards:
+        return cmp_expr
+    return E.And(guards + (cmp_expr,))
+
+
 def inline_correlated_scalars(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
     """Correlated subqueries in WHERE -> :class:`E.KeyedLookup`
     expressions over decorrelated per-key aggregates (executed ONCE
@@ -315,9 +349,13 @@ def inline_correlated_scalars(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
                 return r
             return e
         if isinstance(e, E.BinaryOp):
-            return E.BinaryOp(e.op, val(e.left, allow), val(e.right, allow))
+            l2, r2 = val(e.left, allow), val(e.right, allow)
+            if l2 is e.left and r2 is e.right:
+                return e
+            return E.BinaryOp(e.op, l2, r2)
         if isinstance(e, E.Cast):
-            return E.Cast(val(e.child, allow), e.to)
+            c2 = val(e.child, allow)
+            return e if c2 is e.child else E.Cast(c2, e.to)
         return e
 
     def boolean(e, pos):
@@ -328,19 +366,26 @@ def inline_correlated_scalars(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
         if isinstance(e, E.Not):
             return E.Not(boolean(e.child, not pos))
         if isinstance(e, A.Exists):
-            r = _minmax_exists(ctx, e)
+            r = _minmax_exists(ctx, e, stmt.relation)
             if r is not None:
                 changed[0] = True
                 return r
             return e
         if isinstance(e, E.Comparison):
             allow = pos and e.op in _NAN_SAFE_CMP
-            return E.Comparison(e.op, val(e.left, allow),
-                                val(e.right, allow))
+            out = E.Comparison(e.op, val(e.left, allow),
+                               val(e.right, allow))
+            if out.left is not e.left or out.right is not e.right:
+                return _null_guarded(ctx, stmt.relation, out)
+            return e
         if isinstance(e, E.Between):
             allow = pos and not e.negated
-            return E.Between(val(e.child, allow), val(e.low, allow),
-                             val(e.high, allow), e.negated)
+            out = E.Between(val(e.child, allow), val(e.low, allow),
+                            val(e.high, allow), e.negated)
+            if out.child is not e.child or out.low is not e.low \
+                    or out.high is not e.high:
+                return _null_guarded(ctx, stmt.relation, out)
+            return e
         return e
 
     new_where = boolean(stmt.where, True)
@@ -349,7 +394,7 @@ def inline_correlated_scalars(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
     return dataclasses.replace(stmt, where=new_where)
 
 
-def _minmax_exists(ctx, node) -> Optional[E.Expr]:
+def _minmax_exists(ctx, node, outer_rel=None) -> Optional[E.Expr]:
     """EXISTS with one integer equi-correlation AND one comparison residual
     against a second outer column -> an expression over per-key (min, max)
     KeyedLookups: 'exists (inner.k = outer.k and inner.c <op> outer.c)'
@@ -403,6 +448,10 @@ def _minmax_exists(ctx, node) -> Optional[E.Expr]:
                       E.IsNull(c, negated=True),
                       E.Or((E.Comparison("!=", mn, c),
                             E.Comparison("!=", mx, c)))))
+    if op != "<>" and not _column_non_null(ctx, outer_rel, ccol):
+        # NULL outer probe: every residual comparison is UNKNOWN, so the
+        # EXISTS is false — zero-filled device payloads need the guard
+        cond = E.And((E.IsNull(c, negated=True), cond))
     return E.Not(cond) if node.negated else cond
 
 

@@ -318,7 +318,7 @@ def _align_key(left: pd.Series, right: pd.Series):
     return left, right
 
 
-_MINMAX_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+_MINMAX_FLIP = E.FLIP_CMP
 
 
 def _residual_minmax(ctx, c, free, inner_cols):

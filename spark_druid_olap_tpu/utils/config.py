@@ -143,6 +143,13 @@ GROUPBY_HASH_MAX_SLOTS = _entry(
     "what this table can hold falls back to the host tier (reference "
     "contract: Druid groupBy v2 spills, never refuses — "
     "DruidQuerySpec.scala:558-571).")
+HAVING_DEVICE_MIN_KEYS = _entry(
+    "sdot.engine.having.device.min.keys", 1 << 16,
+    "Min fused key cardinality before an exact-comparable HAVING (int "
+    "literal vs limb/i32/i64/f64 aggregate) evaluates on device and only "
+    "passing groups transfer (two dispatches: finals+mask count, then "
+    "gather). Below it the full [K] result transfers and the host "
+    "filters.")
 TOPN_DEVICE_MIN_KEYS = _entry(
     "sdot.engine.topn.device.min.keys", 8192,
     "Min fused key cardinality before an ordered-limit group-by / topN "

@@ -99,6 +99,17 @@ class Precomputed(E.Expr):
         self.arr = arr
 
 
+def _compare(op: str, a, b):
+    """Two-valued comparison over already-evaluated operands (shared by
+    eval_expr and the 3VL predicate walker, which evaluates operands once
+    for both the result and the null masks)."""
+    a, b = _cmp_promote(a, b)
+    ops = {"=": "eq", "!=": "ne", "<": "lt", "<=": "le", ">": "gt",
+           ">=": "ge"}
+    import operator
+    return getattr(operator, ops[op])(a, b)
+
+
 def eval_expr(e: E.Expr, env: dict):
     """Evaluate ``e``; ``env`` maps column name -> scalar or numpy array."""
     if isinstance(e, Precomputed):
@@ -125,13 +136,8 @@ def eval_expr(e: E.Expr, env: dict):
             return np.mod(a, b)
         raise HostEvalError(e.op)
     if isinstance(e, E.Comparison):
-        a = eval_expr(e.left, env)
-        b = eval_expr(e.right, env)
-        a, b = _cmp_promote(a, b)
-        ops = {"=": "eq", "!=": "ne", "<": "lt", "<=": "le", ">": "gt",
-               ">=": "ge"}
-        import operator
-        return getattr(operator, ops[e.op])(a, b)
+        return _compare(e.op, eval_expr(e.left, env),
+                        eval_expr(e.right, env))
     if isinstance(e, E.And):
         out = True
         for p in e.parts:
@@ -300,7 +306,7 @@ def _pred3(e: E.Expr, env: dict):
         a = eval_expr(e.left, env)
         bb = eval_expr(e.right, env)
         u = OR(_map_null(a), _map_null(bb))
-        res = b(eval_expr(e, env))
+        res = b(_compare(e.op, a, bb))      # operands evaluated once
         res, u = np.broadcast_arrays(res, u)
         return AND(res, NOT(u)), u
     if isinstance(e, E.IsNull):

@@ -181,6 +181,42 @@ def test_keyed_lookup_null_keys_miss():
     np.testing.assert_array_equal(out0, [0.0, 0.0])
 
 
+def test_nullable_outer_column_guarded():
+    """A comparison over a NULLABLE outer column must not read the
+    zero-filled device payload: NULL rows drop (SQL UNKNOWN), matching
+    pandas."""
+    rng = np.random.default_rng(7)
+    n = 20_000
+    qty = rng.integers(1, 50, n).astype(float)
+    qty[rng.random(n) < 0.2] = np.nan          # nullable
+    df = pd.DataFrame({
+        "ts": (np.datetime64("2019-01-01")
+               + rng.integers(0, 365, n).astype("timedelta64[D]"))
+        .astype("datetime64[ns]"),
+        "partkey": rng.integers(1, 200, n),
+        "qty": qty,
+    })
+    c = sdot.Context()
+    c.ingest_dataframe("fact", df, time_column="ts", target_rows=4096)
+    got = c.sql(
+        "select count(*) as n from fact "
+        "where qty < (select avg(f2_qty) from "
+        "  (select partkey as f2_partkey, qty as f2_qty from fact) f2 "
+        "             where f2_partkey = partkey)").to_pandas()
+    thr = df.groupby("partkey")["qty"].mean()
+    want = int((df.qty < df.partkey.map(thr)).sum())   # NaN -> False
+    assert int(got["n"][0]) == want
+    # NOT EXISTS with a nullable outer probe
+    got2 = c.sql(
+        "select count(*) as n from fact where not exists "
+        "(select 1 from (select partkey as f2_partkey, qty as f2_qty "
+        "  from fact) f2 where f2_partkey = partkey "
+        " and f2_qty > qty)").to_pandas()
+    mx = df.groupby("partkey")["qty"].max()
+    want2 = int((~(df.partkey.map(mx) > df.qty)).sum())
+    assert int(got2["n"][0]) == want2
+
+
 def test_keyed_lookup_host_eval():
     tab = E.FrozenKeyedTable(np.array([3, 1, 7]), np.array([30., 10., 70.]))
     e = E.KeyedLookup(E.Column("k"), tab)

@@ -1,0 +1,178 @@
+"""Device HAVING compaction (reference: Druid evaluates HavingSpec on the
+data node — ``DruidQuerySpec`` having tree — instead of shipping every
+group to the broker; here the exact mask + count travel first, then only
+passing groups).
+
+Exactness: limb sums compare lexicographically at any magnitude
+(ops.groupby.limbs_compare); the host epilogue re-applies HAVING over the
+exact finals, so the device mask is a transfer filter, never the source
+of truth.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_druid_olap_tpu.ir import expr as E
+from spark_druid_olap_tpu.ir.spec import (
+    AggregationSpec, DimensionSpec, GroupByQuerySpec, HavingSpec,
+)
+from spark_druid_olap_tpu.ops import groupby as G
+from spark_druid_olap_tpu.parallel.executor import QueryEngine
+from spark_druid_olap_tpu.parallel.mesh import make_mesh
+from spark_druid_olap_tpu.segment.ingest import ingest_dataframe
+from spark_druid_olap_tpu.segment.store import SegmentStore
+from spark_druid_olap_tpu.utils.config import Config
+
+N = 80_000
+N_CUST = 70_000          # above having.device.min.keys (2^16)
+
+
+def _df():
+    rng = np.random.default_rng(41)
+    return pd.DataFrame({
+        "ts": (np.datetime64("2022-01-01")
+               + rng.integers(0, 365, N).astype("timedelta64[D]"))
+        .astype("datetime64[ns]"),
+        "cust": rng.choice([f"c{i:05d}" for i in range(N_CUST)], N),
+        "qty": rng.integers(1, 100, N).astype(np.int64),
+        # wide values: per-group sums can pass 2^31, testing the
+        # lexicographic limb comparison beyond the i32 range
+        "big": rng.integers(2**28, 2**31, N).astype(np.int64),
+        "price": np.round(rng.uniform(1, 500, N), 2),
+    })
+
+
+@pytest.fixture(scope="module")
+def hdf():
+    return _df()
+
+
+@pytest.fixture(scope="module")
+def hstore(hdf):
+    st = SegmentStore()
+    st.register(ingest_dataframe("fact", hdf, time_column="ts",
+                                 target_rows=1 << 14))
+    return st
+
+
+AGGS = (
+    AggregationSpec("longsum", "s_qty", field="qty"),
+    AggregationSpec("doublesum", "s_price", field="price"),
+    AggregationSpec("count", "n"),
+)
+
+
+def _q(metric, op, lit, aggs=AGGS):
+    return GroupByQuerySpec(
+        datasource="fact",
+        dimensions=(DimensionSpec("cust", "cust"),),
+        aggregations=aggs,
+        having=HavingSpec(E.Comparison(op, E.Column(metric),
+                                       E.Literal(lit))))
+
+
+def _want(df, pred):
+    g = df.groupby("cust", as_index=False).agg(
+        s_qty=("qty", "sum"), s_price=("price", "sum"), n=("qty", "size"))
+    return g[pred(g)]
+
+
+def _check(eng, got, want):
+    got = got.sort_values("cust").reset_index(drop=True)
+    want = want.sort_values("cust").reset_index(drop=True)
+    assert len(got) == len(want)
+    np.testing.assert_array_equal(got["cust"].to_numpy().astype(str),
+                                  want["cust"].to_numpy())
+    np.testing.assert_array_equal(got["s_qty"].to_numpy().astype(np.int64),
+                                  want["s_qty"].to_numpy())
+    np.testing.assert_array_equal(got["n"].to_numpy().astype(np.int64),
+                                  want["n"].to_numpy())
+
+
+@pytest.mark.parametrize("op,pred", [
+    (">", lambda g: g.s_qty > 200),
+    (">=", lambda g: g.s_qty >= 200),
+    ("<", lambda g: g.s_qty < 40),
+    ("=", lambda g: g.s_qty == 100),
+])
+def test_having_device_ops(hstore, hdf, op, pred):
+    lit = {"<": 40, "=": 100}.get(op, 200)
+    eng = QueryEngine(hstore, config=Config(
+        {"sdot.engine.having.device.min.keys": 1024}))
+    got = eng.execute(_q("s_qty", op, lit)).to_pandas()
+    assert eng.last_stats["having_device"] > 0
+    _check(eng, got, _want(hdf, pred))
+
+
+def test_having_device_matches_host_path(hstore):
+    q = _q("n", ">", 2)
+    dev = QueryEngine(hstore, config=Config(
+        {"sdot.engine.having.device.min.keys": 1024}))
+    got = dev.execute(q).to_pandas()
+    assert dev.last_stats["having_device"] > 0
+    host = QueryEngine(hstore, config=Config(
+        {"sdot.engine.having.device.min.keys": 1 << 30}))
+    want = host.execute(q).to_pandas()
+    assert host.last_stats["having_device"] == 0
+    pd.testing.assert_frame_equal(
+        got.sort_values("cust").reset_index(drop=True),
+        want.sort_values("cust").reset_index(drop=True))
+
+
+def test_having_device_sharded(hstore, hdf):
+    eng = QueryEngine(hstore, mesh=make_mesh(), config=Config(
+        {"sdot.querycostmodel.enabled": False,
+         "sdot.engine.having.device.min.keys": 1024}))
+    got = eng.execute(_q("s_qty", ">", 200)).to_pandas()
+    assert eng.last_stats["sharded"] is True
+    assert eng.last_stats["having_device"] > 0
+    _check(eng, got, _want(hdf, lambda g: g.s_qty > 200))
+
+
+def test_having_device_wide_sums(hstore, hdf):
+    """Per-group sums beyond 2^31: the limb comparison must stay exact."""
+    lit = int(hdf.groupby("cust")["big"].sum().median())
+    aggs = (AggregationSpec("longsum", "s_big", field="big"),
+            AggregationSpec("count", "n"))
+    eng = QueryEngine(hstore, config=Config(
+        {"sdot.engine.having.device.min.keys": 1024}))
+    got = eng.execute(_q("s_big", ">", lit, aggs=aggs)).to_pandas()
+    assert eng.last_stats["having_device"] > 0
+    g = hdf.groupby("cust", as_index=False).agg(s_big=("big", "sum"))
+    want = g[g.s_big > lit]
+    assert len(got) == len(want)
+    np.testing.assert_array_equal(
+        np.sort(got["s_big"].to_numpy().astype(np.int64)),
+        np.sort(want["s_big"].to_numpy()))
+
+
+def test_having_float_metric_stays_host_on_tpu_dtypes(hstore):
+    """Under TPU dtypes (x64 off) float sums ride the f32 ff route —
+    borderline groups could flip, so the compactor must NOT engage there.
+    (On x64 the f64 route is exact and engaging is correct.)"""
+    import jax
+    jax.config.update("jax_enable_x64", False)
+    try:
+        eng = QueryEngine(hstore, config=Config(
+            {"sdot.engine.having.device.min.keys": 1024}))
+        got = eng.execute(_q("s_price", ">", 1000)).to_pandas()
+        assert eng.last_stats["having_device"] == 0
+        assert len(got) > 0
+    finally:
+        jax.config.update("jax_enable_x64", True)
+
+
+def test_limbs_compare_unit():
+    vals = np.array([-2**40, -5, 0, 3, 2**20, 2**35, 2**45], dtype=np.int64)
+    import jax.numpy as jnp
+    limbs = np.stack([(vals & 0xFFFF), (vals >> 16) & 0xFFFF,
+                      (vals >> 32) & 0xFFFF, vals >> 48],
+                     axis=1).astype(np.int32)
+    for lit in (-2**40, -6, -5, 0, 3, 2**20 + 1, 2**35, 2**44):
+        for op, fn in ((">", np.greater), (">=", np.greater_equal),
+                       ("<", np.less), ("<=", np.less_equal),
+                       ("=", np.equal), ("!=", np.not_equal)):
+            got = np.asarray(G.limbs_compare(jnp.asarray(limbs), lit, op))
+            np.testing.assert_array_equal(
+                got, fn(vals, lit), err_msg=f"{op} {lit}")
