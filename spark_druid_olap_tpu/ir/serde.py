@@ -22,7 +22,7 @@ _EXPR_TYPES = {
     "cmp": E.Comparison, "and": E.And, "or": E.Or, "not": E.Not,
     "isnull": E.IsNull, "in": E.InList, "between": E.Between,
     "like": E.Like, "func": E.Func, "cast": E.Cast, "case": E.Case,
-    "agg": E.AggCall,
+    "agg": E.AggCall, "lookup": E.KeyedLookup,
 }
 _EXPR_NAMES = {v: k for k, v in _EXPR_TYPES.items()}
 
@@ -76,6 +76,13 @@ def expr_to_dict(e: Optional[E.Expr]):
     if isinstance(e, E.AggCall):
         return {"t": t, "fn": e.fn, "arg": expr_to_dict(e.arg),
                 "distinct": e.distinct, "approx": e.approx}
+    if isinstance(e, E.KeyedLookup):
+        import numpy as np
+        return {"t": t, "key": expr_to_dict(e.key),
+                "keys": [int(k) for k in e.table.keys],
+                "values": [None if np.isnan(v) else float(v)
+                           for v in e.table.values],
+                "default": e.default}
     raise AssertionError
 
 
@@ -127,6 +134,15 @@ def expr_from_dict(d) -> Optional[E.Expr]:
     if t == "agg":
         return E.AggCall(d["fn"], expr_from_dict(d.get("arg")),
                          d.get("distinct", False), d.get("approx", False))
+    if t == "lookup":
+        import numpy as np
+        vals = np.array([np.nan if v is None else v for v in d["values"]],
+                        dtype=np.float64)
+        return E.KeyedLookup(
+            expr_from_dict(d["key"]),
+            E.FrozenKeyedTable(np.asarray(d["keys"], dtype=np.int64),
+                               vals),
+            d.get("default"))
     raise ValueError(f"unknown expr type {t!r}")
 
 

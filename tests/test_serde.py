@@ -114,3 +114,18 @@ def test_expr_sql_stability():
         datasource="t", dimensions=(S.DimensionSpec("a", "a"),),
         aggregations=(S.AggregationSpec("doublesum", "s", expr=e),))
     rt(q)
+
+
+def test_keyed_lookup_roundtrip():
+    # broadcast-join lookup tables survive the wire (NaN-coded NULLs
+    # travel as JSON null)
+    import numpy as np
+    tab = E.FrozenKeyedTable(np.array([5, 2, 9]),
+                             np.array([1.5, np.nan, -3.0]))
+    e = E.Comparison("<", E.Column("qty"),
+                     E.KeyedLookup(E.Column("k"), tab, 0.0))
+    q = S.GroupByQuerySpec(
+        datasource="t", dimensions=(S.DimensionSpec("a", "a"),),
+        aggregations=(S.AggregationSpec("count", "n"),),
+        filter=S.ExprFilter(e))
+    rt(q)
