@@ -337,7 +337,41 @@ def numerics_check():
         if not np.array_equal(got, want[c].to_numpy()):
             return False, f"{c}: got {got.tolist()} " \
                           f"want {want[c].tolist()} (mode={mode})"
-    return True, f"exact (mode={mode})"
+
+    # device result-reduction epilogues on the LIVE backend: top-k
+    # selection, HAVING compaction, correlated-lookup broadcast join
+    df2 = pd.DataFrame({
+        "k": r.integers(0, 20_000, n),
+        "q": r.integers(1, 50, n).astype(np.int64),
+    })
+    ctx.ingest_dataframe("epicheck", df2, target_rows=1 << 16)
+    g2 = df2.groupby("k")["q"].sum()
+    topk = ctx.sql("select k, sum(q) as s from epicheck group by k "
+                   "order by s desc limit 5").to_pandas()
+    st = ctx.history.entries()[-1].stats
+    want_top = g2.sort_values(ascending=False).head(5).to_numpy()
+    if not np.array_equal(topk["s"].to_numpy().astype(np.int64), want_top):
+        return False, f"topk: got {topk['s'].tolist()} " \
+                      f"want {want_top.tolist()}"
+    if not st.get("topk_device"):
+        return False, f"topk epilogue did not engage ({st})"
+    hav = ctx.sql("select k, sum(q) as s from epicheck group by k "
+                  "having sum(q) > 400").to_pandas()
+    want_h = g2[g2 > 400]
+    if len(hav) != len(want_h) or \
+            not np.array_equal(np.sort(hav["s"].to_numpy().astype(np.int64)),
+                               np.sort(want_h.to_numpy())):
+        return False, f"having: {len(hav)} rows want {len(want_h)}"
+    corr = ctx.sql(
+        "select count(*) as n from epicheck "
+        "where q < (select 0.5 * avg(i_q) from "
+        "  (select k as i_k, q as i_q from epicheck) i "
+        "   where i_k = k)").to_pandas()
+    thr = df2.groupby("k")["q"].mean() * 0.5
+    want_c = int((df2.q < df2.k.map(thr)).sum())
+    if int(corr["n"][0]) != want_c:
+        return False, f"lookup: got {int(corr['n'][0])} want {want_c}"
+    return True, f"exact incl. topk/having/lookup epilogues (mode={mode})"
 
 
 def main():

@@ -53,20 +53,25 @@ class CompositePlan:
 SubPlan = Union[PlannedQuery, LeftJoinAggPlan, CompositePlan]
 
 
-def _chain(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
+def _chain(ctx, stmt: A.SelectStmt, execute: bool = True) -> A.SelectStmt:
+    """Rewrite pipeline ahead of the builder. ``execute=False`` (EXPLAIN)
+    skips the inlining passes, which RUN subqueries through the session —
+    explain must never dispatch engine queries or pollute the history."""
     from spark_druid_olap_tpu.planner.decorrelate import (
         decorrelate_semijoins, inline_correlated_scalars,
         inline_subqueries)
     from spark_druid_olap_tpu.planner.viewmerge import merge_derived
     s = merge_derived(ctx, stmt)
     s = decorrelate_semijoins(ctx, s)
+    if not execute:
+        return s
     s = inline_correlated_scalars(ctx, s)
     return inline_subqueries(ctx, s)
 
 
-def _build_sub(ctx, stmt: A.SelectStmt) -> SubPlan:
+def _build_sub(ctx, stmt: A.SelectStmt, execute: bool = True) -> SubPlan:
     from spark_druid_olap_tpu.planner import builder as B
-    s = _chain(ctx, stmt)
+    s = _chain(ctx, stmt, execute)
     try:
         return B.build(ctx, s)
     except PlanUnsupported:
@@ -83,7 +88,8 @@ def _fact_scale_tables(ctx) -> set:
     return out
 
 
-def build_composite(ctx, stmt: A.SelectStmt) -> CompositePlan:
+def build_composite(ctx, stmt: A.SelectStmt,
+                    execute: bool = True) -> CompositePlan:
     """Plan the statement as engine-built derived tables + host finish.
     Raises PlanUnsupported unless every derived table plans through the
     engine and every remaining base table is dimension-scale."""
@@ -99,7 +105,7 @@ def build_composite(ctx, stmt: A.SelectStmt) -> CompositePlan:
                     f"host join over fact-scale table {rel.name!r}")
             return rel
         if isinstance(rel, A.SubqueryRef):
-            sub = _build_sub(ctx, rel.query)
+            sub = _build_sub(ctx, rel.query, execute)
             name = f"__derived{len(subs)}"
             subs.append((name, sub))
             return A.TableRef(name)

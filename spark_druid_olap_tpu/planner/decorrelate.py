@@ -455,6 +455,20 @@ def _minmax_exists(ctx, node, outer_rel=None) -> Optional[E.Expr]:
     return E.Not(cond) if node.negated else cond
 
 
+def stmt_has_subqueries(stmt: A.SelectStmt) -> bool:
+    """Any subquery node in WHERE or HAVING — the public hook for EXPLAIN,
+    which must DESCRIBE the execution-time inlining (inline_subqueries /
+    inline_correlated_scalars run real engine queries) without running
+    it."""
+    for e in (stmt.where, stmt.having):
+        if e is None:
+            continue
+        for n in E.walk(e):
+            if isinstance(n, (A.ScalarSubquery, A.InSubquery, A.Exists)):
+                return True
+    return False
+
+
 def inline_subqueries(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
     """Replace uncorrelated subquery nodes in WHERE/HAVING with literals."""
 

@@ -153,14 +153,26 @@ def explain_text(ctx, stmt: A.SelectStmt, sql: str) -> str:
         pq = B.build(ctx, stmt)
     except PlanUnsupported as e:
         from spark_druid_olap_tpu.planner import composite
+        from spark_druid_olap_tpu.planner.decorrelate import (
+            stmt_has_subqueries)
         try:
-            cp = composite.build_composite(ctx, stmt)
+            # execute=False: explain must never dispatch engine queries
+            # (the inlining passes RUN subqueries) or pollute the history
+            cp = composite.build_composite(ctx, stmt, execute=False)
             lines.append("pushdown: COMPOSITE (engine derived tables + "
                          "host finish)")
             lines.append(composite.describe(cp, "  "))
             return "\n".join(lines)
         except Exception:  # noqa: BLE001 — explain must never fail
             pass
+        if stmt_has_subqueries(stmt):
+            lines.append(
+                "pushdown: DEFERRED — subqueries inline at execution "
+                "(inner queries run through the engine; correlated "
+                "shapes become KeyedLookup broadcast joins / per-key "
+                "min-max EXISTS, planner/decorrelate.py); remaining "
+                "shapes run on the host tier")
+            return "\n".join(lines)
         lines.append(f"pushdown: NO ({e})")
         lines.append("execution: host (pandas fallback)")
         return "\n".join(lines)

@@ -181,6 +181,28 @@ def test_keyed_lookup_null_keys_miss():
     np.testing.assert_array_equal(out0, [0.0, 0.0])
 
 
+def test_correlated_sharded_matches_single(cctx):
+    """KeyedLookup filters compile inside shard_map (LUT constants are
+    replicated); sharded results must match single-chip."""
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+    import spark_druid_olap_tpu as sdot
+    df = cctx._test_df
+    mctx = sdot.Context({"sdot.querycostmodel.enabled": False},
+                        mesh=make_mesh())
+    mctx.ingest_dataframe("fact", df, time_column="ts", target_rows=4096)
+    q = ("select sum(price) as s, count(*) as n from fact "
+         "where qty < (select 0.5 * avg(f2_qty) from "
+         "  (select partkey as f2_partkey, qty as f2_qty from fact) f2 "
+         "             where f2_partkey = partkey)")
+    got = mctx.sql(q).to_pandas()
+    st = mctx.history.entries()[-1].stats
+    assert st["mode"] == "engine" and st.get("sharded") is True
+    want = cctx.sql(q).to_pandas()
+    np.testing.assert_allclose(float(got["s"][0]), float(want["s"][0]),
+                               rtol=1e-6)
+    assert int(got["n"][0]) == int(want["n"][0])
+
+
 def test_nullable_outer_column_guarded():
     """A comparison over a NULLABLE outer column must not read the
     zero-filled device payload: NULL rows drop (SQL UNKNOWN), matching
@@ -215,6 +237,20 @@ def test_nullable_outer_column_guarded():
     mx = df.groupby("partkey")["qty"].max()
     want2 = int((~(df.partkey.map(mx) > df.qty)).sum())
     assert int(got2["n"][0]) == want2
+
+
+def test_explain_correlated_never_executes(cctx):
+    """EXPLAIN on a correlated query reports the deferred inlining and
+    dispatches NO engine queries (no history pollution)."""
+    before = len(cctx.history.entries())
+    out = cctx.sql(
+        "explain rewrite select count(*) from fact "
+        "where qty < (select avg(f2_qty) from "
+        "  (select partkey as f2_partkey, qty as f2_qty from fact) f2 "
+        "             where f2_partkey = partkey)").to_pandas()
+    text = "\n".join(str(v) for v in out.iloc[:, 0])
+    assert "DEFERRED" in text and "KeyedLookup" in text
+    assert len(cctx.history.entries()) == before
 
 
 def test_keyed_lookup_host_eval():

@@ -267,9 +267,13 @@ def test_sql_correlated_subquery_host(ctx, sales):
 def test_sql_explain(ctx):
     text = ctx.explain("SELECT region, sum(price) FROM sales GROUP BY region")
     assert "pushdown: YES" in text
+    # subqueries inline at EXECUTION (running them during explain would
+    # dispatch engine queries): explain reports the deferral, not NO
     text2 = ctx.explain("SELECT region FROM sales WHERE qty > "
                         "(SELECT avg(qty) FROM sales)")
-    assert "pushdown: NO" in text2
+    assert "pushdown: DEFERRED" in text2
+    text3 = ctx.explain("SELECT nosuchcol FROM sales GROUP BY nosuchcol")
+    assert "pushdown: NO" in text3
 
 
 def test_sql_raw_query_command(ctx):
