@@ -944,4 +944,11 @@ class Builder:
 
 
 def build(ctx, stmt: A.SelectStmt) -> PlannedQuery:
+    if isinstance(stmt, A.UnionAll):
+        raise PlanUnsupported("UNION ALL (session plans each branch)")
+    if getattr(stmt, "offset", 0):
+        # the top-level session strips OFFSET before building; an
+        # offset-bearing stmt here is a derived table / assisted subtree,
+        # where the host tier must apply it
+        raise PlanUnsupported("OFFSET in a derived table (host tier)")
     return Builder(ctx, stmt).build()

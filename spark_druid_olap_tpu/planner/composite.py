@@ -71,6 +71,8 @@ def _chain(ctx, stmt: A.SelectStmt, execute: bool = True) -> A.SelectStmt:
 
 def _build_sub(ctx, stmt: A.SelectStmt, execute: bool = True) -> SubPlan:
     from spark_druid_olap_tpu.planner import builder as B
+    if isinstance(stmt, A.UnionAll):
+        raise PlanUnsupported("union derived table (host tier handles)")
     s = _chain(ctx, stmt, execute)
     try:
         return B.build(ctx, s)

@@ -143,7 +143,9 @@ def relation_columns(ctx, rel: A.Relation) -> List[str]:
     raise HostExecError(f"relation {type(rel).__name__}")
 
 
-def select_output_names(ctx, stmt: A.SelectStmt) -> List[str]:
+def select_output_names(ctx, stmt) -> List[str]:
+    if isinstance(stmt, A.UnionAll):
+        return select_output_names(ctx, stmt.parts[0])
     names = []
     for i, item in enumerate(stmt.items):
         if item.expr == "*" or (isinstance(item.expr, E.Column)
@@ -168,9 +170,14 @@ def _subquery_nodes(e: E.Expr):
             yield n
 
 
-def _free_columns(ctx, stmt: A.SelectStmt) -> set:
+def _free_columns(ctx, stmt) -> set:
     """Columns referenced by ``stmt`` that its own relation doesn't provide
     (i.e. correlation bindings)."""
+    if isinstance(stmt, A.UnionAll):
+        out = set()
+        for p in stmt.parts:
+            out |= _free_columns(ctx, p)
+        return out
     visible = set(relation_columns(ctx, stmt.relation)) \
         if stmt.relation is not None else set()
     for i, item in enumerate(stmt.items):
@@ -686,6 +693,8 @@ def materialize_relation(ctx, rel: A.Relation, outer_env: Optional[dict],
     if isinstance(rel, A.TableRef):
         return datasource_frame(ctx, rel.name, columns=need)
     if isinstance(rel, A.SubqueryRef):
+        if isinstance(rel.query, A.UnionAll):
+            return _materialize_union(ctx, rel.query, outer_env)
         if getattr(ctx, "host_engine_assist", True):
             df = try_engine(ctx, rel.query)
             if df is not None:
@@ -1061,6 +1070,65 @@ def _one_grouping(ctx, stmt, df, env, group_exprs, all_group_exprs, agg_calls,
     return res
 
 
+def finish_union(frames, u: A.UnionAll) -> pd.DataFrame:
+    """Concatenate UNION ALL branch frames positionally under the first
+    branch's names and apply the union's trailing ORDER BY / OFFSET /
+    LIMIT (the one implementation shared by the session and host
+    tiers)."""
+    cols = None
+    aligned = []
+    for i, df in enumerate(frames):
+        if cols is None:
+            cols = list(df.columns)
+        elif len(df.columns) != len(cols):
+            raise HostExecError(
+                f"UNION ALL branch {i} has {len(df.columns)} columns, "
+                f"expected {len(cols)}")
+        else:
+            df = df.copy(deep=False)
+            df.columns = cols
+        aligned.append(df)
+    out = pd.concat(aligned, ignore_index=True)
+    if u.order_by:
+        sort_cols, asc = [], []
+        for o in u.order_by:
+            e = o.expr
+            if isinstance(e, E.Literal) and isinstance(e.value, int):
+                if not 1 <= e.value <= len(cols):
+                    raise HostExecError(
+                        f"ORDER BY ordinal {e.value} out of range "
+                        f"(1..{len(cols)})")
+                col = cols[e.value - 1]
+            elif isinstance(e, E.Column) and e.name in cols:
+                col = e.name
+            else:
+                raise HostExecError(
+                    "UNION ORDER BY must reference output columns")
+            sort_cols.append(col)
+            asc.append(o.ascending)
+        out = out.sort_values(sort_cols, ascending=asc,
+                              kind="mergesort").reset_index(drop=True)
+    if u.offset:
+        out = out.iloc[u.offset:].reset_index(drop=True)
+    if u.limit is not None:
+        out = out.head(u.limit).reset_index(drop=True)
+    return out
+
+
+def _materialize_union(ctx, u: A.UnionAll, outer_env):
+    """Derived UNION ALL: branches materialize independently (engine
+    assist per branch); see finish_union for the trailing clauses."""
+    frames = []
+    for part in u.parts:
+        df = None
+        if not outer_env and getattr(ctx, "host_engine_assist", True):
+            df = try_engine(ctx, part)
+        if df is None:
+            df = execute_select(ctx, part, outer_env=outer_env)
+        frames.append(df)
+    return finish_union(frames, u)
+
+
 def _order_limit_distinct(ctx, res: pd.DataFrame, stmt: A.SelectStmt, env):
     if stmt.distinct:
         res = res.drop_duplicates().reset_index(drop=True)
@@ -1103,6 +1171,8 @@ def _order_limit_distinct(ctx, res: pd.DataFrame, stmt: A.SelectStmt, env):
         tmp = tmp.sort_values(sort_cols, ascending=ascending,
                               kind="mergesort")
         res = tmp[res.columns].reset_index(drop=True)
+    if stmt.offset:
+        res = res.iloc[stmt.offset:].reset_index(drop=True)
     if stmt.limit is not None:
         res = res.head(stmt.limit).reset_index(drop=True)
     return res

@@ -52,7 +52,7 @@ def merge_derived(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
             break
         if inner.group_by is not None or inner.having is not None \
                 or inner.limit is not None or inner.distinct \
-                or inner.order_by:
+                or inner.order_by or inner.offset:
             break
         if any(it.expr == "*" or (isinstance(it.expr, E.Column)
                                   and it.expr.name == "*")

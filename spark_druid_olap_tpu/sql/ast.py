@@ -97,6 +97,20 @@ class SelectStmt:
     order_by: Tuple[OrderItem, ...] = ()
     limit: Optional[int] = None
     distinct: bool = False
+    offset: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class UnionAll:
+    """``<select> UNION ALL <select> [...] [ORDER BY ..] [LIMIT n]
+    [OFFSET m]`` — each branch plans independently (engine pushdown per
+    branch, like Spark planning each child of a Union), rows concatenate
+    positionally under the FIRST branch's column names, then the trailing
+    ordering applies."""
+    parts: Tuple[SelectStmt, ...]
+    order_by: Tuple[OrderItem, ...] = ()
+    limit: Optional[int] = None
+    offset: int = 0
 
 
 # -- commands (≈ SparklineDataParser commands) --------------------------------
@@ -119,4 +133,5 @@ class ExecuteRawQuery:
     use_sharded: bool = False
 
 
-Statement = Union[SelectStmt, ExplainRewrite, ClearMetadata, ExecuteRawQuery]
+Statement = Union[SelectStmt, UnionAll, ExplainRewrite, ClearMetadata,
+                  ExecuteRawQuery]

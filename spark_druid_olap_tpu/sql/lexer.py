@@ -28,7 +28,7 @@ KEYWORDS = {
     "interval", "date", "timestamp", "extract", "union", "all", "grouping",
     "sets", "cube", "rollup", "true", "false", "explain", "rewrite", "clear",
     "metadata", "execute", "query", "using", "datasource", "druiddatasource",
-    "substring", "for", "approx",
+    "substring", "for", "approx", "with", "offset",
 }
 
 _TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "||"}

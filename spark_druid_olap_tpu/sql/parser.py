@@ -25,6 +25,61 @@ from spark_druid_olap_tpu.sql.lexer import SqlSyntaxError, Token, tokenize
 AGG_FUNCS = {"sum", "min", "max", "avg", "count"}
 
 
+def _substitute_ctes(node, ctes):
+    """Replace TableRef(name) with SubqueryRef(cte_query) everywhere a CTE
+    name is referenced — relations, derived tables, and subqueries in
+    expressions (≈ Spark's CTESubstitution)."""
+    if not ctes:
+        return node
+    import dataclasses
+
+    def sub_rel(rel):
+        if rel is None:
+            return None
+        if isinstance(rel, A.TableRef):
+            q = ctes.get(rel.name)
+            if q is not None:
+                return A.SubqueryRef(q, rel.alias or rel.name)
+            return rel
+        if isinstance(rel, A.SubqueryRef):
+            return dataclasses.replace(rel, query=sub_stmt(rel.query))
+        if isinstance(rel, A.Join):
+            return dataclasses.replace(rel, left=sub_rel(rel.left),
+                                       right=sub_rel(rel.right),
+                                       condition=sub_expr(rel.condition))
+        return rel
+
+    def sub_expr(e):
+        if e is None or isinstance(e, str):
+            return e
+
+        def rep(n):
+            if isinstance(n, (A.ScalarSubquery, A.Exists, A.InSubquery)):
+                return dataclasses.replace(n, query=sub_stmt(n.query))
+            return n
+
+        return E.transform(e, rep)
+
+    def sub_stmt(st):
+        if isinstance(st, A.UnionAll):
+            return dataclasses.replace(
+                st, parts=tuple(sub_stmt(p) for p in st.parts))
+        items = tuple(it if it.expr == "*"
+                      else dataclasses.replace(it, expr=sub_expr(it.expr))
+                      for it in st.items)
+        gb = st.group_by
+        if isinstance(gb, tuple):
+            gb = tuple(sub_expr(g) for g in gb)
+        ob = tuple(dataclasses.replace(o, expr=sub_expr(o.expr))
+                   for o in st.order_by)
+        return dataclasses.replace(
+            st, items=items, relation=sub_rel(st.relation),
+            where=sub_expr(st.where), having=sub_expr(st.having),
+            group_by=gb, order_by=ob)
+
+    return sub_stmt(node)
+
+
 class Parser:
     def __init__(self, sql: str):
         self.sql = sql
@@ -74,7 +129,8 @@ class Parser:
             self.next()
             self.eat_kw("rewrite")
             rest_pos = self.peek().pos
-            q = self.parse_select()
+            q = self.parse_with() if self.at_kw("with") \
+                else self.parse_select_or_union()
             self._expect_eof()
             return A.ExplainRewrite(q, self.sql[rest_pos:])
         if self.at_kw("clear"):
@@ -86,11 +142,87 @@ class Parser:
             self._expect_eof()
             return A.ClearMetadata(ds)
         t = self.peek()
+        if t.kind == "kw" and t.value == "with":
+            q = self.parse_with()
+            self._expect_eof()
+            return q
         if (t.kind == "kw" and t.value == "select") or self.at_op("("):
-            q = self.parse_select()
+            q = self.parse_select_or_union()
             self._expect_eof()
             return q
         raise SqlSyntaxError(f"cannot parse statement at {t.pos}: {t.value!r}")
+
+    def parse_with(self):
+        """WITH name AS (select), ... <select|union> — CTEs desugar to
+        derived tables wherever their name is referenced (the existing
+        view-merge / composite machinery then plans them; ≈ Spark's
+        CTESubstitution rule)."""
+        self.expect_kw("with")
+        ctes: dict = {}
+        while True:
+            name = self._ident()
+            self.expect_kw("as")
+            self.expect_op("(")
+            q = self.parse_select_or_union()
+            self.expect_op(")")
+            if name in ctes:
+                raise SqlSyntaxError(f"duplicate CTE name {name!r}")
+            # earlier CTEs are visible inside later ones
+            ctes[name] = _substitute_ctes(q, ctes)
+            if not self.at_op(","):
+                break
+            self.next()
+        return _substitute_ctes(self.parse_select_or_union(), ctes)
+
+    def parse_select_or_union(self):
+        q = self.parse_select()
+        if not self.at_kw("union"):
+            return q
+        parts = [q]
+        last_paren = False
+        while self.eat_kw("union"):
+            if not self.eat_kw("all"):
+                raise SqlSyntaxError(
+                    "only UNION ALL is supported (use SELECT DISTINCT "
+                    "over a derived union for UNION)")
+            last_paren = self.at_op("(")
+            parts.append(self.parse_select())
+        if last_paren:
+            # '(select ... limit n)' keeps its own clauses; the union's
+            # trailing ORDER BY / LIMIT / OFFSET follow the parens
+            ob, lim, off = self._parse_trailing_clauses()
+        else:
+            # a bare last SELECT consumed the trailing clauses, which
+            # standard SQL binds to the WHOLE union — hoist them
+            import dataclasses
+            last = parts[-1]
+            ob, lim, off = last.order_by, last.limit, last.offset
+            parts[-1] = dataclasses.replace(last, order_by=(), limit=None,
+                                            offset=0)
+        return A.UnionAll(tuple(parts), ob, lim, off)
+
+    def _parse_trailing_clauses(self):
+        order_by: List[A.OrderItem] = []
+        if self.at_kw("order"):
+            self.next()
+            self.expect_kw("by")
+            order_by.append(self.parse_order_item())
+            while self.at_op(","):
+                self.next()
+                order_by.append(self.parse_order_item())
+        limit = None
+        if self.eat_kw("limit"):
+            t = self.next()
+            if t.kind != "number":
+                raise SqlSyntaxError(f"LIMIT expects a number at {t.pos}")
+            limit = int(t.value)
+        offset = 0
+        if self.eat_kw("offset"):
+            t = self.next()
+            if t.kind != "number":
+                raise SqlSyntaxError(f"OFFSET expects a number at {t.pos}")
+            offset = int(t.value)
+        return tuple(order_by), limit, offset
 
     def _expect_eof(self):
         t = self.peek()
@@ -126,22 +258,9 @@ class Parser:
         having = None
         if self.eat_kw("having"):
             having = self.parse_expr()
-        order_by: List[A.OrderItem] = []
-        if self.at_kw("order"):
-            self.next()
-            self.expect_kw("by")
-            order_by.append(self.parse_order_item())
-            while self.at_op(","):
-                self.next()
-                order_by.append(self.parse_order_item())
-        limit = None
-        if self.eat_kw("limit"):
-            t = self.next()
-            if t.kind != "number":
-                raise SqlSyntaxError(f"LIMIT expects a number at {t.pos}")
-            limit = int(t.value)
+        order_by, limit, offset = self._parse_trailing_clauses()
         return A.SelectStmt(tuple(items), relation, where, group_by, having,
-                            tuple(order_by), limit, distinct)
+                            order_by, limit, distinct, offset)
 
     def parse_select_item(self) -> A.SelectItem:
         if self.at_op("*"):
@@ -245,8 +364,9 @@ class Parser:
     def parse_relation_primary(self) -> A.Relation:
         if self.at_op("("):
             self.next()
-            if self.at_kw("select"):
-                q = self.parse_select()
+            if self.at_kw("select", "with"):
+                q = self.parse_with() if self.at_kw("with") \
+                    else self.parse_select_or_union()
                 self.expect_op(")")
                 alias = self._alias_required()
                 return A.SubqueryRef(q, alias)

@@ -134,7 +134,7 @@ def explain_sql(ctx, sql: str) -> str:
     stmt = parse_statement(sql)
     if isinstance(stmt, A.ExplainRewrite):
         return explain_text(ctx, stmt.query, stmt.sql)
-    if isinstance(stmt, A.SelectStmt):
+    if isinstance(stmt, (A.SelectStmt, A.UnionAll)):
         return explain_text(ctx, stmt, sql)
     return f"command: {type(stmt).__name__}"
 
@@ -143,6 +143,14 @@ def explain_text(ctx, stmt: A.SelectStmt, sql: str) -> str:
     """≈ ``ExplainDruidRewrite`` (reference DruidMetadataCommands.scala:49-78)
     — shows whether the query pushes down, the engine query specs, and the
     cost-model decision."""
+    if isinstance(stmt, A.UnionAll):
+        lines = [f"SQL: {sql.strip()}",
+                 f"UNION ALL over {len(stmt.parts)} branches (each plans "
+                 f"independently):"]
+        for i, p in enumerate(stmt.parts):
+            sub = explain_text(ctx, p, f"<branch {i}>")
+            lines.append("  " + sub.replace("\n", "\n  "))
+        return "\n".join(lines)
     lines = [f"SQL: {sql.strip()}"]
     stmt = resolve_lookups(ctx, stmt)
     try:
@@ -202,8 +210,18 @@ def _run_select(ctx, stmt: A.SelectStmt, sql: str) -> QueryResult:
         _he.SESSION_TZ.reset(_tz_tok)
 
 
-def _run_select_tz(ctx, stmt: A.SelectStmt, sql: str) -> QueryResult:
+def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
+    if isinstance(stmt, A.UnionAll):
+        return _run_union(ctx, stmt, sql)
     t0 = _time.perf_counter()
+    offset = stmt.offset
+    if offset:
+        # strip the offset before planning: the engine/host paths see an
+        # extended LIMIT, the slice happens once here
+        import dataclasses as _dc
+        stmt = _dc.replace(stmt, offset=0,
+                           limit=None if stmt.limit is None
+                           else stmt.limit + offset)
     stmt = resolve_lookups(ctx, stmt)
     try:
         from spark_druid_olap_tpu.planner.decorrelate import (
@@ -233,10 +251,30 @@ def _run_select_tz(ctx, stmt: A.SelectStmt, sql: str) -> QueryResult:
         if df is None:
             df = host_exec.execute_select(ctx, stmt)
             mode = f"host ({e})"
+    if offset:
+        df = df.iloc[offset:].reset_index(drop=True)
     stats = dict(ctx.engine.last_stats)
     stats["mode"] = mode
     stats["total_ms"] = (_time.perf_counter() - t0) * 1000
     ctx.history.record(stmt, stats, sql=sql)
+    return QueryResult(list(df.columns),
+                       {c: df[c].to_numpy() for c in df.columns})
+
+
+def _run_union(ctx, u: A.UnionAll, sql: str) -> QueryResult:
+    """UNION ALL: each branch plans independently (engine pushdown per
+    branch, like Spark planning each Union child), rows concatenate
+    positionally under the first branch's column names, then the trailing
+    ORDER BY / OFFSET / LIMIT apply."""
+    t0 = _time.perf_counter()
+    frames = [
+        _run_select_tz(ctx, part, f"{sql} <union branch {i}>").to_pandas()
+        for i, part in enumerate(u.parts)]
+    df = host_exec.finish_union(frames, u)
+    ctx.history.record(u, {"mode": "union",
+                           "branches": len(u.parts),
+                           "total_ms": (_time.perf_counter() - t0) * 1000},
+                       sql=sql)
     return QueryResult(list(df.columns),
                        {c: df[c].to_numpy() for c in df.columns})
 
