@@ -217,10 +217,38 @@ FLIP_CMP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
             "=": "=", "!=": "!=", "<>": "<>"}
 
 
-class FrozenKeyedTable:
-    """Immutable sorted int64-key -> float64-value map with O(1) repr/eq/
-    hash (digest stands in for contents, like :class:`FrozenIntSet` — the
-    executor's program-cache key is ``repr(query)``)."""
+class _FrozenTableBase:
+    """Shared identity protocol for frozen lookup tables: a sha1 digest
+    stands in for the contents everywhere except actual lookups — the
+    executor's program-cache key is ``repr(query)`` (like
+    :class:`FrozenIntSet`)."""
+
+    __slots__ = ()
+
+    def _freeze(self, arrays):
+        import hashlib
+        h = hashlib.sha1()
+        for a in arrays:
+            a.setflags(write=False)
+            h.update(a.tobytes())
+        object.__setattr__(self, "_digest", h.hexdigest())
+
+    def __len__(self):
+        return int(len(self.values))
+
+    def __repr__(self):
+        return f"{type(self).__name__}(n={len(self)}, " \
+               f"sha={self._digest[:16]})"
+
+    def __eq__(self, o):
+        return type(o) is type(self) and self._digest == o._digest
+
+    def __hash__(self):
+        return hash(self._digest)
+
+
+class FrozenKeyedTable(_FrozenTableBase):
+    """Immutable sorted int64-key -> float64-value map."""
 
     __slots__ = ("keys", "values", "_digest")
 
@@ -230,29 +258,53 @@ class FrozenKeyedTable:
         v = np.asarray(values, dtype=np.float64)
         assert k.shape == v.shape and k.ndim == 1
         order = np.argsort(k, kind="stable")
-        k = k[order]
-        v = v[order]
-        k.setflags(write=False)
-        v.setflags(write=False)
-        object.__setattr__(self, "keys", k)
-        object.__setattr__(self, "values", v)
-        import hashlib
-        h = hashlib.sha1(k.tobytes())
-        h.update(v.tobytes())
-        object.__setattr__(self, "_digest", h.hexdigest())
+        object.__setattr__(self, "keys", k[order])
+        object.__setattr__(self, "values", v[order])
+        self._freeze((self.keys, self.values))
 
-    def __len__(self):
-        return int(len(self.keys))
 
-    def __repr__(self):
-        return f"FrozenKeyedTable(n={len(self.keys)}, " \
-               f"sha={self._digest[:16]})"
+class FrozenKeyedTable2(_FrozenTableBase):
+    """Immutable (int32-range, int32-range) composite-key -> float64-value
+    map, sorted lexicographically. Key domains MUST fit int32: the host
+    packs pairs into one int64 (k1*2^32 + offset(k2)) and the device
+    compares i32 pairs — wider keys would wrap. Enforced here so every
+    construction path (planner, serde) keeps the invariant."""
 
-    def __eq__(self, o):
-        return isinstance(o, FrozenKeyedTable) and self._digest == o._digest
+    __slots__ = ("keys1", "keys2", "values", "_digest")
 
-    def __hash__(self):
-        return hash(self._digest)
+    def __init__(self, keys1, keys2, values):
+        import numpy as np
+        k1 = np.asarray(keys1, dtype=np.int64)
+        k2 = np.asarray(keys2, dtype=np.int64)
+        v = np.asarray(values, dtype=np.float64)
+        assert k1.shape == k2.shape == v.shape and k1.ndim == 1
+        for k in (k1, k2):
+            if len(k) and (k.min() < -(2**31) or k.max() >= 2**31):
+                raise ValueError(
+                    "FrozenKeyedTable2 keys must fit int32")
+        order = np.lexsort((k2, k1))
+        object.__setattr__(self, "keys1", k1[order])
+        object.__setattr__(self, "keys2", k2[order])
+        object.__setattr__(self, "values", v[order])
+        self._freeze((self.keys1, self.keys2, self.values))
+
+
+@dataclasses.dataclass(frozen=True)
+class KeyedLookup2(Expr):
+    """Composite-key broadcast join: the table value at integer pair
+    (key1, key2), NULL/default on miss — the decorrelated form of a
+    scalar subquery correlated on TWO columns (TPC-H q20's
+    'where l_partkey = ps_partkey and l_suppkey = ps_suppkey' shape).
+    Device lowering binary-searches the lexicographically-sorted pair
+    arrays (no int64 needed on 32-bit backends)."""
+
+    key1: Expr
+    key2: Expr
+    table: FrozenKeyedTable2
+    default: Optional[float] = None
+
+    def children(self):
+        return (self.key1, self.key2)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -338,6 +390,9 @@ def transform(e: Expr, fn):
                      e.distinct, e.approx)
     elif isinstance(e, KeyedLookup):
         e2 = KeyedLookup(transform(e.key, fn), e.table, e.default)
+    elif isinstance(e, KeyedLookup2):
+        e2 = KeyedLookup2(transform(e.key1, fn), transform(e.key2, fn),
+                          e.table, e.default)
     else:
         e2 = e
     return fn(e2)
@@ -385,4 +440,6 @@ def to_sql(e: Expr) -> str:
         return f"{e.fn}({d}{arg})"
     if isinstance(e, KeyedLookup):
         return f"lookup[{e.table!r}]({to_sql(e.key)})"
+    if isinstance(e, KeyedLookup2):
+        return f"lookup[{e.table!r}]({to_sql(e.key1)}, {to_sql(e.key2)})"
     return repr(e)

@@ -23,6 +23,7 @@ _EXPR_TYPES = {
     "isnull": E.IsNull, "in": E.InList, "between": E.Between,
     "like": E.Like, "func": E.Func, "cast": E.Cast, "case": E.Case,
     "agg": E.AggCall, "lookup": E.KeyedLookup,
+    "lookup2": E.KeyedLookup2,
 }
 _EXPR_NAMES = {v: k for k, v in _EXPR_TYPES.items()}
 
@@ -80,6 +81,15 @@ def expr_to_dict(e: Optional[E.Expr]):
         import numpy as np
         return {"t": t, "key": expr_to_dict(e.key),
                 "keys": [int(k) for k in e.table.keys],
+                "values": [None if np.isnan(v) else float(v)
+                           for v in e.table.values],
+                "default": e.default}
+    if isinstance(e, E.KeyedLookup2):
+        import numpy as np
+        return {"t": t, "key1": expr_to_dict(e.key1),
+                "key2": expr_to_dict(e.key2),
+                "keys1": [int(k) for k in e.table.keys1],
+                "keys2": [int(k) for k in e.table.keys2],
                 "values": [None if np.isnan(v) else float(v)
                            for v in e.table.values],
                 "default": e.default}
@@ -142,6 +152,16 @@ def expr_from_dict(d) -> Optional[E.Expr]:
             expr_from_dict(d["key"]),
             E.FrozenKeyedTable(np.asarray(d["keys"], dtype=np.int64),
                                vals),
+            d.get("default"))
+    if t == "lookup2":
+        import numpy as np
+        vals = np.array([np.nan if v is None else v for v in d["values"]],
+                        dtype=np.float64)
+        return E.KeyedLookup2(
+            expr_from_dict(d["key1"]), expr_from_dict(d["key2"]),
+            E.FrozenKeyedTable2(np.asarray(d["keys1"], dtype=np.int64),
+                                np.asarray(d["keys2"], dtype=np.int64),
+                                vals),
             d.get("default"))
     raise ValueError(f"unknown expr type {t!r}")
 

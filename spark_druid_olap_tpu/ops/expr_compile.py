@@ -26,6 +26,7 @@ import dataclasses
 import re
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -151,6 +152,58 @@ def compile_expr(e: E.Expr, ctx: ScanContext):
         v = compile_expr(e.child, ctx)
         b = _in_list(v, e.values, ctx)
         return BoolValue(~b if e.negated else b)
+    if isinstance(e, E.KeyedLookup2):
+        # composite-key broadcast join: manual binary search over the
+        # lexicographically-sorted (k1, k2) pair arrays — ~21 gather
+        # rounds, no int64 required on 32-bit backends
+        if not (isinstance(e.key1, E.Column)
+                and isinstance(e.key2, E.Column)):
+            raise Unsupported("pair lookup over computed keys")
+        n1 = _as_num(compile_expr(e.key1, ctx), ctx)
+        n2 = _as_num(compile_expr(e.key2, ctx), ctx)
+        if n1.is_float or n2.is_float:
+            raise Unsupported("pair lookup over float key expression")
+        tab = e.table
+        wide = (n1.arr.dtype == jnp.int64 or n2.arr.dtype == jnp.int64)
+        # probes keep their own width: table keys are int32-range by
+        # FrozenKeyedTable2's invariant, but int64 PROBE values outside
+        # that range must miss, never truncate into a false match
+        kdt = jnp.int64 if wide else jnp.int32
+        miss = jnp.asarray(np.nan if e.default is None else e.default,
+                           jnp.float64 if wide else jnp.float32)
+        if len(tab) == 0:
+            return NumValue(jnp.full(jnp.shape(n1.arr), miss), True)
+        k1 = jnp.asarray(tab.keys1.astype(
+            np.int64 if wide else np.int32))
+        k2 = jnp.asarray(tab.keys2.astype(
+            np.int64 if wide else np.int32))
+        vdev = jnp.asarray(tab.values)
+        a = n1.arr.astype(kdt)
+        b = n2.arr.astype(kdt)
+        n = len(tab)
+        lo = jnp.zeros_like(a)
+        hi = jnp.full_like(a, n)
+        steps = int(np.ceil(np.log2(max(n, 2)))) + 1
+
+        def body(_, st):
+            lo_, hi_ = st
+            mid = (lo_ + hi_) // 2
+            mid_c = jnp.clip(mid, 0, n - 1)
+            m1 = k1[mid_c]
+            m2 = k2[mid_c]
+            less = (m1 < a) | ((m1 == a) & (m2 < b))
+            lo_ = jnp.where(less & (lo_ < hi_), mid + 1, lo_)
+            hi_ = jnp.where((~less) & (lo_ < hi_), mid, hi_)
+            return lo_, hi_
+
+        lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
+        idx = jnp.clip(lo, 0, n - 1)
+        found = (k1[idx] == a) & (k2[idx] == b)
+        for key_col in (e.key1, e.key2):
+            nv = ctx.null_valid(key_col.name)
+            if nv is not None:
+                found = found & nv     # NULL key: empty set -> miss
+        return NumValue(jnp.where(found, vdev[idx], miss), True)
     if isinstance(e, E.KeyedLookup):
         # broadcast-join gather: binary search the sorted key array, take
         # the value; misses read ``default`` (NaN = SQL NULL: comparisons

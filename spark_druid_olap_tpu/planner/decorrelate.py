@@ -161,17 +161,19 @@ def _try_semijoin(ctx, outer: A.SelectStmt, c) -> Optional[E.Expr]:
                         negated=negated)
 
 
-def _classify_correlation(ctx, q, free, inner_cols, max_residuals):
-    """Split ``q.where`` into (outer_key_col, inner_key_expr, rest,
-    residuals): exactly one equality conjunct binds a free column to an
-    inner key expression; up to ``max_residuals`` further free-referencing
-    conjuncts may be min/max-decidable comparisons
+def _classify_correlation(ctx, q, free, inner_cols, max_residuals,
+                          max_pairs=1):
+    """Split ``q.where`` into (pairs, rest, residuals): up to
+    ``max_pairs`` equality conjuncts each bind a DISTINCT free column to
+    an inner key expression; up to ``max_residuals`` further
+    free-referencing conjuncts may be min/max-decidable comparisons
     (host_exec._residual_minmax); everything else must be inner-only.
     Returns None when the correlation has any other shape. Shared by the
     scalar and EXISTS inlining passes so their gating cannot diverge."""
     from spark_druid_olap_tpu.planner.host_exec import (
         _expr_refs, _residual_minmax)
-    inner_key = kcol = None
+    pairs = []               # (outer_col, inner_key_expr)
+    bound = set()
     residuals = []
     rest = []
     for c in _split_and(q.where):
@@ -179,18 +181,20 @@ def _classify_correlation(ctx, q, free, inner_cols, max_residuals):
         if not (refs & free):
             rest.append(c)
             continue
-        if inner_key is None and isinstance(c, E.Comparison) \
+        if len(pairs) < max_pairs and isinstance(c, E.Comparison) \
                 and c.op == "=":
             pair = None
             for a, b in ((c.left, c.right), (c.right, c.left)):
-                if isinstance(a, E.Column) and a.name in free:
+                if isinstance(a, E.Column) and a.name in free \
+                        and a.name not in bound:
                     brefs = _expr_refs(ctx, b)
                     if brefs and not (brefs & free) \
                             and brefs <= inner_cols:
                         pair = (a.name, b)
                         break
             if pair is not None:
-                kcol, inner_key = pair
+                pairs.append(pair)
+                bound.add(pair[0])
                 continue
         if len(residuals) < max_residuals:
             mm = _residual_minmax(ctx, c, free, inner_cols)
@@ -198,9 +202,9 @@ def _classify_correlation(ctx, q, free, inner_cols, max_residuals):
                 residuals.append(mm)
                 continue
         return None
-    if inner_key is None:
+    if not pairs:
         return None
-    return kcol, inner_key, rest, residuals
+    return pairs, rest, residuals
 
 
 def _numeric_series(s):
@@ -212,31 +216,38 @@ def _numeric_series(s):
     return pd.to_numeric(s, errors="coerce").to_numpy(dtype=np.float64)
 
 
-def _run_grouped_inner(ctx, q, inner_key, rest, value_items):
+def _run_grouped_inner(ctx, q, inner_keys, rest, value_items):
     """Execute the decorrelated per-key aggregate through the full session
-    path (engine pushdown for the inner). Returns (int64 keys, [value
-    arrays]) or None."""
+    path (engine pushdown for the inner). Returns ([int64 key arrays],
+    [value arrays]) or None."""
     q2 = A.SelectStmt(
-        items=(A.SelectItem(inner_key, "__k"),)
+        items=tuple(A.SelectItem(k, f"__k{j}")
+                    for j, k in enumerate(inner_keys))
         + tuple(A.SelectItem(e, f"__v{i}")
                 for i, e in enumerate(value_items)),
-        relation=q.relation, where=_and_all(rest), group_by=(inner_key,))
+        relation=q.relation, where=_and_all(rest),
+        group_by=tuple(inner_keys))
     try:
         from spark_druid_olap_tpu.sql.session import _run_select
         df = _run_select(ctx, q2, sql="<correlated subquery>").to_pandas()
     except Exception:  # noqa: BLE001 — leave to the host tier
         return None
-    keep = df["__k"].notna()
-    k = df["__k"][keep]
-    if len(k) and np.asarray(k).dtype.kind not in "iu":
-        return None
+    keep = np.ones(len(df), dtype=bool)
+    for j in range(len(inner_keys)):
+        keep &= df[f"__k{j}"].notna().to_numpy()
+    keys = []
+    for j in range(len(inner_keys)):
+        k = df[f"__k{j}"][keep]
+        if len(k) and np.asarray(k).dtype.kind not in "iu":
+            return None
+        keys.append(np.asarray(k, dtype=np.int64))
     vals = []
     for i in range(len(value_items)):
         v = _numeric_series(df[f"__v{i}"][keep])
         if v is None:
             return None
         vals.append(v)
-    return np.asarray(k, dtype=np.int64), vals
+    return keys, vals
 
 
 _NAN_SAFE_CMP = ("=", "<", "<=", ">", ">=")
@@ -250,7 +261,7 @@ def _cols_outside_lookups(e) -> set:
     out = set()
 
     def rec(n):
-        if isinstance(n, E.KeyedLookup):
+        if isinstance(n, (E.KeyedLookup, E.KeyedLookup2)):
             return
         if isinstance(n, E.Column):
             out.add(n.name)
@@ -311,32 +322,44 @@ def inline_correlated_scalars(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
             _relation_free_refs, relation_columns)
         try:
             free = _free_columns(ctx, q)
-            if len(free) != 1:
+            if not free or len(free) > 2:
                 return None
-            (fcol,) = tuple(free)
             if _relation_free_refs(ctx, q.relation) & free:
                 return None
             if _expr_refs(ctx, q.items[0].expr) & free:
                 return None
             inner_cols = set(relation_columns(ctx, q.relation))
-            cl = _classify_correlation(ctx, q, free, inner_cols, 0)
+            cl = _classify_correlation(ctx, q, free, inner_cols, 0,
+                                       max_pairs=len(free))
         except Exception:  # noqa: BLE001 — unknown tables/columns
             return None
         if cl is None or not E.agg_calls_in(q.items[0].expr):
             return None
-        kcol, inner_key, rest, _ = cl
-        r = _run_grouped_inner(ctx, q, inner_key, rest,
+        pairs, rest, _ = cl
+        if len(pairs) != len(free):
+            return None              # a free column escaped the key pairs
+        r = _run_grouped_inner(ctx, q, [b for _, b in pairs], rest,
                                [q.items[0].expr])
         if r is None:
             return None
-        karr, (varr,) = r
+        keys, (varr,) = r
         d = _empty_group_value(q.items[0].expr)
         default = None
         if isinstance(d, (int, float, np.number)) \
                 and not (isinstance(d, float) and np.isnan(d)):
             default = float(d)
-        return E.KeyedLookup(E.Column(kcol),
-                             E.FrozenKeyedTable(karr, varr), default)
+        if len(pairs) == 1:
+            return E.KeyedLookup(E.Column(pairs[0][0]),
+                                 E.FrozenKeyedTable(keys[0], varr),
+                                 default)
+        # composite key: both key domains must fit int32 (the host packs
+        # pairs into one int64; the device compares i32 pairs)
+        for k in keys:
+            if len(k) and (k.min() < -(2**31) or k.max() >= 2**31):
+                return None
+        return E.KeyedLookup2(E.Column(pairs[0][0]), E.Column(pairs[1][0]),
+                              E.FrozenKeyedTable2(keys[0], keys[1], varr),
+                              default)
 
     def val(e, allow):
         """Value position: inline only when ``allow`` (reached from a
@@ -420,18 +443,19 @@ def _minmax_exists(ctx, node, outer_rel=None) -> Optional[E.Expr]:
         cl = _classify_correlation(ctx, q, free, inner_cols, 1)
     except Exception:  # noqa: BLE001 — unknown tables/columns
         return None
-    if cl is None or len(cl[3]) != 1:
+    if cl is None or len(cl[2]) != 1:
         return None
-    kcol, inner_key, rest, (mm,) = cl
+    pairs, rest, (mm,) = cl
+    (kcol, inner_key), = pairs
     op, inner_expr, ccol = mm
     if ccol == kcol:
         return None
-    r = _run_grouped_inner(ctx, q, inner_key, rest,
+    r = _run_grouped_inner(ctx, q, [inner_key], rest,
                            [E.AggCall("min", inner_expr),
                             E.AggCall("max", inner_expr)])
     if r is None:
         return None
-    karr, (mnv, mxv) = r
+    (karr,), (mnv, mxv) = r
     mn = E.KeyedLookup(E.Column(kcol), E.FrozenKeyedTable(karr, mnv))
     mx = E.KeyedLookup(E.Column(kcol), E.FrozenKeyedTable(karr, mxv))
     c = E.Column(ccol)

@@ -237,6 +237,40 @@ def eval_expr(e: E.Expr, env: dict):
             found &= ok
         out = np.where(found, vals[idx], miss)
         return out.reshape(k.shape)
+    if isinstance(e, E.KeyedLookup2):
+        k1 = np.asarray(eval_expr(e.key1, env))
+        k2 = np.asarray(eval_expr(e.key2, env))
+        miss = np.nan if e.default is None else float(e.default)
+
+        def intify(k):
+            if k.dtype == object or k.dtype.kind == "f":
+                kn = pd.to_numeric(pd.Series(k.reshape(-1)),
+                                   errors="coerce").to_numpy()
+                ok = ~np.isnan(kn) & (kn == np.floor(kn))
+                return np.where(ok, kn, 0).astype(np.int64), ok
+            return k.reshape(-1).astype(np.int64), None
+
+        a, ok1 = intify(k1)
+        b, ok2 = intify(k2)
+        tab = e.table
+        if len(tab) == 0:
+            return np.full(a.shape, miss)
+        # monotone int64 packing: keys2 offset into [0, 2^32) preserves
+        # the lexicographic order of (k1, k2) pairs. Table keys fit int32
+        # (FrozenKeyedTable2 invariant); PROBE values outside that range
+        # must miss — their packing would wrap into false matches
+        inr = (a >= -(2**31)) & (a < 2**31) & (b >= -(2**31)) & (b < 2**31)
+        a0 = np.where(inr, a, 0)
+        b0 = np.where(inr, b, 0)
+        packed = tab.keys1 * (1 << 32) + (tab.keys2 + (1 << 31))
+        probe = a0 * (1 << 32) + (b0 + (1 << 31))
+        idx = np.clip(np.searchsorted(packed, probe), 0, len(tab) - 1)
+        found = (packed[idx] == probe) & inr
+        for ok in (ok1, ok2):
+            if ok is not None:
+                found &= ok
+        out = np.where(found, tab.values[idx], miss)
+        return out.reshape(k1.shape)
     if isinstance(e, E.Case):
         otherwise = eval_expr(e.otherwise, env) if e.otherwise is not None else 0
         out = otherwise

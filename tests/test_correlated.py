@@ -239,6 +239,44 @@ def test_nullable_outer_column_guarded():
     assert int(got2["n"][0]) == want2
 
 
+def test_correlated_two_key_scalar(cctx):
+    """TPC-H q20 shape: the scalar subquery correlates on TWO keys —
+    composite-key broadcast join (KeyedLookup2, pair binary search on
+    device)."""
+    df = cctx._test_df
+    got = cctx.sql(
+        "select count(*) as n from fact "
+        "where qty > (select 0.5 * avg(f2_qty) from "
+        "  (select partkey as f2_pk, suppkey as f2_sk, qty as f2_qty "
+        "   from fact) f2 "
+        "  where f2_pk = partkey and f2_sk = suppkey)").to_pandas()
+    assert _mode(cctx) == "engine"
+    thr = df.groupby(["partkey", "suppkey"])["qty"].mean() * 0.5
+    mapped = pd.MultiIndex.from_arrays([df.partkey, df.suppkey]) \
+        .map(thr)
+    want = int((df.qty.to_numpy() > np.asarray(mapped)).sum())
+    assert int(got["n"][0]) == want
+
+
+def test_correlated_two_key_sharded(cctx):
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+    import spark_druid_olap_tpu as sdot
+    df = cctx._test_df
+    mctx = sdot.Context({"sdot.querycostmodel.enabled": False},
+                        mesh=make_mesh())
+    mctx.ingest_dataframe("fact", df, time_column="ts", target_rows=4096)
+    q = ("select count(*) as n from fact "
+         "where qty > (select 0.5 * avg(f2_qty) from "
+         "  (select partkey as f2_pk, suppkey as f2_sk, qty as f2_qty "
+         "   from fact) f2 "
+         "  where f2_pk = partkey and f2_sk = suppkey)")
+    got = mctx.sql(q).to_pandas()
+    st = mctx.history.entries()[-1].stats
+    assert st["mode"] == "engine" and st.get("sharded") is True
+    want = cctx.sql(q).to_pandas()
+    assert int(got["n"][0]) == int(want["n"][0])
+
+
 def test_explain_correlated_never_executes(cctx):
     """EXPLAIN on a correlated query reports the deferred inlining and
     dispatches NO engine queries (no history pollution)."""

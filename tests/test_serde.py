@@ -129,3 +129,16 @@ def test_keyed_lookup_roundtrip():
         aggregations=(S.AggregationSpec("count", "n"),),
         filter=S.ExprFilter(e))
     rt(q)
+
+
+def test_keyed_lookup2_roundtrip():
+    import numpy as np
+    tab = E.FrozenKeyedTable2(np.array([5, 2, 2]), np.array([1, 9, 3]),
+                              np.array([1.5, np.nan, -3.0]))
+    e = E.Comparison(">", E.Column("qty"),
+                     E.KeyedLookup2(E.Column("a"), E.Column("b"), tab))
+    q = S.GroupByQuerySpec(
+        datasource="t", dimensions=(S.DimensionSpec("a", "a"),),
+        aggregations=(S.AggregationSpec("count", "n"),),
+        filter=S.ExprFilter(e))
+    rt(q)
