@@ -84,18 +84,27 @@ class Context:
                               for k, v in mapping.items()}
 
     # -- ingest / registration ------------------------------------------------
+    def _ingest_kwargs(self, kwargs):
+        """Session default for segment sizing (sdot.segment.target.rows)
+        when the caller doesn't pass target_rows explicitly."""
+        if "target_rows" not in kwargs:
+            from spark_druid_olap_tpu.utils.config import SEGMENT_ROWS
+            kwargs = {**kwargs,
+                      "target_rows": self.config.get(SEGMENT_ROWS)}
+        return kwargs
+
     def ingest_dataframe(self, name, df, **kwargs):
-        ds = ingest_dataframe(name, df, **kwargs)
+        ds = ingest_dataframe(name, df, **self._ingest_kwargs(kwargs))
         self.store.register(ds)
         return ds
 
     def ingest_parquet(self, name, path, **kwargs):
-        ds = ingest_parquet(name, path, **kwargs)
+        ds = ingest_parquet(name, path, **self._ingest_kwargs(kwargs))
         self.store.register(ds)
         return ds
 
     def ingest_csv(self, name, path, **kwargs):
-        ds = ingest_csv(name, path, **kwargs)
+        ds = ingest_csv(name, path, **self._ingest_kwargs(kwargs))
         self.store.register(ds)
         return ds
 
@@ -105,7 +114,7 @@ class Context:
         would not fit in host memory."""
         from spark_druid_olap_tpu.segment.stream_ingest import (
             ingest_parquet_stream)
-        ds = ingest_parquet_stream(name, path, **kwargs)
+        ds = ingest_parquet_stream(name, path, **self._ingest_kwargs(kwargs))
         self.store.register(ds)
         return ds
 

@@ -210,6 +210,30 @@ def _run_select(ctx, stmt: A.SelectStmt, sql: str) -> QueryResult:
         _he.SESSION_TZ.reset(_tz_tok)
 
 
+def _transform_tracer(ctx):
+    """Per-statement rewrite tracing gated by ``sdot.debug.transformations``
+    (≈ the reference's DruidTransforms debug tracing,
+    ``DruidTransforms.scala:121-136``): logs each rewrite stage that
+    CHANGED the statement, with O(1)-repr lookup tables."""
+    from spark_druid_olap_tpu.utils.config import DEBUG_TRANSFORMATIONS
+    if not ctx.config.get(DEBUG_TRANSFORMATIONS):
+        return lambda name, before, after: after
+
+    import reprlib
+    import sys as _sys
+    rl = reprlib.Repr()
+    rl.maxstring = rl.maxother = 2000
+    rl.maxtuple = rl.maxlist = rl.maxdict = 40
+
+    def trace(name, before, after):
+        if after is not before:
+            print(f"[sdot.rewrite] {name}: {rl.repr(after)}",
+                  file=_sys.stderr)
+        return after
+
+    return trace
+
+
 def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
     if isinstance(stmt, A.UnionAll):
         return _run_union(ctx, stmt, sql)
@@ -223,15 +247,19 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
                            limit=None if stmt.limit is None
                            else stmt.limit + offset)
     stmt = resolve_lookups(ctx, stmt)
+    trace = _transform_tracer(ctx)
     try:
         from spark_druid_olap_tpu.planner.decorrelate import (
             decorrelate_semijoins, inline_correlated_scalars,
             inline_subqueries)
         from spark_druid_olap_tpu.planner.viewmerge import merge_derived
-        stmt2 = merge_derived(ctx, stmt)
-        stmt2 = decorrelate_semijoins(ctx, stmt2)
-        stmt2 = inline_correlated_scalars(ctx, stmt2)
-        stmt2 = inline_subqueries(ctx, stmt2)
+        stmt2 = trace("merge_derived", stmt, merge_derived(ctx, stmt))
+        stmt2 = trace("decorrelate_semijoins", stmt2,
+                      decorrelate_semijoins(ctx, stmt2))
+        stmt2 = trace("inline_correlated_scalars", stmt2,
+                      inline_correlated_scalars(ctx, stmt2))
+        stmt2 = trace("inline_subqueries", stmt2,
+                      inline_subqueries(ctx, stmt2))
         pq = B.build(ctx, stmt2)
         df = execute_planned(ctx, pq)
         mode = "engine"

@@ -74,3 +74,17 @@ def test_metadata_summary(sales_ds):
     assert md["numRows"] == sales_ds.num_rows
     assert md["columns"]["region"]["cardinality"] == 4
     assert md["columns"]["price"]["type"] == "DOUBLE"
+
+
+def test_session_segment_target_rows_config():
+    """sdot.segment.target.rows drives ingest segment sizing when the
+    caller doesn't pass target_rows."""
+    import spark_druid_olap_tpu as sdot
+    from conftest import make_sales_df
+    c = sdot.Context({"sdot.segment.target.rows": 2048})
+    ds = c.ingest_dataframe("s", make_sales_df(10_000), time_column="ts")
+    assert ds.num_segments >= 4
+    c2 = sdot.Context({"sdot.segment.target.rows": 2048})
+    ds2 = c2.ingest_dataframe("s", make_sales_df(10_000), time_column="ts",
+                              target_rows=1 << 20)
+    assert ds2.num_segments == 1

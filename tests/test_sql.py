@@ -368,3 +368,12 @@ def test_sql_bare_and_aliased_column(ctx, sales):
     assert list(got.columns) == ["region", "r"]
     assert len(got) == 5
     assert (got["region"] == got["r"]).all()
+
+
+def test_debug_transformations_tracing(capsys):
+    c = sdot.Context({"sdot.debug.transformations": True})
+    c.ingest_dataframe("sales", make_sales_df(2000), time_column="ts")
+    c.sql("select region, sum(qty) from "
+          "(select region, qty from sales) s group by region")
+    err = capsys.readouterr().err
+    assert "[sdot.rewrite] merge_derived" in err
