@@ -153,6 +153,33 @@ def build_slots(khi, klo, valid, n_slots: int, rounds: int = PROBE_ROUNDS):
     return res.reshape(shape), tk_hi, tk_lo, unresolved
 
 
+def probe_slots(tk_hi, tk_lo, khi_q, klo_q, rounds: int = PROBE_ROUNDS):
+    """Look up query keys in a built table: follow the same double-hash
+    probe sequence build_slots used. Returns ``(slot, found)`` — slot is
+    clamped to 0 where not found. A key absent from the table never
+    false-positives (both parts must match; EMPTY query keys — padding
+    from underfull candidate lists — are explicitly misses)."""
+    T = int(tk_hi.shape[0])
+    kh = khi_q.astype(jnp.int32)
+    kl = klo_q.astype(jnp.int32)
+    h = _mix(kh, kl)
+    step = _mix(kl, kh) | jnp.uint32(1)
+    slot0 = (h % jnp.uint32(T)).astype(jnp.int32)
+
+    def body(_, st):
+        slot, fnd = st
+        hit = (tk_hi[slot] == kh) & (tk_lo[slot] == kl) & (fnd < 0)
+        fnd = jnp.where(hit, slot, fnd)
+        slot = ((slot.astype(jnp.uint32) + step)
+                % jnp.uint32(T)).astype(jnp.int32)
+        return slot, fnd
+
+    _, fnd = jax.lax.fori_loop(0, rounds, body,
+                               (slot0, jnp.full_like(kh, -1)))
+    found = (fnd >= 0) & (kh != EMPTY)
+    return jnp.maximum(fnd, 0), found
+
+
 def pack_key(khi: np.ndarray, klo: np.ndarray) -> np.ndarray:
     """Host: pack two int32 parts into one comparable int64 (parts < 2^31)."""
     return (np.asarray(khi, np.int64) << np.int64(31)) \

@@ -1027,14 +1027,20 @@ class QueryEngine:
                             maxabs=p.maxabs) for p in agg_plans]
         topk_plan = self._plan_device_topk_hashed(limit, having, agg_plans,
                                                   n_dev, n_waves)
+        exch_plan = None
+        if topk_plan is None and n_dev > 1 and n_waves == 1:
+            exch_plan = self._plan_hash_topk_exchange(q, limit, having,
+                                                      agg_plans)
 
         kg_used = 0
         while True:
             # k_sel*4 <= T also bounds k_sel < T, so no clamp is needed
             topk = topk_plan if topk_plan and topk_plan[1] * 4 <= T \
                 else None
-            compact = (topk is None and T >= self.config.get(
-                GROUPBY_HASH_COMPACT_MIN))
+            exch = exch_plan if exch_plan and exch_plan[1] * 4 <= T \
+                else None
+            compact = (topk is None and exch is None
+                       and T >= self.config.get(GROUPBY_HASH_COMPACT_MIN))
             k_out = topk[1] if topk else T
             routes = G.plan_routes(
                 metas, T, self.config.get(GROUPBY_MATMUL_MAX_KEYS))
@@ -1044,7 +1050,7 @@ class QueryEngine:
                    jax.default_backend(), bool(jax.config.jax_enable_x64))
 
             def build():
-                if compact:
+                if compact or exch:
                     return self._build_hash_table_program(
                         ds, dim_plans, parts, agg_plans, filter_spec,
                         intervals, min_day, max_day, T, sharded, routes)
@@ -1067,7 +1073,7 @@ class QueryEngine:
             for i in range(len(wave_segs)):
                 if t0 is not None:
                     self._stage_check(q, t0)
-                if compact:
+                if compact or exch:
                     table = dict(prog(cur))         # table stays on device
                     nxt = bind(i + 1) if i + 1 < len(wave_segs) else None
                     stats = np.asarray(
@@ -1076,6 +1082,25 @@ class QueryEngine:
                     unresolved += int(stats[:, 0].sum())
                     if unresolved:
                         break
+                    if exch:
+                        metric, k_sel, ascending = exch
+                        # sums need wider per-chip candidate lists (a
+                        # key large in total can rank lower locally);
+                        # min/max are exact with k_sel alone
+                        mplan = next(p for p in agg_plans
+                                     if p.spec.name == metric)
+                        k_cand = k_sel if mplan.kind in ("min", "max") \
+                            else min(T, max(4 * k_sel, 1024))
+                        kg_used = max(kg_used, k_sel)
+                        gfn, unpackB = self._cached_program(
+                            (sig, "exchange", exch, k_cand),
+                            lambda: self._build_hash_topk_exchange_program(
+                                agg_plans, routes, metric, ascending,
+                                k_cand, k_sel, T))
+                        raw = unpackB(gfn(table))
+                        partials.extend(
+                            _hash_chip_partials(raw, routes, k_sel, n_dev))
+                        continue
                     occ_max = max(1, int(stats[:, 1].max()))
                     kg = min(T, 1 << max(6, (occ_max - 1).bit_length()))
                     kg_used = max(kg_used, kg)
@@ -1132,7 +1157,9 @@ class QueryEngine:
             "rows_scanned": int(ds.num_rows), "waves": int(len(wave_segs)),
             "segments_per_wave": int(s_pad), "hashed": True,
             "hash_slots": int(T), "hash_compact_k": int(kg_used),
-            "topk_device": int(topk[1]) if topk else 0})
+            "topk_device": int(topk[1]) if topk
+            else (int(exch[1]) if exch else 0),
+            "topk_exchange": bool(exch)})
         return QueryResult(columns, data)
 
     def _plan_device_topk_hashed(self, limit, having, agg_plans, n_dev,
@@ -1282,6 +1309,132 @@ class QueryEngine:
         if not sharded:
             return jax.jit(run)
         return self._shard_wrap(run, P(SEGMENT_AXIS, None), P(SEGMENT_AXIS))
+
+    def _plan_hash_topk_exchange(self, q, limit, having, agg_plans):
+        """Gate for the multi-chip candidate-exchange ordered limit (see
+        _build_hash_topk_exchange_program). min/max metrics are EXACT under
+        the exchange; sum/count metrics carry Druid's topN union skew, so
+        they engage only for TopNQuerySpec (whose contract is approximate)
+        — exact GroupBy keeps the full-table key-wise merge."""
+        if having is not None or limit is None or limit.limit is None:
+            return None
+        if not limit.columns:
+            return None
+        oc = limit.columns[0]
+        plan = next((p for p in agg_plans if p.spec.name == oc.name), None)
+        if plan is None:
+            return None
+        if plan.kind not in ("min", "max") \
+                and not isinstance(q, S.TopNQuerySpec):
+            return None
+        return (oc.name, _topk_slack(limit), bool(oc.ascending))
+
+    def _build_hash_topk_exchange_program(self, agg_plans, routes, metric,
+                                          ascending, k_cand, k_sel, T):
+        """Multi-chip hashed ordered-limit WITHOUT shipping the tables:
+        each chip nominates its local top-``k_cand`` keys, the candidate
+        lists all_gather over ICI, every chip probes its OWN table for
+        every candidate, and the per-chip metric contributions combine
+        with psum/pmin/pmax into EXACT global scores. The global
+        top-``k_sel`` candidates' rows then travel per chip (a key a chip
+        doesn't hold contributes an EMPTY row the host merge drops).
+
+        Exact for min/max metrics (a global top-k key's global extremum
+        is attained on some chip, where it ranks locally at least as high
+        — the candidate union must contain it, given slack for ties).
+        For sum metrics the union can miss a key that is mediocre on
+        every chip yet large in total — Druid's topN accepts exactly this
+        skew, and values here are still exact for every returned key
+        (never under-counted, unlike Druid's merge)."""
+        pack, unpack = self._hash_packers(agg_plans, routes, k_sel, False)
+        r = routes[metric]
+
+        def run(table):
+            table = dict(table)
+            table.pop("__stats__", None)
+            tkhi = table["__tkhi__"]
+            tklo = table["__tklo__"]
+            occ = tkhi != H.EMPTY
+            local_sc = _topk_score(r, table, T, ascending, occ)
+            _, lidx = jax.lax.top_k(local_sc, k_cand)
+            cand_hi = jnp.where(occ[lidx], tkhi[lidx], H.EMPTY)
+            cand_lo = jnp.where(occ[lidx], tklo[lidx], H.EMPTY)
+            cand_hi = jax.lax.all_gather(cand_hi, SEGMENT_AXIS,
+                                         tiled=True)
+            cand_lo = jax.lax.all_gather(cand_lo, SEGMENT_AXIS,
+                                         tiled=True)
+            C = cand_hi.shape[0]
+            slot, found = H.probe_slots(tkhi, tklo, cand_hi, cand_lo)
+            # exact global metric per candidate from per-chip
+            # contributions (identity where this chip lacks the key)
+            mvals = {}
+            for oname, _, _ in r.outputs(1):
+                flat = table[oname].reshape(-1)
+                width = flat.shape[0] // T
+                if width == 1:
+                    mvals[oname] = flat[slot]
+                else:
+                    mvals[oname] = flat.reshape(T, width)[slot] \
+                        .reshape(-1)
+            v = G.route_score(r, mvals, C)
+            if r.kind == "min":
+                # +/-inf identity: strictly above every value AND every
+                # NULL sentinel (f64's sentinel IS inf), so absent chips
+                # can never mask a NULL-metric group's nulls-last rank
+                v = jnp.where(found, v, jnp.asarray(jnp.inf, v.dtype))
+                v = jax.lax.pmin(v, SEGMENT_AXIS)
+            elif r.kind == "max":
+                v = jnp.where(found, v, jnp.asarray(-jnp.inf, v.dtype))
+                v = jax.lax.pmax(v, SEGMENT_AXIS)
+            else:
+                v = jnp.where(found, v, jnp.zeros_like(v))
+                v = jax.lax.psum(v, SEGMENT_AXIS)
+            sc = -v if ascending else v
+            big = jnp.finfo(sc.dtype).max
+            nm = G.route_null_mask(r, {r.name: v}) \
+                if r.kind in ("min", "max") else None
+            if nm is not None:
+                sc = jnp.where(nm, -big, sc)
+            # duplicates (one key nominated by several chips) keep only
+            # their first occurrence; padding/absent keys rank last
+            order = jnp.lexsort((cand_lo, cand_hi))
+            sh = cand_hi[order]
+            sl = cand_lo[order]
+            dup_sorted = jnp.concatenate(
+                [jnp.zeros((1,), bool),
+                 (sh[1:] == sh[:-1]) & (sl[1:] == sl[:-1])])
+            dup = jnp.zeros_like(dup_sorted).at[order].set(dup_sorted)
+            exists = jax.lax.psum(found.astype(jnp.int32),
+                                  SEGMENT_AXIS) > 0
+            sc = jnp.where(dup | ~exists | (cand_hi == H.EMPTY),
+                           jnp.asarray(-jnp.inf, sc.dtype), sc)
+            _, cidx = jax.lax.top_k(sc, k_sel)
+            sel_slot = slot[cidx]
+            sel_found = found[cidx]
+            out = {}
+            for name, arr in table.items():
+                flat = arr.reshape(-1)
+                width = flat.shape[0] // T
+                if width == 1:
+                    out[name] = flat[sel_slot]
+                else:
+                    out[name] = flat.reshape(T, width)[sel_slot] \
+                        .reshape(-1)
+            # a chip without the key contributes an EMPTY row (dropped by
+            # the host occupancy filter), so absent values never pollute
+            # the key-wise merge
+            out["__tkhi__"] = jnp.where(sel_found, cand_hi[cidx], H.EMPTY)
+            out["__tklo__"] = jnp.where(sel_found, cand_lo[cidx], H.EMPTY)
+            return pack(out)
+
+        in_specs = {"__tkhi__": P(SEGMENT_AXIS),
+                    "__tklo__": P(SEGMENT_AXIS)}
+        for p in agg_plans:
+            for oname, _, _ in routes[p.spec.name].outputs(1):
+                in_specs[oname] = P(SEGMENT_AXIS)
+        smfn = jax.shard_map(run, mesh=self.mesh, in_specs=(in_specs,),
+                             out_specs=P(SEGMENT_AXIS), check_vma=False)
+        return jax.jit(lambda table: smfn(table)), unpack
 
     def _build_hash_gather_program(self, agg_plans, routes, k_gather, T,
                                    sharded):

@@ -264,10 +264,11 @@ def test_hashed_sharded_groupby_keeps_full_table(hstore, hdf):
                                   want["s_qty"].to_numpy())
 
 
-def test_hashed_sharded_topn_spec_stays_exact(hstore, hdf):
-    """Sharded TopNQuerySpec over the hashed path: per-chip top-k would
-    under-count keys split across chips, so it must NOT engage — results
-    stay exact via the full-table key-wise merge."""
+def test_hashed_sharded_topn_exchange(hstore, hdf):
+    """Sharded TopNQuerySpec: the candidate-exchange path engages (chips
+    nominate local candidates, all_gather + exact rescore over every
+    chip's table). Values for returned keys are EXACT — never the
+    under-counted partials Druid's topN merge accepts."""
     from spark_druid_olap_tpu.ir.spec import TopNQuerySpec
     from spark_druid_olap_tpu.parallel.mesh import make_mesh
     q = TopNQuerySpec(
@@ -278,9 +279,79 @@ def test_hashed_sharded_topn_spec_stays_exact(hstore, hdf):
         "sdot.querycostmodel.enabled": False,
         "sdot.engine.groupby.hash.slots": 1 << 14}))
     got = eng.execute(q).to_pandas()
-    assert eng.last_stats["topk_device"] == 0
+    assert eng.last_stats["topk_exchange"] is True
+    assert eng.last_stats["topk_device"] > 0
     want = hdf.groupby("cust", as_index=False).agg(s_qty=("qty", "sum")) \
         .sort_values("s_qty", ascending=False).head(7)
+    np.testing.assert_array_equal(got["s_qty"].to_numpy(),
+                                  want["s_qty"].to_numpy())
+
+
+def test_hashed_sharded_minmax_limit_exchange_exact(hstore, hdf):
+    """Sharded GroupBy ordered by a MAX metric: the exchange is provably
+    exact (a global extremum is attained on some chip), so plain GroupBy
+    engages it too."""
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+    limit = LimitSpec((OrderByColumn("mx_big", ascending=False),), 9)
+    eng = QueryEngine(hstore, mesh=make_mesh(), config=_cfg(**{
+        "sdot.querycostmodel.enabled": False,
+        "sdot.engine.groupby.hash.slots": 1 << 14}))
+    got = eng.execute(_q(["cust"], limit=limit)).to_pandas()
+    assert eng.last_stats["topk_exchange"] is True
+    g = hdf.groupby("cust", as_index=False).agg(
+        s_qty=("qty", "sum"), s_big=("big", "sum"), mn_big=("big", "min"),
+        mx_big=("big", "max"), s_price=("price", "sum"), n=("qty", "size"))
+    want = g.sort_values("mx_big", ascending=False).head(9)
+    np.testing.assert_array_equal(got["mx_big"].to_numpy().astype(np.int64),
+                                  want["mx_big"].to_numpy())
+    # the full row for every returned key is exact
+    np.testing.assert_array_equal(got["s_big"].to_numpy().astype(np.int64),
+                                  want["s_big"].to_numpy())
+
+
+def test_hashed_exchange_null_metrics_rank_last(hstore, hdf):
+    """ORDER BY MIN(x) DESC with NULL-metric groups (filtered agg leaves
+    some groups empty): absent-chip identities must not mask the NULL
+    sentinel — NULL groups rank last, never first."""
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+    filt = SelectorFilter("region", "east")
+    q = GroupByQuerySpec(
+        datasource="fact",
+        dimensions=(DimensionSpec("cust", "cust"),),
+        aggregations=(
+            AggregationSpec("doublemin", "mn_e", field="price",
+                            filter=filt),
+            AggregationSpec("count", "n"),
+        ),
+        limit=LimitSpec((OrderByColumn("mn_e", ascending=False),), 10))
+    eng = QueryEngine(hstore, mesh=make_mesh(), config=_cfg(**{
+        "sdot.querycostmodel.enabled": False,
+        "sdot.engine.groupby.hash.slots": 1 << 14}))
+    got = eng.execute(q).to_pandas()
+    assert eng.last_stats["topk_exchange"] is True
+    sub = hdf[hdf.region == "east"]
+    want = sub.groupby("cust")["price"].min() \
+        .sort_values(ascending=False).head(10)
+    vals = got["mn_e"].to_numpy()
+    assert not any(v is None or (isinstance(v, float) and np.isnan(v))
+                   for v in vals), "NULL groups displaced real candidates"
+    np.testing.assert_allclose(np.sort(vals.astype(np.float64)),
+                               np.sort(want.to_numpy()), rtol=1e-6)
+
+
+def test_hashed_sharded_sum_groupby_keeps_full_merge(hstore, hdf):
+    """Plain GroupBy ordered by a SUM stays on the exact full-table merge
+    (the exchange's candidate union could miss an everywhere-mediocre
+    key; only TopNQuerySpec's approximate contract accepts that)."""
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+    limit = LimitSpec((OrderByColumn("s_qty", ascending=False),), 7)
+    eng = QueryEngine(hstore, mesh=make_mesh(), config=_cfg(**{
+        "sdot.querycostmodel.enabled": False,
+        "sdot.engine.groupby.hash.slots": 1 << 14}))
+    got = eng.execute(_q(["cust"], limit=limit)).to_pandas()
+    assert eng.last_stats.get("topk_exchange") in (False, None)
+    want = _want(hdf, ["cust"]).sort_values(
+        ["s_qty"], ascending=False).head(7).reset_index(drop=True)
     np.testing.assert_array_equal(got["s_qty"].to_numpy(),
                                   want["s_qty"].to_numpy())
 
