@@ -348,3 +348,48 @@ def test_concurrent_correctness_hammer(slow_server):
     for t in ts:
         t.join(timeout=120)
     assert not errors, errors
+
+
+def test_concurrent_mixed_epilogues():
+    """Concurrent sessions exercising the NEW two-dispatch paths (device
+    having, hash compaction, top-k) must not corrupt each other's
+    program caches or device tables (compile-only locking)."""
+    import threading
+    import spark_druid_olap_tpu as sdot
+    from conftest import make_sales_df
+    import numpy as np
+
+    c = sdot.Context({"sdot.engine.having.device.min.keys": 64,
+                      "sdot.engine.topn.device.min.keys": 64,
+                      "sdot.engine.groupby.dense.max.keys": 1024,
+                      "sdot.engine.groupby.hash.compact.min.slots": 1})
+    df = make_sales_df(30_000)
+    c.ingest_dataframe("sales", df, time_column="ts", target_rows=4096)
+    want_top = df.groupby("product")["qty"].sum() \
+        .sort_values(ascending=False).head(5).to_numpy()
+    g = df.groupby("product")["qty"].sum()
+    want_hav = np.sort(g[g > 600].to_numpy())
+    errs = []
+
+    def run(i):
+        try:
+            for _ in range(3):
+                t = c.sql("select product, sum(qty) as s from sales "
+                          "group by product order by s desc limit 5") \
+                    .to_pandas()
+                np.testing.assert_array_equal(
+                    t["s"].to_numpy().astype(np.int64), want_top)
+                h = c.sql("select product, sum(qty) as s from sales "
+                          "group by product having sum(qty) > 600") \
+                    .to_pandas()
+                np.testing.assert_array_equal(
+                    np.sort(h["s"].to_numpy().astype(np.int64)), want_hav)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs[:2]

@@ -90,3 +90,54 @@ def test_sharded_semijoin_membership(mesh_ctx, single_ctx):
     got = mesh_ctx.sql(sql).to_pandas()
     want = single_ctx.sql(sql).to_pandas()
     assert_frames_equal(got, want, sort_by=None)
+
+
+def test_sharded_union_and_cte():
+    """UNION ALL / CTE branches plan independently on the mesh."""
+    import numpy as np
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+    from conftest import make_sales_df
+    df = make_sales_df(12_000)
+    m = sdot.Context({"sdot.querycostmodel.enabled": False},
+                     mesh=make_mesh())
+    m.ingest_dataframe("sales", df, time_column="ts", target_rows=2048)
+    got = m.sql(
+        "with o as (select region, sum(qty) as s from sales "
+        "           where status = 'O' group by region) "
+        "select region, s from o "
+        "union all "
+        "select region, sum(qty) as s from sales where status = 'F' "
+        "group by region order by region, s").to_pandas()
+    a = df[df.status == "O"].groupby("region")["qty"].sum()
+    b = df[df.status == "F"].groupby("region")["qty"].sum()
+    import pandas as pd
+    want = np.sort(pd.concat([a, b]).to_numpy())
+    np.testing.assert_array_equal(np.sort(got["s"].to_numpy()), want)
+
+
+def test_sharded_timezone_bucketing():
+    """Session timezone shifts granularity bucketing identically on the
+    mesh (offset LUTs ride into shard_map as constants)."""
+    import numpy as np
+    import pandas as pd
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+    rng = np.random.default_rng(9)
+    n = 8_000
+    ts = (np.datetime64("2021-03-10T00:00") +
+          rng.integers(0, 96, n) * np.timedelta64(1, "h"))
+    df = pd.DataFrame({"ts": ts.astype("datetime64[ns]"),
+                       "v": rng.integers(1, 10, n)})
+    cfgs = {"sdot.timezone": "America/New_York",
+            "sdot.querycostmodel.enabled": False}
+    single = sdot.Context(dict(cfgs))
+    single.ingest_dataframe("t", df, time_column="ts", target_rows=1024)
+    mesh = sdot.Context(dict(cfgs), mesh=make_mesh())
+    mesh.ingest_dataframe("t", df, time_column="ts", target_rows=1024)
+    q = ("select year(ts) as y, month(ts) as m, day(ts) as d, "
+         "sum(v) as s from t group by year(ts), month(ts), day(ts) "
+         "order by y, m, d")
+    a = single.sql(q).to_pandas()
+    b = mesh.sql(q).to_pandas()
+    pd.testing.assert_frame_equal(a, b, check_dtype=False)
