@@ -216,6 +216,25 @@ def _numeric_series(s):
     return pd.to_numeric(s, errors="coerce").to_numpy(dtype=np.float64)
 
 
+def _cached_inner(ctx, q2, sql_tag):
+    """Run an inlined subquery through the full session path, cached per
+    (store version, statement): dashboard-repetitive statements re-plan
+    on every execution, and without this every warm run re-executed each
+    decorrelated inner (ingest bumps store.version, so results can never
+    go stale; bounded like the engine-assist cache)."""
+    from spark_druid_olap_tpu.planner.host_exec import result_cache
+    cache, key = result_cache(ctx, "subquery", q2)
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
+    from spark_druid_olap_tpu.sql.session import _run_select
+    df = _run_select(ctx, q2, sql=sql_tag).to_pandas()
+    if len(cache) > 64:
+        cache.clear()
+    cache[key] = df
+    return df
+
+
 def _run_grouped_inner(ctx, q, inner_keys, rest, value_items):
     """Execute the decorrelated per-key aggregate through the full session
     path (engine pushdown for the inner). Returns ([int64 key arrays],
@@ -228,8 +247,7 @@ def _run_grouped_inner(ctx, q, inner_keys, rest, value_items):
         relation=q.relation, where=_and_all(rest),
         group_by=tuple(inner_keys))
     try:
-        from spark_druid_olap_tpu.sql.session import _run_select
-        df = _run_select(ctx, q2, sql="<correlated subquery>").to_pandas()
+        df = _cached_inner(ctx, q2, "<correlated subquery>")
     except Exception:  # noqa: BLE001 — leave to the host tier
         return None
     keep = np.ones(len(df), dtype=bool)
@@ -497,8 +515,7 @@ def inline_subqueries(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
     """Replace uncorrelated subquery nodes in WHERE/HAVING with literals."""
 
     def run_inner(q: A.SelectStmt) -> pd.DataFrame:
-        from spark_druid_olap_tpu.sql.session import _run_select
-        return _run_select(ctx, q, sql="<subquery>").to_pandas()
+        return _cached_inner(ctx, q, "<subquery>")
 
     changed = [False]
 

@@ -87,6 +87,19 @@ def datasource_frame(ctx, name: str, columns=None) -> pd.DataFrame:
     return out
 
 
+def result_cache(ctx, kind: str, stmt):
+    """(cache_dict, key) for session-scoped result caches. The key folds
+    in the store version (ingest/drop invalidates) AND the session config
+    fingerprint (a timezone or precision change must never serve results
+    computed under the old settings). One policy shared by the
+    engine-assist and decorrelated-subquery caches."""
+    cache = getattr(ctx, "_result_cache", None)
+    if cache is None:
+        cache = ctx._result_cache = {}
+    key = (kind, ctx.store.version, ctx.config.fingerprint(), repr(stmt))
+    return cache, key
+
+
 def try_engine(ctx, stmt: A.SelectStmt) -> Optional[pd.DataFrame]:
     """Engine-assisted host tier: attempt device pushdown of an
     uncorrelated sub-statement (derived table, inner block of a subquery).
@@ -100,10 +113,7 @@ def try_engine(ctx, stmt: A.SelectStmt) -> Optional[pd.DataFrame]:
     from spark_druid_olap_tpu.parallel.executor import EngineFallback
     from spark_druid_olap_tpu.planner import builder as B
     from spark_druid_olap_tpu.planner.plans import PlanUnsupported
-    cache = getattr(ctx, "_assist_cache", None)
-    if cache is None:
-        cache = ctx._assist_cache = {}
-    key = (ctx.store.version, repr(stmt))
+    cache, key = result_cache(ctx, "assist", stmt)
     if key in cache:
         return cache[key]
     try:

@@ -199,6 +199,12 @@ class Config:
             value = entry.parse(value)
         self._values[key] = value
 
+    def fingerprint(self) -> tuple:
+        """Hashable snapshot of every override — result caches key on it
+        so a session config change (timezone, HLL precision, ...) can
+        never serve results computed under the old settings."""
+        return tuple(sorted((k, repr(v)) for k, v in self._values.items()))
+
     def get(self, entry_or_key) -> Any:
         if isinstance(entry_or_key, ConfigEntry):
             return self._values.get(entry_or_key.key, entry_or_key.default)

@@ -308,3 +308,63 @@ def test_keyed_lookup_repr_is_o1():
     tab2 = E.FrozenKeyedTable(np.arange(1_000_000),
                               np.arange(1_000_000, dtype=np.float64))
     assert tab == tab2 and hash(tab) == hash(tab2)
+
+
+def test_subquery_cache_invalidated_by_ingest(cctx):
+    """Cached inner results key on store.version: re-ingest must not
+    serve stale subquery results."""
+    import spark_druid_olap_tpu as sdot
+    rng = np.random.default_rng(3)
+    n = 5_000
+
+    def mk(scale):
+        return pd.DataFrame({
+            "ts": (np.datetime64("2019-01-01")
+                   + rng.integers(0, 100, n).astype("timedelta64[D]"))
+            .astype("datetime64[ns]"),
+            "k": rng.integers(1, 50, n),
+            "q": (rng.integers(1, 10, n) * scale).astype(np.int64),
+        })
+    c = sdot.Context()
+    c.ingest_dataframe("f", mk(1), time_column="ts", target_rows=1024)
+    sql = ("select count(*) as n from f "
+           "where q > (select avg(i_q) from "
+           "  (select k as i_k, q as i_q from f) i where i_k = k)")
+    first = int(c.sql(sql).to_pandas()["n"][0])
+    assert first == int(c.sql(sql).to_pandas()["n"][0])   # warm hit
+    # re-ingest constant data -> the answer must be exactly recomputed
+    d = pd.DataFrame({
+        "ts": pd.to_datetime(["2019-01-01"] * 4),
+        "k": [1, 1, 2, 2], "q": [1, 3, 5, 5]})
+    c.ingest_dataframe("f", d, time_column="ts", target_rows=1024)
+    out = int(c.sql(sql).to_pandas()["n"][0])
+    # per-key avgs: k1 -> 2 (q=3 passes), k2 -> 5 (none pass)
+    assert out == 1
+
+
+def test_subquery_cache_invalidated_by_config():
+    """The cache folds in the session config fingerprint: a timezone
+    change must never serve inner results computed under the old tz."""
+    import spark_druid_olap_tpu as sdot
+    ts = pd.to_datetime(["2019-01-01 20:00"] * 2 + ["2019-01-02 20:00"] * 2)
+    df = pd.DataFrame({"ts": ts, "k": [1, 1, 1, 1],
+                       "q": [1, 1, 2, 2]})
+    c = sdot.Context()
+    c.ingest_dataframe("f", df, time_column="ts", target_rows=1024)
+    sql = ("select count(*) as n from f "
+           "where q <= (select max(day(i_ts)) from "
+           "  (select k as i_k, ts as i_ts from f) i where i_k = k)")
+    utc = int(c.sql(sql).to_pandas()["n"][0])     # max day = 2 (UTC)
+    assert utc == 4
+    c.config.set("sdot.timezone", "Asia/Kolkata")  # 20:00 UTC -> next day
+    local = int(c.sql(sql).to_pandas()["n"][0])    # max day = 3
+    assert local == 4
+    # sharper: threshold sits between the two answers
+    sql2 = ("select count(*) as n from f "
+            "where 3 <= (select max(day(i_ts)) from "
+            "  (select k as i_k, ts as i_ts from f) i where i_k = k)")
+    c2 = sdot.Context()
+    c2.ingest_dataframe("f", df, time_column="ts", target_rows=1024)
+    assert int(c2.sql(sql2).to_pandas()["n"][0]) == 0   # UTC: max day 2
+    c2.config.set("sdot.timezone", "Asia/Kolkata")
+    assert int(c2.sql(sql2).to_pandas()["n"][0]) == 4   # local: max day 3
