@@ -446,6 +446,7 @@ def main():
 
     wall_lat, adj_lat = {}, {}
     n_engine = 0
+    host_queries = []
     for name in names:
         # queries run as written over the base tables; the planner's
         # star-join collapse routes fact+dim joins onto the flat index
@@ -460,6 +461,8 @@ def main():
             continue
         mode = ctx.history.entries()[-1].stats.get("mode", "?")
         n_engine += mode == "engine"
+        if mode != "engine":
+            host_queries.append(f"{name}:{mode}")
         n_reps = 1 if cold > 3.0 else reps
         ts = []
         try:
@@ -515,6 +518,7 @@ def main():
         "dispatch_floor_ms": round(floor_ms, 1),
         "n_queries": len(wall_lat),
         "n_engine_mode": n_engine,
+        "host_queries": host_queries,
         "n_failed": n_fail,
         "rows": n_rows,
         "numerics": numerics,
