@@ -447,6 +447,11 @@ def main():
     wall_lat, adj_lat = {}, {}
     n_engine = 0
     host_queries = []
+    suite_t0 = time.perf_counter()
+    try:
+        budget_s = float(os.environ.get("SDOT_BENCH_TIME_BUDGET", "2400"))
+    except ValueError:
+        budget_s = 2400.0
     for name in names:
         # queries run as written over the base tables; the planner's
         # star-join collapse routes fact+dim joins onto the flat index
@@ -463,7 +468,10 @@ def main():
         n_engine += mode == "engine"
         if mode != "engine":
             host_queries.append(f"{name}:{mode}")
-        n_reps = 1 if cold > 3.0 else reps
+        over_budget = (time.perf_counter() - suite_t0) > budget_s
+        if over_budget:
+            log(f"{name}: over SDOT_BENCH_TIME_BUDGET, single rep")
+        n_reps = 1 if (cold > 3.0 or over_budget) else reps
         ts = []
         try:
             for _ in range(n_reps):
