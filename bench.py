@@ -470,9 +470,11 @@ def main():
             host_queries.append(f"{name}:{mode}")
         over_budget = (time.perf_counter() - suite_t0) > budget_s
         if over_budget:
-            log(f"{name}: over SDOT_BENCH_TIME_BUDGET, single rep")
-        n_reps = 1 if (cold > 3.0 or over_budget) else reps
-        ts = []
+            # past the soft budget, the cold run (already paid) is the
+            # only sample — wall for these queries includes compile
+            log(f"{name}: over SDOT_BENCH_TIME_BUDGET, cold sample only")
+        n_reps = 0 if over_budget else (1 if cold > 3.0 else reps)
+        ts = [cold] if over_budget else []
         try:
             for _ in range(n_reps):
                 t0 = time.perf_counter()

@@ -25,14 +25,10 @@ class IntervalAccumulator:
     tz: str = "UTC"
 
     def _ms(self, value) -> int:
-        # a date/time literal is LOCAL wall-clock; the stored time axis is
-        # UTC (reference: tz.id driving interval extraction,
-        # DateTimeExtractor.scala)
-        ms = date_literal_to_millis(value)
-        from spark_druid_olap_tpu.ops import timezone as TZ
-        if not TZ.is_utc(self.tz):
-            ms = TZ.local_naive_to_utc_millis(self.tz, ms)
-        return ms
+        # naive literals are session-local wall clock, zoned ones are
+        # absolute instants (one policy: time_ops.literal_to_utc_millis)
+        from spark_druid_olap_tpu.ops.time_ops import literal_to_utc_millis
+        return literal_to_utc_millis(value, self.tz)
 
     def ge(self, value):            # t >= v
         self.lo = max(self.lo, self._ms(value))

@@ -150,22 +150,14 @@ def _time_bound(f: S.BoundFilter, ctx):
     ms = ctx.time_ms()
     mask = None
 
-    def to_utc(naive_ms):
-        # literals mean session-local wall clock, like the interval
-        # accumulator and the expression path
-        from spark_druid_olap_tpu.ops import timezone as TZ
-        if not TZ.is_utc(ctx.tz):
-            return TZ.local_naive_to_utc_millis(ctx.tz, naive_ms)
-        return naive_ms
-
     if f.lower is not None:
-        lo = to_utc(time_ops.date_literal_to_millis(f.lower))
+        lo = time_ops.literal_to_utc_millis(f.lower, ctx.tz)
         d, r = divmod(lo, time_ops.MILLIS_PER_DAY)
         cmp = (ms > r) if f.lower_strict else (ms >= r)
         m = (days > d) | ((days == d) & cmp)
         mask = m
     if f.upper is not None:
-        hi = to_utc(time_ops.date_literal_to_millis(f.upper))
+        hi = time_ops.literal_to_utc_millis(f.upper, ctx.tz)
         d, r = divmod(hi, time_ops.MILLIS_PER_DAY)
         cmp = (ms < r) if f.upper_strict else (ms <= r)
         m = (days < d) | ((days == d) & cmp)

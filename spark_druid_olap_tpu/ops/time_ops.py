@@ -71,12 +71,46 @@ def date_literal_to_days(value) -> int:
 
 def date_literal_to_millis(value) -> int:
     if isinstance(value, str) and ("T" in value or " " in value.strip()):
-        ts = _dt.datetime.fromisoformat(value.strip().replace("Z", "+00:00"))
-        if ts.tzinfo is not None:
-            ts = ts.astimezone(_dt.timezone.utc).replace(tzinfo=None)
-        epoch = _dt.datetime(1970, 1, 1)
-        return int((ts - epoch).total_seconds() * 1000)
+        value = _dt.datetime.fromisoformat(
+            value.strip().replace("Z", "+00:00"))
+    if isinstance(value, _dt.datetime):
+        # keep sub-day precision (the parser lowers `timestamp '...'` to
+        # a datetime; flooring it to days would silently widen filters)
+        if value.tzinfo is not None:
+            value = value.astimezone(_dt.timezone.utc).replace(tzinfo=None)
+        return int((value - _dt.datetime(1970, 1, 1))
+                   .total_seconds() * 1000)
+    if isinstance(value, np.datetime64):
+        return int(value.astype("datetime64[ms]").astype(np.int64))
     return date_literal_to_days(value) * MILLIS_PER_DAY
+
+
+def literal_is_zoned(value) -> bool:
+    """True when a time literal carries an EXPLICIT zone/offset — it is
+    then an absolute instant and must NOT be re-shifted by the session
+    timezone."""
+    if isinstance(value, _dt.datetime):
+        return value.tzinfo is not None
+    if isinstance(value, str):
+        s = value.strip()
+        if "T" in s or " " in s:
+            try:
+                return _dt.datetime.fromisoformat(
+                    s.replace("Z", "+00:00")).tzinfo is not None
+            except ValueError:
+                return False
+    return False
+
+
+def literal_to_utc_millis(value, tz: str) -> int:
+    """The ONE policy for time-literal lowering: zoned literals are
+    absolute instants; naive ones mean session-local wall clock
+    (reference: spark.sparklinedata.tz.id driving DateTimeExtractor)."""
+    ms = date_literal_to_millis(value)
+    from spark_druid_olap_tpu.ops import timezone as TZ
+    if not TZ.is_utc(tz) and not literal_is_zoned(value):
+        ms = TZ.local_naive_to_utc_millis(tz, ms)
+    return ms
 
 
 # -- field extraction ---------------------------------------------------------
