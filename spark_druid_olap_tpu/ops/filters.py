@@ -81,7 +81,9 @@ def _selector(f: S.SelectorFilter, ctx):
     if kind == ColumnKind.DATE:
         return ctx.col(f.dimension) == time_ops.date_literal_to_days(f.value)
     if kind == ColumnKind.TIME:
-        ms = time_ops.date_literal_to_millis(f.value)
+        # same literal policy as _time_bound: naive literals are
+        # session-local, zoned ones absolute
+        ms = time_ops.literal_to_utc_millis(f.value, ctx.tz)
         day, rem = divmod(ms, time_ops.MILLIS_PER_DAY)
         return (ctx.col(f.dimension) == day) & (ctx.time_ms() == rem)
     raise EC.Unsupported(f"selector on {kind}")

@@ -162,3 +162,21 @@ def test_between_matches_comparison_forms(tz_ctx, local):
     want = int(((local.lts >= pd.Timestamp("2019-06-01"))
                 & (local.lts <= pd.Timestamp("2019-06-30"))).sum())
     assert a == want
+
+
+def test_time_equality_selector_uses_session_tz():
+    """ts = timestamp '...' equality follows the same literal policy as
+    range bounds (naive literal = session-local wall clock)."""
+    import spark_druid_olap_tpu as sdot
+    ts = pd.to_datetime(["2020-06-01 10:00", "2020-06-01 12:00"])
+    df = pd.DataFrame({"ts": ts, "v": [1, 2]})
+    c = sdot.Context({"sdot.timezone": "Europe/Paris"})
+    c.ingest_dataframe("z", df, time_column="ts", target_rows=1024)
+    # Paris 12:00 local == 10:00Z -> matches the first row
+    got = c.sql("select count(*) as n from z "
+                "where ts = timestamp '2020-06-01 12:00:00'").to_pandas()
+    assert int(got["n"][0]) == 1
+    got2 = c.sql("select v from z "
+                 "where ts = timestamp '2020-06-01T12:00:00+02:00'") \
+        .to_pandas()
+    assert got2["v"].tolist() == [1]
