@@ -441,6 +441,10 @@ class AggPlan:
     source_cols: tuple
     is_int: bool = False         # integer-exact device lanes (i32 storage)
     maxabs: Optional[float] = None   # static |value| bound (col metadata)
+    dim_codes: bool = False      # min/max over a NON-numeric string dim:
+    #   aggregate the dictionary CODES (the global dictionary is sorted
+    #   ascending, segment/column.py:46, so code order IS lexicographic
+    #   order) and decode the extremum code to its string at output
 
     def build_values(self, ctx: ScanContext):
         a = self.spec
@@ -463,6 +467,8 @@ class AggPlan:
                 raise EngineFallback(f"cardinality over {k}")
             if k in (ColumnKind.LONG, ColumnKind.DOUBLE, ColumnKind.DATE):
                 return ctx.col(a.field)
+            if k == ColumnKind.DIM and self.dim_codes:
+                return ctx.col(a.field)          # sorted-dict codes
             if k == ColumnKind.DIM and self.kind in ("min", "max", "sum"):
                 # numeric-parsed dim (Druid coerces); host LUT
                 lut = np.array([host_eval_try_float(s)
@@ -612,6 +618,14 @@ def plan_aggregation(a: S.AggregationSpec, ds: Datasource) -> AggPlan:
             if ck == ColumnKind.DOUBLE:
                 is_int = False
         elif ck == ColumnKind.DIM:
+            if kind in ("min", "max") and not _dim_parses_numeric(
+                    ds, a.field):
+                # lexicographic min/max of a string dim = min/max of its
+                # sorted-dictionary codes, decoded at output
+                is_int, maxabs = _col_bounds(ds, a.field)
+                cols |= F.columns_of_filter(a.filter)
+                return AggPlan(a, kind, dtype, tuple(sorted(cols)),
+                               is_int, maxabs, dim_codes=True)
             # numeric-parsed dim rides an f32 LUT
             is_int, maxabs = False, None
         else:
@@ -621,6 +635,25 @@ def plan_aggregation(a: S.AggregationSpec, ds: Datasource) -> AggPlan:
         is_int, maxabs = _expr_bounds(a.expr, ds)
     cols |= F.columns_of_filter(a.filter)
     return AggPlan(a, kind, dtype, tuple(sorted(cols)), is_int, maxabs)
+
+
+def _dim_parses_numeric(ds: Datasource, field: str) -> bool:
+    """Whether EVERY dictionary entry of a string dim parses as a number
+    (then Druid's numeric-coercion semantics apply to min/max/sum over
+    it); cached per datasource column — dictionaries can be large."""
+    cache = getattr(ds, "_dim_numeric_cache", None)
+    if cache is None:
+        try:
+            cache = ds._dim_numeric_cache = {}
+        except AttributeError:           # frozen datasource: no cache
+            cache = {}
+    r = cache.get(field)
+    if r is None:
+        d = ds.dims[field].dictionary
+        r = bool(len(d)) and not np.isnan(np.array(
+            [host_eval_try_float(s) for s in d], dtype=np.float64)).any()
+        cache[field] = r
+    return r
 
 
 # =============================================================================
@@ -745,7 +778,8 @@ class QueryEngine:
     # -- aggregation path -----------------------------------------------------
     def _run_agg(self, q, dimensions: List[S.DimensionSpec], aggregations,
                  post_aggregations, having, limit, granularity, filter_spec,
-                 intervals, t0: Optional[float] = None) -> QueryResult:
+                 intervals, t0: Optional[float] = None,
+                 no_topk: bool = False) -> QueryResult:
         ds = self.store.get(q.datasource)
         seg_idx = ds.prune_segments(intervals, filter_spec)
         gran_kind = granularity.kind if granularity else "all"
@@ -785,7 +819,7 @@ class QueryEngine:
             return self._run_agg_hashed(
                 q, ds, seg_idx, all_dim_plans, agg_plans, names, min_day,
                 max_day, post_aggregations, having, limit, filter_spec,
-                intervals, t0)
+                intervals, t0, no_topk=no_topk)
 
         sharded = self._should_shard(q, ds, seg_idx)
         n_dev = mesh_size(self.mesh) if sharded else 1
@@ -797,7 +831,7 @@ class QueryEngine:
         s_pad = spw if n_waves > 1 else _pad_segments(len(seg_idx), n_dev)
         sketch_plans = [p for p in agg_plans if p.kind in ("hll", "theta")]
         topk = self._plan_device_topk(limit, having, agg_plans, n_keys) \
-            if n_waves == 1 else None
+            if n_waves == 1 and not no_topk else None
         having_dev = self._plan_device_having(having, routes, agg_plans,
                                               n_keys, topk, n_waves)
         n_out = topk[1] if topk else n_keys
@@ -898,11 +932,24 @@ class QueryEngine:
         data = self._agg_epilogue(data, columns, post_aggregations, having,
                                   limit)
 
+        if topk and not isinstance(q, S.TopNQuerySpec):
+            # exact-contract GroupBy: the candidate selection is
+            # f32-approximate — prove the boundary row clears the cutoff
+            # or re-run with the full-table transfer (ADVICE r2)
+            scores = np.asarray(out["__topk_score__"], np.float64)
+            if not _topk_selection_exact(limit, topk, routes[topk[0]],
+                                         scores, data):
+                return self._run_agg(q, dimensions, aggregations,
+                                     post_aggregations, having, limit,
+                                     granularity, filter_spec, intervals,
+                                     t0, no_topk=True)
+
         self.last_stats.update({
             "datasource": ds.name, "segments": int(len(seg_idx)),
             "sharded": sharded, "groups": int(len(sel)),
             "rows_scanned": int(ds.num_rows), "waves": int(n_waves),
             "segments_per_wave": int(spw),
+            "bytes_scanned": int(seg_bytes) * int(len(seg_idx)),
             "topk_device": int(topk[1]) if topk else 0,
             "having_device": int(n_out) if having_dev else 0})
         return QueryResult(columns, data)
@@ -932,9 +979,12 @@ class QueryEngine:
         if n_keys < self.config.get(TOPN_DEVICE_MIN_KEYS):
             return None
         oc = limit.columns[0]
-        dense = {p.spec.name for p in agg_plans
-                 if p.kind not in ("hll", "theta")}
-        if oc.name not in dense:
+        mplan = next((p for p in agg_plans if p.spec.name == oc.name), None)
+        if mplan is None or mplan.kind in ("hll", "theta"):
+            return None
+        if mplan.dim_codes:
+            # string min/max decodes to text: the exactness proof can't
+            # score it (float(str)), so the epilogue would always re-run
             return None
         k_sel = min(n_keys, _topk_slack(limit))
         if k_sel * 4 >= n_keys:
@@ -981,7 +1031,7 @@ class QueryEngine:
     # -- hashed high-cardinality aggregation path -----------------------------
     def _run_agg_hashed(self, q, ds, seg_idx, dim_plans, agg_plans, names,
                         min_day, max_day, post_aggregations, having, limit,
-                        filter_spec, intervals, t0):
+                        filter_spec, intervals, t0, no_topk: bool = False):
         """Group-by above the dense key-space ceiling: fixed-size device hash
         table per chip/wave (ops/hash_groupby.py), partials merged by *key*
         on host. Table overflow retries at 4x slots, then falls back.
@@ -1026,13 +1076,15 @@ class QueryEngine:
         metas = [G.AggInput(p.spec.name, p.kind, is_int=p.is_int,
                             maxabs=p.maxabs) for p in agg_plans]
         topk_plan = self._plan_device_topk_hashed(limit, having, agg_plans,
-                                                  n_dev, n_waves)
+                                                  n_dev, n_waves) \
+            if not no_topk else None
         exch_plan = None
         if topk_plan is None and n_dev > 1 and n_waves == 1:
             exch_plan = self._plan_hash_topk_exchange(q, limit, having,
                                                       agg_plans)
 
         kg_used = 0
+        tk_scores = None
         while True:
             # k_sel*4 <= T also bounds k_sel < T, so no clamp is needed
             topk = topk_plan if topk_plan and topk_plan[1] * 4 <= T \
@@ -1121,6 +1173,8 @@ class QueryEngine:
                     unresolved += int(raw.pop("__unres__").sum())
                     if unresolved:
                         break
+                    if topk:
+                        tk_scores = raw.pop("__topk_score__")
                     partials.extend(
                         _hash_chip_partials(raw, routes, k_out, n_dev))
             if not unresolved:
@@ -1151,10 +1205,25 @@ class QueryEngine:
 
         data = self._agg_epilogue(data, columns, post_aggregations, having,
                                   limit)
+
+        if topk and tk_scores is not None \
+                and not isinstance(q, S.TopNQuerySpec):
+            # exact-contract GroupBy over the hashed tier: same proof as
+            # the dense epilogue (ADVICE r2); single-chip single-wave by
+            # _plan_device_topk_hashed, so the slot scores are global
+            scores = np.sort(np.asarray(tk_scores, np.float64))[::-1]
+            if not _topk_selection_exact(limit, topk, routes[topk[0]],
+                                         scores, data):
+                return self._run_agg_hashed(
+                    q, ds, seg_idx, dim_plans, agg_plans, names, min_day,
+                    max_day, post_aggregations, having, limit, filter_spec,
+                    intervals, t0, no_topk=True)
+
         self.last_stats.update({
             "datasource": ds.name, "segments": int(len(seg_idx)),
             "sharded": sharded, "groups": int(len(keys)),
             "rows_scanned": int(ds.num_rows), "waves": int(len(wave_segs)),
+            "bytes_scanned": int(seg_bytes) * int(len(seg_idx)),
             "segments_per_wave": int(s_pad), "hashed": True,
             "hash_slots": int(T), "hash_compact_k": int(kg_used),
             "topk_device": int(topk[1]) if topk
@@ -1180,7 +1249,8 @@ class QueryEngine:
         if not limit.columns:
             return None
         oc = limit.columns[0]
-        if oc.name not in {p.spec.name for p in agg_plans}:
+        mplan = next((p for p in agg_plans if p.spec.name == oc.name), None)
+        if mplan is None or mplan.dim_codes:
             return None
         if n_dev != 1 or n_waves != 1:
             return None
@@ -1225,7 +1295,8 @@ class QueryEngine:
 
         return core
 
-    def _hash_packers(self, agg_plans, routes, k_out, with_unres: bool):
+    def _hash_packers(self, agg_plans, routes, k_out, with_unres: bool,
+                      with_score: bool = False):
         """(pack, unpack) over the hash outputs: ONE flat buffer — a
         tunneled/remote chip charges a full RTT per device->host transfer,
         so the table must not travel as 8-10 separate arrays (same packing
@@ -1233,6 +1304,8 @@ class QueryEngine:
         x64 = G._x64()
         meta = ([("__unres__", 1, "i32")] if with_unres else []) \
             + [("__tkhi__", k_out, "i32"), ("__tklo__", k_out, "i32")]
+        if with_score:
+            meta.append(("__topk_score__", k_out, "f64" if x64 else "f32"))
         for p in agg_plans:
             meta.extend(routes[p.spec.name].outputs(k_out))
         total = sum(m[1] for m in meta)
@@ -1274,7 +1347,8 @@ class QueryEngine:
         core = self._hash_core(ds, dim_plans, parts, agg_plans, filter_spec,
                                intervals, min_day, max_day, T, routes)
         k_out = topk[1] if topk else T
-        pack, unpack = self._hash_packers(agg_plans, routes, k_out, True)
+        pack, unpack = self._hash_packers(agg_plans, routes, k_out, True,
+                                          with_score=bool(topk))
 
         def run(arrays):
             out = core(arrays)
@@ -1391,10 +1465,17 @@ class QueryEngine:
                 v = jax.lax.psum(v, SEGMENT_AXIS)
             sc = -v if ascending else v
             big = jnp.finfo(sc.dtype).max
-            nm = G.route_null_mask(r, {r.name: v}) \
-                if r.kind in ("min", "max") else None
-            if nm is not None:
-                sc = jnp.where(nm, -big, sc)
+            if r.kind in ("min", "max"):
+                # NULL group = every chip HOLDING the key has the
+                # sentinel, detected on the RAW per-chip values BEFORE
+                # the float cast (a legitimate i32/i64 extremum within
+                # one f32 ulp of the sentinel must not be misclassified
+                # as NULL — ADVICE r2), combined across chips
+                local_null = G.route_null_mask(r, mvals)
+                has_real = jax.lax.psum(
+                    (found & jnp.logical_not(local_null))
+                    .astype(jnp.int32), SEGMENT_AXIS) > 0
+                sc = jnp.where(has_real, sc, jnp.asarray(-big, sc.dtype))
             # duplicates (one key nominated by several chips) keep only
             # their first occurrence; padding/absent keys rank last
             order = jnp.lexsort((cand_lo, cand_hi))
@@ -1644,7 +1725,7 @@ class QueryEngine:
         theta_plans = [p for p in agg_plans if p.kind == "theta"]
         pack, unpack = self._agg_meta_packers(
             agg_plans, routes, topk[1] if topk else n_keys,
-            with_idx=bool(topk))
+            with_idx=bool(topk), with_score=bool(topk))
 
         def topk_gather(out, axis_name=None):
             """Select k_sel candidate keys by score, gather every output."""
@@ -1653,10 +1734,11 @@ class QueryEngine:
                                     axis_name)
             sc = _topk_score(routes[metric], out, n_keys, ascending,
                              rows_sc > 0.5, axis_name)
-            _, idx = jax.lax.top_k(sc, k_sel)
+            vals, idx = jax.lax.top_k(sc, k_sel)
             idx = idx.astype(jnp.int32)
             g = _gather_rows(out, idx, n_keys)
             g["__topk_idx__"] = idx
+            g["__topk_score__"] = vals
             return g
 
         if not sharded:
@@ -1858,7 +1940,8 @@ class QueryEngine:
                              check_vma=False)
         return jax.jit(lambda table: smfn(table)), unpack
 
-    def _agg_meta_packers(self, agg_plans, routes, n_out, with_idx):
+    def _agg_meta_packers(self, agg_plans, routes, n_out, with_idx,
+                          with_score=False):
         """(pack, unpack) for the dense path's TWO-buffer transfer:
         collective-merged outputs in one replicated buffer, per-chip
         ff/lanes partial pairs in one segment-sharded buffer. ``n_out``
@@ -1885,6 +1968,9 @@ class QueryEngine:
                   "f64" if x64 else "f32", True) for p in theta_plans]
         if with_idx:
             meta.append(("__topk_idx__", n_out, "i32", True))
+        if with_score:
+            meta.append(("__topk_score__", n_out, "f64" if x64 else "f32",
+                         True))
         merged_meta = [t for t in meta if t[3]]
         perchip_meta = [t for t in meta if not t[3]]
         buf_dtype = jnp.int64 if x64 else jnp.int32
@@ -2179,6 +2265,10 @@ def _decode_agg_value(ds, p, r, v) -> np.ndarray:
             empty = np.abs(v) >= 3.0e38
         if p.spec.kind == "anyvalue":
             return _decode_anyvalue(ds, p.spec.field, v, empty)
+        if p.dim_codes:
+            # extremum CODE of the sorted dictionary -> its string (the
+            # same decode contract as FD-demoted grouping columns)
+            return _decode_anyvalue(ds, p.spec.field, v, empty)
         if empty.any():
             if r.tag == "i64" and \
                     np.abs(np.where(empty, 0, v)).max(initial=0) >= 2**53:
@@ -2258,6 +2348,100 @@ def _topk_score(route, out, n_keys, ascending, valid, axis_name=None):
     return jnp.where(valid, sc, jnp.asarray(-jnp.inf, sc.dtype))
 
 
+def _score_cast_exact(route, x64: bool, vlo: float, vhi: float) -> bool:
+    """True when route_score is bit-exact for every metric value in
+    [vlo, vhi] AND no value OUTSIDE that range can round onto a value
+    inside it (so a boundary tie in score space is a true value tie).
+    Bounds are therefore STRICT: at an inclusive 2^24 cutoff, an
+    excluded i32 key at 2^24+1 rounds ties-to-even DOWN onto the
+    cutoff and a tie-accept would certify a wrong result."""
+    t = route.tag
+    if t == "f32":
+        return True                  # the score IS the device value
+    if t == "f64":
+        return x64
+    if t == "i64":
+        return x64 and -(2.0 ** 53) < vlo and vhi < 2.0 ** 53
+    if t == "i32":
+        return -(2.0 ** 24) < vlo and vhi < 2.0 ** 24
+    if t in ("limbs", "lanes"):
+        # nonnegative values below the first carry boundary reconstruct
+        # as a sum of two exactly-representable f32 terms; values past
+        # 2^24 round by at most 1 ulp and cannot reach below 2^23
+        return 0.0 <= vlo and vhi < 2.0 ** 23
+    return False                     # ff compensated pairs
+
+
+def _topk_selection_exact(limit, topk, route, scores, data) -> bool:
+    """True when the f32-approximate device candidate selection PROVABLY
+    contains the exact ordered-limit result. Exact-contract GroupBy
+    re-runs without the device epilogue when this returns False;
+    TopNQuerySpec never checks (its contract is approximate, like
+    Druid's topN engine — reference TopNQuerySpec semantics,
+    DruidQuerySpec.scala:767-822).
+
+    Soundness: the device transfers the best ``k_sel`` keys by a
+    possibly-rounded score; every non-transferred key's device score is
+    <= the k_sel-th best ("cutoff"), and its EXACT value can exceed its
+    own device score only by the score-reconstruction error. So the
+    result is exact whenever the LIMIT-th emitted row's exact value
+    clears the cutoff by more than that error bound — excluded keys
+    then cannot rank above (or tie with) any emitted row, which also
+    makes secondary ORDER BY columns moot at the boundary."""
+    metric, k_sel, ascending = topk
+    cutoff = float(scores[-1]) if len(scores) else float("-inf")
+    if cutoff != cutoff:
+        return False                       # NaN scores: cannot reason
+    if cutoff == float("-inf"):
+        # an unoccupied (-inf) slot made the candidate set: every
+        # occupied key was transferred, so the selection is complete
+        return True
+    n = int(limit.limit)
+    if n <= 0:
+        return True
+    vals = data.get(metric)
+    if vals is None:
+        return False
+    vals = np.asarray(vals)
+    if len(vals) < n:
+        # occupied keys were excluded (finite cutoff) yet the LIMIT is
+        # under-subscribed — an excluded key might belong in the result
+        return False
+    v_k = vals[n - 1]
+    if v_k is None or (isinstance(v_k, float) and v_k != v_k):
+        return False      # NULL boundary row: excluded NULLs could tie
+    try:
+        s_k = float(v_k)
+    except (TypeError, ValueError):
+        return False
+    if ascending:
+        s_k = -s_k
+    x64 = bool(jax.config.jax_enable_x64)
+    c_val = -cutoff if ascending else cutoff        # cutoff in VALUE domain
+    vlo = min(s_k if not ascending else -s_k, c_val)
+    vhi = max(s_k if not ascending else -s_k, c_val)
+    if _score_cast_exact(route, x64, vlo, vhi):
+        # scores near the boundary are bit-exact: strictly-better is
+        # always safe, and an exact TIE is safe when the primary metric
+        # is the only order column (excluded tying keys are
+        # interchangeable answers under SQL's unspecified tie order)
+        return s_k > cutoff \
+            or (s_k == cutoff and len(limit.columns) == 1)
+    # Error bound for an excluded key's route_score reconstruction: a
+    # few ulps relative to the magnitudes involved. The split integer
+    # routes (limbs/lanes) renormalize through ~2^48-scale positive
+    # intermediates that cancel for negative values, so near a
+    # non-positive value the ABSOLUTE error is that scale's ulp.
+    base = max(abs(cutoff), abs(s_k), 1.0)
+    if route.tag in ("limbs", "lanes") and vlo <= 0:
+        base = max(base, float(2 ** 50))
+    f32_score = route.tag in ("limbs", "lanes", "ff", "i32", "f32") \
+        or not x64
+    eps = float(np.spacing(np.float32(base))) if f32_score \
+        else float(np.spacing(np.float64(base)))
+    return (s_k - cutoff) > 64.0 * eps
+
+
 def _topk_slack(limit: S.LimitSpec) -> int:
     """Candidate count for a device top-k selection. Secondary order
     columns (e.g. TPC-H q3/q18 'ORDER BY revenue DESC, o_orderdate') only
@@ -2277,8 +2461,10 @@ def _hash_topk_gather(out, routes, topk, T):
     metric, k_sel, ascending = topk
     occ = out["__tkhi__"] != H.EMPTY
     sc = _topk_score(routes[metric], out, T, ascending, occ)
-    _, idx = jax.lax.top_k(sc, k_sel)
-    return _gather_rows(out, idx, T)
+    vals, idx = jax.lax.top_k(sc, k_sel)
+    g = _gather_rows(out, idx, T)
+    g["__topk_score__"] = vals
+    return g
 
 
 def _hash_chip_partials(raw, routes, T, n_dev):
