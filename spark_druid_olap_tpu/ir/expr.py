@@ -93,6 +93,14 @@ def lit(v) -> "Expr":
 @dataclasses.dataclass(frozen=True)
 class Column(Expr):
     name: str
+    # The table-alias qualifier as WRITTEN ('s2.region' -> qual='s2'),
+    # carried as non-comparing metadata for the planner's alias-scoping
+    # pass (planner/scoping.py) — correlated self-references like
+    # 's2.region = s.region' are unresolvable from bare names alone.
+    # Stripped (None) everywhere after that pass; excluded from eq/repr
+    # so resolved trees and cache keys are unaffected.
+    qual: Optional[str] = dataclasses.field(default=None, compare=False,
+                                            repr=False)
 
 
 @dataclasses.dataclass(frozen=True)

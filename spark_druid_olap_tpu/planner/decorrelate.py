@@ -222,16 +222,16 @@ def _cached_inner(ctx, q2, sql_tag):
     on every execution, and without this every warm run re-executed each
     decorrelated inner (ingest bumps store.version, so results can never
     go stale; bounded like the engine-assist cache)."""
-    from spark_druid_olap_tpu.planner.host_exec import result_cache
+    from spark_druid_olap_tpu.planner.host_exec import (result_cache,
+                                                        result_cache_put)
     cache, key = result_cache(ctx, "subquery", q2)
     hit = cache.get(key)
     if hit is not None:
+        cache.move_to_end(key)               # keep hot entries resident
         return hit
     from spark_druid_olap_tpu.sql.session import _run_select
     df = _run_select(ctx, q2, sql=sql_tag).to_pandas()
-    if len(cache) > 64:
-        cache.clear()
-    cache[key] = df
+    result_cache_put(cache, key, df)
     return df
 
 

@@ -13,6 +13,7 @@ aggregates, grouping sets, distinct, order/limit.
 from __future__ import annotations
 
 import dataclasses
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -87,17 +88,33 @@ def datasource_frame(ctx, name: str, columns=None) -> pd.DataFrame:
     return out
 
 
+_RESULT_CACHE_BOUND = 64
+
+
 def result_cache(ctx, kind: str, stmt):
-    """(cache_dict, key) for session-scoped result caches. The key folds
-    in the store version (ingest/drop invalidates) AND the session config
+    """(cache_dict, key) for session-scoped result caches. Each kind
+    ("assist", "subquery") gets its own bounded LRU namespace so the two
+    pathways cannot evict each other's entries. The key folds in the
+    store version (ingest/drop invalidates) AND the session config
     fingerprint (a timezone or precision change must never serve results
-    computed under the old settings). One policy shared by the
-    engine-assist and decorrelated-subquery caches."""
-    cache = getattr(ctx, "_result_cache", None)
+    computed under the old settings)."""
+    caches = getattr(ctx, "_result_cache", None)
+    if caches is None:
+        caches = ctx._result_cache = {}
+    cache = caches.get(kind)
     if cache is None:
-        cache = ctx._result_cache = {}
-    key = (kind, ctx.store.version, ctx.config.fingerprint(), repr(stmt))
+        cache = caches[kind] = OrderedDict()
+    key = (ctx.store.version, ctx.config.fingerprint(), repr(stmt))
     return cache, key
+
+
+def result_cache_put(cache, key, value):
+    """Insert with LRU eviction (oldest-inserted first), keeping the
+    cache at most _RESULT_CACHE_BOUND entries *after* the insert."""
+    cache[key] = value
+    cache.move_to_end(key)
+    while len(cache) > _RESULT_CACHE_BOUND:
+        cache.popitem(last=False)
 
 
 def try_engine(ctx, stmt: A.SelectStmt) -> Optional[pd.DataFrame]:
@@ -115,6 +132,7 @@ def try_engine(ctx, stmt: A.SelectStmt) -> Optional[pd.DataFrame]:
     from spark_druid_olap_tpu.planner.plans import PlanUnsupported
     cache, key = result_cache(ctx, "assist", stmt)
     if key in cache:
+        cache.move_to_end(key)               # keep hot entries resident
         return cache[key]
     try:
         from spark_druid_olap_tpu.planner.decorrelate import \
@@ -130,9 +148,7 @@ def try_engine(ctx, stmt: A.SelectStmt) -> Optional[pd.DataFrame]:
     except (PlanUnsupported, EngineFallback, HostExecError,
             host_eval.HostEvalError, KeyError):
         df = None
-    if len(cache) > 64:
-        cache.clear()
-    cache[key] = df
+    result_cache_put(cache, key, df)
     return df
 
 
@@ -220,6 +236,17 @@ def _free_columns(ctx, stmt) -> set:
     collect(stmt.having)
     for o in stmt.order_by:
         collect(o.expr)
+
+    def collect_join_conds(rel):
+        # Join ON conditions are expressions of THIS scope (a correlated
+        # reference may live there); derived-table bodies declare their
+        # own free columns via relation_columns, not here
+        if isinstance(rel, A.Join):
+            collect(rel.condition)
+            collect_join_conds(rel.left)
+            collect_join_conds(rel.right)
+
+    collect_join_conds(stmt.relation)
     return refs - visible
 
 
@@ -540,31 +567,37 @@ def _execute_sub_decorrelated(ctx, node, env, free, n_rows, outer_env):
     negated = getattr(node, "negated", False)
     if minmax is not None:
         op, _, fname = minmax
-        if df2["__mn"].dtype == object or df2["__mn"].dtype.kind == "M":
-            return None    # non-numeric min/max: row-wise fallback
+        if df2["__mn"].dtype.kind == "M":
+            return None    # datetime min/max: row-wise fallback
         merged = odf.merge(df2, left_on=key_ok_cols, right_on=right_keys,
                            how="left", sort=False) \
             .drop_duplicates("__oidx").sort_values("__oidx")
-        ocv = pd.Series(merged[f"__of_{fname}"].to_numpy())
-        if ocv.dtype == object:
-            ocv = pd.to_numeric(ocv, errors="coerce")
-        mn = pd.Series(merged["__mn"].to_numpy())
-        mx = pd.Series(merged["__mx"].to_numpy())
-        # pandas ordered compares are False on NaN (no group / all-NULL
-        # inner / NULL probe), which is EXISTS' UNKNOWN-drops-row rule;
-        # '<>' needs the explicit notna guard (NaN != x is True)
-        if op == "<":
-            hit = mn < ocv
-        elif op == "<=":
-            hit = mn <= ocv
-        elif op == ">":
-            hit = mx > ocv
-        elif op == ">=":
-            hit = mx >= ocv
-        else:                      # '<>'
-            hit = mn.notna() & ocv.notna() & ((mn != ocv) | (mx != ocv))
-        flags = np.asarray(hit, dtype=bool)
-        return _PrecomputedColumn(flags ^ negated)
+        mn = merged["__mn"].to_numpy()
+        mx = merged["__mx"].to_numpy()
+        ocv = merged[f"__of_{fname}"].to_numpy()
+        str_mode = mn.dtype == object       # lexicographic string min/max
+        if not str_mode and ocv.dtype == object:
+            ocv = pd.to_numeric(pd.Series(ocv), errors="coerce").to_numpy()
+        # ordered compares are UNKNOWN on NULL (no group / all-NULL inner
+        # / NULL probe) — EXISTS' UNKNOWN-drops-row rule; evaluated under
+        # an explicit validity mask so string mode never compares None
+        valid = (pd.Series(mn).notna() & pd.Series(ocv).notna()).to_numpy()
+        hit = np.zeros(len(mn), dtype=bool)
+        try:
+            if op == "<":
+                hit[valid] = mn[valid] < ocv[valid]
+            elif op == "<=":
+                hit[valid] = mn[valid] <= ocv[valid]
+            elif op == ">":
+                hit[valid] = mx[valid] > ocv[valid]
+            elif op == ">=":
+                hit[valid] = mx[valid] >= ocv[valid]
+            else:                  # '<>'
+                hit[valid] = (mn[valid] != ocv[valid]) \
+                    | (mx[valid] != ocv[valid])
+        except TypeError:
+            return None            # mixed-type compare: row-wise fallback
+        return _PrecomputedColumn(hit ^ negated)
     if isinstance(node, A.InSubquery) and not residual_conjs:
         # Fast path (no residual predicates): never materialize the
         # outer x per-key-inner-set cross product. Membership is a
@@ -766,6 +799,13 @@ def materialize_relation(ctx, rel: A.Relation, outer_env: Optional[dict],
             df = left.merge(right, how="cross")
         if residual:
             env = {c: df[c].to_numpy() for c in df.columns}
+            if outer_env:
+                # correlated references inside a JOIN ON condition read
+                # the enclosing row's scalars (broadcast by eval)
+                for k, v in outer_env.items():
+                    if k not in env and not isinstance(v, np.ndarray):
+                        env[k] = np.full(len(df), v, dtype=object) \
+                            if isinstance(v, str) else v
             mask = np.ones(len(df), dtype=bool)
             for c in residual:
                 c2 = resolve_subqueries(ctx, c, env, outer_env)

@@ -1,0 +1,343 @@
+"""Alias-scope resolution for correlated subqueries.
+
+The engine binds columns by GLOBALLY-UNIQUE bare names, mirroring the
+reference's star-schema contract (StarSchemaInfo.scala:127-165 requires
+globally-unique column names; Spark's analyzer then resolves alias
+qualifiers before the rewrite ever sees the plan). The parser therefore
+stores ``s2.region`` as bare ``region`` — which silently mis-scopes a
+correlated SELF-reference: in
+
+    select .. from sales s
+    where qty > (select avg(qty) from sales s2 where s2.region = s.region)
+
+both sides collapse to ``region = region``, the subquery loses its free
+variable, and the "correlation" becomes an always-true inner conjunct
+(the subquery then computes ONE global aggregate — a wrong answer, not
+an error).
+
+This pass runs right after parsing, while :class:`ir.expr.Column` still
+carries the written qualifier as non-comparing metadata. For every
+subquery scope it detects outer-qualified references whose bare name
+collides with a column of the subquery's own relation ("shadowed"), and
+rewrites the scope capture-avoidingly: the inner relation is wrapped in
+a derived table that RENAMES the shadowed columns, every inner-bound
+reference follows the rename, and the outer reference keeps its bare
+name — now genuinely free, so the existing decorrelation machinery
+(planner/decorrelate.py, host_exec._execute_sub_decorrelated) applies
+unchanged. This is exactly the manual workaround TPC-H q21 needed
+before; published q21 text now parses and runs verbatim.
+
+Scopes compose: each level renames only collisions with ITS own
+relation; deeper scopes handle their own when the pass recurses.
+Derived tables and CTE bodies are self-contained scopes (no LATERAL).
+After resolution every qualifier is stripped, so downstream planning,
+caching, and serde see exactly the bare-name trees they always did.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from spark_druid_olap_tpu.ir import expr as E
+from spark_druid_olap_tpu.sql import ast as A
+from spark_druid_olap_tpu.sql.lexer import SqlSyntaxError
+
+_SUBQ = (A.ScalarSubquery, A.InSubquery, A.Exists)
+
+
+def resolve_alias_scopes(ctx, stmt):
+    """Entry point: resolve qualifiers in a parsed statement tree and
+    strip them. Idempotent; the qualifier-free common case returns the
+    SAME object (one cheap walk, no rebuild) — this runs on the hot
+    path of every statement."""
+    if not _has_quals(stmt):
+        return stmt
+    if isinstance(stmt, A.UnionAll):
+        return dataclasses.replace(
+            stmt, parts=tuple(resolve_alias_scopes(ctx, p)
+                              for p in stmt.parts),
+            order_by=tuple(_strip_order(o) for o in stmt.order_by))
+    if not isinstance(stmt, A.SelectStmt):
+        return stmt
+    out = _resolve_scope(ctx, stmt, outer=())
+    return _strip_stmt(out)
+
+
+def _has_quals(stmt) -> bool:
+    if isinstance(stmt, A.UnionAll):
+        return any(_has_quals(p) for p in stmt.parts) \
+            or any(_expr_has_quals(o.expr) for o in stmt.order_by)
+    if not isinstance(stmt, A.SelectStmt):
+        return False
+    for e in _iter_stmt_exprs(stmt):
+        if _expr_has_quals(e):
+            return True
+    rel = stmt.relation
+    stack = [rel]
+    while stack:
+        r = stack.pop()
+        if isinstance(r, A.SubqueryRef) and _has_quals(r.query):
+            return True
+        if isinstance(r, A.Join):
+            stack.extend((r.left, r.right))
+    return False
+
+
+def _expr_has_quals(e) -> bool:
+    for n in E.walk(e):
+        if isinstance(n, E.Column) and n.qual is not None:
+            return True
+        if isinstance(n, _SUBQ) and _has_quals(n.query):
+            return True
+    return False
+
+
+# -- scope walk ---------------------------------------------------------------
+
+def _relation_aliases(rel) -> frozenset:
+    if rel is None:
+        return frozenset()
+    if isinstance(rel, A.TableRef):
+        return frozenset({rel.alias or rel.name, rel.name})
+    if isinstance(rel, A.SubqueryRef):
+        return frozenset({rel.alias})
+    if isinstance(rel, A.Join):
+        return _relation_aliases(rel.left) | _relation_aliases(rel.right)
+    return frozenset()
+
+
+def _try_columns(ctx, rel) -> Optional[frozenset]:
+    from spark_druid_olap_tpu.planner.host_exec import relation_columns
+    try:
+        return frozenset(relation_columns(ctx, rel))
+    except Exception:  # noqa: BLE001 — unknown tables: resolve leniently
+        return None
+
+
+def _map_stmt_exprs(q: A.SelectStmt, f) -> A.SelectStmt:
+    """Rebuild ``q`` with ``f`` applied to every top-level expression."""
+    items = tuple(it if it.expr == "*"
+                  else A.SelectItem(f(it.expr), it.alias) for it in q.items)
+    where = None if q.where is None else f(q.where)
+    having = None if q.having is None else f(q.having)
+    gb = q.group_by
+    if isinstance(gb, A.GroupingSets):
+        gb = A.GroupingSets(tuple(tuple(f(e) for e in s) for s in gb.sets))
+    elif gb is not None:
+        gb = tuple(f(e) for e in gb)
+    ob = tuple(A.OrderItem(f(o.expr), o.ascending) for o in q.order_by)
+    return dataclasses.replace(q, items=items, where=where, group_by=gb,
+                               having=having, order_by=ob)
+
+
+def _map_relation(rel, f_query, f_expr=None):
+    """Rebuild a relation tree: derived-table bodies through ``f_query``,
+    Join ON conditions (expressions of the ENCLOSING scope) through
+    ``f_expr``."""
+    if isinstance(rel, A.SubqueryRef):
+        return A.SubqueryRef(f_query(rel.query), rel.alias)
+    if isinstance(rel, A.Join):
+        cond = rel.condition
+        if cond is not None and f_expr is not None:
+            cond = f_expr(cond)
+        return A.Join(_map_relation(rel.left, f_query, f_expr),
+                      _map_relation(rel.right, f_query, f_expr),
+                      rel.kind, cond)
+    return rel
+
+
+def _iter_relation_conditions(rel):
+    """Join ON conditions in a relation tree (derived-table bodies are
+    separate scopes and are NOT entered)."""
+    if isinstance(rel, A.Join):
+        if rel.condition is not None:
+            yield rel.condition
+        yield from _iter_relation_conditions(rel.left)
+        yield from _iter_relation_conditions(rel.right)
+
+
+def _resolve_scope(ctx, q, outer: Tuple[frozenset, ...]):
+    """Resolve a SELECT scope: derived tables are fresh self-contained
+    scopes; subquery expressions are nested scopes that see this one."""
+    if isinstance(q, A.UnionAll):          # union-bodied derived table/CTE
+        return dataclasses.replace(
+            q, parts=tuple(_resolve_scope(ctx, p, outer)
+                           for p in q.parts))
+    aliases = _relation_aliases(q.relation)
+    inner = outer + (aliases,)
+
+    def fix(e):
+        def fn(n):
+            if isinstance(n, A.ScalarSubquery):
+                return A.ScalarSubquery(_resolve_subscope(ctx, n.query,
+                                                          inner))
+            if isinstance(n, A.Exists):
+                return A.Exists(_resolve_subscope(ctx, n.query, inner),
+                                n.negated)
+            if isinstance(n, A.InSubquery):
+                return A.InSubquery(fix(n.child),
+                                    _resolve_subscope(ctx, n.query, inner),
+                                    n.negated)
+            return n
+        return E.transform(e, fn)
+
+    rel = _map_relation(q.relation,
+                        lambda sub: _resolve_scope(ctx, sub, ()), fix)
+    if rel is not q.relation:
+        q = dataclasses.replace(q, relation=rel)
+    return _map_stmt_exprs(q, fix)
+
+
+def _resolve_subscope(ctx, q, outer: Tuple[frozenset, ...]):
+    """Resolve one correlated-capable subquery scope: rename shadowed
+    self-references, then recurse."""
+    if not isinstance(q, A.SelectStmt):
+        return _resolve_scope(ctx, q, outer)
+    aliases = _relation_aliases(q.relation)
+    outer_names = frozenset().union(*outer) if outer else frozenset()
+    inner_cols = _try_columns(ctx, q.relation)
+    shadowed = _shadowed_names(ctx, q, aliases, inner_cols,
+                               outer_names - aliases)
+    if shadowed:
+        q = _rename_shadowed(ctx, q, aliases, inner_cols, shadowed)
+    return _resolve_scope(ctx, q, outer)
+
+
+def _shadowed_names(ctx, q, aliases, inner_cols, outer_names) -> frozenset:
+    """Bare names referenced with a strictly-outer alias qualifier that
+    collide with this scope's own relation columns."""
+    if not inner_cols or not outer_names:
+        return frozenset()
+    out = set()
+
+    def scan_stmt(q2, nested_aliases):
+        for e in _iter_stmt_exprs(q2):
+            scan_expr(e, nested_aliases)
+
+    def scan_expr(e, nested_aliases):
+        for n in E.walk(e):
+            if isinstance(n, _SUBQ):
+                scan_stmt(n.query, nested_aliases
+                          | _relation_aliases(n.query.relation))
+            elif isinstance(n, E.Column) and n.qual:
+                if n.qual in nested_aliases or n.qual in aliases:
+                    continue
+                if n.qual in outer_names and n.name in inner_cols:
+                    out.add(n.name)
+
+    scan_stmt(q, frozenset())
+    return frozenset(out)
+
+
+def _iter_stmt_exprs(q: A.SelectStmt):
+    for it in q.items:
+        if it.expr != "*":
+            yield it.expr
+    if q.where is not None:
+        yield q.where
+    gb = q.group_by
+    if isinstance(gb, A.GroupingSets):
+        for s in gb.sets:
+            yield from s
+    elif gb is not None:
+        yield from gb
+    if q.having is not None:
+        yield q.having
+    for o in q.order_by:
+        yield o.expr
+    # Join ON conditions belong to THIS scope; derived-table bodies are
+    # separate scopes and are not ours
+    yield from _iter_relation_conditions(q.relation)
+
+
+def _rename_shadowed(ctx, q, aliases, inner_cols, shadowed):
+    """Capture-avoiding rewrite: wrap the inner relation in a derived
+    table renaming the shadowed columns, redirect every inner-bound
+    reference, and leave outer-qualified references bare (now free)."""
+    if not isinstance(q.relation, A.TableRef):
+        raise SqlSyntaxError(
+            f"correlated reference to outer column(s) "
+            f"{sorted(shadowed)} shadowed by the subquery's own FROM "
+            f"(non-simple relation): rename the inner columns via a "
+            f"derived table, e.g. (select c as c2 ... ) x")
+    ren = {c: f"__sc_{c}" for c in sorted(shadowed)}
+    t = q.relation
+    body = A.SelectStmt(
+        items=tuple(A.SelectItem(E.Column(c), ren.get(c, c))
+                    for c in sorted(inner_cols)),
+        relation=A.TableRef(t.name))
+    new_rel = A.SubqueryRef(body, alias=t.alias or t.name)
+
+    def rename_stmt(q2, nested):
+        # nested: ((aliases, cols-or-None), ...) for scopes between the
+        # expression and this one
+        f = lambda e: rename_expr(e, nested)  # noqa: E731
+        rel2 = _map_relation(q2.relation, lambda s: s, f)
+        if rel2 is not q2.relation:
+            q2 = dataclasses.replace(q2, relation=rel2)
+        return _map_stmt_exprs(q2, f)
+
+    def rename_expr(e, nested):
+        def fn(n):
+            if isinstance(n, A.ScalarSubquery):
+                return A.ScalarSubquery(rec(n.query, nested))
+            if isinstance(n, A.Exists):
+                return A.Exists(rec(n.query, nested), n.negated)
+            if isinstance(n, A.InSubquery):
+                return A.InSubquery(rename_expr(n.child, nested),
+                                    rec(n.query, nested), n.negated)
+            if not isinstance(n, E.Column) or n.name not in ren:
+                return n
+            if n.qual:
+                if any(n.qual in na for na, _ in nested):
+                    return n                      # binds a nested scope
+                if n.qual in aliases:
+                    return E.Column(ren[n.name])  # explicit inner ref
+                return n                          # outer/unknown: free
+            # unqualified: binds the nearest enclosing scope holding the
+            # column — a nested scope that has it wins over ours
+            for _, nc in nested:
+                if nc is not None and n.name in nc:
+                    return n
+            return E.Column(ren[n.name])
+        return E.transform(e, fn)
+
+    def rec(q2, nested):
+        na = _relation_aliases(q2.relation)
+        nc = _try_columns(ctx, q2.relation)
+        return rename_stmt(q2, nested + ((na, nc),))
+
+    return dataclasses.replace(rename_stmt(q, ()), relation=new_rel)
+
+
+# -- qualifier strip ----------------------------------------------------------
+
+def _strip_order(o: A.OrderItem) -> A.OrderItem:
+    return A.OrderItem(_strip_expr(o.expr), o.ascending)
+
+
+def _strip_expr(e):
+    def fn(n):
+        if isinstance(n, E.Column) and n.qual is not None:
+            return E.Column(n.name)
+        if isinstance(n, A.ScalarSubquery):
+            return A.ScalarSubquery(_strip_stmt(n.query))
+        if isinstance(n, A.Exists):
+            return A.Exists(_strip_stmt(n.query), n.negated)
+        if isinstance(n, A.InSubquery):
+            return A.InSubquery(_strip_expr(n.child), _strip_stmt(n.query),
+                                n.negated)
+        return n
+    return E.transform(e, fn)
+
+
+def _strip_stmt(q):
+    if isinstance(q, A.UnionAll):
+        return dataclasses.replace(
+            q, parts=tuple(_strip_stmt(p) for p in q.parts),
+            order_by=tuple(_strip_order(o) for o in q.order_by))
+    rel = _map_relation(q.relation, _strip_stmt, _strip_expr)
+    if rel is not q.relation:
+        q = dataclasses.replace(q, relation=rel)
+    return _map_stmt_exprs(q, _strip_expr)

@@ -175,10 +175,12 @@ class Parser:
         return _substitute_ctes(self.parse_select_or_union(), ctes)
 
     def parse_select_or_union(self):
+        first_paren = self.at_op("(")
         q = self.parse_select()
         if not self.at_kw("union"):
             return q
         parts = [q]
+        parens = [first_paren]
         last_paren = False
         while self.eat_kw("union"):
             if not self.eat_kw("all"):
@@ -186,7 +188,16 @@ class Parser:
                     "only UNION ALL is supported (use SELECT DISTINCT "
                     "over a derived union for UNION)")
             last_paren = self.at_op("(")
+            parens.append(last_paren)
             parts.append(self.parse_select())
+        for p, was_paren in zip(parts[:-1], parens[:-1]):
+            # standard SQL binds trailing clauses to the whole union; a
+            # bare non-final branch that consumed its own is ambiguous
+            if not was_paren and (p.order_by or p.limit is not None
+                                  or p.offset):
+                raise SqlSyntaxError(
+                    "ORDER BY/LIMIT/OFFSET on a non-final UNION ALL "
+                    "branch: parenthesize the branch to scope them to it")
         if last_paren:
             # '(select ... limit n)' keeps its own clauses; the union's
             # trailing ORDER BY / LIMIT / OFFSET follow the parens
@@ -631,11 +642,16 @@ class Parser:
         if t.kind == "ident" or (t.kind == "kw" and t.value in
                                  ("query", "metadata", "datasource")):
             name = self.next().value
-            # qualified name: keep only the final part (globally-unique cols)
+            # qualified name: the engine binds by GLOBALLY-UNIQUE bare
+            # column names (≈ StarSchemaInfo.scala:127-165), but the
+            # qualifier is retained as metadata so the alias-scoping
+            # pass can resolve correlated self-references
+            qual = None
             while self.at_op("."):
                 self.next()
                 nxt = self.peek()
                 if nxt.kind in ("ident", "kw"):
+                    qual = name
                     name = self.next().value
                 elif nxt.kind == "op" and nxt.value == "*":
                     self.next()
@@ -644,7 +660,7 @@ class Parser:
                     raise SqlSyntaxError(f"bad qualified name at {nxt.pos}")
             if self.at_op("("):
                 return self.parse_function_call(name)
-            return E.Column(name)
+            return E.Column(name, qual=qual)
         raise SqlSyntaxError(
             f"unexpected token {t.value!r} at {t.pos}")
 

@@ -291,11 +291,16 @@ def _map_null(v):
         return np.ones((), dtype=bool)
     if isinstance(v, float) and math.isnan(v):
         return np.ones((), dtype=bool)
-    return _map1(v, lambda x: x is None or (isinstance(x, float) and math.isnan(x))) \
-        if isinstance(v, np.ndarray) and v.dtype == object \
-        else (np.isnan(v) if isinstance(v, np.ndarray)
-              and np.issubdtype(v.dtype, np.floating) else
-              np.zeros(np.shape(v), dtype=bool))
+    if isinstance(v, np.ndarray):
+        if v.dtype == object:
+            return _map1(v, lambda x: x is None
+                         or (isinstance(x, float) and math.isnan(x)))
+        if np.issubdtype(v.dtype, np.floating):
+            return np.isnan(v)
+        if np.issubdtype(v.dtype, np.datetime64) \
+                or np.issubdtype(v.dtype, np.timedelta64):
+            return np.isnat(v)
+    return np.zeros(np.shape(v), dtype=bool)
 
 
 def eval_pred3(e: E.Expr, env: dict) -> np.ndarray:

@@ -155,8 +155,10 @@ def test_offset_survives_view_merge(sctx):
 
 
 def test_union_parenthesized_branch_keeps_its_limit(sctx):
+    # a NON-final branch carrying its own LIMIT must be parenthesized
+    # (bare form is a syntax error since the ADVICE r2 fix)
     got = sctx.sql(
-        "select qty from sales where qty <= 2 limit 2 union all "
+        "(select qty from sales where qty <= 2 limit 2) union all "
         "(select qty from sales order by qty desc limit 2)").to_pandas()
     assert len(got) == 4
     vals = got["qty"].tolist()
