@@ -70,6 +70,7 @@ I64_MAX = np.int64(2**63 - 1)
 I64_MIN = np.int64(-(2**63))
 N_LIMBS = 4
 N_LANES = 4
+FFL_LANES = 128              # 'ffl' route: per-VPU-lane compensated pairs
 _CHUNK_ROWS = 1 << 14        # scatter-path row chunk: 2^16 * 2^14 < 2^31
 
 
@@ -112,6 +113,12 @@ class Route:
         if self.tag == "ff":
             return [(self.name + ".acc", n_keys, "f32"),
                     (self.name + ".c", n_keys, "f32")]
+        if self.tag == "ffl":
+            # fused-pallas sums: one compensated (acc, c) pair PER VPU
+            # LANE — the 128-lane reduction happens in f64 on host, so
+            # per-lane exactness is all the kernel must guarantee
+            return [(self.name + ".acc", n_keys * FFL_LANES, "f32"),
+                    (self.name + ".c", n_keys * FFL_LANES, "f32")]
         if self.tag == "lanes":
             return [(self.name + ".acc", n_keys * self.n_lanes, "f32"),
                     (self.name + ".c", n_keys * self.n_lanes, "f32")]
@@ -136,7 +143,8 @@ def choose_path(n_keys: int, matmul_max: int) -> str:
 
 
 def plan_route(name: str, kind: str, is_int: bool, maxabs: Optional[float],
-               path: str, blk: int) -> Route:
+               path: str, blk: int,
+               n_rows: Optional[int] = None) -> Route:
     """Decide the numeric route for one aggregation. Static — callable at
     plan time (no traced values)."""
     if kind in ("min", "max"):
@@ -151,6 +159,12 @@ def plan_route(name: str, kind: str, is_int: bool, maxabs: Optional[float],
                      else "f64")
     if path == "scatter":
         if kind == "count" or is_int:
+            if n_rows is not None and maxabs is not None \
+                    and maxabs * n_rows < 2**31:
+                # the WHOLE table's contribution fits i32: one exact
+                # scatter-add pass, no limb splitting/chunk scan (the
+                # q18-class hot path — sum(l_quantity) over 1.5M keys)
+                return Route(name, kind, "i32")
             return Route(name, kind, "limbs")
         return Route(name, kind, "ff", merged=False)
     # matmul path
@@ -165,11 +179,24 @@ def plan_route(name: str, kind: str, is_int: bool, maxabs: Optional[float],
 
 
 def plan_routes(inputs: Sequence[AggInput], n_keys: int,
-                matmul_max: int) -> Dict[str, Route]:
+                matmul_max: int, pallas_max: int = 0,
+                n_rows: Optional[int] = None) -> Dict[str, Route]:
     path = choose_path(n_keys, matmul_max)
     blk = _block_size(n_keys, 1 << 30)
-    return {a.name: plan_route(a.name, a.kind, a.is_int, a.maxabs, path, blk)
-            for a in inputs}
+    use_pallas = False
+    if pallas_max:
+        from spark_druid_olap_tpu.ops import pallas_groupby as PG
+        use_pallas = PG.eligible(n_keys, inputs, pallas_max,
+                                 n_rows=n_rows)
+    out = {}
+    for a in inputs:
+        if use_pallas and a.kind in ("sum", "count"):
+            # the fused kernel's sums travel as per-lane Kahan pairs
+            out[a.name] = Route(a.name, a.kind, "ffl", merged=False)
+        else:
+            out[a.name] = plan_route(a.name, a.kind, a.is_int, a.maxabs,
+                                     path, blk, n_rows=n_rows)
+    return out
 
 
 def fuse_keys(code_arrays: Sequence[object], cards: Sequence[int]):
@@ -216,6 +243,10 @@ def combine_route(route: Route, out: Dict[str, np.ndarray],
         acc = chips(out[route.name + ".acc"]).astype(np.float64)
         c = chips(out[route.name + ".c"]).astype(np.float64)
         return (acc + c).sum(axis=0)
+    if route.tag == "ffl":
+        acc = chips(out[route.name + ".acc"], FFL_LANES).astype(np.float64)
+        c = chips(out[route.name + ".c"], FFL_LANES).astype(np.float64)
+        return (acc + c).sum(axis=0).reshape(n_keys, FFL_LANES).sum(axis=1)
     if route.tag == "lanes":
         ln = route.n_lanes
         acc = chips(out[route.name + ".acc"], ln).astype(np.float64)
@@ -253,8 +284,8 @@ def int_lanes8(v):
 # =============================================================================
 
 def dense_groupby(key, mask, n_keys: int, inputs: List[AggInput],
-                  routes: Dict[str, Route], matmul_max: int = 4096,
-                  pallas_max: int = 0) -> Dict[str, object]:
+                  routes: Dict[str, Route],
+                  matmul_max: int = 4096) -> Dict[str, object]:
     """Aggregate ``inputs`` grouped by dense ``key`` under ``mask``.
 
     key: int32 [S, R] (or any shape); mask: bool same shape (row validity &
@@ -266,82 +297,59 @@ def dense_groupby(key, mask, n_keys: int, inputs: List[AggInput],
     key = jnp.where(mask, key, jnp.int32(n_keys))
     path = choose_path(n_keys, matmul_max)
 
-    if pallas_max:
+    if any(r.tag == "ffl" for r in routes.values()):
+        # plan_routes is the single source of truth for the fused-kernel
+        # decision (it assigns 'ffl' to every sum/count iff eligible);
+        # re-deriving eligibility here from local shapes could disagree
+        # with the planned route set
         from spark_druid_olap_tpu.ops import pallas_groupby as PG
-        n_rows = int(np.prod(key.shape))
-        if PG.supported(n_keys, inputs, pallas_max) and \
-                _pallas_exact_ok(inputs, n_rows):
-            flat = PG.pallas_dense_groupby(key, n_keys, [
-                dataclasses.replace(
-                    a, values=None if a.values is None
-                    else a.values.reshape(-1),
-                    mask=None if a.mask is None else a.mask.reshape(-1))
-                for a in inputs])
-            return _pallas_to_routes(flat, inputs, routes)
+        flat = PG.pallas_dense_groupby(key, n_keys, [
+            dataclasses.replace(
+                a, values=None if a.values is None
+                else a.values.reshape(-1),
+                mask=None if a.mask is None else a.mask.reshape(-1))
+            for a in inputs])
+        return _pallas_to_routes(flat, inputs, routes)
     if path == "scatter":
         return _scatter_groupby(key, mask, n_keys, inputs, routes)
     return _matmul_groupby(key.reshape(-1), mask.reshape(-1), n_keys,
                            inputs, routes)
 
 
-def _pallas_exact_ok(inputs: List[AggInput], n_rows: int) -> bool:
-    """The pallas kernel accumulates per-lane f32 and its epilogue sums the
-    128 lane partials in f32, so the FULL group total must stay exactly
-    representable: bound maxabs * n_rows (not just the per-lane share)."""
-    for a in inputs:
-        if a.kind == "count":
-            if n_rows >= 2**24:
-                return False
-        elif a.kind == "sum":
-            if a.maxabs is None or a.maxabs * n_rows >= 2**24:
-                return False
-        elif a.is_int:
-            if a.maxabs is None or a.maxabs >= 2**24:
-                return False
-    return True
-
-
 def _pallas_to_routes(flat: Dict[str, object], inputs: List[AggInput],
                       routes: Dict[str, Route]) -> Dict[str, object]:
-    """Adapt the pallas kernel's plain-f32 outputs to the route contract
-    (gated exact by _pallas_exact_ok)."""
+    """Adapt the pallas kernel's outputs to the route contract: sums and
+    counts arrive as [K, 128] per-lane Kahan (acc, comp) pairs for the
+    'ffl' route; min/max arrive as reduced [K] f32 (exact under the
+    eligible() gate, so route-dtype conversion is lossless)."""
     out: Dict[str, object] = {}
     for a in inputs:
         r = routes[a.name]
         v = flat[a.name]
-        if r.tag in ("ff", "lanes"):
-            # exact under the gate; present as a (acc, 0) pair. lanes only
-            # plan when maxabs is unknown/huge, which the gate excludes —
-            # but keep the shape contract if it happens.
-            if r.tag == "lanes":
-                z = jnp.zeros((v.shape[0], r.n_lanes - 1), jnp.float32)
-                acc = jnp.concatenate([v[:, None], z], axis=1).reshape(-1)
-            else:
-                acc = v
-            out[r.name + ".acc"] = acc
-            out[r.name + ".c"] = jnp.zeros_like(acc)
-        elif r.tag == "limbs":
-            v64 = v.astype(jnp.float32)
-            l0 = jnp.mod(v64, 2.0**16)
-            l1 = jnp.mod(jnp.floor(v64 / 2.0**16), 2.0**16)
-            l2 = jnp.floor(v64 / 2.0**32)
-            limbs = jnp.stack([l0, l1, l2, jnp.zeros_like(l0)], axis=1)
-            out[r.name + ".limbs"] = limbs.astype(jnp.int32).reshape(-1)
+        if r.tag == "ffl":
+            acc, comp = v                        # [K, 128] each
+            out[r.name + ".acc"] = acc.reshape(-1)
+            out[r.name + ".c"] = comp.reshape(-1)  # Neumaier: acc + comp
         elif r.tag == "i32":
             big = jnp.abs(v) >= F32_MAX
             iv = jnp.clip(v, -2.0**31 + 1, 2.0**31 - 1).astype(jnp.int32)
             sent = I32_MAX if r.kind == "min" else I32_MIN
             out[r.name] = jnp.where(big, jnp.int32(sent), iv)
         elif r.tag == "f64":
-            out[r.name] = v.astype(jnp.float64)
-        elif r.tag == "i64":
             if r.kind in ("min", "max"):
-                big = jnp.abs(v) >= F32_MAX     # empty-group f32 sentinel
-                sent = I64_MAX if r.kind == "min" else I64_MIN
-                out[r.name] = jnp.where(
-                    big, sent, jnp.round(v).astype(jnp.int64))
+                # kernel empty-group sentinel (+-3.4e38) -> the f64
+                # route's +-inf sentinel, or the group would decode as a
+                # huge value instead of NULL
+                big = jnp.abs(v) >= F32_MAX
+                sent = jnp.inf if r.kind == "min" else -jnp.inf
+                out[r.name] = jnp.where(big, sent, v.astype(jnp.float64))
             else:
-                out[r.name] = jnp.round(v).astype(jnp.int64)
+                out[r.name] = v.astype(jnp.float64)
+        elif r.tag == "i64":
+            big = jnp.abs(v) >= F32_MAX          # empty-group f32 sentinel
+            sent = I64_MAX if r.kind == "min" else I64_MIN
+            out[r.name] = jnp.where(
+                big, sent, jnp.round(v).astype(jnp.int64))
         else:
             out[r.name] = v
     return out
@@ -522,6 +530,17 @@ def _scatter_groupby(key, mask, n_keys, inputs, routes):
     def seg2d(a):
         return a.reshape(key.shape)
 
+    def seg_sum(a, am, dtype):
+        """Masked per-segment scatter-add in ``dtype``, summed across
+        segments: the one shared body of the i32/i64/f64 sum routes."""
+        if a.values is None:                 # count: the mask is the value
+            v = am.astype(dtype)
+        else:
+            v = jnp.where(am, seg2d(a.values).astype(dtype),
+                          jnp.zeros((), dtype))
+        per = jax.vmap(lambda x, k: jax.ops.segment_sum(x, k, num))(v, key)
+        return per.sum(axis=0)[:n_keys]
+
     for a in inputs:
         r = routes[a.name]
         am = mask if a.mask is None else (mask & seg2d(a.mask))
@@ -539,23 +558,13 @@ def _scatter_groupby(key, mask, n_keys, inputs, routes):
             out[r.name] = red[:n_keys]
         elif r.tag == "i64":
             # native 64-bit sums: exact at any magnitude (x64 backends only)
-            if a.kind == "count":
-                v = am.astype(jnp.int64)
-            else:
-                v = seg2d(a.values).astype(jnp.int64) \
-                    * am.astype(jnp.int64)
-            per_seg = jax.vmap(lambda x, k: jax.ops.segment_sum(x, k, num))(
-                v, key)
-            out[r.name] = per_seg.sum(axis=0)[:n_keys]
+            out[r.name] = seg_sum(a, am, jnp.int64)
         elif r.tag == "f64":
-            if a.kind == "count":
-                v = am.astype(jnp.float64)
-            else:
-                v = seg2d(a.values).astype(jnp.float64) \
-                    * am.astype(jnp.float64)
-            per_seg = jax.vmap(lambda x, k: jax.ops.segment_sum(x, k, num))(
-                v, key)
-            out[r.name] = per_seg.sum(axis=0)[:n_keys]
+            out[r.name] = seg_sum(a, am, jnp.float64)
+        elif r.tag == "i32" and r.kind in ("sum", "count"):
+            # single-pass exact i32 scatter-add (static bound
+            # maxabs * total_rows < 2^31 — no limb splitting needed)
+            out[r.name] = seg_sum(a, am, jnp.int32)
         elif r.tag == "limbs":
             ones = jnp.ones(key.shape, jnp.int32)
             v = ones if a.kind == "count" else seg2d(a.values) \
@@ -713,7 +722,10 @@ def route_score(route: Route, out: Dict[str, object], n_keys: int,
     if t in ("f64", "i64"):
         return out[route.name].astype(
             jnp.float64 if _x64() else jnp.float32)
-    if t == "ff":
+    if t == "ffl":
+        v = (out[route.name + ".acc"] + out[route.name + ".c"]) \
+            .reshape(n_keys, FFL_LANES).sum(axis=1)
+    elif t == "ff":
         v = out[route.name + ".acc"] + out[route.name + ".c"]
     elif t == "lanes":
         acc = out[route.name + ".acc"].reshape(n_keys, route.n_lanes)

@@ -43,32 +43,88 @@ LANES = 128
 DEFAULT_BLOCK_ROWS = 256          # sublanes per grid step -> 32K rows/step
 
 
-def supported(n_keys: int, inputs, pallas_max: int) -> bool:
-    """Whether the pallas kernel applies: small dense K, plain agg kinds,
+def eligible(n_keys: int, inputs, pallas_max: int,
+             block_rows: int = DEFAULT_BLOCK_ROWS,
+             n_rows=None) -> bool:
+    """Whether the fused kernel applies: small dense K, plain agg kinds,
     TPU backend (or interpret mode forced via SDOT_PALLAS=interpret — CPU
-    differential tests otherwise keep the f64 XLA path)."""
+    differential tests otherwise keep the f64 XLA path), and per-agg
+    exactness:
+
+    - integer sums: each VPU lane accumulates ``block_rows`` values per
+      grid step, so the per-lane block partial is exact f32 iff
+      ``maxabs * block_rows < 2^24``; cross-step Kahan carries and the
+      host's f64 lane reduction keep the total exact at any row count
+      (the same invariant as the XLA 'ff' route's block sums).
+    - float sums: in-block f32 rounding only, like 'ff'.
+    - integer min/max: values must be exact in f32 (compares happen in
+      the f32 domain).
+
+    Static metadata only — callable at route-planning time, and the
+    executor's plan and the kernel dispatch must make the SAME call.
+    """
     env = os.environ.get("SDOT_PALLAS", "")
     if env == "0":
         return False
-    if env != "interpret" and jax.default_backend() != "tpu":
+    if env != "interpret" and not _tpu_backend():
         return False
-    if n_keys > pallas_max:
+    if pallas_max <= 0 or n_keys > pallas_max:
         return False
-    return all(a.kind in ("count", "sum", "min", "max") for a in inputs)
+    for a in inputs:
+        if a.kind not in ("count", "sum", "min", "max"):
+            return False
+        if a.kind == "sum" and a.is_int:
+            if a.maxabs is None or a.maxabs * block_rows >= 2**24:
+                return False
+            # Neumaier comp accumulates integer roundoffs exactly only
+            # while it stays < 2^24: comp <= steps * ulp(acc)/2 with
+            # acc <= maxabs*n_rows/128 and steps = n_rows/(block*128)
+            # gives the conservative growth bound maxabs * n_rows^2 <
+            # 2^70 (TPC-H SF100 counts/qty sums sit near 2^64)
+            if n_rows is not None \
+                    and a.maxabs * float(n_rows) * float(n_rows) >= 2**70:
+                return False
+        if a.kind in ("min", "max") and a.is_int:
+            if a.maxabs is None or a.maxabs >= 2**24:
+                return False
+    return True
+
+
+def _tpu_backend() -> bool:
+    """TPU-class backend: the stock 'tpu' platform OR the tunneled 'axon'
+    plugin (whose platform name is not 'tpu' but whose devices compile
+    Mosaic kernels all the same). Checked via the device platform so a
+    rename of the plugin doesn't silently disable the fused kernel."""
+    if jax.default_backend() in ("tpu", "axon"):
+        return True
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # noqa: BLE001 — uninitialized backend
+        return False
 
 
 def _interpret() -> bool:
     if os.environ.get("SDOT_PALLAS", "") == "interpret":
         return True
-    return jax.default_backend() != "tpu"
+    return not _tpu_backend()
 
 
 _INIT = {"count": 0.0, "sum": 0.0, "min": 3.4e38, "max": -3.4e38}
 
 
+def _row_offsets(specs):
+    """Per-agg row offset inside each key's output stripe. Sums/counts
+    take TWO rows (Kahan acc + comp); min/max one."""
+    offs, rpk = [], 0
+    for kind, _, _ in specs:
+        offs.append(rpk)
+        rpk += 2 if kind in ("count", "sum") else 1
+    return offs, rpk
+
+
 def _make_kernel(n_keys: int, specs, n_in: int):
     """specs: list of (kind, value_ref_idx or None, mask_ref_idx or None)."""
-    m_aggs = len(specs)
+    offs, rpk = _row_offsets(specs)
     # python-float literals only: pallas kernels may not close over jnp
     # constants
     fmax = 3.4e38
@@ -82,15 +138,19 @@ def _make_kernel(n_keys: int, specs, n_in: int):
             for m, (kind, _, _) in enumerate(specs):
                 fill = jnp.float32(_INIT[kind])
                 for k in range(n_keys):
-                    out_ref[k * m_aggs + m, :] = jnp.full(
-                        (LANES,), fill, dtype=jnp.float32)
+                    row = k * rpk + offs[m]
+                    out_ref[row, :] = jnp.full((LANES,), fill,
+                                               dtype=jnp.float32)
+                    if kind in ("count", "sum"):
+                        out_ref[row + 1, :] = jnp.zeros((LANES,),
+                                                        dtype=jnp.float32)
 
         kb = key_ref[:]                                   # [B, 128] int32
         for k in range(n_keys):
             mk = kb == k
             for m, (kind, vi, mi) in enumerate(specs):
                 eff = mk if mi is None else (mk & (refs[mi][:] != 0))
-                row = k * m_aggs + m
+                row = k * rpk + offs[m]
                 if kind == "count":
                     part = jnp.sum(eff.astype(jnp.float32), axis=0)
                 elif kind == "sum":
@@ -104,7 +164,20 @@ def _make_kernel(n_keys: int, specs, n_in: int):
                         jnp.where(eff, refs[vi][:], -fmax), axis=0)
                 cur = out_ref[row, :]
                 if kind in ("count", "sum"):
-                    out_ref[row, :] = cur + part
+                    # per-lane NEUMAIER accumulation across grid steps:
+                    # 2Sum's branch captures the EXACT roundoff of
+                    # cur + part regardless of relative magnitudes
+                    # (plain Kahan's 'part - comp' can itself round once
+                    # the accumulator is large); integer roundoffs are
+                    # integers, so comp accumulates exactly within the
+                    # eligible() growth bound. True total = acc + comp.
+                    comp = out_ref[row + 1, :]
+                    t = cur + part
+                    big = jnp.abs(cur) >= jnp.abs(part)
+                    err = jnp.where(big, (cur - t) + part,
+                                    (part - t) + cur)
+                    out_ref[row + 1, :] = comp + err
+                    out_ref[row, :] = t
                 elif kind == "min":
                     out_ref[row, :] = jnp.minimum(cur, part)
                 else:
@@ -119,8 +192,10 @@ def pallas_dense_groupby(key, n_keys: int, inputs: List,
 
     key: int32 [N] with filtered-out rows already set to the sentinel
     ``n_keys``; inputs: list of ``groupby.AggInput`` with flat [N] values /
-    masks. Returns dict name -> [n_keys] f32 array (same contract as the
-    XLA paths in :mod:`groupby`).
+    masks. Returns dict name -> value per agg: sums/counts yield an
+    ``([K, 128] acc, [K, 128] comp)`` per-lane Kahan pair (the 'ffl'
+    route — host reduces lanes in f64); min/max yield a reduced
+    ``[n_keys]`` f32 array.
     """
     key = key.reshape(-1).astype(jnp.int32)
     n = key.shape[0]
@@ -148,30 +223,30 @@ def pallas_dense_groupby(key, n_keys: int, inputs: List,
         specs.append((a.kind, vi, mi))
 
     n_in = len(operands)
-    m_aggs = len(specs)
+    offs, rpk = _row_offsets(specs)
     grid = (n_pad // tile,)
     blk = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0))
-    out_blk = pl.BlockSpec((n_keys * m_aggs, LANES), lambda i: (0, 0))
+    out_blk = pl.BlockSpec((n_keys * rpk, LANES), lambda i: (0, 0))
 
     out = pl.pallas_call(
         _make_kernel(n_keys, specs, n_in),
         grid=grid,
         in_specs=[blk] * (1 + n_in),
         out_specs=out_blk,
-        out_shape=jax.ShapeDtypeStruct((n_keys * m_aggs, LANES),
+        out_shape=jax.ShapeDtypeStruct((n_keys * rpk, LANES),
                                        jnp.float32),
         interpret=_interpret(),
     )(key2, *operands)
 
-    # tiny XLA epilogue: reduce the 128 lane-partials per (key, agg)
-    out3 = out.reshape(n_keys, m_aggs, LANES)
+    # sums/counts leave as per-lane (acc, comp) pairs (host combines in
+    # f64); min/max reduce their 128 lanes here (order-free, exact)
+    out3 = out.reshape(n_keys, rpk, LANES)
     result = {}
-    for m, (a, (kind, _, _)) in enumerate(zip(inputs, specs)):
-        col = out3[:, m, :]
+    for a, (kind, _, _), off in zip(inputs, specs, offs):
         if kind in ("count", "sum"):
-            result[a.name] = jnp.sum(col, axis=-1)
+            result[a.name] = (out3[:, off, :], out3[:, off + 1, :])
         elif kind == "min":
-            result[a.name] = jnp.min(col, axis=-1)
+            result[a.name] = jnp.min(out3[:, off, :], axis=-1)
         else:
-            result[a.name] = jnp.max(col, axis=-1)
+            result[a.name] = jnp.max(out3[:, off, :], axis=-1)
     return result

@@ -57,6 +57,7 @@ from spark_druid_olap_tpu.utils import host_eval
 from spark_druid_olap_tpu.utils.config import (
     Config,
     TZ_ID,
+    BACKEND_RETRY_SECONDS,
     GROUPBY_DENSE_MAX_KEYS,
     GROUPBY_HASH_COMPACT_MIN,
     GROUPBY_HASH_MAX_SLOTS,
@@ -676,6 +677,12 @@ class QueryEngine:
         # thread-local so concurrent sessions don't trample each other
         self._compile_lock = __import__("threading").RLock()
         self._tls = __import__("threading").local()
+        # device-loss state (≈ the reference's ZK-watch topology
+        # invalidation, CuratorConnection.scala:77-136): when the backend
+        # dies mid-session, statements demote to the host tier and a
+        # bounded re-attach probe runs at most once per cooldown window
+        self._backend_lost_at: Optional[float] = None
+        self._backend_retry_at: float = 0.0
 
     @property
     def last_stats(self) -> Dict[str, object]:
@@ -740,15 +747,57 @@ class QueryEngine:
             # holder releases
             self.register_query(qid)
         try:
+            if self._backend_lost_at is not None \
+                    and not self._try_reattach():
+                self.last_stats["backend_lost"] = True
+                raise EngineFallback(
+                    "backend_lost (device unreachable; host tier serving)")
             return self._execute_inner(q, t0)
         except EC.Unsupported as e:
             # expression/filter compilation is lazy (trace time), so an
             # unsupported node can surface only here — demote it to the
             # fallback signal the session layer handles
             raise EngineFallback(str(e)) from e
+        except Exception as e:  # noqa: BLE001 — classify device loss
+            if _is_backend_loss(e):
+                self._mark_backend_lost()
+                raise EngineFallback(
+                    f"backend_lost ({type(e).__name__}: "
+                    f"{str(e)[:120]})") from e
+            raise
         finally:
             if qid is not None:
                 self.release_query(qid)
+
+    def _mark_backend_lost(self):
+        """Invalidate everything referencing dead device buffers; the
+        host tier serves until a re-attach probe succeeds."""
+        with self._compile_lock:
+            self._backend_lost_at = _time.time()
+            self._backend_retry_at = self._backend_lost_at \
+                + float(self.config.get(BACKEND_RETRY_SECONDS))
+            self._programs.clear()
+            self._device_arrays.clear()
+        self.last_stats["backend_lost"] = True
+
+    def _try_reattach(self) -> bool:
+        """At most one bounded device probe per cooldown window. The probe
+        runs in a daemon thread with a hard deadline — a dispatch to a
+        dead tunnel can hang, and an in-process hang would otherwise take
+        the session down with it."""
+        now = _time.time()
+        with self._compile_lock:
+            if now < self._backend_retry_at:
+                return False
+            # claim this window under the lock so concurrent statements
+            # don't pile probes onto a dead backend
+            self._backend_retry_at = now \
+                + float(self.config.get(BACKEND_RETRY_SECONDS))
+        if _probe_device_alive():
+            with self._compile_lock:
+                self._backend_lost_at = None
+            return True
+        return False
 
     def _execute_inner(self, q: S.QuerySpec, t0: float) -> QueryResult:
         self._stage_check(q, t0)
@@ -1095,7 +1144,8 @@ class QueryEngine:
                        and T >= self.config.get(GROUPBY_HASH_COMPACT_MIN))
             k_out = topk[1] if topk else T
             routes = G.plan_routes(
-                metas, T, self.config.get(GROUPBY_MATMUL_MAX_KEYS))
+                metas, T, self.config.get(GROUPBY_MATMUL_MAX_KEYS),
+                n_rows=int(ds.padded_rows) * int(ds.num_segments))
             sig = ("hashagg", ds.name, id(ds), repr(q), s_pad,
                    ds.padded_rows, min_day, max_day, sharded, n_dev, T,
                    tuple(names), topk, compact, self.config.get(TZ_ID),
@@ -1286,8 +1336,7 @@ class QueryEngine:
                                          p.build_values(ctx),
                                          p.build_mask(ctx),
                                          is_int=p.is_int, maxabs=p.maxabs))
-            out = G.dense_groupby(slot, base, T, inputs, routes, matmul_max,
-                                  pallas_max=0)
+            out = G.dense_groupby(slot, base, T, inputs, routes, matmul_max)
             out["__tkhi__"] = tk_hi
             out["__tklo__"] = tk_lo
             out["__unres__"] = unresolved.reshape(1)
@@ -1605,18 +1654,20 @@ class QueryEngine:
         if time_in_play:
             needed.add(ds.time.name)
         names = array_names(ds, sorted(needed), time_in_play)
-        routes = self._plan_routes(agg_plans, n_keys)
+        routes = self._plan_routes(agg_plans, n_keys, ds)
         return dim_plans, agg_plans, min_day, max_day, n_keys, names, routes
 
-    def _plan_routes(self, agg_plans, n_keys):
+    def _plan_routes(self, agg_plans, n_keys, ds):
         """Static numeric routes for the dense (non-HLL) aggregations plus
         the '__rows__' group-occupancy count."""
         metas = [G.AggInput(p.spec.name, p.kind, is_int=p.is_int,
                             maxabs=p.maxabs)
                  for p in agg_plans if p.kind not in ("hll", "theta")]
         metas.append(G.AggInput("__rows__", "count", is_int=True, maxabs=1.0))
-        return G.plan_routes(metas, n_keys,
-                             self.config.get(GROUPBY_MATMUL_MAX_KEYS))
+        return G.plan_routes(
+            metas, n_keys, self.config.get(GROUPBY_MATMUL_MAX_KEYS),
+            pallas_max=self.config.get(GROUPBY_PALLAS_MAX_KEYS),
+            n_rows=int(ds.padded_rows) * int(ds.num_segments))
 
     def build_core(self, q: S.QuerySpec):
         """Build the *unjitted* scan-aggregate program for an agg query plus
@@ -1648,7 +1699,6 @@ class QueryEngine:
     def _make_core(self, ds, dim_plans, agg_plans, filter_spec,
                    intervals, min_day, max_day, n_keys, routes):
         matmul_max = self.config.get(GROUPBY_MATMUL_MAX_KEYS)
-        pallas_max = self.config.get(GROUPBY_PALLAS_MAX_KEYS)
         log2m = self.config.get(HLL_LOG2M)
         hll_plans = [p for p in agg_plans if p.kind == "hll"]
         theta_plans = [p for p in agg_plans if p.kind == "theta"]
@@ -1679,7 +1729,7 @@ class QueryEngine:
             inputs.append(G.AggInput("__rows__", "count", is_int=True,
                                      maxabs=1.0))
             out = G.dense_groupby(key, base, n_keys, inputs, routes,
-                                  matmul_max, pallas_max=pallas_max)
+                                  matmul_max)
             for p in hll_plans:
                 vals = p.build_values(ctx)
                 am = p.build_mask(ctx)
@@ -2224,6 +2274,41 @@ class QueryEngine:
         self._device_arrays.clear()
 
 
+_LOST_MARKERS = ("unavailable", "deadline_exceeded", "deadline exceeded",
+                 "connection", "socket", "transport", "unreachable",
+                 "device or resource busy", "premature end")
+
+
+def _is_backend_loss(e: BaseException) -> bool:
+    """Heuristic classification of a permanently-dead device backend
+    (tunneled-TPU failure mode: transfers/dispatches raise UNAVAILABLE /
+    connection errors after _device_put_retry exhausts its backoff)."""
+    if isinstance(e, EngineFallback) \
+            or not isinstance(e, (RuntimeError, OSError)):
+        return False
+    s = str(e).lower()
+    return any(m in s for m in _LOST_MARKERS)
+
+
+def _probe_device_alive(timeout_s: float = 10.0) -> bool:
+    """Whether the default backend answers a trivial dispatch within the
+    deadline, probed from a daemon thread (a hung dispatch must never
+    hang the session)."""
+    result = []
+
+    def work():
+        try:
+            r = jax.device_put(np.arange(8, dtype=np.int32))
+            result.append(int(jnp.sum(r)) == 28)
+        except Exception:  # noqa: BLE001
+            result.append(False)
+
+    th = __import__("threading").Thread(target=work, daemon=True)
+    th.start()
+    th.join(timeout_s)
+    return bool(result and result[0])
+
+
 def _device_put_retry(host, sharding=None):
     """device_put with backoff on transient backend errors — the tunneled
     TPU's transfers can hiccup with UNAVAILABLE (≈ the reference wrapping
@@ -2301,6 +2386,11 @@ def _encode_buf(a, dt: str, x64: bool):
         if dt == "f64":
             return jax.lax.bitcast_convert_type(
                 a.astype(jnp.float64), jnp.int64)
+        if dt == "f32":
+            # ffl pairs are f32 even on x64 backends: bitcast into the
+            # low lane (astype would TRUNCATE the fraction)
+            return jax.lax.bitcast_convert_type(
+                a.astype(jnp.float32), jnp.int32).astype(jnp.int64)
         return a.astype(jnp.int64)
     if dt == "f32":
         return jax.lax.bitcast_convert_type(a.astype(jnp.float32), jnp.int32)
@@ -2312,7 +2402,9 @@ def _decode_buf(chunk: np.ndarray, dt: str, x64: bool) -> np.ndarray:
     bitcast view)."""
     if x64 and dt == "f64":
         return chunk.view(np.float64)
-    if not x64 and dt == "f32":
+    if dt == "f32":
+        if x64:
+            return chunk.astype(np.int32).view(np.float32)
         return chunk.view(np.float32)
     return chunk
 
@@ -2435,8 +2527,8 @@ def _topk_selection_exact(limit, topk, route, scores, data) -> bool:
     base = max(abs(cutoff), abs(s_k), 1.0)
     if route.tag in ("limbs", "lanes") and vlo <= 0:
         base = max(base, float(2 ** 50))
-    f32_score = route.tag in ("limbs", "lanes", "ff", "i32", "f32") \
-        or not x64
+    f32_score = route.tag in ("limbs", "lanes", "ff", "ffl", "i32",
+                              "f32") or not x64
     eps = float(np.spacing(np.float32(base))) if f32_score \
         else float(np.spacing(np.float64(base)))
     return (s_k - cutoff) > 64.0 * eps

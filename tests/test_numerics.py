@@ -344,3 +344,21 @@ def test_wide_long_min_with_empty_groups_stays_exact():
         assert got.loc[1, "mn"] is None           # empty group -> null
     finally:
         jax.config.update("jax_enable_x64", prev)
+
+
+def test_i32_scatter_sum_route_planned(no_x64):
+    """Small-magnitude integer sums on the scatter path take the
+    single-pass i32 scatter-add (maxabs * total_rows < 2^31) instead of
+    the chunked limb scan; wide values keep limbs."""
+    from spark_druid_olap_tpu.ops.groupby import AggInput, plan_routes
+    metas = [AggInput("small", "sum", is_int=True, maxabs=100.0),
+             AggInput("wide", "sum", is_int=True, maxabs=float(2 ** 30)),
+             AggInput("n", "count", is_int=True, maxabs=1.0)]
+    routes = plan_routes(metas, 1 << 20, matmul_max=4096,
+                         n_rows=6_100_000)
+    assert routes["small"].tag == "i32"
+    assert routes["n"].tag == "i32"
+    assert routes["wide"].tag == "limbs"
+    # without a row bound the exact-by-construction limb path stays
+    routes2 = plan_routes(metas, 1 << 20, matmul_max=4096)
+    assert routes2["small"].tag == "limbs"

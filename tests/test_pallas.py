@@ -45,9 +45,8 @@ def _aggs(v, am):
 
 
 def _run(key, mask, n_keys, inputs, pallas_max):
-    routes = plan_routes(inputs, n_keys, 4096)
-    out = dense_groupby(key, mask, n_keys, inputs, routes, 4096,
-                        pallas_max=pallas_max)
+    routes = plan_routes(inputs, n_keys, 4096, pallas_max=pallas_max)
+    out = dense_groupby(key, mask, n_keys, inputs, routes, 4096)
     return {a.name: np.asarray(combine_route(routes[a.name],
                                              {k: np.asarray(x)
                                               for k, x in out.items()},
@@ -89,9 +88,76 @@ def test_pallas_all_rows_masked_out():
     assert np.all(got["s"] == 0)
 
 
+def test_pallas_int_sums_exact_past_2_24():
+    """The Kahan-lane ('ffl') accumulation keeps integer sums EXACT when
+    the group total far exceeds 2^24 — the gate that previously kept the
+    fused kernel off every real benchmark query (q1 sums ~3e8)."""
+    rng = np.random.default_rng(7)
+    n = 300_000
+    key = jnp.asarray(rng.integers(0, 3, n, dtype=np.int32))
+    mask = jnp.asarray(np.ones(n, dtype=bool))
+    vals = rng.integers(0, 1000, n, dtype=np.int64)
+    inputs = [AggInput("s", "sum", values=jnp.asarray(vals,
+                                                      dtype=jnp.int32),
+                       is_int=True, maxabs=1000.0),
+              AggInput("__rows__", "count", is_int=True, maxabs=1.0)]
+    got = _run(key, mask, 3, inputs, pallas_max=64)
+    want = np.zeros(3, dtype=np.int64)
+    np.add.at(want, np.asarray(key), vals)
+    assert want.max() > 2 ** 24          # the regime the old gate refused
+    np.testing.assert_array_equal(
+        np.rint(got["s"]).astype(np.int64), want)
+    np.testing.assert_array_equal(
+        np.rint(got["__rows__"]).astype(np.int64),
+        np.bincount(np.asarray(key), minlength=3))
+
+
+def test_pallas_engine_end_to_end():
+    """Full session path under the fused kernel (interpret): a q1-shaped
+    group-by must match pandas exactly (int sums) / tightly (float sums),
+    single-chip and on the 8-device mesh."""
+    import pandas as pd
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+    rng = np.random.default_rng(3)
+    n = 120_000
+    df = pd.DataFrame({
+        "ts": (np.datetime64("2020-01-01")
+               + rng.integers(0, 300, n).astype("timedelta64[D]"))
+        .astype("datetime64[ns]"),
+        "flag": rng.choice(["A", "N", "R"], n),
+        "status": rng.choice(["O", "F"], n),
+        "qty": rng.integers(1, 51, n).astype(np.int64),
+        "price": np.round(rng.uniform(1, 1000, n), 2),
+    })
+    want = df.groupby(["flag", "status"]).agg(
+        sq=("qty", "sum"), sp=("price", "sum"), n=("qty", "size"),
+        mnq=("qty", "min"), mxq=("qty", "max")).reset_index() \
+        .sort_values(["flag", "status"]).reset_index(drop=True)
+    sql = ("select flag, status, sum(qty) as sq, sum(price) as sp, "
+           "count(*) as n, min(qty) as mnq, max(qty) as mxq "
+           "from t group by flag, status order by flag, status")
+    for mesh in (None, make_mesh()):
+        ctx = sdot.Context({"sdot.querycostmodel.enabled": False},
+                           mesh=mesh)
+        ctx.ingest_dataframe("t", df, time_column="ts", target_rows=16384)
+        got = ctx.sql(sql).to_pandas()
+        assert ctx.history.entries()[-1].stats["mode"] == "engine"
+        np.testing.assert_array_equal(got["sq"].to_numpy(),
+                                      want["sq"].to_numpy())
+        np.testing.assert_array_equal(got["n"].to_numpy(),
+                                      want["n"].to_numpy())
+        np.testing.assert_array_equal(got["mnq"].to_numpy(),
+                                      want["mnq"].to_numpy())
+        np.testing.assert_array_equal(got["mxq"].to_numpy(),
+                                      want["mxq"].to_numpy())
+        np.testing.assert_allclose(got["sp"].to_numpy(),
+                                   want["sp"].to_numpy(), rtol=1e-6)
+
+
 def test_pallas_respects_backend_gate(monkeypatch):
     # without the interpret override, CPU backend must not take the
     # pallas path (keeps f64 differential accuracy)
     monkeypatch.delenv("SDOT_PALLAS", raising=False)
     from spark_druid_olap_tpu.ops import pallas_groupby as PG
-    assert not PG.supported(4, [AggInput("c", "count")], 64)
+    assert not PG.eligible(4, [AggInput("c", "count")], 64)
