@@ -23,6 +23,7 @@ from spark_druid_olap_tpu.utils.config import (
     COST_PER_BYTE_TRANSPORT,
     COST_PER_ROW_MERGE,
     COST_PER_ROW_SCAN,
+    COST_SHARD_EFFICIENCY,
 )
 
 
@@ -187,11 +188,14 @@ def estimate(ctx_or_engine, q: S.QuerySpec) -> CostEstimate:
     compile_c = conf.get(COST_COMPILE)
 
     n_dev = mesh_size(engine.mesh)
+    eff = max(1e-3, min(1.0, float(conf.get(COST_SHARD_EFFICIENCY))))
     # single chip: scan everything + decode output
     single = rows * scan_c + groups * byte_c * 16
-    # sharded: scan split across devices + ICI merge of [K] partials per agg
+    # sharded: scan split across devices (at the CALIBRATED parallel
+    # efficiency — a virtual mesh on shared cores splits nothing) + ICI
+    # merge of [K] partials per agg
     n_aggs = max(1, len(S.query_aggregations(q)))
-    sharded = (rows / max(n_dev, 1)) * scan_c \
+    sharded = (rows / max(n_dev * eff, 1e-9)) * scan_c \
         + groups * n_aggs * merge_c \
         + groups * byte_c * 16 \
         + compile_c * 0.1  # sharded programs compile slower

@@ -118,10 +118,72 @@ def build_composite(ctx, stmt: A.SelectStmt,
 
     new_rel = walk(stmt.relation)
     if not subs:
-        raise PlanUnsupported("no derived table to plan through the engine")
+        # Dim-only FROM whose WHERE still engages the fact table through
+        # subqueries (TPC-H q20: supplier x suppnation filtered by an IN
+        # chain whose correlated scalar scans lineitem): route every
+        # base-table scan through an engine Select so ALL data access
+        # stays on the engine path — the host joins the dim-scale
+        # results and resolves the subqueries (their fact scans run
+        # engine-assisted). ≈ the reference's DruidQuery-scans-under-
+        # Spark-join shape with dim relations as scans.
+        if not _subqueries_touch_fact(ctx, stmt, banned):
+            raise PlanUnsupported(
+                "no derived table to plan through the engine")
+        new_rel = _tables_to_engine_selects(ctx, stmt.relation, subs,
+                                            execute)
     return CompositePlan(sub_plans=subs,
                          outer_stmt=dataclasses.replace(stmt,
                                                         relation=new_rel))
+
+
+def _subqueries_touch_fact(ctx, stmt: A.SelectStmt, banned: set) -> bool:
+    """Whether any subquery under the statement references a fact-scale
+    table (directly or in ITS nested subqueries/relations)."""
+    from spark_druid_olap_tpu.planner.host_exec import _subquery_nodes
+
+    def rel_tables(rel, out):
+        if isinstance(rel, A.TableRef):
+            out.add(rel.name)
+        elif isinstance(rel, A.SubqueryRef):
+            stmt_tables(rel.query, out)
+        elif isinstance(rel, A.Join):
+            rel_tables(rel.left, out)
+            rel_tables(rel.right, out)
+
+    def stmt_tables(q, out):
+        parts = q.parts if isinstance(q, A.UnionAll) else (q,)
+        for p in parts:
+            if p.relation is not None:
+                rel_tables(p.relation, out)
+            for e in (p.where, p.having):
+                if e is not None:
+                    for n in _subquery_nodes(e):
+                        stmt_tables(n.query, out)
+
+    names: set = set()
+    for e in (stmt.where, stmt.having):
+        if e is not None:
+            for n in _subquery_nodes(e):
+                stmt_tables(n.query, names)
+    return bool(names & banned)
+
+
+def _tables_to_engine_selects(ctx, rel, subs, execute: bool):
+    """Replace each base TableRef with an engine full-table Select plan
+    registered as a temp frame (aliases preserved for the host join)."""
+    if isinstance(rel, A.TableRef):
+        sub = _build_sub(ctx, A.SelectStmt(
+            items=(A.SelectItem("*"),),
+            relation=A.TableRef(rel.name)), execute)
+        name = f"__dim{len(subs)}"
+        subs.append((name, sub))
+        return A.TableRef(name, alias=rel.alias or rel.name)
+    if isinstance(rel, A.Join):
+        return dataclasses.replace(
+            rel,
+            left=_tables_to_engine_selects(ctx, rel.left, subs, execute),
+            right=_tables_to_engine_selects(ctx, rel.right, subs, execute))
+    raise PlanUnsupported(f"relation {type(rel).__name__}")
 
 
 def _build_leftjoin_agg(ctx, stmt: A.SelectStmt) -> LeftJoinAggPlan:

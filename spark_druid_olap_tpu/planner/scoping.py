@@ -99,7 +99,9 @@ def _relation_aliases(rel) -> frozenset:
     if rel is None:
         return frozenset()
     if isinstance(rel, A.TableRef):
-        return frozenset({rel.alias or rel.name, rel.name})
+        # an alias HIDES the table name (SQL scoping): 'from sales s2'
+        # makes 'sales.region' an OUTER reference inside a subquery
+        return frozenset({rel.alias or rel.name})
     if isinstance(rel, A.SubqueryRef):
         return frozenset({rel.alias})
     if isinstance(rel, A.Join):
@@ -251,6 +253,46 @@ def _iter_stmt_exprs(q: A.SelectStmt):
     yield from _iter_relation_conditions(q.relation)
 
 
+def _referenced_names(q) -> set:
+    """Every column name mentioned anywhere in a statement, including
+    nested subquery scopes (an over-approximation is safe: it only
+    widens the pruned derived table)."""
+    out = set()
+
+    star = [False]
+
+    def scan_stmt(q2, root=False):
+        # SQL '*' never binds an OUTER scope: only the ROOT scope's own
+        # star expands the relation being renamed; deeper scopes' stars
+        # expand THEIR relations and are irrelevant here
+        if root and any(it.expr == "*" for it in q2.items):
+            star[0] = True
+        for e in _iter_stmt_exprs(q2):
+            scan_expr(e, root)
+        rel = q2.relation
+        stack = [rel]
+        while stack:
+            r = stack.pop()
+            if isinstance(r, A.SubqueryRef):
+                scan_stmt(r.query)
+            elif isinstance(r, A.Join):
+                stack.extend((r.left, r.right))
+
+    def scan_expr(e, root):
+        for n in E.walk(e):
+            if isinstance(n, E.Column):
+                if n.name == "*":
+                    if root:
+                        star[0] = True
+                else:
+                    out.add(n.name)
+            elif isinstance(n, _SUBQ):
+                scan_stmt(n.query)
+
+    scan_stmt(q, root=True)
+    return None if star[0] else out
+
+
 def _rename_shadowed(ctx, q, aliases, inner_cols, shadowed):
     """Capture-avoiding rewrite: wrap the inner relation in a derived
     table renaming the shadowed columns, redirect every inner-bound
@@ -263,9 +305,20 @@ def _rename_shadowed(ctx, q, aliases, inner_cols, shadowed):
             f"derived table, e.g. (select c as c2 ... ) x")
     ren = {c: f"__sc_{c}" for c in sorted(shadowed)}
     t = q.relation
+    # prune: expose only the inner columns the subquery actually
+    # references (plus every shadowed one) — materializing the full
+    # table width per correlated execution is the q21 hot path
+    refs = _referenced_names(q)
+    if refs is None:
+        # SELECT * inside the scope would re-expose renamed columns
+        raise SqlSyntaxError(
+            f"correlated reference to outer column(s) {sorted(shadowed)} "
+            f"shadowed by the subquery's own FROM cannot combine with "
+            f"SELECT *: list the needed columns explicitly")
+    used = (refs & inner_cols) | shadowed
     body = A.SelectStmt(
         items=tuple(A.SelectItem(E.Column(c), ren.get(c, c))
-                    for c in sorted(inner_cols)),
+                    for c in sorted(used)),
         relation=A.TableRef(t.name))
     new_rel = A.SubqueryRef(body, alias=t.alias or t.name)
 

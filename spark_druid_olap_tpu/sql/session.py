@@ -274,7 +274,11 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
             # reference's DruidQuery-scans-under-Spark-join shape)
             from spark_druid_olap_tpu.planner import composite
             try:
-                cp = composite.build_composite(ctx, stmt2)
+                # build from the PRE-inline statement: the inlining
+                # passes execute subqueries away, and the composite
+                # planner needs to SEE them (its dim-only-FROM gate) and
+                # plan derived tables through its own chain
+                cp = composite.build_composite(ctx, stmt)
                 df = composite.execute_composite(ctx, cp)
                 mode = "engine"
             except (PlanUnsupported, EngineFallback,

@@ -115,6 +115,13 @@ COST_COMPILE = _entry(
     "sdot.querycostmodel.compile.cost", 0.05,
     "Fixed abstract cost charged per distinct compiled program (XLA "
     "compilation amortization; no reference analog — TPU-specific).", float)
+COST_SHARD_EFFICIENCY = _entry(
+    "sdot.querycostmodel.shard.efficiency", 1.0,
+    "Calibrated parallel efficiency of the mesh's scan split in (0, 1]: "
+    "1.0 = N chips scan N-fold faster (real ICI-connected TPUs); a "
+    "virtual mesh over shared host cores measures far lower and the "
+    "single-vs-sharded decision must reflect that. Fit by "
+    "tools/calibrate.py from measured wall times.", float)
 # --- engine knobs (TPU-specific; no reference analog) -------------------------
 SEGMENT_ROWS = _entry(
     "sdot.segment.target.rows", 1 << 20,
@@ -150,6 +157,14 @@ HAVING_DEVICE_MIN_KEYS = _entry(
     "passing groups transfer (two dispatches: finals+mask count, then "
     "gather). Below it the full [K] result transfers and the host "
     "filters.")
+BACKEND_RETRY_SECONDS = _entry(
+    "sdot.engine.backend.retry.seconds", 30.0,
+    "Cooldown between re-attach probes after the device backend is lost "
+    "mid-session (e.g. the TPU tunnel dies): statements keep being served "
+    "by the host tier, and at most one probe per cooldown window checks "
+    "whether the device answers again (≈ the reference's ZK-watch cache "
+    "invalidation re-planning against live servers, "
+    "CuratorConnection.scala:77-136).", float)
 TOPN_DEVICE_MIN_KEYS = _entry(
     "sdot.engine.topn.device.min.keys", 8192,
     "Min fused key cardinality before an ordered-limit group-by / topN "

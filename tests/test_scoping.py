@@ -131,6 +131,21 @@ def test_published_tpch_q21_text():
                                   want.reset_index(drop=True))
 
 
+def test_table_name_hidden_by_inner_alias(ctx):
+    """'from sales s2' HIDES the name 'sales' inside the subquery, so
+    'sales.region' binds the OUTER scope (code-review r3 finding: it was
+    silently bound to the aliased inner table, losing the correlation)."""
+    df = ctx._test_df
+    got = ctx.sql(
+        "select region, count(*) as n from sales "
+        "where qty > (select avg(qty) from sales s2 "
+        "             where s2.region = sales.region) "
+        "group by region order by region").to_pandas()
+    m = df.groupby("region")["qty"].mean()
+    want = df[df.qty > df.region.map(m)].groupby("region").size()
+    assert got["n"].tolist() == want.tolist()
+
+
 def test_inner_alias_shadows_outer(ctx):
     """Same alias reused inside the subquery: the inner binding wins
     (standard SQL scoping) — no rename, correlation stays inner-only."""
