@@ -446,6 +446,7 @@ def main():
 
     wall_lat, adj_lat = {}, {}
     gbps = {}
+    cold_total_s = 0.0
     n_engine = 0
     host_queries = []
     suite_t0 = time.perf_counter()
@@ -465,6 +466,7 @@ def main():
             log(f"{name}: FAILED ({type(e).__name__}: {e})")
             wall_lat[name] = adj_lat[name] = float("nan")
             continue
+        cold_total_s += cold
         mode = ctx.history.entries()[-1].stats.get("mode", "?")
         n_engine += mode == "engine"
         if mode != "engine":
@@ -542,6 +544,10 @@ def main():
         "n_failed": n_fail,
         "rows": n_rows,
         "numerics": numerics,
+        # compile-diet regression surface (VERDICT r2 #10): total cold
+        # (first-execution, compile-inclusive) seconds across the suite;
+        # the persistent XLA cache makes repeat runs near-warm
+        "cold_total_s": round(cold_total_s, 1),
     }
     if gbps:
         try:

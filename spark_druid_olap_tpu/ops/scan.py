@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, Optional
 
+import jax.numpy as jnp
 import numpy as np
 
 from spark_druid_olap_tpu.segment.column import ColumnKind
@@ -39,7 +40,13 @@ class ScanContext:
             raise KeyError(
                 f"column {name!r} not bound into this scan program "
                 f"(bound: {sorted(self.arrays)})")
-        return self.arrays[name]
+        arr = self.arrays[name]
+        dt = getattr(arr, "dtype", None)
+        if dt is not None and dt.kind == "i" and dt.itemsize < 4:
+            # narrow storage (i8/i16 codes and small longs) widens on
+            # read: HBM holds the narrow bytes, kernels see i32
+            arr = arr.astype(jnp.int32)
+        return arr
 
     def row_valid(self):
         return self.arrays[ROW_VALID_KEY]

@@ -46,6 +46,23 @@ from spark_druid_olap_tpu.sql.lexer import SqlSyntaxError
 _SUBQ = (A.ScalarSubquery, A.InSubquery, A.Exists)
 
 
+def _rebuild_subqueries(e, on_query):
+    """E.transform over ``e`` rebuilding each subquery node with
+    ``on_query`` applied to its statement (InSubquery children recurse
+    with the same rewriter) — the shared traversal of the strip and
+    database-resolution passes."""
+    def fn(n):
+        if isinstance(n, A.ScalarSubquery):
+            return A.ScalarSubquery(on_query(n.query))
+        if isinstance(n, A.Exists):
+            return A.Exists(on_query(n.query), n.negated)
+        if isinstance(n, A.InSubquery):
+            return A.InSubquery(_rebuild_subqueries(n.child, on_query),
+                                on_query(n.query), n.negated)
+        return n
+    return E.transform(e, fn)
+
+
 def resolve_alias_scopes(ctx, stmt):
     """Entry point: resolve qualifiers in a parsed statement tree and
     strip them. Idempotent; the qualifier-free common case returns the
@@ -175,7 +192,10 @@ def _resolve_scope(ctx, q, outer: Tuple[frozenset, ...]):
                 return A.ScalarSubquery(_resolve_subscope(ctx, n.query,
                                                           inner))
             if isinstance(n, A.Exists):
-                return A.Exists(_resolve_subscope(ctx, n.query, inner),
+                # EXISTS ignores its select list, so 'select *' in its
+                # body is compatible with the shadow rename
+                return A.Exists(_resolve_subscope(ctx, n.query, inner,
+                                                  allow_star=True),
                                 n.negated)
             if isinstance(n, A.InSubquery):
                 return A.InSubquery(fix(n.child),
@@ -191,7 +211,8 @@ def _resolve_scope(ctx, q, outer: Tuple[frozenset, ...]):
     return _map_stmt_exprs(q, fix)
 
 
-def _resolve_subscope(ctx, q, outer: Tuple[frozenset, ...]):
+def _resolve_subscope(ctx, q, outer: Tuple[frozenset, ...],
+                      allow_star: bool = False):
     """Resolve one correlated-capable subquery scope: rename shadowed
     self-references, then recurse."""
     if not isinstance(q, A.SelectStmt):
@@ -202,7 +223,8 @@ def _resolve_subscope(ctx, q, outer: Tuple[frozenset, ...]):
     shadowed = _shadowed_names(ctx, q, aliases, inner_cols,
                                outer_names - aliases)
     if shadowed:
-        q = _rename_shadowed(ctx, q, aliases, inner_cols, shadowed)
+        q = _rename_shadowed(ctx, q, aliases, inner_cols, shadowed,
+                             allow_star=allow_star)
     return _resolve_scope(ctx, q, outer)
 
 
@@ -262,6 +284,10 @@ def _referenced_names(q) -> set:
     star = [False]
 
     def scan_stmt(q2, root=False):
+        if isinstance(q2, A.UnionAll):       # union-bodied derived table
+            for p in q2.parts:
+                scan_stmt(p, root)
+            return
         # SQL '*' never binds an OUTER scope: only the ROOT scope's own
         # star expands the relation being renamed; deeper scopes' stars
         # expand THEIR relations and are irrelevant here
@@ -293,7 +319,8 @@ def _referenced_names(q) -> set:
     return None if star[0] else out
 
 
-def _rename_shadowed(ctx, q, aliases, inner_cols, shadowed):
+def _rename_shadowed(ctx, q, aliases, inner_cols, shadowed,
+                     allow_star: bool = False):
     """Capture-avoiding rewrite: wrap the inner relation in a derived
     table renaming the shadowed columns, redirect every inner-bound
     reference, and leave outer-qualified references bare (now free)."""
@@ -310,12 +337,19 @@ def _rename_shadowed(ctx, q, aliases, inner_cols, shadowed):
     # table width per correlated execution is the q21 hot path
     refs = _referenced_names(q)
     if refs is None:
-        # SELECT * inside the scope would re-expose renamed columns
-        raise SqlSyntaxError(
-            f"correlated reference to outer column(s) {sorted(shadowed)} "
-            f"shadowed by the subquery's own FROM cannot combine with "
-            f"SELECT *: list the needed columns explicitly")
-    used = (refs & inner_cols) | shadowed
+        if not allow_star:
+            # SELECT * in a value-producing scope would re-expose
+            # renamed columns
+            raise SqlSyntaxError(
+                f"correlated reference to outer column(s) "
+                f"{sorted(shadowed)} shadowed by the subquery's own FROM "
+                f"cannot combine with SELECT *: list the needed columns "
+                f"explicitly")
+        # EXISTS body: its select list is semantically irrelevant —
+        # expose every inner column (shadowed ones renamed)
+        used = frozenset(inner_cols)
+    else:
+        used = (refs & inner_cols) | shadowed
     body = A.SelectStmt(
         items=tuple(A.SelectItem(E.Column(c), ren.get(c, c))
                     for c in sorted(used)),
@@ -364,6 +398,48 @@ def _rename_shadowed(ctx, q, aliases, inner_cols, shadowed):
     return dataclasses.replace(rename_stmt(q, ()), relation=new_rel)
 
 
+# -- database-namespace resolution --------------------------------------------
+
+def resolve_databases(ctx, stmt):
+    """Rewrite unqualified table names to '<default_db>.<name>' when only
+    the qualified form is registered (reference: multi-DB operation,
+    MultiDBTest.scala — Hive database resolution ahead of the rewrite).
+    Explicit 'db.table' names pass through; registered bare names win."""
+    from spark_druid_olap_tpu.utils.config import DATABASE_DEFAULT
+    db = ctx.config.get(DATABASE_DEFAULT)
+    if not db:
+        return stmt
+    known = set(ctx.store.names())
+
+    def fix_rel(rel):
+        if isinstance(rel, A.TableRef):
+            if rel.name not in known and f"{db}.{rel.name}" in known:
+                return A.TableRef(f"{db}.{rel.name}",
+                                  rel.alias or rel.name)
+            return rel
+        if isinstance(rel, A.SubqueryRef):
+            return A.SubqueryRef(fix_stmt(rel.query), rel.alias)
+        if isinstance(rel, A.Join):
+            return A.Join(fix_rel(rel.left), fix_rel(rel.right),
+                          rel.kind, rel.condition)
+        return rel
+
+    def fix_expr(e):
+        return _rebuild_subqueries(e, fix_stmt)
+
+    def fix_stmt(q):
+        if isinstance(q, A.UnionAll):
+            return dataclasses.replace(
+                q, parts=tuple(fix_stmt(p) for p in q.parts))
+        if not isinstance(q, A.SelectStmt):
+            return q
+        if q.relation is not None:
+            q = dataclasses.replace(q, relation=fix_rel(q.relation))
+        return _map_stmt_exprs(q, fix_expr)
+
+    return fix_stmt(stmt)
+
+
 # -- qualifier strip ----------------------------------------------------------
 
 def _strip_order(o: A.OrderItem) -> A.OrderItem:
@@ -374,15 +450,8 @@ def _strip_expr(e):
     def fn(n):
         if isinstance(n, E.Column) and n.qual is not None:
             return E.Column(n.name)
-        if isinstance(n, A.ScalarSubquery):
-            return A.ScalarSubquery(_strip_stmt(n.query))
-        if isinstance(n, A.Exists):
-            return A.Exists(_strip_stmt(n.query), n.negated)
-        if isinstance(n, A.InSubquery):
-            return A.InSubquery(_strip_expr(n.child), _strip_stmt(n.query),
-                                n.negated)
         return n
-    return E.transform(e, fn)
+    return E.transform(_rebuild_subqueries(e, _strip_stmt), fn)
 
 
 def _strip_stmt(q):

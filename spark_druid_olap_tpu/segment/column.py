@@ -160,6 +160,8 @@ def build_dim_column(name: str, raw: np.ndarray,
         fast = native.encode_strings(raw)
         if fast is not None:
             d, codes = fast
+            codes = codes.astype(
+                narrow_int_dtype(0, max(len(d) - 1, 0)), copy=False)
             return DimColumn(name=name, dictionary=d, codes=codes,
                              validity=None)
     raw = np.asarray(raw, dtype=object)
@@ -173,12 +175,25 @@ def build_dim_column(name: str, raw: np.ndarray,
     safe = safe.astype(str)
     if dictionary is None:
         dictionary = np.unique(safe[validity] if has_null else safe)
+    cdt = narrow_int_dtype(0, max(len(dictionary) - 1, 0))
     codes = np.searchsorted(dictionary, safe)
-    codes = np.clip(codes, 0, max(len(dictionary) - 1, 0)).astype(np.int32)
+    codes = np.clip(codes, 0, max(len(dictionary) - 1, 0)).astype(cdt)
     if has_null:
-        codes = np.where(validity, codes, 0).astype(np.int32)
+        codes = np.where(validity, codes, 0).astype(cdt)
     return DimColumn(name=name, dictionary=np.asarray(dictionary, dtype=object),
                      codes=codes, validity=validity if has_null else None)
+
+
+def narrow_int_dtype(lo: int, hi: int) -> np.dtype:
+    """Smallest signed integer dtype holding [lo, hi]. Storage (host RSS,
+    HBM residency, transfer) is bandwidth-bound; narrow columns read
+    upcast to i32 inside the scan programs (ScanContext.col), so compute
+    kernels never see sub-32-bit values."""
+    for dt in (np.int8, np.int16, np.int32):
+        ii = np.iinfo(dt)
+        if lo >= ii.min and hi <= ii.max:
+            return np.dtype(dt)
+    return np.dtype(np.int64)
 
 
 def build_metric_column(name: str, raw: np.ndarray, kind: ColumnKind) -> MetricColumn:
@@ -196,11 +211,14 @@ def build_metric_column(name: str, raw: np.ndarray, kind: ColumnKind) -> MetricC
     else:
         # wide longs keep int64 host-side rather than silently wrapping
         # (Druid LONG is a 64-bit type); 32-bit device backends route
-        # queries over them to the host tier
+        # queries over them to the host tier. In-range longs store at
+        # the narrowest width their min/max allows.
         i64 = raw.astype(np.int64)
         ii = np.iinfo(np.int32)
         wide = len(i64) > 0 and (i64.min() < ii.min or i64.max() > ii.max)
-        dtype = np.int64 if wide else np.int32
+        dtype = np.int64 if wide else (
+            narrow_int_dtype(int(i64.min()), int(i64.max()))
+            if len(i64) else np.dtype(np.int32))
     values = raw.astype(dtype)
     has_null = validity is not None and not validity.all()
     return MetricColumn(name=name, values=values,

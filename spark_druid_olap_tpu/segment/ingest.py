@@ -62,6 +62,7 @@ def ingest_dataframe(
     target_rows: int = 1 << 20,
     metric_kinds: Optional[Dict[str, ColumnKind]] = None,
     spatial_dims: Optional[Dict[str, Iterable[str]]] = None,
+    drop_columns: Optional[Iterable[str]] = None,
 ) -> Datasource:
     """Ingest a DataFrame as a datasource.
 
@@ -127,14 +128,19 @@ def ingest_dataframe(
             return col, build_dim_column(col, series)
         if kind == ColumnKind.DATE:
             ms = _to_epoch_millis(series)
-            days = np.floor_divide(ms, 86_400_000).astype(np.int32)
-            from spark_druid_olap_tpu.segment.column import MetricColumn
-            return col, MetricColumn(name=col, values=days, validity=None,
-                                     kind=ColumnKind.DATE)
+            days = np.floor_divide(ms, 86_400_000)
+            from spark_druid_olap_tpu.segment.column import (
+                MetricColumn, narrow_int_dtype)
+            ddt = narrow_int_dtype(int(days.min()), int(days.max())) \
+                if len(days) else np.dtype(np.int32)
+            return col, MetricColumn(name=col, values=days.astype(ddt),
+                                     validity=None, kind=ColumnKind.DATE)
         return col, build_metric_column(col, series.to_numpy(), kind)
 
+    drop = set(drop_columns or ())
     columns = [c for c in df.columns
-               if not (time_column is not None and c == time_column)]
+               if c not in drop
+               and not (time_column is not None and c == time_column)]
     # the native encoder releases the GIL, so columns encode in parallel
     from spark_druid_olap_tpu.segment import native as _native
     if _native.load() is not None and len(columns) > 1:

@@ -170,6 +170,7 @@ def ingest_parquet_stream(
     ii = np.iinfo(np.int32)
 
     def metric_dtype(c):
+        from spark_druid_olap_tpu.segment.column import narrow_int_dtype
         k = kinds[c]
         if k == ColumnKind.DOUBLE:
             return np.float32
@@ -177,7 +178,7 @@ def ingest_parquet_stream(
             return np.int32
         lo, hi = int_min.get(c, 0), int_max.get(c, 0)
         wide = lo < ii.min or hi > ii.max
-        return np.int64 if wide else np.int32
+        return np.int64 if wide else narrow_int_dtype(lo, hi)
 
     out: Dict[str, np.ndarray] = {}
     validity: Dict[str, np.ndarray] = {}
@@ -188,8 +189,10 @@ def ingest_parquet_stream(
             out["__ms__"] = np.zeros(n_total, np.int32)
             continue
         if kinds[c] == ColumnKind.DIM:
+            from spark_druid_olap_tpu.segment.column import narrow_int_dtype
             dicts[c] = uniques.get(c, np.array([], dtype=object))
-            out[c] = np.zeros(n_total, np.int32)
+            out[c] = np.zeros(n_total, narrow_int_dtype(
+                0, max(len(dicts[c]) - 1, 0)))
         else:
             out[c] = np.zeros(n_total, metric_dtype(c))
         if has_null[c]:

@@ -385,6 +385,12 @@ class Parser:
             self.expect_op(")")
             return rel
         name = self._ident()
+        # schema-qualified datasource: 'db.table' (reference works across
+        # non-default Hive databases, MultiDBTest.scala; here databases
+        # are dotted namespaces in one store)
+        while self.at_op("."):
+            self.next()
+            name = f"{name}.{self._ident()}"
         alias = None
         if self.eat_kw("as"):
             alias = self._ident()

@@ -152,7 +152,9 @@ def explain_text(ctx, stmt: A.SelectStmt, sql: str) -> str:
             lines.append("  " + sub.replace("\n", "\n  "))
         return "\n".join(lines)
     lines = [f"SQL: {sql.strip()}"]
-    from spark_druid_olap_tpu.planner.scoping import resolve_alias_scopes
+    from spark_druid_olap_tpu.planner.scoping import (resolve_alias_scopes,
+                                                      resolve_databases)
+    stmt = resolve_databases(ctx, stmt)
     stmt = resolve_alias_scopes(ctx, stmt)
     stmt = resolve_lookups(ctx, stmt)
     try:
@@ -248,7 +250,9 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
         stmt = _dc.replace(stmt, offset=0,
                            limit=None if stmt.limit is None
                            else stmt.limit + offset)
-    from spark_druid_olap_tpu.planner.scoping import resolve_alias_scopes
+    from spark_druid_olap_tpu.planner.scoping import (resolve_alias_scopes,
+                                                      resolve_databases)
+    stmt = resolve_databases(ctx, stmt)
     stmt = resolve_alias_scopes(ctx, stmt)
     stmt = resolve_lookups(ctx, stmt)
     trace = _transform_tracer(ctx)

@@ -108,8 +108,16 @@ def fit(samples: List[dict], n_dev: int) -> Dict[str, float]:
            COST_PER_BYTE_TRANSPORT.key: byte_c,
            COST_COMPILE.key: 0.0}
     if any("sharded_s" in s for s in samples) and n_dev > 1:
-        t8 = np.array([s.get("sharded_s", np.nan) for s in samples])
+        # only timings where the mesh engine REALLY sharded inform the
+        # sharded-side terms (a cost-model single-chip run would fit
+        # eff ~= 1/n_dev and a noise merge_c)
+        t8 = np.array([s["sharded_s"]
+                       if s.get("sharded_really", True)
+                       and "sharded_s" in s else np.nan
+                       for s in samples])
         ok = ~np.isnan(t8)
+        if not ok.any():
+            return out
         a8 = np.stack([rows[ok], grp[ok] * naggs[ok]], axis=1)
         resid = t8[ok] - grp[ok] * 16.0 * byte_c
         (alpha, merge_c), *_ = np.linalg.lstsq(a8, resid, rcond=None)
@@ -131,7 +139,18 @@ def calibrate(ctx, datasource: Optional[str] = None, reps: int = 3,
     shapes = default_shapes(datasource, ds)
     mesh_engine = mesh_ctx.engine if mesh_ctx is not None else None
     n_dev = mesh_size(mesh_engine.mesh) if mesh_engine is not None else 1
-    samples = measure_samples(ctx.engine, mesh_engine, shapes, reps)
+    from spark_druid_olap_tpu.utils.config import COST_MODEL_ENABLED
+    prev_cm = None
+    if mesh_ctx is not None:
+        # the sharded probes must REALLY shard, whatever the current
+        # (uncalibrated) model would decide
+        prev_cm = mesh_ctx.config.get(COST_MODEL_ENABLED)
+        mesh_ctx.config.set(COST_MODEL_ENABLED.key, False)
+    try:
+        samples = measure_samples(ctx.engine, mesh_engine, shapes, reps)
+    finally:
+        if mesh_ctx is not None:
+            mesh_ctx.config.set(COST_MODEL_ENABLED.key, prev_cm)
     fitted = fit(samples, n_dev)
     if apply:
         for k, v in fitted.items():

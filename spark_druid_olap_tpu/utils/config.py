@@ -157,6 +157,13 @@ HAVING_DEVICE_MIN_KEYS = _entry(
     "passing groups transfer (two dispatches: finals+mask count, then "
     "gather). Below it the full [K] result transfers and the host "
     "filters.")
+DATABASE_DEFAULT = _entry(
+    "sdot.database.default", "",
+    "Default database namespace: an unqualified table name that is not "
+    "registered resolves to '<default>.<name>' when that is (reference: "
+    "multi-database operation across non-default Hive DBs, "
+    "MultiDBTest.scala). Databases are dotted name prefixes in the one "
+    "store; 'db.table' in FROM always addresses explicitly.")
 BACKEND_RETRY_SECONDS = _entry(
     "sdot.engine.backend.retry.seconds", 30.0,
     "Cooldown between re-attach probes after the device backend is lost "

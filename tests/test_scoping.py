@@ -185,3 +185,31 @@ def test_shadowed_nonsimple_from_raises(ctx):
             "select count(*) as n from sales s where qty > "
             "(select avg(s2.qty) from sales s2 join sales s3 "
             " on s2.cust = s3.cust where s2.region = s.region)")
+
+
+def test_exists_select_star_with_shadowing(ctx):
+    """Official TPC-H q21 phrasing uses 'exists (select * ...)': EXISTS
+    ignores its select list, so the shadow rename must accept it."""
+    df = ctx._test_df
+    got = ctx.sql(
+        "select count(*) as n from sales where exists "
+        "(select * from sales s2 where s2.region = sales.region "
+        " and s2.qty > 90)").to_pandas()
+    hot = set(df[df.qty > 90].region)
+    want = int(df.region.isin(hot).sum())
+    assert int(got["n"].iloc[0]) == want
+
+
+def test_union_derived_inside_shadowed_subquery(ctx):
+    """A union-bodied derived table nested in a shadow-renamed scope must
+    not crash the reference scan."""
+    df = ctx._test_df
+    got = ctx.sql(
+        "select count(*) as n from sales where qty > "
+        "(select avg(qty) from sales s2 where s2.region = sales.region "
+        " and exists (select 1 from (select qty as q2 from sales "
+        "             union all select qty as q2 from sales) u "
+        "             where u.q2 = s2.qty))").to_pandas()
+    m = df.groupby("region")["qty"].mean()
+    want = int((df.qty > df.region.map(m)).sum())  # exists always true
+    assert int(got["n"].iloc[0]) == want

@@ -88,3 +88,44 @@ def test_session_segment_target_rows_config():
     ds2 = c2.ingest_dataframe("s", make_sales_df(10_000), time_column="ts",
                               target_rows=1 << 20)
     assert ds2.num_segments == 1
+
+
+def test_narrow_dtype_storage():
+    """Dictionary codes and in-range LONGs store at the narrowest signed
+    int their cardinality/min-max allows (SF100 budget, docs/SF100.md);
+    compute reads widen to i32 so results stay exact."""
+    import numpy as np
+    import pandas as pd
+    import spark_druid_olap_tpu as sdot
+    rng = np.random.default_rng(4)
+    n = 30_000
+    df = pd.DataFrame({
+        "ts": np.repeat(np.datetime64("2021-01-01"), n)
+        .astype("datetime64[ns]"),
+        "tiny": rng.choice(["a", "b", "c"], n),              # card 3 -> i8
+        "mid": rng.choice([f"m{i:04d}" for i in range(900)], n),  # i16
+        "small_int": rng.integers(0, 100, n).astype(np.int64),   # i8
+        "mid_int": rng.integers(-30_000, 30_000, n),             # i16
+        "wide_int": rng.integers(0, 2**40, n),                   # i64
+    })
+    ctx = sdot.Context()
+    ctx.ingest_dataframe("t", df, time_column="ts")
+    ds = ctx.store.get("t")
+    from spark_druid_olap_tpu.segment.column import narrow_int_dtype
+    assert ds.dims["tiny"].codes.dtype == np.int8
+    assert ds.dims["mid"].codes.dtype == np.int16
+    assert narrow_int_dtype(0, 40_000) == np.int32       # past i16
+    assert narrow_int_dtype(-2**40, 2**40) == np.int64
+    assert ds.metrics["small_int"].values.dtype == np.int8
+    assert ds.metrics["mid_int"].values.dtype == np.int16
+    assert ds.metrics["wide_int"].values.dtype == np.int64
+    got = ctx.sql("select tiny, sum(small_int) as s, min(mid_int) as mn, "
+                  "count(*) as n from t group by tiny order by tiny") \
+        .to_pandas()
+    assert ctx.history.entries()[-1].stats["mode"] == "engine"
+    want = df.groupby("tiny").agg(s=("small_int", "sum"),
+                                  mn=("mid_int", "min"),
+                                  n=("tiny", "size")).reset_index()
+    assert got["s"].tolist() == want["s"].tolist()
+    assert got["mn"].tolist() == want["mn"].tolist()
+    assert got["n"].tolist() == want["n"].tolist()
