@@ -420,8 +420,10 @@ def resolve_databases(ctx, stmt):
         if isinstance(rel, A.SubqueryRef):
             return A.SubqueryRef(fix_stmt(rel.query), rel.alias)
         if isinstance(rel, A.Join):
+            cond = None if rel.condition is None \
+                else fix_expr(rel.condition)   # ON may hold subqueries
             return A.Join(fix_rel(rel.left), fix_rel(rel.right),
-                          rel.kind, rel.condition)
+                          rel.kind, cond)
         return rel
 
     def fix_expr(e):

@@ -215,10 +215,11 @@ def build_metric_column(name: str, raw: np.ndarray, kind: ColumnKind) -> MetricC
         # the narrowest width their min/max allows.
         i64 = raw.astype(np.int64)
         ii = np.iinfo(np.int32)
-        wide = len(i64) > 0 and (i64.min() < ii.min or i64.max() > ii.max)
+        lo, hi = (int(i64.min()), int(i64.max())) if len(i64) else (0, 0)
+        wide = len(i64) > 0 and (lo < ii.min or hi > ii.max)
         dtype = np.int64 if wide else (
-            narrow_int_dtype(int(i64.min()), int(i64.max()))
-            if len(i64) else np.dtype(np.int32))
+            narrow_int_dtype(lo, hi) if len(i64)
+            else np.dtype(np.int32))
     values = raw.astype(dtype)
     has_null = validity is not None and not validity.all()
     return MetricColumn(name=name, values=values,

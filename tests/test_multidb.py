@@ -69,3 +69,16 @@ def test_default_db_in_subqueries_and_joins(ctx):
         "select count(*) as n from sales s where qty > "
         "(select avg(qty) from staging.sales)").to_pandas()
     assert int(got["n"].iloc[0]) > 0
+
+
+def test_default_db_in_join_on_subquery(ctx):
+    aux = pd.DataFrame({"aregion": ["east", "west"], "aval": [1, 2]})
+    ctx.ingest_dataframe("mart.aux", aux)
+    ctx.config.set("sdot.database.default", "mart")
+    got = ctx.sql(
+        "select count(*) as n from mart.sales s join mart.aux b "
+        "on s.region = b.aregion and s.qty in "
+        "(select qty from sales where qty > 95)").to_pandas()
+    want = ctx._len_hiqty = int(
+        (_df(["east", "west"]).qty > 95).sum())
+    assert int(got["n"].iloc[0]) == want   # resolves; no KeyError
