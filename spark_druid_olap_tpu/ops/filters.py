@@ -170,8 +170,9 @@ def _time_bound(f: S.BoundFilter, ctx):
 def _in(f: S.InFilter, ctx):
     kind = ctx.kind(f.dimension)
     if isinstance(f.values, E.FrozenIntSet):
-        # semi-join-scale membership: binary search against the sorted
-        # constant array (log n gathers) instead of an O(n) equality chain
+        # semi-join-scale membership: dense spans hit a packed-bitmap
+        # gather, wide spans binary-search the sorted constant (shared
+        # lowering, EC.int_set_membership)
         if kind not in (ColumnKind.LONG, ColumnKind.DATE):
             raise EC.Unsupported("large integer IN set over non-integer")
         vals = f.values.array
@@ -181,10 +182,8 @@ def _in(f: S.InFilter, ctx):
         if arr.dtype != jnp.int64 and (
                 int(vals[0]) < -(2**31) or int(vals[-1]) >= 2**31):
             raise EC.Unsupported("IN-set values exceed 32-bit column range")
-        dev = jnp.asarray(vals.astype(
-            np.int64 if arr.dtype == jnp.int64 else np.int32))
-        idx = jnp.clip(jnp.searchsorted(dev, arr), 0, len(vals) - 1)
-        return _nullsafe(dev[idx] == arr, f.dimension, ctx)
+        return _nullsafe(EC.int_set_membership(arr, vals),
+                         f.dimension, ctx)
     if kind == ColumnKind.DIM:
         mask = np.isin(ctx.dictionary(f.dimension).astype(str),
                        np.array([str(v) for v in f.values]))

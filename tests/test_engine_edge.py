@@ -101,3 +101,52 @@ def test_device_cache_reused(nullable_engine):
     n1 = len(eng._device_arrays)
     eng.execute(q)
     assert len(eng._device_arrays) == n1  # no re-upload entries
+
+
+def test_bitmap_membership_matches_searchsorted(monkeypatch):
+    """Dense-span FrozenIntSet filters lower to a packed-bitmap gather;
+    wide-span sets keep the binary search — both must agree with numpy
+    membership, and the dense query must ACTUALLY take the bitmap path
+    (the shared lowering serves both the filter and expression tiers)."""
+    import numpy as np
+    import pandas as pd
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.ops import expr_compile as EC
+    spans = []
+    orig = EC.int_set_membership
+
+    def spy(arr, vals):
+        spans.append(int(vals[-1]) - int(vals[0]) + 1)
+        return orig(arr, vals)
+
+    monkeypatch.setattr(EC, "int_set_membership", spy)
+    rng = np.random.default_rng(12)
+    n = 50_000
+    keys = rng.integers(0, 3_000_000, n)
+    df = pd.DataFrame({
+        "ts": np.repeat(np.datetime64("2021-01-01"), n)
+        .astype("datetime64[ns]"),
+        "k": keys.astype(np.int64),
+        "q": rng.integers(1, 10, n).astype(np.int64),
+    })
+    ctx = sdot.Context()
+    ctx.ingest_dataframe("t", df, time_column="ts")
+    # dense span via semijoin-shaped EXISTS -> bitmap branch
+    got = ctx.sql(
+        "select count(*) as n from t where exists "
+        "(select 1 from t t2 where t2.k = t.k and t2.q >= 9)").to_pandas()
+    hot = set(df[df.q >= 9].k)
+    assert int(got["n"].iloc[0]) == int(df.k.isin(hot).sum())
+    assert spans and any(s <= (1 << 26) for s in spans), \
+        "dense EXISTS set never reached the shared membership lowering"
+    # wide span (> 2^26): binary-search fallback stays correct
+    spans.clear()
+    w = df.assign(k=df.k * 1_000)     # span ~3e9
+    ctx.ingest_dataframe("w", w, time_column="ts")
+    got = ctx.sql(
+        "select count(*) as n from w where exists "
+        "(select 1 from w w2 where w2.k = w.k and w2.q >= 9)").to_pandas()
+    hotw = set(w[w.q >= 9].k)
+    assert int(got["n"].iloc[0]) == int(w.k.isin(hotw).sum())
+    assert spans and any(s > (1 << 26) for s in spans), \
+        "wide EXISTS set never reached the shared membership lowering"
