@@ -419,7 +419,11 @@ def int_set_membership(arr, vals: np.ndarray):
     (ops/filters._in) and the compiled-expression tier (_in_list)."""
     lo_v, hi_v = int(vals[0]), int(vals[-1])
     span = hi_v - lo_v + 1
-    if span <= (1 << 26):
+    # bitmap only when reasonably DENSE (or small): a sparse thousand-key
+    # set under the span cap would bake megabytes of mostly-zero constant
+    # into the program where binary search needs kilobytes
+    if span <= (1 << 26) and (span <= (1 << 20)
+                              or span <= 64 * len(vals)):
         off_np = vals.astype(np.int64) - lo_v
         words = np.zeros((span + 31) // 32, dtype=np.uint32)
         np.bitwise_or.at(
