@@ -2097,7 +2097,19 @@ class QueryEngine:
         for c in cols:
             data[c] = _host_column_values(ds, c, page)
         self.last_stats.update({"datasource": ds.name,
-                                "rows": int(len(page))})
+                                "rows": int(len(page)),
+                                "rows_scanned": int(ds.num_rows)})
+        if self.last_stats.get("select_filter") != "host":
+            # the device pass reads only the MASK's inputs (filter
+            # columns); the page gather is host-side — sizing from the
+            # output columns would overstate the roofline by orders
+            mask_cols = sorted(F.columns_of_filter(q.filter))
+            if q.intervals and ds.time is not None:
+                mask_cols.append(ds.time.name)
+            if mask_cols:
+                self.last_stats["bytes_scanned"] = \
+                    int(C.bytes_per_segment(ds, mask_cols)) \
+                    * int(len(seg_idx))
         return QueryResult(cols, data)
 
     def _run_search(self, q: S.SearchQuerySpec) -> QueryResult:

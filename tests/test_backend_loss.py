@@ -98,3 +98,38 @@ def test_backend_loss_classifier():
     assert _is_backend_loss(OSError("Socket closed"))
     assert not _is_backend_loss(ValueError("UNAVAILABLE"))   # wrong type
     assert not _is_backend_loss(RuntimeError("shape mismatch [4] vs [8]"))
+
+
+def test_backend_loss_on_sharded_mesh(monkeypatch):
+    """Loss during mesh execution demotes and recovers the same way."""
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+    rng = np.random.default_rng(3)
+    n = 10_000
+    df = pd.DataFrame({
+        "ts": np.repeat(np.datetime64("2021-01-01"), n)
+        .astype("datetime64[ns]"),
+        "region": rng.choice(["a", "b", "c"], n),
+        "qty": rng.integers(1, 50, n).astype(np.int64),
+    })
+    ctx = sdot.Context({"sdot.querycostmodel.enabled": False,
+                        "sdot.engine.backend.retry.seconds": 3600.0},
+                       mesh=make_mesh())
+    ctx.ingest_dataframe("m", df, time_column="ts")
+    sql = "select region, sum(qty) as s from m group by region order by region"
+    want = df.groupby("region")["qty"].sum().tolist()
+    assert ctx.sql(sql).to_pandas()["s"].tolist() == want
+    assert ctx.history.entries()[-1].stats.get("sharded") is True
+
+    orig = QueryEngine._bind_arrays
+
+    def dead(self, *a, **k):
+        raise jax.errors.JaxRuntimeError("UNAVAILABLE: ICI link down")
+
+    monkeypatch.setattr(QueryEngine, "_bind_arrays", dead)
+    assert ctx.sql(sql).to_pandas()["s"].tolist() == want
+    assert ctx.history.entries()[-1].stats["mode"] \
+        .startswith("host (backend_lost")
+    monkeypatch.setattr(QueryEngine, "_bind_arrays", orig)
+    ctx.engine._backend_retry_at = 0.0
+    assert ctx.sql(sql).to_pandas()["s"].tolist() == want
+    assert ctx.history.entries()[-1].stats["mode"] == "engine"

@@ -69,3 +69,24 @@ def test_healthcheck_action(served):
     _, _, server, client = served
     (res,) = list(client.do_action(flight.Action("healthcheck", b"")))
     assert res.body.to_pybytes() == b"ok"
+
+
+def test_concurrent_flight_statements(served):
+    """gRPC serves on a thread pool; concurrent statements on the shared
+    Context must all return correct results (the session layer keeps
+    per-thread state)."""
+    import concurrent.futures as cf
+    _, df, server, client0 = served
+    want = df.groupby("region")["qty"].sum().tolist()
+
+    def one(_):
+        c = flight.connect(f"grpc://127.0.0.1:{server.port}")
+        try:
+            t = c.do_get(flight.Ticket(SQL.encode())).read_all()
+            return t.column("s").to_pylist()
+        finally:
+            c.close()
+
+    with cf.ThreadPoolExecutor(max_workers=6) as ex:
+        results = list(ex.map(one, range(12)))
+    assert all(r == want for r in results)
