@@ -886,7 +886,7 @@ class QueryEngine:
         n_out = topk[1] if topk else n_keys
 
         top_idx = None
-        base_sig = (ds.name, id(ds), repr(q), s_pad, ds.padded_rows,
+        base_sig = (ds.name, id(ds), _cache_repr(q), s_pad, ds.padded_rows,
                     min_day, max_day, sharded, n_dev, tuple(names),
                     self.config.get(TZ_ID), jax.default_backend(),
                     bool(jax.config.jax_enable_x64))
@@ -1146,7 +1146,7 @@ class QueryEngine:
             routes = G.plan_routes(
                 metas, T, self.config.get(GROUPBY_MATMUL_MAX_KEYS),
                 n_rows=int(ds.padded_rows) * int(ds.num_segments))
-            sig = ("hashagg", ds.name, id(ds), repr(q), s_pad,
+            sig = ("hashagg", ds.name, id(ds), _cache_repr(q), s_pad,
                    ds.padded_rows, min_day, max_day, sharded, n_dev, T,
                    tuple(names), topk, compact, self.config.get(TZ_ID),
                    jax.default_backend(), bool(jax.config.jax_enable_x64))
@@ -2103,12 +2103,12 @@ class QueryEngine:
             # the device pass reads only the MASK's inputs (filter
             # columns); the page gather is host-side — sizing from the
             # output columns would overstate the roofline by orders
-            mask_cols = sorted(F.columns_of_filter(q.filter))
+            mask_cols = set(F.columns_of_filter(q.filter))
             if q.intervals and ds.time is not None:
-                mask_cols.append(ds.time.name)
+                mask_cols.add(ds.time.name)
             if mask_cols:
                 self.last_stats["bytes_scanned"] = \
-                    int(C.bytes_per_segment(ds, mask_cols)) \
+                    int(C.bytes_per_segment(ds, sorted(mask_cols))) \
                     * int(len(seg_idx))
         return QueryResult(cols, data)
 
@@ -2289,6 +2289,17 @@ class QueryEngine:
 _LOST_MARKERS = ("unavailable", "deadline_exceeded", "deadline exceeded",
                  "connection", "socket", "transport", "unreachable",
                  "device or resource busy", "premature end")
+
+
+def _cache_repr(q) -> str:
+    """repr(q) with the per-request QueryContext stripped: query_id /
+    timeout never shape the compiled program, and leaving them in the
+    signature would recompile EVERY server statement (each request
+    carries a fresh query id — a 3-45s compile per request on a TPU)."""
+    try:
+        return repr(dataclasses.replace(q, context=None))
+    except Exception:  # noqa: BLE001 — non-dataclass/frozen edge
+        return repr(q)
 
 
 def _is_backend_loss(e: BaseException) -> bool:

@@ -393,3 +393,30 @@ def test_concurrent_mixed_epilogues():
     for t in threads:
         t.join()
     assert not errs, errs[:2]
+
+
+def test_program_cache_survives_query_ids():
+    """Per-request query ids must NOT key the compile cache: every server
+    statement carries a fresh id, and a signature containing it would
+    recompile per request (3-45s per statement on a TPU)."""
+    import numpy as np
+    import pandas as pd
+    import spark_druid_olap_tpu as sdot
+    rng = np.random.default_rng(2)
+    n = 20_000
+    df = pd.DataFrame({
+        "ts": np.repeat(np.datetime64("2021-01-01"), n)
+        .astype("datetime64[ns]"),
+        "r": rng.choice(["a", "b"], n),
+        "q": rng.integers(1, 10, n).astype(np.int64),
+    })
+    # low device-select threshold so the selmask program compiles too
+    ctx = sdot.Context({"sdot.select.device.min.rows": 1024})
+    ctx.ingest_dataframe("t", df, time_column="ts")
+    for sql in ("select r, sum(q) as s from t group by r",
+                "select r, q from t where q > 5 limit 20"):
+        ctx.sql(sql, query_id="req-1")
+        before = len(ctx.engine._programs)
+        assert before > 0, sql             # a device program compiled
+        ctx.sql(sql, query_id="req-2")
+        assert len(ctx.engine._programs) == before, sql
