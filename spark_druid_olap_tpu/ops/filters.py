@@ -242,9 +242,11 @@ def _spatial(f: S.SpatialFilter, ctx):
 
 def _logical(f: S.LogicalFilter, ctx):
     if f.op == "not":
+        # BOOLEAN not (planner-generated wrappers — EXISTS encodings —
+        # rely on it; SQL-level NOT gets its Kleene null guards added by
+        # the builder at construction, builder._kleene_not)
         inner = lower_filter(f.fields[0], ctx)
-        base = ctx.row_valid() if inner is None else ~inner
-        return base
+        return ctx.row_valid() if inner is None else ~inner
     masks = [lower_filter(x, ctx) for x in f.fields]
     if f.op == "or":
         # an all-true (None) operand makes the whole OR all-true

@@ -221,6 +221,22 @@ class Builder:
                     continue  # inlined EXISTS etc. — constant true
                 specs.append(S.ExprFilter(E.Literal(False)))
                 continue
+            if not E.columns_in(c):
+                # column-free conjunct (e.g. the Kleene NULL-list
+                # encoding fully folded): 3VL constant-fold at plan
+                # time — it must act at SCAN level, never as a
+                # post-aggregation residual (which would drop the
+                # global identity row)
+                from spark_druid_olap_tpu.utils import host_eval as HEv
+                try:
+                    keep = bool(HEv.eval_pred3(c, {}).all())
+                except Exception:  # noqa: BLE001 — leave to lowering
+                    keep = None
+                if keep is True:
+                    continue
+                if keep is False:
+                    specs.append(S.ExprFilter(E.Literal(False)))
+                    continue
             if tcol is not None and self._try_interval(c, tcol, acc):
                 continue
             try:
@@ -329,7 +345,7 @@ class Builder:
             return S.LogicalFilter(
                 "or", tuple(self.to_filter(p) for p in e.parts))
         if isinstance(e, E.Not):
-            return S.LogicalFilter("not", (self.to_filter(e.child),))
+            return self._kleene_not(self.to_filter(e.child), e.child)
         if isinstance(e, E.IsNull):
             if isinstance(e.child, E.Column):
                 return S.NullFilter(e.child.name, negated=e.negated)
@@ -346,7 +362,7 @@ class Builder:
                                tuple(str(v) for v in e.values))
             else:
                 f = S.InFilter(e.child.name, tuple(e.values))
-            return S.LogicalFilter("not", (f,)) if e.negated else f
+            return self._kleene_not(f, e) if e.negated else f
         if isinstance(e, E.Between) and isinstance(e.child, E.Column):
             kind = self._col_kind(e.child.name)
             lo = e.low.value if isinstance(e.low, E.Literal) else None
@@ -355,13 +371,79 @@ class Builder:
                 f = S.BoundFilter(e.child.name, lower=lo, upper=hi,
                                   numeric=kind in (ColumnKind.LONG,
                                                    ColumnKind.DOUBLE))
-                return S.LogicalFilter("not", (f,)) if e.negated else f
+                return self._kleene_not(f, e) if e.negated else f
             return S.ExprFilter(e)
         if isinstance(e, E.Like) and isinstance(e.child, E.Column) and \
                 self._col_kind(e.child.name) == ColumnKind.DIM:
             f = S.PatternFilter(e.child.name, "like", e.pattern)
-            return S.LogicalFilter("not", (f,)) if e.negated else f
+            return self._kleene_not(f, e) if e.negated else f
         return S.ExprFilter(e)
+
+    def _kleene_not(self, inner: S.FilterSpec, negated_expr: E.Expr):
+        """SQL NOT with Kleene null semantics: a NULL operand keeps the
+        predicate UNKNOWN (never TRUE), so the negation carries IS NOT
+        NULL guards for every NULLABLE column it negates over — columns
+        under IS [NOT] NULL or KeyedLookup subtrees excepted (those
+        predicates are never UNKNOWN / handle their own misses).
+        Planner-generated negations are BOOLEAN by construction: the
+        decorrelation pass only inlines lookups under polarity-checked
+        positions (its generated predicates are False on miss/NULL), so
+        any lookup under the negation means the 3VL analysis already
+        happened — plain boolean not there.
+
+        The guard equivalence 'NOT(P) is UNKNOWN iff a referenced
+        column is NULL' is EXACT only for a single column-vs-literal
+        predicate; for compound children (NOT(U AND F) is TRUE, but a
+        blanket guard would drop the row) the conjunct goes to the host
+        tier when nullable columns are involved (eval_pred3 is a full
+        Kleene evaluator)."""
+        if any(isinstance(n, (E.KeyedLookup, E.KeyedLookup2))
+               for n in E.walk(negated_expr)):
+            return S.LogicalFilter("not", (inner,))
+        nullable = sorted(
+            c for c in self._cols_outside_isnull(negated_expr)
+            if (col := self.ds.dims.get(c) or self.ds.metrics.get(c))
+            is not None and col.validity is not None)
+        if not nullable:
+            return S.LogicalFilter("not", (inner,))
+        if not self._simple_negatable(negated_expr):
+            raise PlanUnsupported(
+                "NOT over a compound predicate with nullable columns "
+                "(Kleene semantics need the host evaluator)")
+        return S.LogicalFilter(
+            "and", (S.LogicalFilter("not", (inner,)),)
+            + tuple(S.NullFilter(c, negated=True) for c in nullable))
+
+    @staticmethod
+    def _cols_outside_isnull(e: E.Expr) -> Set[str]:
+        out: Set[str] = set()
+
+        def rec(n):
+            if isinstance(n, E.IsNull):
+                return
+            if isinstance(n, E.Column):
+                out.add(n.name)
+            for ch in n.children():
+                rec(ch)
+
+        rec(e)
+        return out
+
+    @staticmethod
+    def _simple_negatable(e: E.Expr) -> bool:
+        """One column-vs-literal predicate: its UNKNOWN-ness is exactly
+        'the column is NULL', so the IS NOT NULL guard is lossless."""
+        def col_or_lit(x):
+            return isinstance(x, (E.Column, E.Literal))
+
+        if isinstance(e, E.Comparison):
+            return col_or_lit(e.left) and col_or_lit(e.right)
+        if isinstance(e, (E.InList, E.Like)):
+            return isinstance(e.child, E.Column)
+        if isinstance(e, E.Between):
+            return isinstance(e.child, E.Column) \
+                and col_or_lit(e.low) and col_or_lit(e.high)
+        return False
 
     def _col_kind(self, name: str) -> Optional[ColumnKind]:
         try:

@@ -511,6 +511,37 @@ def stmt_has_subqueries(stmt: A.SelectStmt) -> bool:
     return False
 
 
+def build_in_list_expr(child: E.Expr, raw: pd.Series,
+                       negated: bool) -> E.Expr:
+    """An executed IN-subquery's value list -> the membership expr, with
+    SQL 3VL for NULL-bearing lists: membership in such a list is TRUE on
+    a match else UNKNOWN (never FALSE), so NOT IN can never be TRUE.
+    Encoded as Kleene 'inlist OR NULL', which eval_pred3 resolves
+    through the node's own negation AND any enclosing NOT. Null-free
+    lists keep the pushdown-friendly negated-InList shape (lowers to
+    the engine's InFilter). The ONE shared encoding of the uncorrelated
+    inline pass and the host executor."""
+    col = raw.dropna()
+    had_null = len(col) < len(raw)
+    if len(col) > 1024 and \
+            np.issubdtype(col.to_numpy().dtype, np.integer):
+        # semi-join-scale integer key list: O(1)-repr sorted set
+        base = E.InList(child, E.FrozenIntSet(col.to_numpy()),
+                        negated=False)
+    elif len(col):
+        base = E.InList(child, tuple(_to_python(v) for v in pd.unique(col)),
+                        negated=False)
+    else:
+        base = None                        # empty list matches nothing
+    if not had_null:
+        if base is None:
+            return E.Literal(bool(negated))
+        return dataclasses.replace(base, negated=negated)
+    base = E.Literal(None) if base is None \
+        else E.Or((base, E.Literal(None)))
+    return E.Not(base) if negated else base
+
+
 def inline_subqueries(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
     """Replace uncorrelated subquery nodes in WHERE/HAVING with literals."""
 
@@ -535,18 +566,8 @@ def inline_subqueries(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
                     not _is_correlated(ctx, n.query):
                 df = run_inner(n.query)
                 changed[0] = True
-                col = df.iloc[:, 0].dropna()
-                if len(col) > 1024 and \
-                        np.issubdtype(col.to_numpy().dtype, np.integer):
-                    # semi-join-scale integer key list: O(1)-repr sorted set
-                    return E.InList(n.child,
-                                    E.FrozenIntSet(col.to_numpy()),
-                                    negated=n.negated)
-                vals = tuple(_to_python(v) for v in pd.unique(col))
-                if not vals:
-                    # empty IN-list: constant false (true for NOT IN)
-                    return E.Literal(bool(n.negated))
-                return E.InList(n.child, vals, negated=n.negated)
+                return build_in_list_expr(n.child, df.iloc[:, 0],
+                                          n.negated)
             if isinstance(n, A.Exists) and not _is_correlated(ctx, n.query):
                 df = run_inner(n.query)
                 changed[0] = True

@@ -299,8 +299,8 @@ def _execute_sub_once(ctx, node, outer_env):
     if isinstance(node, A.Exists):
         flag = (len(df) > 0) != node.negated
         return E.Literal(flag)
-    vals = tuple(pd.unique(df.iloc[:, 0].dropna()))
-    return E.InList(node.child, vals, negated=node.negated)
+    from spark_druid_olap_tpu.planner.decorrelate import build_in_list_expr
+    return build_in_list_expr(node.child, df.iloc[:, 0], node.negated)
 
 
 _PrecomputedColumn = host_eval.Precomputed
@@ -705,8 +705,20 @@ def _execute_sub_rowwise(ctx, node, env, free, n_rows, outer_env):
         elif isinstance(node, A.Exists):
             results.append((len(df) > 0) != node.negated)
         else:
-            inset = child_vals[i] in set(df.iloc[:, 0])
-            results.append(inset != node.negated)
+            # SQL 3VL: a NULL probe, or a miss against a NULL-bearing
+            # list, is UNKNOWN (never TRUE under either polarity)
+            inner = df.iloc[:, 0]
+            probe = child_vals[i]
+            probe_null = probe is None or (isinstance(probe, float)
+                                           and np.isnan(probe))
+            inset = (not probe_null
+                     and probe in set(inner.dropna()))
+            if inset:
+                results.append(not node.negated)
+            elif len(inner) and (probe_null or inner.isna().any()):
+                results.append(False)          # UNKNOWN -> drop
+            else:
+                results.append(bool(node.negated))
     arr = np.array(results, dtype=object)
     try:
         arr = arr.astype(np.float64)

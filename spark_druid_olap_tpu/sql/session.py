@@ -342,7 +342,12 @@ def execute_planned(ctx, pq: PlannedQuery) -> pd.DataFrame:
     if pq.residual is not None:
         from spark_druid_olap_tpu.utils import host_eval
         env = {c: df[c].to_numpy() for c in df.columns}
-        mask = np.asarray(host_eval.eval_expr(pq.residual, env), dtype=bool)
+        # WHERE-derived conjuncts: Kleene 3VL (UNKNOWN drops the row;
+        # plain eval_expr would mis-handle NULL-bearing predicates and
+        # can collapse to a scalar)
+        mask = np.broadcast_to(
+            np.asarray(host_eval.eval_pred3(pq.residual, env), dtype=bool),
+            (len(df),))
         df = df[mask].reset_index(drop=True)
 
     if pq.distinct_phase2 is not None:

@@ -192,7 +192,10 @@ def eval_expr(e: E.Expr, env: dict):
         v = eval_expr(e.child, env)
         from spark_druid_olap_tpu.ops.expr_compile import like_to_regex
         rx = re.compile(like_to_regex(e.pattern))
-        out = _map1(v, lambda s: bool(rx.match(s)))
+        # NULLs (None/NaN in object arrays) match nothing under either
+        # polarity here; eval_pred3's Like branch adds the UNKNOWN mask
+        out = _map1(v, lambda s: bool(rx.match(s))
+                    if isinstance(s, str) else False)
         if isinstance(out, np.ndarray):
             out = out.astype(bool)
         return np.logical_not(out) if e.negated else out
@@ -357,9 +360,10 @@ def _pred3(e: E.Expr, env: dict):
         if e.negated:
             inner = E.Not(inner)
         return _pred3(inner, env)
-    if isinstance(e, E.InList):
-        # membership itself implements its list-null rules; the probe
-        # being NULL makes the result UNKNOWN (never TRUE)
+    if isinstance(e, (E.InList, E.Like)):
+        # membership/pattern matching implements its own list-null
+        # rules; the probe being NULL makes the result UNKNOWN (never
+        # TRUE — 'NOT LIKE' over a NULL must drop the row)
         u = _map_null(eval_expr(e.child, env))
         res = b(eval_expr(e, env))
         res, u = np.broadcast_arrays(res, u)

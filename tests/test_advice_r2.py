@@ -180,3 +180,58 @@ def test_union_nonfinal_bare_branch_clauses_rejected():
     # trailing clauses bind to the whole union
     parse_statement("(select a from t limit 2) union all select a from t")
     parse_statement("select a from t union all select a from t order by a limit 3")
+
+
+# -- 6. Kleene 3VL over NULL-bearing membership and negated filters ----------
+# (round-3 probe findings: NOT IN over a NULL-bearing subquery list was
+# TRUE for every row, and the device lowering's NOT inverted the null
+# guard so negated predicates KEPT null rows)
+
+def test_not_in_null_bearing_subquery_and_negated_filters():
+    rng = np.random.default_rng(42)
+    n = 40_000
+    df = pd.DataFrame({
+        "ts": (np.datetime64("2020-06-01")
+               + rng.integers(0, 400, n).astype("timedelta64[D]"))
+        .astype("datetime64[ns]"),
+        "cat": rng.choice(["x", "y", "z"], n),
+        "subc": rng.choice([f"s{i}" for i in range(50)], n),
+    })
+    df.loc[rng.choice(n, 500, replace=False), "subc"] = None
+    ctx = sdot.Context()
+    ctx.ingest_dataframe("t3v", df, time_column="ts")
+
+    def n_of(sql):
+        return int(ctx.sql(sql).to_pandas()["n"].iloc[0])
+
+    nn = df.subc.notna()
+    cases = [
+        # NOT IN over any NULL-bearing list can never be TRUE
+        ("select count(*) as n from t3v where cat not in "
+         "(select subc from t3v where subc is null)", 0),
+        ("select count(*) as n from t3v where cat not in "
+         "(select subc from t3v where subc = 's1' or subc is null)", 0),
+        ("select count(*) as n from t3v where not (cat in "
+         "(select subc from t3v where subc is null))", 0),
+        ("select count(*) as n from t3v where not (subc in "
+         "(select subc from t3v where subc = 's1' or subc is null))", 0),
+        # IN keeps its match semantics
+        ("select count(*) as n from t3v where subc in "
+         "(select subc from t3v where subc = 's1' or subc is null)",
+         int((df.subc == "s1").sum())),
+        # negated predicates over a nullable dim DROP its null rows
+        ("select count(*) as n from t3v where subc not in "
+         "(select subc from t3v where subc = 's1')",
+         int(((df.subc != "s1") & nn).sum())),
+        ("select count(*) as n from t3v where subc not in ('s1', 's2')",
+         int((~df.subc.isin(["s1", "s2"]) & nn).sum())),
+        ("select count(*) as n from t3v where subc <> 's1'",
+         int(((df.subc != "s1") & nn).sum())),
+        ("select count(*) as n from t3v where subc not like 's1%'",
+         int((~df.subc.fillna("s1").str.startswith("s1") & nn).sum())),
+        ("select count(*) as n from t3v where not "
+         "(subc = 's1' or subc = 's2')",
+         int((~df.subc.isin(["s1", "s2"]) & nn).sum())),
+    ]
+    for sql, want in cases:
+        assert n_of(sql) == want, sql
