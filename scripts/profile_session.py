@@ -1,0 +1,97 @@
+"""Interactive on-chip profiling session (round-3 perf work).
+
+Usage:  python -i scripts/profile_session.py
+Builds the SF1 TPC-H context once (ingest ~70s), then exposes:
+
+  prof("q21")        — run one TPC-H query, print per-engine-call breakdown
+  prof_warm("q21")   — same, but reports the warm (2nd) run's breakdown
+  calls              — list of (spec, datasource, ms, stats) from last run
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("SDOT_BENCH_PLATFORM", "axon")
+
+import bench  # noqa: E402
+
+platform, diags = bench.select_platform()
+print("platform:", platform, flush=True)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", platform)
+try:
+    cache = os.path.join(bench.cache_dir(), "xla_cache")
+    os.makedirs(cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+except Exception as e:  # noqa: BLE001
+    print("no persistent cache:", e)
+if platform == "cpu":
+    jax.config.update("jax_enable_x64", True)
+print("backend:", jax.default_backend(), jax.devices(), flush=True)
+
+t0 = time.perf_counter()
+ctx, n_rows = bench.setup(float(os.environ.get("SDOT_PROF_SF", "1")))
+print(f"setup done in {time.perf_counter() - t0:.1f}s", flush=True)
+
+from spark_druid_olap_tpu.tools import tpch  # noqa: E402
+
+calls = []
+_orig_execute = ctx.engine.execute
+
+
+def _patched(q):
+    t0 = time.perf_counter()
+    r = _orig_execute(q)
+    ms = (time.perf_counter() - t0) * 1000
+    st = dict(ctx.engine.last_stats)
+    calls.append((type(q).__name__, getattr(q, "datasource", "?"), ms, st))
+    return r
+
+
+ctx.engine.execute = _patched
+
+
+def _run(name):
+    calls.clear()
+    t0 = time.perf_counter()
+    r = ctx.sql(tpch.QUERIES[name])
+    wall = (time.perf_counter() - t0) * 1000
+    return r, wall
+
+
+def _report(name, wall, r):
+    eng = sum(c[2] for c in calls)
+    print(f"{name}: wall {wall:.0f}ms, {len(calls)} engine calls "
+          f"({eng:.0f}ms on-engine, {wall - eng:.0f}ms host), "
+          f"{len(r.rows) if hasattr(r, 'rows') else '?'} rows")
+    for i, (spec, ds, ms, st) in enumerate(calls):
+        keys = {k: st.get(k) for k in
+                ("segments", "sharded", "groups", "rows_scanned", "mode",
+                 "select_filter", "tier", "waves") if k in st}
+        print(f"  [{i}] {spec:<22} {ds:<16} {ms:8.1f}ms  {keys}")
+
+
+def prof(name):
+    r, wall = _run(name)
+    _report(name + " (cold-ish)", wall, r)
+    return r
+
+
+def prof_warm(name, reps=2):
+    _run(name)
+    best = None
+    for _ in range(reps):
+        r, wall = _run(name)
+        if best is None or wall < best[1]:
+            best = (r, wall)
+    _report(name + " (warm best)", best[1], best[0])
+    return best[0]
+
+
+if __name__ == "__main__" and not sys.flags.interactive:
+    for q in sys.argv[1:]:
+        prof_warm(q)
