@@ -77,22 +77,28 @@ _DENSE_MAX_SPAN = 1 << 23          # 8M slots (32MB f32) hard cap
 _DENSE_MAX_EXPAND = 8              # span <= 8x the key count
 
 
-def _dense_lookup_table(tab, default):
-    """(k0, k_last, dense_f64_values) when ``tab``'s integer keys are dense
+def _dense_lookup_table(tab, default, probe_dtype):
+    """(k0, k_last, dense_values) when ``tab``'s integer keys are dense
     enough that a direct-addressed [span] array is a better lookup than
-    binary search; None otherwise. Holes/fill carry the miss value so an
-    in-range probe of an absent key reads exactly what a miss returns."""
+    binary search; None otherwise (incl. keys outside the PROBE dtype's
+    range — the binary-search path keeps its Unsupported/32-bit guards).
+    Holes/fill carry the miss value so an in-range probe of an absent key
+    reads exactly what a miss returns. Values are f64 on x64 and f32
+    otherwise — the same precision the binary-search gather delivers."""
     if len(tab) == 0:
         return None
     k0, k1 = int(tab.keys[0]), int(tab.keys[-1])
+    if probe_dtype != jnp.int64 and (k0 < -(2**31) or k1 >= 2**31):
+        return None
     span = k1 - k0 + 1
     if span > _DENSE_MAX_SPAN or span > _DENSE_MAX_EXPAND * len(tab):
         return None
     fill = np.nan if default is None else float(default)
-    ck = (tab._digest, fill)
+    x64 = bool(jax.config.jax_enable_x64)
+    ck = (tab._digest, fill, x64)
     got = _DENSE_TABLES.get(ck)
     if got is None:
-        dense = np.full(span, fill, np.float64)
+        dense = np.full(span, fill, np.float64 if x64 else np.float32)
         dense[tab.keys - k0] = tab.values
         if len(_DENSE_TABLES) > 64:
             _DENSE_TABLES.clear()
@@ -252,7 +258,7 @@ def compile_expr(e: E.Expr, ctx: ScanContext):
                            else jnp.float32)
         if len(tab) == 0:
             return NumValue(jnp.full(jnp.shape(n.arr), miss), True)
-        dense = _dense_lookup_table(tab, e.default)
+        dense = _dense_lookup_table(tab, e.default, n.arr.dtype)
         if dense is not None:
             # direct-addressed fast path: TPC-H-class surrogate keys are
             # near-dense, so ONE gather into a [span] value array replaces

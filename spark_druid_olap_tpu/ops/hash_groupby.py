@@ -1,5 +1,5 @@
-"""Hashed high-cardinality group-by: fixed-size open-addressing hash table
-built from XLA scatter-min claims, probed over a static number of rounds.
+"""Hashed high-cardinality group-by: sort-assigned dense group ids in a
+fixed-size table.
 
 This is the TPU answer to Druid's groupBy v2 engine handling arbitrary key
 cardinality (reference contract: ``QuerySpecContext``
@@ -13,21 +13,29 @@ Design constraints driven by XLA/TPU semantics:
 - **Static shapes**: the table size ``n_slots`` is a compile-time constant;
   overflow surfaces as a scalar the host checks (retry bigger, then fall
   back) rather than a dynamic reallocation.
-- **No atomics**: slot claiming uses a two-stage ``scatter-min`` — all rows
-  attempt a claim simultaneously, the lexicographically-smallest key wins an
-  empty slot, losers re-probe next round (double hashing). Occupied slots
-  are never overwritten (candidates for non-empty slots are the EMPTY
-  sentinel, and ``min(cur, EMPTY) == cur``).
+- **No atomics, no probe loops**: group ids come from ONE ``lax.sort`` over
+  the key pairs — run boundaries in the sorted order become dense ids via a
+  cumulative sum, inverted back to row order through the sort's payload
+  index. An earlier design claimed slots with a 32-round scatter-min
+  double-hashing loop; on a v5e that cost ~6 random HBM accesses per row
+  *per round* and dominated q16-class queries (~20x over the raw scatter
+  aggregation). One bitonic sort is far cheaper than 32 gather/scatter
+  rounds, and deterministic.
+- **Sorted tables for free**: slot k holds the k-th smallest key, so the
+  key table is sorted — cross-chip candidate probing is a pair binary
+  search (``probe_slots``), and host-side key-wise merges consume
+  pre-sorted runs.
 - **62-bit keys without i64**: the fused key is split into two int32 parts
   (each a product of dim cardinalities < 2^31), compared as a pair.
 - **The aggregation itself** reuses the exact scatter routes
   (``ops.groupby``: limb sums, compensated f32, i32 min/max) with the
-  claimed slot as the dense key — so hashed group-by inherits the same
+  assigned slot as the dense key — so hashed group-by inherits the same
   TPU-dtype exactness guarantees.
 
 Cross-chip / cross-wave merge happens on host by *key*, not by slot (each
-chip builds its own table layout) — the direct analog of the reference's
-historical partials merged broker-side (``DruidStrategy.scala:349-360``).
+chip sees different keys, so slot k differs per chip) — the direct analog of
+the reference's historical partials merged broker-side
+(``DruidStrategy.scala:349-360``).
 """
 
 from __future__ import annotations
@@ -39,7 +47,6 @@ import jax.numpy as jnp
 import numpy as np
 
 EMPTY = np.int32(2**31 - 1)       # empty-slot sentinel; valid codes >= 0
-PROBE_ROUNDS = 32
 PART_LIMIT = 2**31 - 1            # max product of cardinalities per key part
 
 
@@ -99,85 +106,75 @@ def unfuse_part(vals: np.ndarray, cards: Sequence[int],
     return list(reversed(out))
 
 
-def _mix(a, b):
-    """murmur3-style finalizer over a pair of int32s -> uint32 hash."""
-    h = a.astype(jnp.uint32)
-    h = (h ^ (h >> 16)) * jnp.uint32(0x85EBCA6B)
-    h = h ^ (b.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35))
-    h = (h ^ (h >> 13)) * jnp.uint32(0x27D4EB2F)
-    return h ^ (h >> 16)
-
-
-def build_slots(khi, klo, valid, n_slots: int, rounds: int = PROBE_ROUNDS):
-    """Claim one table slot per distinct (khi, klo) key.
+def build_slots(khi, klo, valid, n_slots: int):
+    """Assign one dense table slot per distinct valid (khi, klo) key.
 
     Returns ``(slot, table_khi, table_klo, n_unresolved)``: ``slot`` has the
-    input shape (claimed slot per row; untrustworthy where unresolved or
+    input shape (assigned slot per row; untrustworthy where unresolved or
     ~valid — callers must mask), tables are the per-slot key parts ([n_slots]
-    int32, EMPTY where unoccupied), ``n_unresolved`` is the number of valid
-    rows that failed to claim within ``rounds`` probes (host: retry with a
-    bigger table).
+    int32, EMPTY where unoccupied, **sorted ascending** over occupied slots),
+    ``n_unresolved`` is the number of valid rows whose group did not fit in
+    ``n_slots`` (host: retry with a bigger table).
+
+    One ``lax.sort`` over (khi, klo, row-index): run starts in the sorted
+    key sequence become dense group ids via cumsum, scattered back to row
+    order through the payload index. Invalid rows get both parts EMPTY
+    (every real part is < EMPTY by the PART_LIMIT invariant), sort last,
+    and form a trailing pseudo-group whose table entry stays EMPTY.
     """
     shape = khi.shape
-    khi_f = khi.reshape(-1).astype(jnp.int32)
-    klo_f = klo.reshape(-1).astype(jnp.int32)
-    val_f = valid.reshape(-1)
+    khi_f = jnp.where(valid.reshape(-1), khi.reshape(-1).astype(jnp.int32),
+                      EMPTY)
+    klo_f = jnp.where(valid.reshape(-1), klo.reshape(-1).astype(jnp.int32),
+                      EMPTY)
     T = int(n_slots)
-    h = _mix(khi_f, klo_f)
-    # odd step => full cycle over a power-of-two table (double hashing)
-    step = _mix(klo_f, khi_f) | jnp.uint32(1)
-    slot0 = (h % jnp.uint32(T)).astype(jnp.int32)
-
-    def body(_, state):
-        tk_hi, tk_lo, slot, claimed, res = state
-        empty = tk_hi[slot] == EMPTY
-        cand_hi = jnp.where(~claimed & empty & val_f, khi_f, EMPTY)
-        tk_hi = tk_hi.at[slot].min(cand_hi)
-        hi_ok = tk_hi[slot] == khi_f
-        cand_lo = jnp.where(~claimed & empty & val_f & hi_ok, klo_f, EMPTY)
-        tk_lo = tk_lo.at[slot].min(cand_lo)
-        owner = (~claimed & val_f & (tk_hi[slot] == khi_f)
-                 & (tk_lo[slot] == klo_f))
-        res = jnp.where(owner, slot, res)
-        claimed = claimed | owner
-        slot = ((slot.astype(jnp.uint32) + step)
-                % jnp.uint32(T)).astype(jnp.int32)
-        return tk_hi, tk_lo, slot, claimed, res
-
-    init = (jnp.full((T,), EMPTY, jnp.int32),
-            jnp.full((T,), EMPTY, jnp.int32),
-            slot0, ~val_f, jnp.zeros_like(khi_f))
-    tk_hi, tk_lo, _, claimed, res = jax.lax.fori_loop(
-        0, rounds, body, init)
-    unresolved = jnp.sum((~claimed).astype(jnp.int32))
-    return res.reshape(shape), tk_hi, tk_lo, unresolved
+    n = khi_f.shape[0]
+    ridx = jnp.arange(n, dtype=jnp.int32)
+    skh, skl, sidx = jax.lax.sort((khi_f, klo_f, ridx), num_keys=2)
+    new = (skh != jnp.roll(skh, 1)) | (skl != jnp.roll(skl, 1))
+    new = new.at[0].set(True)
+    gid = jnp.cumsum(new.astype(jnp.int32)) - 1
+    # back to row order; overflowed gids (>= T) scatter with 'drop' below,
+    # and the host retries on unresolved > 0 before reading anything
+    slot = jnp.zeros(n, jnp.int32).at[sidx].set(gid)
+    occupied = skh != EMPTY
+    tk_hi = jnp.full((T,), EMPTY, jnp.int32).at[gid].set(
+        jnp.where(occupied, skh, EMPTY), mode="drop")
+    tk_lo = jnp.full((T,), EMPTY, jnp.int32).at[gid].set(
+        jnp.where(occupied, skl, EMPTY), mode="drop")
+    unresolved = jnp.sum((occupied & (gid >= T)).astype(jnp.int32))
+    return slot.reshape(shape), tk_hi, tk_lo, unresolved
 
 
-def probe_slots(tk_hi, tk_lo, khi_q, klo_q, rounds: int = PROBE_ROUNDS):
-    """Look up query keys in a built table: follow the same double-hash
-    probe sequence build_slots used. Returns ``(slot, found)`` — slot is
-    clamped to 0 where not found. A key absent from the table never
+def probe_slots(tk_hi, tk_lo, khi_q, klo_q):
+    """Look up query keys in a built table: pair binary search over the
+    sorted occupied prefix (EMPTY padding sorts last, so the WHOLE table is
+    lexicographically sorted). Returns ``(slot, found)`` — slot is clamped
+    to 0 where not found. A key absent from the table never
     false-positives (both parts must match; EMPTY query keys — padding
     from underfull candidate lists — are explicitly misses)."""
     T = int(tk_hi.shape[0])
     kh = khi_q.astype(jnp.int32)
     kl = klo_q.astype(jnp.int32)
-    h = _mix(kh, kl)
-    step = _mix(kl, kh) | jnp.uint32(1)
-    slot0 = (h % jnp.uint32(T)).astype(jnp.int32)
+    lo = jnp.zeros_like(kh)
+    hi = jnp.full_like(kh, T)
+    steps = int(np.ceil(np.log2(max(T, 2)))) + 1
 
     def body(_, st):
-        slot, fnd = st
-        hit = (tk_hi[slot] == kh) & (tk_lo[slot] == kl) & (fnd < 0)
-        fnd = jnp.where(hit, slot, fnd)
-        slot = ((slot.astype(jnp.uint32) + step)
-                % jnp.uint32(T)).astype(jnp.int32)
-        return slot, fnd
+        lo_, hi_ = st
+        mid = (lo_ + hi_) // 2
+        mid_c = jnp.clip(mid, 0, T - 1)
+        m1 = tk_hi[mid_c]
+        m2 = tk_lo[mid_c]
+        less = (m1 < kh) | ((m1 == kh) & (m2 < kl))
+        lo_ = jnp.where(less & (lo_ < hi_), mid + 1, lo_)
+        hi_ = jnp.where((~less) & (lo_ < hi_), mid, hi_)
+        return lo_, hi_
 
-    _, fnd = jax.lax.fori_loop(0, rounds, body,
-                               (slot0, jnp.full_like(kh, -1)))
-    found = (fnd >= 0) & (kh != EMPTY)
-    return jnp.maximum(fnd, 0), found
+    lo, _ = jax.lax.fori_loop(0, steps, body, (lo, hi))
+    idx = jnp.clip(lo, 0, T - 1)
+    found = (tk_hi[idx] == kh) & (tk_lo[idx] == kl) & (kh != EMPTY)
+    return jnp.where(found, idx, 0), found
 
 
 def pack_key(khi: np.ndarray, klo: np.ndarray) -> np.ndarray:
