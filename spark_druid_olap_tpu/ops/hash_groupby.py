@@ -190,8 +190,13 @@ def unpack_key(packed: np.ndarray):
 
 def initial_slots(est_groups: int, lo: int = 1 << 14,
                   hi: int = 1 << 23) -> int:
-    """Power-of-two table size targeting <=25% load at the estimate."""
+    """Power-of-two table size for ``est_groups``. Sort-assigned slots
+    need no load-factor headroom (slot k = k-th smallest key), and the
+    caller's estimate — min(key-space, scanned rows) — is already an
+    upper bound on the group count, so the next power of two above it
+    always fits; the 4x-retry path only engages when a config override
+    undersizes the table."""
     t = lo
-    while t < min(max(1, est_groups) * 4, hi):
+    while t < min(max(1, est_groups) + 1, hi):
         t <<= 1
     return min(t, hi)

@@ -1095,17 +1095,19 @@ class QueryEngine:
         except H.KeySpaceTooWide as e:
             raise EngineFallback(str(e)) from e
 
-        rows_sel = int(ds.num_rows * len(seg_idx)
-                       / max(ds.num_segments, 1))
+        # EXACT selected-row count: initial_slots sizes the table straight
+        # to min(key space, rows), which is only a true upper bound on the
+        # group count when this is not an average-based estimate (a skewed
+        # segment selection could undershoot an average and trigger a
+        # spurious 4x-retry recompile)
+        rows_sel = int(sum(ds.segments[int(si)].num_rows
+                           for si in seg_idx))
         max_slots = int(self.config.get(GROUPBY_HASH_MAX_SLOTS))
         n_keys_total = 1
         for c in cards:
             n_keys_total *= int(c)
         T = int(self.config.get(GROUPBY_HASH_SLOTS)) or H.initial_slots(
             min(n_keys_total, rows_sel), hi=max_slots)
-        if T & (T - 1):
-            # double hashing cycles the full table only for power-of-two T
-            T = 1 << T.bit_length()
 
         sharded = self._should_shard(q, ds, seg_idx)
         n_dev = mesh_size(self.mesh) if sharded else 1
@@ -2208,9 +2210,10 @@ class QueryEngine:
                     prog = jax.jit(core)
                     self._programs[sig] = prog
         try:
-            arrays = {k: _device_put_retry(
-                _build_array_checked(ds, k, seg_idx, s_pad), None)
-                for k in names}
+            # cached device bindings: a repeated (dashboard/paging) select
+            # re-runs the mask program against resident arrays instead of
+            # re-uploading the filter columns every call
+            arrays = self._bind_arrays(ds, names, seg_idx, s_pad, False)
             words = np.asarray(prog(arrays))
         except (EngineFallback, EC.Unsupported):
             return None

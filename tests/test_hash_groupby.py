@@ -70,6 +70,81 @@ def test_unfuse_part_roundtrip():
 
 
 # -----------------------------------------------------------------------------
+# sort-assigned slot builder / sorted-table probe unit tests
+# -----------------------------------------------------------------------------
+
+def test_build_slots_sorted_table_invariants():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(1)
+    keys = rng.choice(np.arange(500, dtype=np.int64) * 9973 + 7, 4000)
+    khi = jnp.asarray((keys >> 31).astype(np.int32))
+    klo = jnp.asarray((keys & (2**31 - 1)).astype(np.int32))
+    valid = jnp.asarray(rng.random(4000) < 0.9)
+    T = 1024
+    slot, tkh, tkl, unres = H.build_slots(khi, klo, valid, T)
+    assert int(unres) == 0
+    tkh = np.asarray(tkh)
+    tkl = np.asarray(tkl)
+    occ = tkh != H.EMPTY
+    ng = int(occ.sum())
+    # occupied slots form a sorted prefix
+    assert occ[:ng].all() and not occ[ng:].any()
+    packed = H.pack_key(tkh[:ng], tkl[:ng])
+    assert (np.diff(packed) > 0).all()
+    # every valid row's slot holds its own key
+    slot = np.asarray(slot)
+    v = np.asarray(valid)
+    np.testing.assert_array_equal(tkh[slot[v]],
+                                  np.asarray(khi)[v])
+    np.testing.assert_array_equal(tkl[slot[v]],
+                                  np.asarray(klo)[v])
+    # exactly the distinct valid keys appear
+    want = np.unique(keys[v])
+    np.testing.assert_array_equal(packed, want)
+
+
+def test_build_slots_overflow_reports_unresolved():
+    import jax.numpy as jnp
+    keys = np.arange(100, dtype=np.int32)      # 100 groups
+    slot, tkh, tkl, unres = H.build_slots(
+        jnp.zeros(100, jnp.int32), jnp.asarray(keys),
+        jnp.ones(100, bool), 64)
+    assert int(unres) == 100 - 64
+
+
+def test_build_slots_all_invalid():
+    import jax.numpy as jnp
+    slot, tkh, tkl, unres = H.build_slots(
+        jnp.zeros(50, jnp.int32), jnp.zeros(50, jnp.int32),
+        jnp.zeros(50, bool), 64)
+    assert int(unres) == 0
+    assert (np.asarray(tkh) == H.EMPTY).all()
+
+
+def test_probe_slots_hits_and_misses():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(2)
+    keys = np.sort(rng.choice(np.arange(1, 100000, dtype=np.int64), 300,
+                              replace=False))
+    khi = jnp.asarray((keys >> 31).astype(np.int32))
+    klo = jnp.asarray((keys & (2**31 - 1)).astype(np.int32))
+    slot, tkh, tkl, _ = H.build_slots(khi, klo, jnp.ones(300, bool), 512)
+    # probe every stored key + some misses + an EMPTY pad
+    probe = np.concatenate([keys, [5, 99_999], [2**31 - 1]])
+    p_hi = jnp.asarray((probe >> 31).astype(np.int32))
+    p_lo = jnp.asarray((probe & (2**31 - 1)).astype(np.int32))
+    got, found = H.probe_slots(tkh, tkl, p_hi, p_lo)
+    found = np.asarray(found)
+    got = np.asarray(got)
+    assert found[:300].all()
+    np.testing.assert_array_equal(np.asarray(tkl)[got[:300]],
+                                  np.asarray(klo))
+    present = set(keys.tolist())
+    for i, k in enumerate(probe[300:], start=300):
+        assert found[i] == (int(k) in present and k != 2**31 - 1)
+
+
+# -----------------------------------------------------------------------------
 # engine differential tests
 # -----------------------------------------------------------------------------
 
