@@ -58,6 +58,7 @@ from spark_druid_olap_tpu.utils.config import (
     Config,
     TZ_ID,
     BACKEND_RETRY_SECONDS,
+    DEVICE_CACHE_BYTES,
     GROUPBY_DENSE_MAX_KEYS,
     GROUPBY_HASH_COMPACT_MIN,
     GROUPBY_HASH_MAX_SLOTS,
@@ -669,6 +670,7 @@ class QueryEngine:
         self.mesh = mesh
         self._programs: Dict[tuple, object] = {}   # compile cache
         self._device_arrays: Dict[tuple, object] = {}
+        self._device_bytes = 0
         self._cancel_flags: Dict[str, object] = {}
         self._cancel_refs: Dict[str, int] = {}
         self._cancel_lock = __import__("threading").Lock()
@@ -778,6 +780,7 @@ class QueryEngine:
                 + float(self.config.get(BACKEND_RETRY_SECONDS))
             self._programs.clear()
             self._device_arrays.clear()
+            self._device_bytes = 0
         self.last_stats["backend_lost"] = True
 
     def _try_reattach(self) -> bool:
@@ -2179,7 +2182,9 @@ class QueryEngine:
         if time_in_play:
             needed.add(ds.time.name)
         names = array_names(ds, sorted(needed), time_in_play)
-        s_pad = len(seg_idx)
+        # pad like the single-device agg path so the bound arrays SHARE
+        # the device cache entries aggregations already made resident
+        s_pad = _pad_segments(len(seg_idx), 1)
         sig = ("selmask", ds.name, id(ds), repr(filter_spec),
                repr(intervals), s_pad, ds.padded_rows, min_day, max_day,
                tuple(names), self.config.get(TZ_ID),
@@ -2279,14 +2284,24 @@ class QueryEngine:
                     dev = self._device_arrays.get(key)
                     if dev is None:
                         host = _build_array_checked(ds, k, seg_idx, s_pad)
+                        # bound device residency: distinct segment
+                        # selections (paged selects, shifting intervals)
+                        # would otherwise pin fresh copies until OOM
+                        cap = int(self.config.get(DEVICE_CACHE_BYTES))
+                        if self._device_bytes + host.nbytes > cap \
+                                and self._device_arrays:
+                            self._device_arrays.clear()
+                            self._device_bytes = 0
                         dev = _device_put_retry(host, sharding)
                         self._device_arrays[key] = dev
+                        self._device_bytes += int(host.nbytes)
             out[k] = dev
         return out
 
     def clear_caches(self):
         self._programs.clear()
         self._device_arrays.clear()
+        self._device_bytes = 0
 
 
 _LOST_MARKERS = ("unavailable", "deadline_exceeded", "deadline exceeded",

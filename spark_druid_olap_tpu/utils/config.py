@@ -141,9 +141,16 @@ GROUPBY_DENSE_MAX_KEYS = _entry(
     "engine switches to the hashed group-by (ops/hash_groupby.py).")
 GROUPBY_HASH_SLOTS = _entry(
     "sdot.engine.groupby.hash.slots", 0,
-    "Initial hash-table slot count for the hashed group-by (power of two); "
-    "0 = auto-size to 4x the estimated group count. Overflow retries at 4x "
-    "up to sdot.engine.groupby.hash.max.slots.")
+    "Group-table slot count for the hashed group-by (any value; used "
+    "as-is). 0 = auto-size to the next power of two above the group-count "
+    "upper bound min(key space, selected rows). Overflow retries at 4x up "
+    "to sdot.engine.groupby.hash.max.slots.")
+DEVICE_CACHE_BYTES = _entry(
+    "sdot.engine.device.cache.bytes", 8 << 30,
+    "Budget for device-resident bound column arrays (host-side bytes "
+    "tracked per upload). When a new binding would exceed it the whole "
+    "array cache is dropped and rebuilt on demand — bounding HBM held by "
+    "shifting segment selections (paged selects, moving intervals).")
 GROUPBY_HASH_MAX_SLOTS = _entry(
     "sdot.engine.groupby.hash.max.slots", 1 << 23,
     "Max hash-table slot count; a query whose actual group count exceeds "
