@@ -446,6 +446,7 @@ def main():
 
     wall_lat, adj_lat = {}, {}
     gbps = {}
+    ndisp = {}
     cold_total_s = 0.0
     n_engine = 0
     host_queries = []
@@ -503,8 +504,14 @@ def main():
         if mode == "engine" and bs:
             gbps[name] = round(bs / (adj / 1000.0) / 1e9, 2)
             gb = f", {gbps[name]:.1f}GB/s"
+        nd = ctx.history.entries()[-1].stats.get("n_dispatch")
+        nt = ctx.history.entries()[-1].stats.get("n_transfer")
+        dd = ""
+        if nd is not None:
+            ndisp[name] = int(nd)
+            dd = f", {nd}+{nt}rt"   # program dispatches + host->dev transfers
         log(f"{name}: {wall:.1f}ms wall ({adj:.1f}ms floor-adjusted, cold "
-            f"{cold:.2f}s, mode={mode}, {len(r)} rows{gb})")
+            f"{cold:.2f}s, mode={mode}, {len(r)} rows{gb}{dd})")
 
     def geomean(d):
         vals = [max(v, 0.05) for v in d.values() if np.isfinite(v)]
@@ -549,6 +556,11 @@ def main():
         # the persistent XLA cache makes repeat runs near-warm
         "cold_total_s": round(cold_total_s, 1),
     }
+    if ndisp:
+        # device round trips per query: on the tunneled chip each costs
+        # the dispatch floor, so this is wall time's dominant term made
+        # auditable (and the target of dispatch-reduction work)
+        out["n_dispatch"] = ndisp
     if gbps:
         try:
             peak = float(os.environ.get("SDOT_BENCH_HBM_PEAK_GBPS", "819"))

@@ -693,6 +693,21 @@ class QueryEngine:
             d = self._tls.stats = {}
         return d
 
+    @property
+    def dispatch_counts(self):
+        """Thread-local MONOTONE [program_dispatches, host_transfers]
+        counters (never reset by execute); statement layers diff them
+        around a statement to report device round trips. On the tunneled
+        chip each round trip costs the dispatch floor (~80ms), so this is
+        the per-query wall-time budget made visible."""
+        c = getattr(self._tls, "dcount", None)
+        if c is None:
+            c = self._tls.dcount = [0, 0]
+        return c
+
+    def _tick(self, kind: int = 0, n: int = 1):
+        self.dispatch_counts[kind] += n
+
     # -- cancellation / timeout ----------------------------------------------
     def register_query(self, query_id: str) -> None:
         """Register a cancellable id BEFORE planning starts, so a cancel
@@ -906,6 +921,7 @@ class QueryEngine:
                                            sharded)
             if t0 is not None:
                 self._stage_check(q, t0)
+            self._tick()
             table = dict(progA(dev_arrays))
             cnt = int(np.asarray(table.pop("__stats__"))[0])
             n_out = min(n_keys,
@@ -914,6 +930,7 @@ class QueryEngine:
                 (sigA, "gather", n_out),
                 lambda: self._build_agg_gather_program(
                     agg_plans, routes, n_out, n_keys, sharded))
+            self._tick()
             out = unpackB(gfn(table))
             if t0 is not None:
                 self._stage_check(q, t0)
@@ -929,6 +946,7 @@ class QueryEngine:
                                            sharded)
             if t0 is not None:
                 self._stage_check(q, t0)  # pre-dispatch boundary
+            self._tick()
             out = unpack(prog_fn(dev_arrays))
             if t0 is not None:
                 self._stage_check(q, t0)  # post-device boundary
@@ -1171,6 +1189,7 @@ class QueryEngine:
             partials, unresolved = [], 0
 
             def bind(i):
+                self._tick(1, len(names))
                 return {k: _device_put_retry(
                     _build_array_checked(ds, k, wave_segs[i], s_pad),
                     sharding) for k in names}
@@ -1181,6 +1200,7 @@ class QueryEngine:
                 if t0 is not None:
                     self._stage_check(q, t0)
                 if compact or exch:
+                    self._tick()
                     table = dict(prog(cur))         # table stays on device
                     nxt = bind(i + 1) if i + 1 < len(wave_segs) else None
                     stats = np.asarray(
@@ -1204,6 +1224,7 @@ class QueryEngine:
                             lambda: self._build_hash_topk_exchange_program(
                                 agg_plans, routes, metric, ascending,
                                 k_cand, k_sel, T))
+                        self._tick()
                         raw = unpackB(gfn(table))
                         partials.extend(
                             _hash_chip_partials(raw, routes, k_sel, n_dev))
@@ -1215,11 +1236,13 @@ class QueryEngine:
                         (sig, "gather", kg),
                         lambda kg=kg: self._build_hash_gather_program(
                             agg_plans, routes, kg, T, sharded))
+                    self._tick()
                     raw = unpackB(gfn(table))
                     partials.extend(
                         _hash_chip_partials(raw, routes, kg, n_dev))
                 else:
                     prog_fn, unpack = prog
+                    self._tick()
                     buf = prog_fn(cur)              # async dispatch
                     # double buffer: next wave's transfer overlaps compute
                     nxt = bind(i + 1) if i + 1 < len(wave_segs) else None
@@ -1605,6 +1628,7 @@ class QueryEngine:
 
         def bind(w):
             # no caching: wave mode exists because the scan exceeds HBM
+            self._tick(1, len(names))
             return {k: _device_put_retry(
                 _build_array_checked(ds, k, w, spw), sharding)
                     for k in names}
@@ -1614,6 +1638,7 @@ class QueryEngine:
         for i in range(len(wave_segs)):
             if t0 is not None:
                 self._stage_check(q, t0)   # per-wave boundary
+            self._tick()
             bufs = prog_fn(cur)            # async dispatch
             nxt = bind(wave_segs[i + 1]) if i + 1 < len(wave_segs) else None
             out = unpack(bufs)             # blocks on the device round-trip
@@ -2219,6 +2244,7 @@ class QueryEngine:
             # re-runs the mask program against resident arrays instead of
             # re-uploading the filter columns every call
             arrays = self._bind_arrays(ds, names, seg_idx, s_pad, False)
+            self._tick()
             words = np.asarray(prog(arrays))
         except (EngineFallback, EC.Unsupported):
             return None
@@ -2292,6 +2318,7 @@ class QueryEngine:
                                 and self._device_arrays:
                             self._device_arrays.clear()
                             self._device_bytes = 0
+                        self._tick(1)
                         dev = _device_put_retry(host, sharding)
                         self._device_arrays[key] = dev
                         self._device_bytes += int(host.nbytes)

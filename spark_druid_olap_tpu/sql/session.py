@@ -242,6 +242,7 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
     if isinstance(stmt, A.UnionAll):
         return _run_union(ctx, stmt, sql)
     t0 = _time.perf_counter()
+    dc0 = list(ctx.engine.dispatch_counts)
     offset = stmt.offset
     if offset:
         # strip the offset before planning: the engine/host paths see an
@@ -296,6 +297,9 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
     stats = dict(ctx.engine.last_stats)
     stats["mode"] = mode
     stats["total_ms"] = (_time.perf_counter() - t0) * 1000
+    dc1 = ctx.engine.dispatch_counts
+    stats["n_dispatch"] = dc1[0] - dc0[0]
+    stats["n_transfer"] = dc1[1] - dc0[1]
     ctx.history.record(stmt, stats, sql=sql)
     return QueryResult(list(df.columns),
                        {c: df[c].to_numpy() for c in df.columns})
