@@ -22,6 +22,7 @@ knob).
 from __future__ import annotations
 
 import dataclasses
+import os as _os
 import time as _time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -70,6 +71,9 @@ from spark_druid_olap_tpu.utils.config import (
     SELECT_DEVICE_MIN_ROWS,
     TOPN_DEVICE_MIN_KEYS,
 )
+
+
+_STAGE_TIMING = _os.environ.get("SDOT_STAGE_TIMING", "") == "1"
 
 
 class EngineFallback(Exception):
@@ -708,6 +712,16 @@ class QueryEngine:
     def _tick(self, kind: int = 0, n: int = 1):
         self.dispatch_counts[kind] += n
 
+    def _stamp(self, key: str, t_start: float):
+        """SDOT_STAGE_TIMING=1 diagnostic: accumulate per-stage wall ms
+        into last_stats (plan/bind/device/decode splits for latency
+        work). Off by default — the device stamp forces a block at the
+        dispatch boundary, which costs overlap."""
+        if _STAGE_TIMING:
+            st = self.last_stats
+            st[key] = round(st.get(key, 0.0)
+                            + (_time.perf_counter() - t_start) * 1000, 2)
+
     # -- cancellation / timeout ----------------------------------------------
     def register_query(self, query_id: str) -> None:
         """Register a cancellable id BEFORE planning starts, so a cancel
@@ -877,9 +891,11 @@ class QueryEngine:
                 return QueryResult(names, data)
             return QueryResult.empty(names)
 
+        _tp = _time.perf_counter()
         all_dim_plans, agg_plans, min_day, max_day, n_keys, names, routes = \
             self._plan_agg(ds, seg_idx, dimensions, aggregations,
                            granularity, filter_spec, intervals)
+        self._stamp("plan_ms", _tp)
         cards = [p.card for p in all_dim_plans]
 
         if n_keys > self.config.get(GROUPBY_DENSE_MAX_KEYS):
@@ -937,17 +953,27 @@ class QueryEngine:
             finals = _finals_from_out(out, routes, n_out, sketch_plans)
             top_idx = np.asarray(out["__topk_idx__"]).astype(np.int64)
         elif n_waves == 1:
+            _tc = _time.perf_counter()
             prog_fn, unpack = self._cached_program(
                 ("agg", base_sig, topk),
                 lambda: self._build_agg_program(
                     ds, all_dim_plans, agg_plans, filter_spec, intervals,
                     min_day, max_day, n_keys, sharded, routes, topk=topk))
+            self._stamp("compile_ms", _tc)
+            _tb = _time.perf_counter()
             dev_arrays = self._bind_arrays(ds, names, seg_idx, s_pad,
                                            sharded)
+            self._stamp("bind_ms", _tb)
             if t0 is not None:
                 self._stage_check(q, t0)  # pre-dispatch boundary
             self._tick()
-            out = unpack(prog_fn(dev_arrays))
+            _td = _time.perf_counter()
+            bufs = prog_fn(dev_arrays)
+            if _STAGE_TIMING:
+                jax.block_until_ready(bufs)
+                self._stamp("device_ms", _td)
+            out = unpack(bufs)
+            self._stamp("fetch_ms", _td)
             if t0 is not None:
                 self._stage_check(q, t0)  # post-device boundary
             finals = _finals_from_out(out, routes, n_out, sketch_plans)
@@ -964,6 +990,7 @@ class QueryEngine:
                                      sketch_plans, t0)
 
         # --- decode -----------------------------------------------------------
+        _tdec = _time.perf_counter()
         rows = finals["__rows__"]
         sel = np.nonzero(rows > 0)[0]
         # a GLOBAL aggregate (no dims, no time bucketing) over zero matching
@@ -1014,6 +1041,7 @@ class QueryEngine:
                                      granularity, filter_spec, intervals,
                                      t0, no_topk=True)
 
+        self._stamp("decode_ms", _tdec)
         self.last_stats.update({
             "datasource": ds.name, "segments": int(len(seg_idx)),
             "sharded": sharded, "groups": int(len(sel)),
@@ -1201,7 +1229,11 @@ class QueryEngine:
                     self._stage_check(q, t0)
                 if compact or exch:
                     self._tick()
+                    _td = _time.perf_counter()
                     table = dict(prog(cur))         # table stays on device
+                    if _STAGE_TIMING:
+                        jax.block_until_ready(table)
+                        self._stamp("device_ms", _td)
                     nxt = bind(i + 1) if i + 1 < len(wave_segs) else None
                     stats = np.asarray(
                         table.pop("__stats__")).reshape(-1, 2)
@@ -1225,7 +1257,9 @@ class QueryEngine:
                                 agg_plans, routes, metric, ascending,
                                 k_cand, k_sel, T))
                         self._tick()
+                        _tf = _time.perf_counter()
                         raw = unpackB(gfn(table))
+                        self._stamp("fetch_ms", _tf)
                         partials.extend(
                             _hash_chip_partials(raw, routes, k_sel, n_dev))
                         continue
@@ -1237,16 +1271,24 @@ class QueryEngine:
                         lambda kg=kg: self._build_hash_gather_program(
                             agg_plans, routes, kg, T, sharded))
                     self._tick()
+                    _tf = _time.perf_counter()
                     raw = unpackB(gfn(table))
+                    self._stamp("fetch_ms", _tf)
                     partials.extend(
                         _hash_chip_partials(raw, routes, kg, n_dev))
                 else:
                     prog_fn, unpack = prog
                     self._tick()
+                    _td = _time.perf_counter()
                     buf = prog_fn(cur)              # async dispatch
+                    if _STAGE_TIMING:
+                        jax.block_until_ready(buf)
+                        self._stamp("device_ms", _td)
                     # double buffer: next wave's transfer overlaps compute
                     nxt = bind(i + 1) if i + 1 < len(wave_segs) else None
+                    _tf = _time.perf_counter()
                     raw = unpack(buf)
+                    self._stamp("fetch_ms", _tf)
                     cur = nxt
                     unresolved += int(raw.pop("__unres__").sum())
                     if unresolved:
@@ -1264,7 +1306,10 @@ class QueryEngine:
         if t0 is not None:
             self._stage_check(q, t0)
 
+        _tm = _time.perf_counter()
         keys, merged = _merge_hash_partials(partials, routes)
+        self._stamp("merge_ms", _tm)
+        _tdec = _time.perf_counter()
         data: Dict[str, np.ndarray] = {}
         columns: List[str] = []
         khi, klo = H.unpack_key(keys)
@@ -1283,6 +1328,7 @@ class QueryEngine:
 
         data = self._agg_epilogue(data, columns, post_aggregations, having,
                                   limit)
+        self._stamp("decode_ms", _tdec)
 
         if topk and tk_scores is not None \
                 and not isinstance(q, S.TopNQuerySpec):

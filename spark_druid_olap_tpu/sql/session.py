@@ -243,6 +243,13 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
         return _run_union(ctx, stmt, sql)
     t0 = _time.perf_counter()
     dc0 = list(ctx.engine.dispatch_counts)
+    _stage = __import__("os").environ.get("SDOT_STAGE_TIMING", "") == "1"
+    _marks = {}
+
+    def _mark(key, t_start):
+        if _stage:
+            _marks[key] = round(_marks.get(key, 0.0)
+                                + (_time.perf_counter() - t_start) * 1000, 2)
     offset = stmt.offset
     if offset:
         # strip the offset before planning: the engine/host paths see an
@@ -262,6 +269,7 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
             decorrelate_semijoins, inline_correlated_scalars,
             inline_subqueries)
         from spark_druid_olap_tpu.planner.viewmerge import merge_derived
+        _tr = _time.perf_counter()
         stmt2 = trace("merge_derived", stmt, merge_derived(ctx, stmt))
         stmt2 = trace("decorrelate_semijoins", stmt2,
                       decorrelate_semijoins(ctx, stmt2))
@@ -269,8 +277,13 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
                       inline_correlated_scalars(ctx, stmt2))
         stmt2 = trace("inline_subqueries", stmt2,
                       inline_subqueries(ctx, stmt2))
+        _mark("stmt_rewrite_ms", _tr)
+        _tb = _time.perf_counter()
         pq = B.build(ctx, stmt2)
+        _mark("stmt_build_ms", _tb)
+        _te = _time.perf_counter()
         df = execute_planned(ctx, pq)
+        _mark("stmt_exec_ms", _te)
         mode = "engine"
     except (PlanUnsupported, EngineFallback) as e:
         df = mode = None
@@ -300,6 +313,7 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
     dc1 = ctx.engine.dispatch_counts
     stats["n_dispatch"] = dc1[0] - dc0[0]
     stats["n_transfer"] = dc1[1] - dc0[1]
+    stats.update(_marks)
     ctx.history.record(stmt, stats, sql=sql)
     return QueryResult(list(df.columns),
                        {c: df[c].to_numpy() for c in df.columns})

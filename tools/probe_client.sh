@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# One-shot client for tools/tailprobe.py: probe_client.sh <id> <name> [reps]
+# Prints the response JSON when it lands.
+set -eu
+id="$1"; name="$2"; reps="${3:-3}"
+out="/tmp/sdot_probe_out.${id}.json"
+rm -f "$out"
+printf '{"id": %s, "name": "%s", "reps": %s}\n' "$id" "$name" "$reps" \
+  > /tmp/sdot_probe_cmd.json
+for _ in $(seq 600); do
+  [ -f "$out" ] && { sleep 0.2; cat "$out"; exit 0; }
+  sleep 1
+done
+echo "TIMEOUT waiting for $out" >&2
+exit 1

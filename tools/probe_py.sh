@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Run a python snippet inside the warmed tailprobe session.
+# Usage: probe_py.sh <id> <<'EOF' ... python code setting `result` ... EOF
+set -eu
+id="$1"
+code="$(cat)"
+out="/tmp/sdot_probe_out.${id}.json"
+rm -f "$out"
+python - "$id" "$code" <<'PYEOF'
+import json, sys
+with open("/tmp/sdot_probe_cmd.json", "w") as f:
+    json.dump({"id": int(sys.argv[1]), "py": sys.argv[2]}, f)
+PYEOF
+for _ in $(seq 600); do
+  [ -f "$out" ] && { sleep 0.3; cat "$out"; exit 0; }
+  sleep 1
+done
+echo "TIMEOUT" >&2
+exit 1

@@ -1,0 +1,109 @@
+"""Interactive TPU probe loop for tail-query latency work.
+
+Pays SF1 setup/ingest ONCE, then serves probe requests from a command
+file so per-query experiments cost seconds, not a fresh 90s ingest +
+cold-compile suite (the tunneled-chip equivalent of keeping a warmed
+thriftserver session open, ≈ scripts/start-sparklinedatathriftserver.sh).
+
+Protocol: write JSON to /tmp/sdot_probe_cmd.json:
+    {"id": 1, "name": "q21", "reps": 3}          # TPC-H query by name
+    {"id": 2, "sql": "select ...", "reps": 2}    # raw SQL
+    {"id": 3, "quit": true}
+Response lands in /tmp/sdot_probe_out.<id>.json with wall times and the
+statement's history stats (n_dispatch / n_transfer / bytes_scanned ...).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CMD = "/tmp/sdot_probe_cmd.json"
+OUT = "/tmp/sdot_probe_out.{}.json"
+
+
+def main():
+    os.environ.setdefault("SDOT_BENCH_PLATFORM", "axon")
+    import bench
+    from spark_druid_olap_tpu.tools import tpch
+
+    sf = float(os.environ.get("SDOT_BENCH_SF", "1"))
+    platform = os.environ.get("SDOT_BENCH_PLATFORM", "axon")
+    import jax
+    jax.config.update("jax_platforms", platform)
+    try:
+        cache = os.path.join(bench.cache_dir(), "xla_cache")
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:           # noqa: BLE001
+        print(f"compilation cache unavailable ({e})", flush=True)
+    print(f"backend={jax.default_backend()} devices={jax.devices()}",
+          flush=True)
+    ctx, n_rows = bench.setup(sf)
+    queries = tpch.QUERIES
+    print(f"ready: SF{sf}, {n_rows:,} rows — waiting on {CMD}", flush=True)
+    if os.path.exists(CMD):
+        os.remove(CMD)
+
+    while True:
+        if not os.path.exists(CMD):
+            time.sleep(0.5)
+            continue
+        time.sleep(0.1)              # let the writer finish
+        try:
+            with open(CMD) as f:
+                req = json.load(f)
+        except Exception as e:       # noqa: BLE001 — partial write
+            print(f"bad cmd: {e}", flush=True)
+            time.sleep(0.5)
+            continue
+        os.remove(CMD)
+        if req.get("quit"):
+            print("quit", flush=True)
+            return
+        rid = req.get("id", 0)
+        if "py" in req:
+            # diagnostic escape hatch: run a code snippet inside the warmed
+            # session (micro-bench chained dispatches, inspect plans, ...);
+            # the snippet assigns `result`
+            out = {"id": rid}
+            try:
+                import jax.numpy as jnp
+                import numpy as np
+                ns = {"ctx": ctx, "bench": bench, "np": np, "jnp": jnp,
+                      "time": time, "queries": queries}
+                exec(req["py"], ns)          # noqa: S102 — local dev tool
+                out["result"] = repr(ns.get("result"))
+            except Exception as e:           # noqa: BLE001
+                import traceback
+                out["error"] = traceback.format_exc(limit=8)
+            with open(OUT.format(rid), "w") as f:
+                json.dump(out, f, indent=1)
+            print(f"served py id={rid}", flush=True)
+            continue
+        sql = req.get("sql") or queries[req["name"]]
+        reps = int(req.get("reps", 1))
+        out = {"id": rid, "walls_ms": [], "stats": None}
+        try:
+            for _ in range(max(reps, 1)):
+                t0 = time.perf_counter()
+                r = ctx.sql(sql)
+                out["walls_ms"].append(
+                    round((time.perf_counter() - t0) * 1000, 1))
+            st = dict(ctx.history.entries()[-1].stats)
+            out["stats"] = {k: v for k, v in st.items()
+                            if isinstance(v, (int, float, str, bool))}
+            out["n_rows_out"] = len(r)
+        except Exception as e:       # noqa: BLE001 — report, keep serving
+            out["error"] = f"{type(e).__name__}: {e}"
+        with open(OUT.format(rid), "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"served id={rid}: {out['walls_ms']}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
