@@ -65,9 +65,23 @@ class StrValue:
     host_values: np.ndarray       # object array: code -> string
 
 
+def take1d(table, idx):
+    """Gather ``table[idx]`` with the index array flattened to 1D.
+
+    XLA TPU lowers a gather whose indices carry the scan programs' 2D
+    (8,128)-tiled layout into a serialized while loop (~60ms per 6M rows
+    on v5e, measured); the same gather over a 1D T(1024) layout compiles
+    to a fast vectorized path (~free for small LUTs, ~10ms for
+    multi-MB tables). EVERY in-program gather must go through here."""
+    tdev = jnp.asarray(table)
+    shape = jnp.shape(idx)
+    flat = jnp.take(tdev, idx.reshape(-1), axis=0)
+    return flat.reshape(shape + tdev.shape[1:])
+
+
 def _take_mask(mask: np.ndarray, codes):
     """Gather a per-code host mask by device codes."""
-    return jnp.take(jnp.asarray(mask), codes, axis=0)
+    return take1d(np.asarray(mask), codes)
 
 
 # digest -> (k0, k_last, dense_values) for near-dense keyed tables; the
@@ -107,7 +121,7 @@ def _dense_lookup_table(tab, default, probe_dtype):
 
 
 def _take_lut(lut: np.ndarray, codes):
-    return jnp.take(jnp.asarray(lut), codes, axis=0)
+    return take1d(np.asarray(lut), codes)
 
 
 def like_to_regex(pattern: str) -> str:
@@ -214,8 +228,12 @@ def compile_expr(e: E.Expr, ctx: ScanContext):
         k2 = jnp.asarray(tab.keys2.astype(
             np.int64 if wide else np.int32))
         vdev = jnp.asarray(tab.values)
-        a = n1.arr.astype(kdt)
-        b = n2.arr.astype(kdt)
+        shape = jnp.shape(n1.arr)
+        # the search runs over FLATTENED probes: per-round table gathers
+        # with 2D-tiled indices hit XLA TPU's serialized-gather lowering
+        # (see take1d) — in 1D each round is a cheap vectorized gather
+        a = n1.arr.astype(kdt).reshape(-1)
+        b = n2.arr.astype(kdt).reshape(-1)
         n = len(tab)
         lo = jnp.zeros_like(a)
         hi = jnp.full_like(a, n)
@@ -234,12 +252,13 @@ def compile_expr(e: E.Expr, ctx: ScanContext):
 
         lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
         idx = jnp.clip(lo, 0, n - 1)
-        found = (k1[idx] == a) & (k2[idx] == b)
+        found = ((k1[idx] == a) & (k2[idx] == b)).reshape(shape)
         for key_col in (e.key1, e.key2):
             nv = ctx.null_valid(key_col.name)
             if nv is not None:
                 found = found & nv     # NULL key: empty set -> miss
-        return NumValue(jnp.where(found, vdev[idx], miss), True)
+        return NumValue(jnp.where(found, vdev[idx].reshape(shape), miss),
+                        True)
     if isinstance(e, E.KeyedLookup):
         # broadcast-join gather: binary search the sorted key array, take
         # the value; misses read ``default`` (NaN = SQL NULL: comparisons
@@ -273,8 +292,8 @@ def compile_expr(e: E.Expr, ctx: ScanContext):
             idx = jnp.clip(arr - k0, 0, dvals.shape[0] - 1)
             if idx.dtype == jnp.int64:
                 idx = idx.astype(jnp.int32)   # span bounded; i32 gather
-            vdev = jnp.asarray(dvals)
-            return NumValue(jnp.where(in_range, vdev[idx], miss), True)
+            return NumValue(jnp.where(in_range, take1d(dvals, idx), miss),
+                            True)
         keys = tab.keys
         if n.arr.dtype == jnp.int64:
             kdev = jnp.asarray(keys)
@@ -285,15 +304,18 @@ def compile_expr(e: E.Expr, ctx: ScanContext):
             kdev = jnp.asarray(keys.astype(np.int32))
             arr = n.arr.astype(jnp.int32)
         vdev = jnp.asarray(tab.values)        # f32 off-x64, f64 on x64
-        idx = jnp.clip(jnp.searchsorted(kdev, arr), 0, len(keys) - 1)
-        found = kdev[idx] == arr
+        shape = jnp.shape(arr)
+        flat = arr.reshape(-1)                # 1D search/gathers: take1d
+        idx = jnp.clip(jnp.searchsorted(kdev, flat), 0, len(keys) - 1)
+        found = (kdev[idx] == flat).reshape(shape)
         nv = ctx.null_valid(e.key.name)
         if nv is not None:
             # NULL key: 'inner.k = NULL' matches nothing, so the subquery
             # aggregates the EMPTY set -> miss value (and never key 0's
             # group, which the zero-filled storage would otherwise read)
             found = found & nv
-        return NumValue(jnp.where(found, vdev[idx], miss), True)
+        return NumValue(jnp.where(found, vdev[idx].reshape(shape), miss),
+                        True)
     if isinstance(e, E.Between):
         v = compile_expr(e.child, ctx)
         lo = _comparison(">=", v, compile_expr(e.low, ctx), ctx)
@@ -482,19 +504,20 @@ def int_set_membership(arr, vals: np.ndarray):
         np.bitwise_or.at(
             words, off_np >> 5,
             np.left_shift(np.uint32(1), (off_np & 31).astype(np.uint32)))
-        wdev = jnp.asarray(words)
         inrange = (arr >= lo_v) & (arr <= hi_v)
         # out-of-range rows may wrap in the subtraction; where() masks
         # them to offset 0 before the gather
         off = jnp.where(inrange, arr - jnp.asarray(lo_v, arr.dtype),
                         0).astype(jnp.int32)
-        bit = (wdev[off >> 5] >> (off & 31).astype(jnp.uint32)) \
+        bit = (take1d(words, off >> 5) >> (off & 31).astype(jnp.uint32)) \
             & jnp.uint32(1)
         return inrange & (bit == jnp.uint32(1))
     dev = jnp.asarray(vals.astype(
         np.int64 if arr.dtype == jnp.int64 else np.int32))
-    idx = jnp.clip(jnp.searchsorted(dev, arr), 0, len(vals) - 1)
-    return dev[idx] == arr
+    shape = jnp.shape(arr)
+    flat = arr.reshape(-1)                    # 1D search/gather: take1d
+    idx = jnp.clip(jnp.searchsorted(dev, flat), 0, len(vals) - 1)
+    return (dev[idx] == flat).reshape(shape)
 
 
 def _in_list(v, values, ctx):
@@ -664,7 +687,7 @@ _MONTH_OFFSETS = np.array([0, 31, 59, 90, 120, 151, 181, 212, 243, 273, 304,
 def _month_start(y, m):
     """days-since-epoch of (y, m, 1), vectorized."""
     jan1 = time_ops.days_of_jan1(y)
-    off = jnp.take(jnp.asarray(_MONTH_OFFSETS), m - 1)
+    off = take1d(_MONTH_OFFSETS, m - 1)
     leap = ((jnp.mod(y, 4) == 0) & (jnp.mod(y, 100) != 0)) | (jnp.mod(y, 400) == 0)
     return jan1 + off + (leap & (m > 2)).astype(jnp.int32)
 

@@ -68,8 +68,9 @@ def local_naive_to_utc_millis(tz_id: str, naive_ms: int) -> int:
 def shift_days_ms(days, ms_in_day, lut: np.ndarray, base_day: int):
     """Traced: UTC (days, ms_in_day) -> LOCAL (days, ms_in_day)."""
     import jax.numpy as jnp
+    from spark_druid_olap_tpu.ops.expr_compile import take1d
     idx = jnp.clip(days - jnp.int32(base_day), 0, len(lut) - 1)
-    off = jnp.asarray(lut)[idx]
+    off = take1d(lut, idx)
     tot = ms_in_day + off
     dsh = jnp.floor_divide(tot, MILLIS_PER_DAY)
     return days + dsh, tot - dsh * jnp.int32(MILLIS_PER_DAY)

@@ -74,6 +74,14 @@ from spark_druid_olap_tpu.utils.config import (
 
 
 _STAGE_TIMING = _os.environ.get("SDOT_STAGE_TIMING", "") == "1"
+# SDOT_PROFILE_DISPATCH=N: amortized true-device-time measurement — the
+# dispatch sites re-run the compiled program N extra times back-to-back
+# and record (sync-to-sync time)/N as last_stats['profile_device_ms'],
+# factoring out the tunnel RTT jitter a single dispatch+sync includes
+try:
+    _PROFILE_N = int(_os.environ.get("SDOT_PROFILE_DISPATCH", "0"))
+except ValueError:
+    _PROFILE_N = 0
 
 
 class EngineFallback(Exception):
@@ -712,6 +720,21 @@ class QueryEngine:
     def _tick(self, kind: int = 0, n: int = 1):
         self.dispatch_counts[kind] += n
 
+    def _profile_dispatch(self, fn, args):
+        """See _PROFILE_N: amortized device time of one compiled program."""
+        if not _PROFILE_N:
+            return
+        jax.block_until_ready(fn(args))
+        t0 = _time.perf_counter()
+        r = None
+        for _ in range(_PROFILE_N):
+            r = fn(args)
+        jax.block_until_ready(r)
+        st = self.last_stats
+        st["profile_device_ms"] = round(
+            st.get("profile_device_ms", 0.0)
+            + (_time.perf_counter() - t0) / _PROFILE_N * 1000, 2)
+
     def _stamp(self, key: str, t_start: float):
         """SDOT_STAGE_TIMING=1 diagnostic: accumulate per-stage wall ms
         into last_stats (plan/bind/device/decode splits for latency
@@ -967,6 +990,7 @@ class QueryEngine:
             if t0 is not None:
                 self._stage_check(q, t0)  # pre-dispatch boundary
             self._tick()
+            self._profile_dispatch(prog_fn, dev_arrays)
             _td = _time.perf_counter()
             bufs = prog_fn(dev_arrays)
             if _STAGE_TIMING:
@@ -1229,6 +1253,7 @@ class QueryEngine:
                     self._stage_check(q, t0)
                 if compact or exch:
                     self._tick()
+                    self._profile_dispatch(lambda a: dict(prog(a)), cur)
                     _td = _time.perf_counter()
                     table = dict(prog(cur))         # table stays on device
                     if _STAGE_TIMING:
@@ -1279,6 +1304,7 @@ class QueryEngine:
                 else:
                     prog_fn, unpack = prog
                     self._tick()
+                    self._profile_dispatch(prog_fn, cur)
                     _td = _time.perf_counter()
                     buf = prog_fn(cur)              # async dispatch
                     if _STAGE_TIMING:
