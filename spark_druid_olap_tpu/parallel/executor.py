@@ -42,6 +42,7 @@ from spark_druid_olap_tpu.ops import theta as TH
 from spark_druid_olap_tpu.ops import time_ops as T
 from spark_druid_olap_tpu.ops import timezone as TZ
 from spark_druid_olap_tpu.ops.scan import (
+    CompactScanContext,
     ScanContext,
     array_names,
     build_array,
@@ -61,6 +62,8 @@ from spark_druid_olap_tpu.utils.config import (
     BACKEND_RETRY_SECONDS,
     DEVICE_CACHE_BYTES,
     GROUPBY_DENSE_MAX_KEYS,
+    SCAN_COMPACT,
+    SCAN_COMPACT_MIN_ROWS,
     GROUPBY_HASH_COMPACT_MIN,
     GROUPBY_HASH_MAX_SLOTS,
     GROUPBY_HASH_SLOTS,
@@ -976,30 +979,42 @@ class QueryEngine:
             finals = _finals_from_out(out, routes, n_out, sketch_plans)
             top_idx = np.asarray(out["__topk_idx__"]).astype(np.int64)
         elif n_waves == 1:
-            _tc = _time.perf_counter()
-            prog_fn, unpack = self._cached_program(
-                ("agg", base_sig, topk),
-                lambda: self._build_agg_program(
-                    ds, all_dim_plans, agg_plans, filter_spec, intervals,
-                    min_day, max_day, n_keys, sharded, routes, topk=topk))
-            self._stamp("compile_ms", _tc)
-            _tb = _time.perf_counter()
-            dev_arrays = self._bind_arrays(ds, names, seg_idx, s_pad,
-                                           sharded)
-            self._stamp("bind_ms", _tb)
-            if t0 is not None:
-                self._stage_check(q, t0)  # pre-dispatch boundary
-            self._tick()
-            self._profile_dispatch(prog_fn, dev_arrays)
-            _td = _time.perf_counter()
-            bufs = prog_fn(dev_arrays)
-            if _STAGE_TIMING:
-                jax.block_until_ready(bufs)
-                self._stamp("device_ms", _td)
-            out = unpack(bufs)
-            self._stamp("fetch_ms", _td)
-            if t0 is not None:
-                self._stage_check(q, t0)  # post-device boundary
+            compact_m = self._plan_compact_m(ds, seg_idx, filter_spec,
+                                             sharded)
+            for cm in ((compact_m, None) if compact_m else (None,)):
+                _tc = _time.perf_counter()
+                prog_fn, unpack = self._cached_program(
+                    ("agg", base_sig, topk, cm),
+                    lambda cm=cm: self._build_agg_program(
+                        ds, all_dim_plans, agg_plans, filter_spec,
+                        intervals, min_day, max_day, n_keys, sharded,
+                        routes, topk=topk, compact_m=cm))
+                self._stamp("compile_ms", _tc)
+                _tb = _time.perf_counter()
+                dev_arrays = self._bind_arrays(ds, names, seg_idx, s_pad,
+                                               sharded)
+                self._stamp("bind_ms", _tb)
+                if t0 is not None:
+                    self._stage_check(q, t0)  # pre-dispatch boundary
+                self._tick()
+                self._profile_dispatch(prog_fn, dev_arrays)
+                _td = _time.perf_counter()
+                bufs = prog_fn(dev_arrays)
+                if _STAGE_TIMING:
+                    jax.block_until_ready(bufs)
+                    self._stamp("device_ms", _td)
+                out = unpack(bufs)
+                self._stamp("fetch_ms", _td)
+                if t0 is not None:
+                    self._stage_check(q, t0)  # post-device boundary
+                over = out.pop("__over__", None)
+                if over is None or int(np.asarray(over).reshape(-1)[0]) == 0:
+                    if cm:
+                        self.last_stats["compact_m"] = int(cm)
+                    break
+                # est. selectivity too optimistic: retry uncompacted
+                self.last_stats["compact_overflow"] = \
+                    int(np.asarray(over).reshape(-1)[0])
             finals = _finals_from_out(out, routes, n_out, sketch_plans)
             if topk:
                 top_idx = np.asarray(out["__topk_idx__"]).astype(np.int64)
@@ -1075,6 +1090,27 @@ class QueryEngine:
             "topk_device": int(topk[1]) if topk else 0,
             "having_device": int(n_out) if having_dev else 0})
         return QueryResult(columns, data)
+
+    def _plan_compact_m(self, ds, seg_idx, filter_spec, sharded):
+        """Static survivor budget for late materialization (None = don't
+        compact). Uses the cost model's filter-selectivity estimate with
+        a 4x safety margin; a wrong estimate is caught by the program's
+        '__over__' output and retried uncompacted. Single-chip only for
+        now (per-shard budgets need per-shard overflow plumbing)."""
+        if sharded or filter_spec is None:
+            return None
+        if not self.config.get(SCAN_COMPACT):
+            return None
+        rows = int(sum(ds.segments[int(si)].num_rows for si in seg_idx))
+        if rows < int(self.config.get(SCAN_COMPACT_MIN_ROWS)):
+            return None                  # small scans: the sort wins nothing
+        sel = C._filter_selectivity(filter_spec, ds)
+        est = rows * sel * 4.0           # safety margin before retry
+        m = 1 << max(6, int(np.ceil(np.log2(max(est, 1.0)))))
+        m = max(m, 1 << 15) if rows >= (1 << 21) else m
+        if m > rows // 8:
+            return None
+        return int(m)
 
     def _plan_device_topk(self, limit, having, agg_plans, n_keys):
         """Decide whether the ordered-limit epilogue can run on device:
@@ -1799,7 +1835,8 @@ class QueryEngine:
         return fn, arrays
 
     def _make_core(self, ds, dim_plans, agg_plans, filter_spec,
-                   intervals, min_day, max_day, n_keys, routes):
+                   intervals, min_day, max_day, n_keys, routes,
+                   compact_m=None):
         matmul_max = self.config.get(GROUPBY_MATMUL_MAX_KEYS)
         log2m = self.config.get(HLL_LOG2M)
         hll_plans = [p for p in agg_plans if p.kind == "hll"]
@@ -1817,6 +1854,25 @@ class QueryEngine:
             im = F.interval_mask(intervals, ctx)
             if im is not None:
                 base = base & im
+            n_over = None
+            if compact_m:
+                # late materialization: survivors sort to a static [M]
+                # prefix; group keys / values / aggregation all run at
+                # O(M). Overflow (est. selectivity too optimistic)
+                # surfaces as '__over__' and the host retries without
+                # compaction. A 2-operand sort is ~0.2ms/M rows on v5e
+                # — far below one 6M-row scatter (~40ms).
+                flat = base.reshape(-1)
+                ridx = jnp.arange(flat.shape[0], dtype=jnp.int32)
+                okey = jnp.where(flat, jnp.int32(0), jnp.int32(1))
+                _, sidx = jax.lax.sort((okey, ridx), num_keys=1)
+                keep = jax.lax.slice_in_dim(sidx, 0, compact_m)
+                n_live = jnp.sum(flat.astype(jnp.int32))
+                n_over = jnp.maximum(
+                    n_live - jnp.int32(compact_m), 0).astype(jnp.int32)
+                ctx = CompactScanContext(ds, arrays, min_day, max_day,
+                                         self.config.get(TZ_ID), keep=keep)
+                base = flat[keep]
             if dim_plans:
                 codes = [p.build(ctx) for p in dim_plans]
                 key, _ = G.fuse_keys(codes, [p.card for p in dim_plans])
@@ -1843,13 +1899,15 @@ class QueryEngine:
                 am = p.build_mask(ctx)
                 m = base if am is None else (base & am)
                 out[p.spec.name] = TH.theta_registers(key, m, vals, n_keys)
+            if n_over is not None:
+                out["__over__"] = n_over.reshape(1)
             return out
 
         return core
 
     def _build_agg_program(self, ds, dim_plans, agg_plans, filter_spec,
                            intervals, min_day, max_day, n_keys, sharded,
-                           routes, topk=None):
+                           routes, topk=None, compact_m=None):
         """Returns (jit_fn, unpack).
 
         The program packs outputs into TWO flat device buffers so the host
@@ -1872,12 +1930,14 @@ class QueryEngine:
         topN threshold).
         """
         core = self._make_core(ds, dim_plans, agg_plans, filter_spec,
-                               intervals, min_day, max_day, n_keys, routes)
+                               intervals, min_day, max_day, n_keys, routes,
+                               compact_m=compact_m)
         hll_plans = [p for p in agg_plans if p.kind == "hll"]
         theta_plans = [p for p in agg_plans if p.kind == "theta"]
         pack, unpack = self._agg_meta_packers(
             agg_plans, routes, topk[1] if topk else n_keys,
-            with_idx=bool(topk), with_score=bool(topk))
+            with_idx=bool(topk), with_score=bool(topk),
+            with_over=bool(compact_m))
 
         def topk_gather(out, axis_name=None):
             """Select k_sel candidate keys by score, gather every output."""
@@ -1897,7 +1957,10 @@ class QueryEngine:
             def plain(arrays):
                 out = core(arrays)
                 if topk:
+                    over = out.pop("__over__", None)
                     out = topk_gather(out)
+                    if over is not None:
+                        out["__over__"] = over
                 return pack(out)
 
             fn = jax.jit(plain)
@@ -2093,7 +2156,7 @@ class QueryEngine:
         return jax.jit(lambda table: smfn(table)), unpack
 
     def _agg_meta_packers(self, agg_plans, routes, n_out, with_idx,
-                          with_score=False):
+                          with_score=False, with_over=False):
         """(pack, unpack) for the dense path's TWO-buffer transfer:
         collective-merged outputs in one replicated buffer, per-chip
         ff/lanes partial pairs in one segment-sharded buffer. ``n_out``
@@ -2123,6 +2186,8 @@ class QueryEngine:
         if with_score:
             meta.append(("__topk_score__", n_out, "f64" if x64 else "f32",
                          True))
+        if with_over:
+            meta.append(("__over__", 1, "i32", True))
         merged_meta = [t for t in meta if t[3]]
         perchip_meta = [t for t in meta if not t[3]]
         buf_dtype = jnp.int64 if x64 else jnp.int32

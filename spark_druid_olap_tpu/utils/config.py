@@ -126,6 +126,16 @@ COST_SHARD_EFFICIENCY = _entry(
 SEGMENT_ROWS = _entry(
     "sdot.segment.target.rows", 1 << 20,
     "Target rows per time-sharded segment at ingest.")
+SCAN_COMPACT = _entry(
+    "sdot.engine.scan.compact", True,
+    "Late materialization: when the filter-selectivity estimate says few "
+    "rows survive, sort survivors to a static prefix and run group-key "
+    "building, value derivation, and aggregation at O(survivors) instead "
+    "of O(rows). Overflow of the estimated budget retries uncompacted.")
+SCAN_COMPACT_MIN_ROWS = _entry(
+    "sdot.engine.scan.compact.min.rows", 1 << 21,
+    "Scans below this many rows never compact (the sort pass wins "
+    "nothing at small scale).")
 GROUPBY_PALLAS_MAX_KEYS = _entry(
     "sdot.engine.groupby.pallas.max.keys", 64,
     "Dense group-by uses the fused single-pass Pallas TPU kernel when the "
