@@ -269,18 +269,41 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
             decorrelate_semijoins, inline_correlated_scalars,
             inline_subqueries)
         from spark_druid_olap_tpu.planner.viewmerge import merge_derived
-        _tr = _time.perf_counter()
-        stmt2 = trace("merge_derived", stmt, merge_derived(ctx, stmt))
-        stmt2 = trace("decorrelate_semijoins", stmt2,
-                      decorrelate_semijoins(ctx, stmt2))
-        stmt2 = trace("inline_correlated_scalars", stmt2,
-                      inline_correlated_scalars(ctx, stmt2))
-        stmt2 = trace("inline_subqueries", stmt2,
-                      inline_subqueries(ctx, stmt2))
-        _mark("stmt_rewrite_ms", _tr)
-        _tb = _time.perf_counter()
-        pq = B.build(ctx, stmt2)
-        _mark("stmt_build_ms", _tb)
+        # statement plan cache: the rewrite passes (subquery-inlining
+        # AST transforms) and the pushdown build cost ~100-200ms of
+        # host CPU per statement on deep trees (TPC-H q21-class); the
+        # result is deterministic given (store version, config), both
+        # folded into the key by result_cache. Inlined subquery RESULTS
+        # embedded in the plan stay valid under the same key.
+        _pcache, _pkey = host_exec.result_cache(ctx, "plan", stmt)
+        pq = _pcache.get(_pkey)
+        plan_cached = pq is not None
+        if plan_cached:
+            _pcache.move_to_end(_pkey)
+            if isinstance(pq, tuple) and pq[0] == "unsupported":
+                # negative entry: the builder deterministically rejects
+                # this statement under the current store/config — skip
+                # straight to the composite/host tiers
+                raise PlanUnsupported(pq[1])
+        else:
+            _tr = _time.perf_counter()
+            stmt2 = trace("merge_derived", stmt, merge_derived(ctx, stmt))
+            stmt2 = trace("decorrelate_semijoins", stmt2,
+                          decorrelate_semijoins(ctx, stmt2))
+            stmt2 = trace("inline_correlated_scalars", stmt2,
+                          inline_correlated_scalars(ctx, stmt2))
+            stmt2 = trace("inline_subqueries", stmt2,
+                          inline_subqueries(ctx, stmt2))
+            _mark("stmt_rewrite_ms", _tr)
+            _tb = _time.perf_counter()
+            try:
+                pq = B.build(ctx, stmt2)
+            except PlanUnsupported as pe:
+                host_exec.result_cache_put(_pcache, _pkey,
+                                           ("unsupported", str(pe)))
+                raise
+            _mark("stmt_build_ms", _tb)
+            host_exec.result_cache_put(_pcache, _pkey, pq)
         _te = _time.perf_counter()
         df = execute_planned(ctx, pq)
         _mark("stmt_exec_ms", _te)
@@ -313,6 +336,8 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
     dc1 = ctx.engine.dispatch_counts
     stats["n_dispatch"] = dc1[0] - dc0[0]
     stats["n_transfer"] = dc1[1] - dc0[1]
+    if plan_cached:
+        stats["plan_cached"] = True
     stats.update(_marks)
     ctx.history.record(stmt, stats, sql=sql)
     return QueryResult(list(df.columns),

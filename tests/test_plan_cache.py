@@ -1,0 +1,62 @@
+"""Statement plan cache: warm statements skip the rewrite/build passes;
+ingest and config changes invalidate (key folds store.version + config
+fingerprint — same contract as the subquery result caches)."""
+
+import numpy as np
+import pandas as pd
+
+import spark_druid_olap_tpu as sdot
+
+
+def _ctx():
+    c = sdot.Context()
+    rng = np.random.default_rng(3)
+    df = pd.DataFrame({
+        "ts": pd.Timestamp("2021-01-01")
+        + pd.to_timedelta(rng.integers(0, 30, 800), unit="D"),
+        "region": rng.choice(["a", "b", "c"], 800),
+        "qty": rng.integers(0, 50, 800),
+    })
+    c.ingest_dataframe("sales", df, time_column="ts", target_rows=512)
+    return c
+
+
+Q = "select region, sum(qty) as s from sales group by region order by region"
+
+
+def test_warm_statement_hits_plan_cache():
+    c = _ctx()
+    c.sql(Q)
+    assert not c.history.entries()[-1].stats.get("plan_cached")
+    r = c.sql(Q)
+    st = c.history.entries()[-1].stats
+    assert st.get("plan_cached") is True
+    assert st["mode"] == "engine"
+    assert len(r) == 3
+
+
+def test_ingest_invalidates_plan_cache():
+    c = _ctx()
+    base = c.sql(Q).to_pandas()
+    c.sql(Q)                                   # warm the plan cache
+    assert c.history.entries()[-1].stats.get("plan_cached") is True
+    df2 = pd.DataFrame({
+        "ts": [pd.Timestamp("2021-02-15")] * 5,
+        "region": ["a"] * 5,
+        "qty": [100] * 5,
+    })
+    c.ingest_dataframe("extra", df2, time_column="ts", target_rows=512)
+    r = c.sql(Q)                               # store.version bumped
+    st = c.history.entries()[-1].stats
+    assert not st.get("plan_cached")
+    pd.testing.assert_frame_equal(r.to_pandas(), base, check_dtype=False)
+
+
+def test_config_change_invalidates_plan_cache():
+    c = _ctx()
+    c.sql(Q)
+    c.sql(Q)
+    assert c.history.entries()[-1].stats.get("plan_cached") is True
+    c.config.set("sdot.timezone", "America/New_York")
+    c.sql(Q)
+    assert not c.history.entries()[-1].stats.get("plan_cached")
