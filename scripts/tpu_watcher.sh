@@ -29,9 +29,12 @@ EOF
 
 run_bench() {
   local tag="$1"
+  local suite="${BENCH_SUITE:-tpch}"
+  [ "$suite" != "tpch" ] && tag="${suite}_${tag}"
   local out="/tmp/bench_${tag}.json" log="/tmp/bench_${tag}.log"
-  echo "[watcher] $(date -u +%FT%TZ) chip up — running bench tag=${tag}"
-  SDOT_BENCH_PLATFORM=axon SDOT_BENCH_TIME_BUDGET="${BENCH_TIME_BUDGET:-3000}" \
+  echo "[watcher] $(date -u +%FT%TZ) chip up — running bench tag=${tag} suite=${suite}"
+  SDOT_BENCH_PLATFORM=axon SDOT_BENCH_SUITE="$suite" \
+    SDOT_BENCH_TIME_BUDGET="${BENCH_TIME_BUDGET:-3000}" \
     timeout 5400 python bench.py >"$out" 2>"$log"
   local rc=$?
   echo "[watcher] bench rc=$rc"
@@ -60,6 +63,9 @@ while true; do
       sleep "$PROBE_INTERVAL"
       continue
     fi
+    # SSB snapshot rides the same window (13 queries, much quicker)
+    BENCH_SUITE=ssb run_bench "r03_$(date -u +%H%M)" \
+      || echo "[watcher] ssb bench failed (tpch snapshot already saved)"
     # After a successful run, only re-bench when explicitly requested.
     while [ ! -e "$RERUN_FLAG" ]; do sleep 60; done
     rm -f "$RERUN_FLAG"
