@@ -979,7 +979,11 @@ class QueryEngine:
             finals = _finals_from_out(out, routes, n_out, sketch_plans)
             top_idx = np.asarray(out["__topk_idx__"]).astype(np.int64)
         elif n_waves == 1:
-            compact_m = self._plan_compact_m(ds, seg_idx, filter_spec,
+            # budget from the CHEAP conjuncts only: staged gather-heavy
+            # conjuncts apply after compaction and don't shrink what the
+            # prefix must hold
+            cheap_f0, _ = self._split_filter_staged(filter_spec)
+            compact_m = self._plan_compact_m(ds, seg_idx, cheap_f0,
                                              sharded)
             for cm in ((compact_m, None) if compact_m else (None,)):
                 _tc = _time.perf_counter()
@@ -1090,6 +1094,57 @@ class QueryEngine:
             "topk_device": int(topk[1]) if topk else 0,
             "having_device": int(n_out) if having_dev else 0})
         return QueryResult(columns, data)
+
+    @staticmethod
+    def _split_filter_staged(f):
+        """(cheap, expensive) for staged filter evaluation under
+        compaction: top-level AND conjuncts whose lowering must GATHER
+        (large frozen-int membership, keyed-lookup expressions — the
+        decorrelated-EXISTS machinery) evaluate after compaction, on the
+        survivors of the cheap conjuncts only. A 6M-probe gather costs
+        ~40ms on v5e; post-compaction it costs ~M/6M of that."""
+        def expr_has_gather(e):
+            found = [False]
+
+            def visit(n):
+                if isinstance(n, (E.KeyedLookup, E.KeyedLookup2)):
+                    found[0] = True
+                if isinstance(n, E.InList) \
+                        and isinstance(n.values, E.FrozenIntSet) \
+                        and len(n.values.array) > 2 * EC._CHAIN_MAX_RANGES:
+                    found[0] = True
+                return n
+            E.transform(e, visit)
+            return found[0]
+
+        def is_expensive(x):
+            if isinstance(x, S.InFilter) \
+                    and isinstance(x.values, E.FrozenIntSet) \
+                    and len(x.values.array) > 2 * EC._CHAIN_MAX_RANGES:
+                return True
+            if isinstance(x, S.ExprFilter):
+                return expr_has_gather(x.expr)
+            if isinstance(x, S.LogicalFilter) and x.op == "not":
+                return is_expensive(x.fields[0])
+            return False
+
+        if f is None:
+            return None, None
+        conj = list(f.fields) if isinstance(f, S.LogicalFilter) \
+            and f.op == "and" else [f]
+        cheap = [x for x in conj if not is_expensive(x)]
+        exp = [x for x in conj if is_expensive(x)]
+        if not exp:
+            return f, None
+
+        def rejoin(parts):
+            if not parts:
+                return None
+            if len(parts) == 1:
+                return parts[0]
+            return S.LogicalFilter("and", tuple(parts))
+
+        return rejoin(cheap), rejoin(exp)
 
     def _plan_compact_m(self, ds, seg_idx, filter_spec, sharded):
         """Static survivor budget for late materialization (None = don't
@@ -1844,11 +1899,14 @@ class QueryEngine:
         dense_plans = [p for p in agg_plans
                        if p.kind not in ("hll", "theta")]
 
+        cheap_f, exp_f = (self._split_filter_staged(filter_spec)
+                          if compact_m else (filter_spec, None))
+
         def core(arrays):
             ctx = ScanContext(ds, arrays, min_day, max_day,
                               tz=self.config.get(TZ_ID))
             base = ctx.row_valid()
-            fm = F.lower_filter(filter_spec, ctx)
+            fm = F.lower_filter(cheap_f, ctx)
             if fm is not None:
                 base = base & fm
             im = F.interval_mask(intervals, ctx)
@@ -1873,6 +1931,12 @@ class QueryEngine:
                 ctx = CompactScanContext(ds, arrays, min_day, max_day,
                                          self.config.get(TZ_ID), keep=keep)
                 base = flat[keep]
+                if exp_f is not None:
+                    # staged: gather-heavy conjuncts (membership sets,
+                    # keyed lookups) evaluate on the survivors only
+                    em = F.lower_filter(exp_f, ctx)
+                    if em is not None:
+                        base = base & em
             if dim_plans:
                 codes = [p.build(ctx) for p in dim_plans]
                 key, _ = G.fuse_keys(codes, [p.card for p in dim_plans])

@@ -89,3 +89,18 @@ def test_overflow_retries_uncompacted(monkeypatch):
     pd.testing.assert_frame_equal(got.to_pandas(), ref.to_pandas(),
                                   check_dtype=False)
     assert st.get("compact_overflow", 0) > 0
+
+
+def test_staged_expensive_membership_matches():
+    """A large integer IN-set (gather-lowered membership) is staged
+    after compaction; results must match the uncompacted engine."""
+    import numpy as np
+    rng = np.random.default_rng(11)
+    keys = sorted(rng.choice(5000, 60, replace=False).tolist())
+    inlist = ", ".join(str(k) for k in keys)
+    sql = (f"select region, count(*) as n, sum(qty) as s from sales "
+           f"where sku = 'sku007' and qty * 100 + 1 in ({inlist}) "
+           f"group by region order by region")
+    a = _ctx(True).sql(sql).to_pandas()
+    b = _ctx(False).sql(sql).to_pandas()
+    pd.testing.assert_frame_equal(a, b, check_dtype=False)
