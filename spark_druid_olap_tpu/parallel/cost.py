@@ -60,6 +60,9 @@ def _filter_selectivity(f: Optional[S.FilterSpec], ds) -> float:
         card = ds.cardinality(f.dimension) or 100
         return 1.0 / max(card, 1)
     if isinstance(f, S.BoundFilter):
+        frac = _bound_overlap_fraction(f, ds)
+        if frac is not None:
+            return frac
         both = f.lower is not None and f.upper is not None
         return 0.25 if both else 0.5
     if isinstance(f, S.InFilter):
@@ -80,6 +83,46 @@ def _filter_selectivity(f: Optional[S.FilterSpec], ds) -> float:
             return min(1.0, sum(sels))
         return max(0.0, 1.0 - (sels[0] if sels else 0.0))
     return 0.5  # ExprFilter: unknown
+
+
+def _bound_overlap_fraction(f: S.BoundFilter, ds) -> Optional[float]:
+    """Range-overlap selectivity from column min/max metadata (DATE /
+    LONG / DOUBLE metrics): |bound ∩ [min, max]| / |[min, max]|, assuming
+    uniform density. Far better than the blanket 0.25 for the BI-typical
+    date-quarter predicates (TPC-H q10-class: a 3-month window over 7
+    years is ~0.036, not 0.25) — and the late-materialization budget
+    depends on it."""
+    from spark_druid_olap_tpu.ops import time_ops
+    from spark_druid_olap_tpu.segment.column import ColumnKind
+    try:
+        kind = ds.column_kind(f.dimension)
+    except KeyError:
+        return None
+    if kind not in (ColumnKind.DATE, ColumnKind.LONG, ColumnKind.DOUBLE):
+        return None
+    m = ds.metrics.get(f.dimension)
+    if m is None or m.min is None or m.max is None:
+        return None
+    lo_col, hi_col = float(m.min), float(m.max)
+    if hi_col <= lo_col:
+        return None
+
+    def conv(v):
+        if v is None:
+            return None
+        if kind == ColumnKind.DATE:
+            return float(time_ops.date_literal_to_days(v))
+        return float(v)
+
+    try:
+        lo = conv(f.lower)
+        hi = conv(f.upper)
+    except (TypeError, ValueError):
+        return None
+    lo = lo_col if lo is None else max(lo, lo_col)
+    hi = (hi_col + 1.0) if hi is None else min(hi, hi_col + 1.0)
+    width = hi_col + 1.0 - lo_col
+    return max(0.0, min(1.0, (hi - lo) / width))
 
 
 def _output_groups(q: S.QuerySpec, ds) -> int:

@@ -984,7 +984,7 @@ class QueryEngine:
             # prefix must hold
             cheap_f0, _ = self._split_filter_staged(filter_spec)
             compact_m = self._plan_compact_m(ds, seg_idx, cheap_f0,
-                                             sharded)
+                                             sharded, n_keys=n_keys)
             for cm in ((compact_m, None) if compact_m else (None,)):
                 _tc = _time.perf_counter()
                 prog_fn, unpack = self._cached_program(
@@ -1146,24 +1146,38 @@ class QueryEngine:
 
         return rejoin(cheap), rejoin(exp)
 
-    def _plan_compact_m(self, ds, seg_idx, filter_spec, sharded):
+    def _plan_compact_m(self, ds, seg_idx, filter_spec, sharded,
+                        n_keys=None):
         """Static survivor budget for late materialization (None = don't
         compact). Uses the cost model's filter-selectivity estimate with
-        a 4x safety margin; a wrong estimate is caught by the program's
+        a 2x safety margin; a wrong estimate is caught by the program's
         '__over__' output and retried uncompacted. Single-chip only for
-        now (per-shard budgets need per-shard overflow plumbing)."""
+        now (per-shard budgets need per-shard overflow plumbing).
+
+        Tier-gated: against the scatter/matmul aggregation tiers one
+        avoided 6M-row scatter (~40ms) pays for many [M]-probe column
+        gathers (~7ms/M), so compaction wins up to M ~ rows/2; under the
+        fused Pallas small-K kernel (~2ms/M-row single pass) the
+        re-gather usually LOSES — skip unless the key space is above the
+        kernel's ceiling."""
         if sharded or filter_spec is None:
             return None
         if not self.config.get(SCAN_COMPACT):
             return None
+        if n_keys is not None \
+                and n_keys <= self.config.get(GROUPBY_PALLAS_MAX_KEYS):
+            from spark_druid_olap_tpu.ops import pallas_groupby as PG
+            if PG._tpu_backend() or _os.environ.get(
+                    "SDOT_PALLAS", "") == "interpret":
+                return None
         rows = int(sum(ds.segments[int(si)].num_rows for si in seg_idx))
         if rows < int(self.config.get(SCAN_COMPACT_MIN_ROWS)):
             return None                  # small scans: the sort wins nothing
         sel = C._filter_selectivity(filter_spec, ds)
-        est = rows * sel * 4.0           # safety margin before retry
+        est = rows * sel * 2.0           # safety margin before retry
         m = 1 << max(6, int(np.ceil(np.log2(max(est, 1.0)))))
         m = max(m, 1 << 15) if rows >= (1 << 21) else m
-        if m > rows // 8:
+        if m > rows // 2:
             return None
         return int(m)
 
