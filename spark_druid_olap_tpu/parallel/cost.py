@@ -101,11 +101,15 @@ def _bound_overlap_fraction(f: S.BoundFilter, ds) -> Optional[float]:
     if kind not in (ColumnKind.DATE, ColumnKind.LONG, ColumnKind.DOUBLE):
         return None
     m = ds.metrics.get(f.dimension)
-    if m is None or m.min is None or m.max is None:
+    if m is None:
         return None
-    lo_col, hi_col = float(m.min), float(m.max)
-    if hi_col <= lo_col:
+    mn, mx = m.min, m.max              # uncached O(n) properties: bind once
+    if mn is None or mx is None:
         return None
+    lo_col, hi_col = float(mn), float(mx)
+    if not (hi_col > lo_col):            # also rejects NaN bounds
+        return None
+    unit = 0.0 if kind == ColumnKind.DOUBLE else 1.0
 
     def conv(v):
         if v is None:
@@ -119,9 +123,17 @@ def _bound_overlap_fraction(f: S.BoundFilter, ds) -> Optional[float]:
         hi = conv(f.upper)
     except (TypeError, ValueError):
         return None
-    lo = lo_col if lo is None else max(lo, lo_col)
-    hi = (hi_col + 1.0) if hi is None else min(hi, hi_col + 1.0)
-    width = hi_col + 1.0 - lo_col
+    # half-open [lo_eff, hi_eff) over the column's [min, max + unit):
+    # integer/date inclusive bounds widen by one unit; strict bounds
+    # shift by one unit (measure-zero for DOUBLE, where unit = 0)
+    lo = lo_col if lo is None else (lo + (unit if f.lower_strict else 0.0))
+    hi = (hi_col + unit) if hi is None \
+        else (hi + (0.0 if f.upper_strict else unit))
+    lo = max(lo, lo_col)
+    hi = min(hi, hi_col + unit)
+    width = hi_col + unit - lo_col
+    if width <= 0:
+        return None
     return max(0.0, min(1.0, (hi - lo) / width))
 
 

@@ -684,6 +684,7 @@ class QueryEngine:
         self.config = config or Config()
         self.mesh = mesh
         self._programs: Dict[tuple, object] = {}   # compile cache
+        self._compact_overflowed: set = set()      # shapes whose budget blew
         self._device_arrays: Dict[tuple, object] = {}
         self._device_bytes = 0
         self._cancel_flags: Dict[str, object] = {}
@@ -984,7 +985,12 @@ class QueryEngine:
             # prefix must hold
             cheap_f0, _ = self._split_filter_staged(filter_spec)
             compact_m = self._plan_compact_m(ds, seg_idx, cheap_f0,
-                                             sharded, n_keys=n_keys)
+                                             sharded, routes=routes)
+            if compact_m and ("agg", base_sig, topk) \
+                    in self._compact_overflowed:
+                compact_m = None     # this shape overflowed before: the
+                # estimate is structurally off for it, don't re-pay the
+                # double execution on every warm run
             for cm in ((compact_m, None) if compact_m else (None,)):
                 _tc = _time.perf_counter()
                 prog_fn, unpack = self._cached_program(
@@ -1016,9 +1022,12 @@ class QueryEngine:
                     if cm:
                         self.last_stats["compact_m"] = int(cm)
                     break
-                # est. selectivity too optimistic: retry uncompacted
+                # est. selectivity too optimistic: retry uncompacted and
+                # remember this program shape so warm runs skip straight
+                # to the uncompacted program
                 self.last_stats["compact_overflow"] = \
                     int(np.asarray(over).reshape(-1)[0])
+                self._compact_overflowed.add(("agg", base_sig, topk))
             finals = _finals_from_out(out, routes, n_out, sketch_plans)
             if topk:
                 top_idx = np.asarray(out["__topk_idx__"]).astype(np.int64)
@@ -1147,7 +1156,7 @@ class QueryEngine:
         return rejoin(cheap), rejoin(exp)
 
     def _plan_compact_m(self, ds, seg_idx, filter_spec, sharded,
-                        n_keys=None):
+                        routes=None):
         """Static survivor budget for late materialization (None = don't
         compact). Uses the cost model's filter-selectivity estimate with
         a 2x safety margin; a wrong estimate is caught by the program's
@@ -1164,12 +1173,13 @@ class QueryEngine:
             return None
         if not self.config.get(SCAN_COMPACT):
             return None
-        if n_keys is not None \
-                and n_keys <= self.config.get(GROUPBY_PALLAS_MAX_KEYS):
-            from spark_druid_olap_tpu.ops import pallas_groupby as PG
-            if PG._tpu_backend() or _os.environ.get(
-                    "SDOT_PALLAS", "") == "interpret":
-                return None
+        if routes is not None and any(
+                getattr(r, "tag", None) == "ffl" for r in routes.values()):
+            # the fused Pallas kernel will run ('ffl' is plan_routes'
+            # single source of truth for that decision): its one streamed
+            # pass beats a compact-then-re-gather. Any other tier pays
+            # per-agg scatters that compaction avoids.
+            return None
         rows = int(sum(ds.segments[int(si)].num_rows for si in seg_idx))
         if rows < int(self.config.get(SCAN_COMPACT_MIN_ROWS)):
             return None                  # small scans: the sort wins nothing
@@ -2542,6 +2552,7 @@ class QueryEngine:
 
     def clear_caches(self):
         self._programs.clear()
+        self._compact_overflowed.clear()
         self._device_arrays.clear()
         self._device_bytes = 0
 

@@ -97,15 +97,26 @@ class MetricColumn:
     validity: Optional[np.ndarray]    # bool [n] or None
     kind: ColumnKind = ColumnKind.DOUBLE
 
+    def _bounds(self):
+        """(min, max) over valid values — computed once (columns are
+        immutable after ingest; the planner consults bounds on every
+        query, and a full-column scan per access would dominate warm
+        planning)."""
+        b = getattr(self, "_bounds_cache", None)
+        if b is None:
+            v = self.values if self.validity is None \
+                else self.values[self.validity]
+            b = (v.min(), v.max()) if len(v) else (None, None)
+            self._bounds_cache = b
+        return b
+
     @property
     def min(self):
-        v = self.values if self.validity is None else self.values[self.validity]
-        return v.min() if len(v) else None
+        return self._bounds()[0]
 
     @property
     def max(self):
-        v = self.values if self.validity is None else self.values[self.validity]
-        return v.max() if len(v) else None
+        return self._bounds()[1]
 
 
 MILLIS_PER_DAY = 86_400_000
