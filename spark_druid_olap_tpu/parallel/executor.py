@@ -1324,6 +1324,15 @@ class QueryEngine:
 
         kg_used = 0
         tk_scores = None
+        # late materialization (shared with the dense path): the key
+        # build + scatter aggregation shrink to O(survivors); a budget
+        # overflow folds into '__unres__' and the first retry disables it
+        cheap_f0, _ = self._split_filter_staged(filter_spec)
+        lm = self._plan_compact_m(ds, seg_idx, cheap_f0, sharded) \
+            if n_waves == 1 else None
+        if lm and ("hashlm", ds.name, _cache_repr(q)) \
+                in self._compact_overflowed:
+            lm = None
         while True:
             # k_sel*4 <= T also bounds k_sel < T, so no clamp is needed
             topk = topk_plan if topk_plan and topk_plan[1] * 4 <= T \
@@ -1338,18 +1347,20 @@ class QueryEngine:
                 n_rows=int(ds.padded_rows) * int(ds.num_segments))
             sig = ("hashagg", ds.name, id(ds), _cache_repr(q), s_pad,
                    ds.padded_rows, min_day, max_day, sharded, n_dev, T,
-                   tuple(names), topk, compact, self.config.get(TZ_ID),
+                   tuple(names), topk, compact, lm,
+                   self.config.get(TZ_ID),
                    jax.default_backend(), bool(jax.config.jax_enable_x64))
 
-            def build():
+            def build(lm=lm):
                 if compact or exch:
                     return self._build_hash_table_program(
                         ds, dim_plans, parts, agg_plans, filter_spec,
-                        intervals, min_day, max_day, T, sharded, routes)
+                        intervals, min_day, max_day, T, sharded, routes,
+                        compact_m=lm)
                 return self._build_hash_program(
                     ds, dim_plans, parts, agg_plans, filter_spec,
                     intervals, min_day, max_day, T, sharded, routes,
-                    topk=topk)
+                    topk=topk, compact_m=lm)
 
             prog = self._cached_program(sig, build)
 
@@ -1439,7 +1450,18 @@ class QueryEngine:
                     partials.extend(
                         _hash_chip_partials(raw, routes, k_out, n_dev))
             if not unresolved:
+                if lm:
+                    self.last_stats["compact_m"] = int(lm)
                 break
+            if lm:
+                # the late-materialization budget may be what overflowed
+                # (it folds into '__unres__'): disable it at the SAME T
+                # first; only a second failure means true table overflow
+                self.last_stats["compact_overflow"] = int(unresolved)
+                self._compact_overflowed.add(
+                    ("hashlm", ds.name, _cache_repr(q)))
+                lm = None
+                continue
             T *= 4
             if T > max_slots:
                 raise EngineFallback(
@@ -1522,24 +1544,47 @@ class QueryEngine:
         return (oc.name, _topk_slack(limit), bool(oc.ascending))
 
     def _hash_core(self, ds, dim_plans, parts, agg_plans, filter_spec,
-                   intervals, min_day, max_day, T, routes):
+                   intervals, min_day, max_day, T, routes,
+                   compact_m=None):
         """The shared hash scan body: scan -> filter -> per-dim codes ->
         two-part key -> slot claim -> exact scatter aggregation into [T]
         buffers. Returns the raw out dict incl. '__tkhi__'/'__tklo__' key
-        tables and '__unres__' (shape [1])."""
+        tables and '__unres__' (shape [1]). With ``compact_m``, late
+        materialization (same machinery as the dense path) runs the key
+        build + aggregation at O(survivors); a budget overflow folds into
+        '__unres__' (the host first retries uncompacted, then grows T)."""
         matmul_max = self.config.get(GROUPBY_MATMUL_MAX_KEYS)
         cards = [p.card for p in dim_plans]
+        cheap_f, exp_f = (self._split_filter_staged(filter_spec)
+                          if compact_m else (filter_spec, None))
 
         def core(arrays):
             ctx = ScanContext(ds, arrays, min_day, max_day,
                               tz=self.config.get(TZ_ID))
             base = ctx.row_valid()
-            fm = F.lower_filter(filter_spec, ctx)
+            fm = F.lower_filter(cheap_f, ctx)
             if fm is not None:
                 base = base & fm
             im = F.interval_mask(intervals, ctx)
             if im is not None:
                 base = base & im
+            n_over = None
+            if compact_m:
+                flat = base.reshape(-1)
+                ridx = jnp.arange(flat.shape[0], dtype=jnp.int32)
+                okey = jnp.where(flat, jnp.int32(0), jnp.int32(1))
+                _, sidx = jax.lax.sort((okey, ridx), num_keys=1)
+                keep = jax.lax.slice_in_dim(sidx, 0, compact_m)
+                n_live = jnp.sum(flat.astype(jnp.int32))
+                n_over = jnp.maximum(
+                    n_live - jnp.int32(compact_m), 0).astype(jnp.int32)
+                ctx = CompactScanContext(ds, arrays, min_day, max_day,
+                                         self.config.get(TZ_ID), keep=keep)
+                base = flat[keep]
+                if exp_f is not None:
+                    em = F.lower_filter(exp_f, ctx)
+                    if em is not None:
+                        base = base & em
             codes = [p.build(ctx) for p in dim_plans]
             khi = H.fuse_part(codes, cards, parts[0])
             klo = H.fuse_part(codes, cards, parts[1]) if len(parts) > 1 \
@@ -1554,6 +1599,8 @@ class QueryEngine:
             out = G.dense_groupby(slot, base, T, inputs, routes, matmul_max)
             out["__tkhi__"] = tk_hi
             out["__tklo__"] = tk_lo
+            if n_over is not None:
+                unresolved = unresolved + n_over
             out["__unres__"] = unresolved.reshape(1)
             return out
 
@@ -1602,14 +1649,15 @@ class QueryEngine:
 
     def _build_hash_program(self, ds, dim_plans, parts, agg_plans,
                             filter_spec, intervals, min_day, max_day, T,
-                            sharded, routes, topk=None):
+                            sharded, routes, topk=None, compact_m=None):
         """Single-dispatch hash program (full-table or topk-gathered
         transfer). Outputs stay per-chip in sharded mode (slot layouts
         differ per chip; the key-wise merge is host-side). With ``topk``
         only the top-scored ``k_sel`` slots per chip travel (see
         _plan_device_topk_hashed)."""
         core = self._hash_core(ds, dim_plans, parts, agg_plans, filter_spec,
-                               intervals, min_day, max_day, T, routes)
+                               intervals, min_day, max_day, T, routes,
+                               compact_m=compact_m)
         k_out = topk[1] if topk else T
         pack, unpack = self._hash_packers(agg_plans, routes, k_out, True,
                                           with_score=bool(topk))
@@ -1629,12 +1677,13 @@ class QueryEngine:
 
     def _build_hash_table_program(self, ds, dim_plans, parts, agg_plans,
                                   filter_spec, intervals, min_day, max_day,
-                                  T, sharded, routes):
+                                  T, sharded, routes, compact_m=None):
         """Compaction dispatch 1 of 2: build the table, leave it DEVICE-
         RESIDENT, transfer only '__stats__' = [unresolved, occupied] per
         chip. The host sizes the gather dispatch from the occupancy."""
         core = self._hash_core(ds, dim_plans, parts, agg_plans, filter_spec,
-                               intervals, min_day, max_day, T, routes)
+                               intervals, min_day, max_day, T, routes,
+                               compact_m=compact_m)
 
         def run(arrays):
             out = core(arrays)

@@ -104,3 +104,21 @@ def test_staged_expensive_membership_matches():
     a = _ctx(True).sql(sql).to_pandas()
     b = _ctx(False).sql(sql).to_pandas()
     pd.testing.assert_frame_equal(a, b, check_dtype=False)
+
+
+def test_hashed_tier_compaction_matches():
+    """High-cardinality (hashed-tier) group-by under a selective filter:
+    late materialization engages and matches the uncompacted engine."""
+    c1 = _ctx(True)
+    c1.config.set("sdot.engine.groupby.dense.max.keys", 8)  # force hashed
+    c2 = _ctx(False)
+    c2.config.set("sdot.engine.groupby.dense.max.keys", 8)
+    sql = ("select sku, sum(qty) as s, count(*) as n from sales "
+           "where region = 'east' and qty = 7 "
+           "group by sku order by sku limit 30")
+    a = c1.sql(sql).to_pandas()
+    b = c2.sql(sql).to_pandas()
+    pd.testing.assert_frame_equal(a, b, check_dtype=False)
+    st = c1.history.entries()[-1].stats
+    assert st.get("hashed")
+    assert st.get("compact_m", 0) > 0 or st.get("compact_overflow", 0) > 0
