@@ -69,7 +69,8 @@ def _filter_selectivity(f: Optional[S.FilterSpec], ds) -> float:
         card = ds.cardinality(f.dimension) or 100
         return min(1.0, len(f.values) / max(card, 1))
     if isinstance(f, S.PatternFilter):
-        return 0.25
+        frac = _pattern_fraction(f, ds)
+        return frac if frac is not None else 0.25
     if isinstance(f, S.NullFilter):
         return 0.9 if f.negated else 0.1
     if isinstance(f, S.LogicalFilter):
@@ -83,6 +84,46 @@ def _filter_selectivity(f: Optional[S.FilterSpec], ds) -> float:
             return min(1.0, sum(sels))
         return max(0.0, 1.0 - (sels[0] if sels else 0.0))
     return 0.5  # ExprFilter: unknown
+
+
+def _pattern_fraction(f: S.PatternFilter, ds) -> Optional[float]:
+    """Matching-dictionary fraction as the pattern's selectivity
+    (uniform-frequency assumption). One regex pass over the dictionary,
+    cached on the datasource — the filter lowering pays the same pass at
+    trace time, and the late-materialization budget needs the real
+    fraction (LIKE '%green%' over p_name is ~5%, not the 0.25 blanket)."""
+    import re as _re
+    from spark_druid_olap_tpu.ops import expr_compile as EC
+    dim = getattr(ds, "dims", {}).get(f.dimension)
+    if dim is None:
+        return None
+    cache = getattr(ds, "_pattern_frac_cache", None)
+    if cache is None:
+        cache = ds._pattern_frac_cache = {}
+    key = (f.dimension, f.kind, f.pattern)
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
+    vals = dim.dictionary
+    n = len(vals)
+    if n == 0:
+        return None
+    try:
+        if f.kind == "like":
+            rx = _re.compile(EC.like_to_regex(f.pattern))
+            cnt = sum(1 for s in vals if rx.match(s))
+        elif f.kind == "regex":
+            rx = _re.compile(f.pattern)
+            cnt = sum(1 for s in vals if rx.search(s))
+        elif f.kind == "contains":
+            cnt = sum(1 for s in vals if f.pattern in s)
+        else:
+            return None
+    except _re.error:
+        return None
+    frac = max(cnt / n, 1.0 / (2 * n))
+    cache[key] = frac
+    return frac
 
 
 def _bound_overlap_fraction(f: S.BoundFilter, ds) -> Optional[float]:
