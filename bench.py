@@ -510,6 +510,11 @@ def main():
         if nd is not None:
             ndisp[name] = int(nd)
             dd = f", {nd}+{nt}rt"   # program dispatches + host->dev transfers
+        cm = ctx.history.entries()[-1].stats.get("compact_m")
+        if cm:
+            dd += f", lm={cm}"      # late-materialization budget engaged
+        if ctx.history.entries()[-1].stats.get("compact_overflow"):
+            dd += ", lm-overflow"
         log(f"{name}: {wall:.1f}ms wall ({adj:.1f}ms floor-adjusted, cold "
             f"{cold:.2f}s, mode={mode}, {len(r)} rows{gb}{dd})")
 
