@@ -122,3 +122,27 @@ def test_hashed_tier_compaction_matches():
     st = c1.history.entries()[-1].stats
     assert st.get("hashed")
     assert st.get("compact_m", 0) > 0 or st.get("compact_overflow", 0) > 0
+
+
+def test_sketches_under_compaction_match():
+    """HLL / theta count-distinct registers build from the compacted
+    context; estimates must track the uncompacted engine exactly (same
+    register contents, not just within sketch error)."""
+    sql = ("select region, approx_count_distinct(sku) as d from sales "
+           "where sku in ('sku001','sku002','sku003','sku004','sku005') "
+           "group by region order by region")
+    a = _ctx(True).sql(sql).to_pandas()
+    b = _ctx(False).sql(sql).to_pandas()
+    pd.testing.assert_frame_equal(a, b, check_dtype=False)
+
+
+def test_compaction_all_rows_filtered_out():
+    """A filter matching zero rows under compaction: empty result (or
+    the global identity row), not garbage from the padded prefix."""
+    c = _ctx(True)
+    r = c.sql("select region, sum(qty) as s from sales "
+              "where sku = 'sku001' and qty > 1000000 group by region")
+    assert len(r) == 0
+    g = c.sql("select count(*) as n, sum(qty) as s from sales "
+              "where sku = 'sku001' and qty > 1000000").to_pandas()
+    assert int(g["n"][0]) == 0
