@@ -1173,13 +1173,6 @@ class QueryEngine:
             return None
         if not self.config.get(SCAN_COMPACT):
             return None
-        if routes is not None and any(
-                getattr(r, "tag", None) == "ffl" for r in routes.values()):
-            # the fused Pallas kernel will run ('ffl' is plan_routes'
-            # single source of truth for that decision): its one streamed
-            # pass beats a compact-then-re-gather. Any other tier pays
-            # per-agg scatters that compaction avoids.
-            return None
         rows = int(sum(ds.segments[int(si)].num_rows for si in seg_idx))
         if rows < int(self.config.get(SCAN_COMPACT_MIN_ROWS)):
             return None                  # small scans: the sort wins nothing
@@ -1187,7 +1180,15 @@ class QueryEngine:
         est = rows * sel * 2.0           # safety margin before retry
         m = 1 << max(6, int(np.ceil(np.log2(max(est, 1.0)))))
         m = max(m, 1 << 15) if rows >= (1 << 21) else m
-        if m > rows // 2:
+        ceiling = rows // 2
+        if routes is not None and any(
+                getattr(r, "tag", None) == "ffl" for r in routes.values()):
+            # the fused Pallas kernel will run ('ffl' is plan_routes'
+            # single source of truth for that decision): its one streamed
+            # pass (~2.3ms/M rows) beats a compact-then-re-gather
+            # (~7ms/M per column) unless the filter is VERY selective
+            ceiling = rows // 32
+        if m > ceiling:
             return None
         return int(m)
 
