@@ -444,6 +444,32 @@ def main():
                   f"{type(e).__name__}: {e}", diags)
         return
 
+    # parallel prewarm (VERDICT r2 #10 compile diet): compile-heavy first
+    # executions overlap across a thread pool — per-signature compile
+    # ownership lets different programs compile concurrently (largely
+    # server-side on a tunneled chip), so the cold suite pays
+    # max(compile) depth instead of sum(compile)
+    prewarm_s = 0.0
+    try:
+        n_pre = int(os.environ.get(
+            "SDOT_BENCH_PREWARM", "4" if platform == "axon" else "0"))
+    except ValueError:
+        n_pre = 0
+    if n_pre > 0:
+        from concurrent.futures import ThreadPoolExecutor
+        t0 = time.perf_counter()
+        errs = {}
+        with ThreadPoolExecutor(max_workers=n_pre) as pool:
+            futs = {pool.submit(ctx.sql, queries[n]): n for n in names}
+            for f, n in futs.items():
+                try:
+                    f.result()
+                except Exception as e:   # noqa: BLE001 — timed loop reports
+                    errs[n] = f"{type(e).__name__}: {e}"
+        prewarm_s = time.perf_counter() - t0
+        log(f"parallel prewarm ({n_pre} threads): {prewarm_s:.1f}s"
+            + (f", {len(errs)} failed: {errs}" if errs else ""))
+
     wall_lat, adj_lat = {}, {}
     gbps = {}
     ndisp = {}
@@ -557,9 +583,11 @@ def main():
         "rows": n_rows,
         "numerics": numerics,
         # compile-diet regression surface (VERDICT r2 #10): total cold
-        # (first-execution, compile-inclusive) seconds across the suite;
-        # the persistent XLA cache makes repeat runs near-warm
-        "cold_total_s": round(cold_total_s, 1),
+        # (first-execution, compile-inclusive) seconds across the suite
+        # INCLUDING the parallel prewarm wall; the persistent XLA cache
+        # makes repeat runs near-warm
+        "cold_total_s": round(cold_total_s + prewarm_s, 1),
+        "prewarm_s": round(prewarm_s, 1),
     }
     if ndisp:
         # device round trips per query: on the tunneled chip each costs
