@@ -324,6 +324,31 @@ def estimate(ctx_or_engine, q: S.QuerySpec) -> CostEstimate:
 
 def explain_cost(ctx, q: S.QuerySpec) -> str:
     try:
-        return estimate(ctx, q).table()
+        out = estimate(ctx, q).table()
     except Exception as e:  # cost must never break explain
         return f"cost: unavailable ({e})"
+    try:
+        out += _explain_scan_plan(ctx, q)
+    except Exception:   # noqa: BLE001 — advisory detail only
+        pass
+    return out
+
+
+def _explain_scan_plan(ctx, q: S.QuerySpec) -> str:
+    """Physical scan decisions: late-materialization budget and staged
+    (post-compaction) filter conjuncts — the explain surface for the
+    compact-then-aggregate path."""
+    eng = ctx.engine
+    f = getattr(q, "filter", None)
+    ds = eng.store.get(q.datasource)
+    seg_idx = ds.prune_segments(getattr(q, "intervals", None), f)
+    cheap, exp = eng._split_filter_staged(f)
+    m = eng._plan_compact_m(ds, seg_idx, cheap, sharded=False)
+    if m is None:
+        return ""
+    line = f"\nscan: late-materialize to [{m:,}] survivors"
+    if exp is not None:
+        n_exp = len(exp.fields) if isinstance(exp, S.LogicalFilter) \
+            and exp.op == "and" else 1
+        line += f" (+{n_exp} gather-heavy conjunct(s) staged after)"
+    return line
