@@ -318,8 +318,16 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
                 # build from the PRE-inline statement: the inlining
                 # passes execute subqueries away, and the composite
                 # planner needs to SEE them (its dim-only-FROM gate) and
-                # plan derived tables through its own chain
-                cp = composite.build_composite(ctx, stmt)
+                # plan derived tables through its own chain. Same plan
+                # cache contract as the pushdown path (store version +
+                # config fingerprint in the key).
+                _ccache, _ckey = host_exec.result_cache(ctx, "cplan", stmt)
+                cp = _ccache.get(_ckey)
+                if cp is not None:
+                    _ccache.move_to_end(_ckey)
+                else:
+                    cp = composite.build_composite(ctx, stmt)
+                    host_exec.result_cache_put(_ccache, _ckey, cp)
                 df = composite.execute_composite(ctx, cp)
                 mode = "engine"
             except (PlanUnsupported, EngineFallback,
