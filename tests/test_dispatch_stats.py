@@ -61,3 +61,42 @@ def test_counters_are_monotone_and_thread_local(ctx):
     c1 = ctx.engine.dispatch_counts
     assert c1[0] >= c0[0]
     assert c1[1] >= c0[1]
+
+
+def test_cached_program_concurrent_failure_recovery(ctx):
+    """If a compile owner raises, a waiter claims ownership and retries
+    (per-signature compile events must not deadlock or cache garbage)."""
+    import threading
+    eng = ctx.engine
+    sig = ("test-prog", "failure-recovery")
+    calls = {"n": 0}
+    lock = threading.Lock()
+
+    def flaky_build():
+        with lock:
+            calls["n"] += 1
+            mine = calls["n"]
+        if mine == 1:
+            raise RuntimeError("first build fails")
+        return "compiled"
+
+    results, errors = [], []
+
+    def worker():
+        try:
+            results.append(eng._cached_program(sig, flaky_build))
+        except RuntimeError as e:
+            errors.append(e)
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in ts), "deadlocked"
+    # exactly one failure propagated to the first owner; everyone else
+    # got the successfully-built program
+    assert len(errors) == 1
+    assert results == ["compiled"] * 3
+    assert eng._programs.get(sig) == "compiled"
+    eng._programs.pop(sig, None)
