@@ -162,7 +162,7 @@ DEVICE_CACHE_BYTES = _entry(
     "array cache is dropped and rebuilt on demand — bounding HBM held by "
     "shifting segment selections (paged selects, moving intervals).")
 GROUPBY_HASH_MAX_SLOTS = _entry(
-    "sdot.engine.groupby.hash.max.slots", 1 << 23,
+    "sdot.engine.groupby.hash.max.slots", 1 << 24,
     "Max hash-table slot count; a query whose actual group count exceeds "
     "what this table can hold falls back to the host tier (reference "
     "contract: Druid groupBy v2 spills, never refuses — "
