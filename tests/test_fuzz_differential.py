@@ -129,3 +129,39 @@ def test_random_query_matches_pandas(env, i):
     want = want[got.columns].reset_index(drop=True)
     pd.testing.assert_frame_equal(got, want, check_dtype=False,
                                   rtol=1e-5, atol=1e-6), sql
+
+
+@pytest.mark.parametrize("i", range(20))
+def test_random_having_limit_matches_pandas(env, i):
+    """Ordered-limit + HAVING shapes (device top-k epilogue + having
+    path under compaction)."""
+    ctx, df = env
+    rng = np.random.default_rng(5000 + i)
+    dim = str(rng.choice(DIMS))
+    m = str(rng.choice(METRICS))
+    thresh = int(rng.integers(100, 4000))
+    k = int(rng.integers(1, 8))
+    like = rng.random() < 0.4
+    cond = "sku like 'k01%'" if like else \
+        f"region in ('ne','se')"
+    sql = (f"select {dim}, sum({m}) as s, count(*) as n from t "
+           f"where {cond} and qty >= 10 "
+           f"group by {dim} having count(*) > {thresh // 100} "
+           f"order by s desc, {dim} limit {k}")
+    got = ctx.sql(sql).to_pandas().reset_index(drop=True)
+
+    d = df[(df["sku"].str.startswith("k01") if like
+            else df["region"].isin(["ne", "se"])) & (df["qty"] >= 10)]
+    rows = []
+    for key, g in d.groupby(dim):
+        if len(g) > thresh // 100:
+            rows.append({dim: key, "s": g[m].sum(), "n": len(g)})
+    want = pd.DataFrame(rows, columns=[dim, "s", "n"])
+    if len(want):
+        want = want.sort_values(["s", dim],
+                                ascending=[False, True]).head(k) \
+            .reset_index(drop=True)
+    assert len(got) == len(want), sql
+    if len(want):
+        pd.testing.assert_frame_equal(got, want, check_dtype=False,
+                                      rtol=1e-5), sql
