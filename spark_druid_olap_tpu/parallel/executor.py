@@ -986,7 +986,9 @@ class QueryEngine:
             # prefix must hold
             cheap_f0, _ = self._split_filter_staged(filter_spec)
             compact_m = self._plan_compact_m(ds, seg_idx, cheap_f0,
-                                             sharded, routes=routes)
+                                             sharded, routes=routes,
+                                             n_dev=n_dev,
+                                             allow_sharded=True)
             if compact_m and ("agg", base_sig, topk) \
                     in self._compact_overflowed:
                 compact_m = None     # this shape overflowed before: the
@@ -1157,12 +1159,14 @@ class QueryEngine:
         return rejoin(cheap), rejoin(exp)
 
     def _plan_compact_m(self, ds, seg_idx, filter_spec, sharded,
-                        routes=None):
+                        routes=None, n_dev=1, allow_sharded=False):
         """Static survivor budget for late materialization (None = don't
         compact). Uses the cost model's filter-selectivity estimate with
         a 2x safety margin; a wrong estimate is caught by the program's
-        '__over__' output and retried uncompacted. Single-chip only for
-        now (per-shard budgets need per-shard overflow plumbing).
+        '__over__' output and retried uncompacted. Sharded (dense path
+        only): the budget is PER SHARD — the compact block runs on each
+        shard's local arrays under shard_map, and overflow counts psum
+        before travelling.
 
         Tier-gated: against the scatter/matmul aggregation tiers one
         avoided 6M-row scatter (~40ms) pays for many [M]-probe column
@@ -1170,11 +1174,12 @@ class QueryEngine:
         fused Pallas small-K kernel (~2ms/M-row single pass) the
         re-gather usually LOSES — skip unless the key space is above the
         kernel's ceiling."""
-        if sharded or filter_spec is None:
+        if filter_spec is None or (sharded and not allow_sharded):
             return None
         if not self.config.get(SCAN_COMPACT):
             return None
         rows = int(sum(ds.segments[int(si)].num_rows for si in seg_idx))
+        rows //= max(int(n_dev) if sharded else 1, 1)   # per-shard budget
         if rows < int(self.config.get(SCAN_COMPACT_MIN_ROWS)):
             return None                  # small scans: the sort wins nothing
         sel = C._filter_selectivity(filter_spec, ds)
@@ -2108,6 +2113,7 @@ class QueryEngine:
 
             def sharded_core(arrays):
                 out = core(arrays)
+                over = out.pop("__over__", None)
                 sk_names = {p.spec.name for p in hll_plans} \
                     | {p.spec.name for p in theta_plans}
                 dense_out = {k: v for k, v in out.items()
@@ -2121,6 +2127,11 @@ class QueryEngine:
                         out[p.spec.name], SEGMENT_AXIS)
                 if topk:
                     merged = topk_gather(merged, SEGMENT_AXIS)
+                if over is not None:
+                    # any shard overflowing its local budget invalidates
+                    # the run (those rows were dropped): psum so every
+                    # chip's replicated buffer carries the global count
+                    merged["__over__"] = jax.lax.psum(over, SEGMENT_AXIS)
                 return pack(merged)
 
             smfn = jax.shard_map(sharded_core, mesh=mesh,
