@@ -1179,15 +1179,17 @@ class QueryEngine:
         if not self.config.get(SCAN_COMPACT):
             return None
         min_rows = int(self.config.get(SCAN_COMPACT_MIN_ROWS))
+        rows = int(sum(ds.segments[int(si)].num_rows for si in seg_idx))
         from spark_druid_olap_tpu.ops import pallas_groupby as PG
-        if min_rows > 0 and not PG._tpu_backend():
-            # TPU economics only: there lax.sort is ~0.2ms/M rows vs
-            # ~7ms/M-update scatters; on the CPU fallback the same sort
-            # costs SECONDS at scan scale while its scatters are fast —
-            # measured 10x SSB/TPC-H CPU regressions. min.rows == 0 is
+        if min_rows > 0 and not PG._tpu_backend() and rows < (1 << 24):
+            # On TPU the compaction sort is ~0.2ms/M rows vs ~7ms/M-update
+            # scatters — always cheap. On the CPU fallback the x64 sort
+            # costs ~0.3s/M rows, which LOSES at SF1 scale (measured 10x
+            # SSB regression) but WINS once the scan is large enough that
+            # uncompacted scatter tables thrash the cache (measured q3
+            # SF10: 76s uncompacted vs 16s compacted). min.rows == 0 is
             # the explicit test/config override.
             return None
-        rows = int(sum(ds.segments[int(si)].num_rows for si in seg_idx))
         rows //= max(int(n_dev) if sharded else 1, 1)   # per-shard budget
         if rows < int(self.config.get(SCAN_COMPACT_MIN_ROWS)):
             return None                  # small scans: the sort wins nothing
