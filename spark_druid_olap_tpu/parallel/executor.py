@@ -1309,6 +1309,14 @@ class QueryEngine:
         rows_sel = int(sum(ds.segments[int(si)].num_rows
                            for si in seg_idx))
         max_slots = int(self.config.get(GROUPBY_HASH_MAX_SLOTS))
+        from spark_druid_olap_tpu.ops import pallas_groupby as PG
+        if not PG._tpu_backend():
+            # the 16M-slot ceiling is TPU economics (400MB of HBM table
+            # buffers, ~sort+scatter in hundreds of ms); on the CPU
+            # fallback x64 scatters into a 16M-slot table thrash cache so
+            # badly that the host pandas tier is ~3x faster (measured
+            # q18-inner SF10: 530s engine vs 193s host) — keep CPU at 8M
+            max_slots = min(max_slots, 1 << 23)
         n_keys_total = 1
         for c in cards:
             n_keys_total *= int(c)
