@@ -5,8 +5,9 @@
 # timeout — an in-process init hang is unrecoverable, see
 # docs/bench/README.md). The moment the chip answers, runs the full
 # bench suite on it and snapshots JSON + log into docs/bench/ with a
-# round-3 name, then keeps watching so later code improvements can be
-# re-benched by touching $RERUN_FLAG.
+# round-4 name (SF1 TPC-H, then SSB, then SF10 TPC-H), then keeps
+# watching so later code improvements can be re-benched by touching
+# $RERUN_FLAG.
 #
 # Usage: nohup scripts/tpu_watcher.sh >/tmp/tpu_watcher.log 2>&1 &
 set -u
@@ -30,10 +31,12 @@ EOF
 run_bench() {
   local tag="$1"
   local suite="${BENCH_SUITE:-tpch}"
+  local sf="${BENCH_SF:-1.0}"
   [ "$suite" != "tpch" ] && tag="${suite}_${tag}"
+  [ "$sf" != "1.0" ] && tag="sf${sf%.*}_${tag}"
   local out="/tmp/bench_${tag}.json" log="/tmp/bench_${tag}.log"
   echo "[watcher] $(date -u +%FT%TZ) chip up — running bench tag=${tag} suite=${suite}"
-  SDOT_BENCH_PLATFORM=axon SDOT_BENCH_SUITE="$suite" \
+  SDOT_BENCH_PLATFORM=axon SDOT_BENCH_SUITE="$suite" SDOT_BENCH_SF="$sf" \
     SDOT_BENCH_TIME_BUDGET="${BENCH_TIME_BUDGET:-3000}" \
     timeout 5400 python bench.py >"$out" 2>"$log"
   local rc=$?
@@ -57,15 +60,18 @@ n=0
 while true; do
   if probe; then
     n=$((n + 1))
-    tag="r03_$(date -u +%H%M)"
+    tag="r04_$(date -u +%H%M)"
     if ! run_bench "$tag"; then
       echo "[watcher] bench attempt failed; re-probing"
       sleep "$PROBE_INTERVAL"
       continue
     fi
     # SSB snapshot rides the same window (13 queries, much quicker)
-    BENCH_SUITE=ssb run_bench "r03_$(date -u +%H%M)" \
+    BENCH_SUITE=ssb run_bench "r04_$(date -u +%H%M)" \
       || echo "[watcher] ssb bench failed (tpch snapshot already saved)"
+    # SF10 rides the same window too (table cache pre-built in .bench_cache/)
+    BENCH_SF=10.0 BENCH_TIME_BUDGET=4800 run_bench "r04_$(date -u +%H%M)" \
+      || echo "[watcher] sf10 bench failed (sf1 snapshots already saved)"
     # After a successful run, only re-bench when explicitly requested.
     while [ ! -e "$RERUN_FLAG" ]; do sleep 60; done
     rm -f "$RERUN_FLAG"

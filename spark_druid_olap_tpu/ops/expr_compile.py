@@ -521,6 +521,34 @@ def _as_bool(v):
     raise Unsupported(f"cannot use {type(v).__name__} as boolean")
 
 
+def int_set_runs(vals: np.ndarray):
+    """Contiguous [lo, hi] runs of a sorted int array, or None when the
+    set is not chain-eligible (too many values relative to its span AND
+    too many runs). This is THE predicate for "does int_set_membership
+    lower to a fused range-compare chain?" — the compaction planner's
+    staged-filter split must agree with it, or chain-cheap conjuncts get
+    needlessly staged post-compaction (and scattered gather-heavy small
+    sets sneak in pre-compaction)."""
+    if len(vals) == 0:
+        return []
+    lo_v, hi_v = int(vals[0]), int(vals[-1])
+    span = hi_v - lo_v + 1
+    if len(vals) > 2 * _CHAIN_MAX_RANGES and span > 4 * len(vals):
+        return None
+    arr64 = vals.astype(np.int64)
+    brk = np.nonzero(np.diff(arr64) > 1)[0]
+    starts = np.concatenate([[0], brk + 1])
+    ends = np.concatenate([brk, [len(arr64) - 1]])
+    runs = [(int(arr64[s]), int(arr64[e])) for s, e in zip(starts, ends)]
+    return runs if len(runs) <= _CHAIN_MAX_RANGES else None
+
+
+def int_set_lowers_to_chain(vals: np.ndarray) -> bool:
+    """Whether membership in ``vals`` compiles to compare chains (free on
+    the VPU) rather than a gather (bitmap probe / binary search)."""
+    return int_set_runs(vals) is not None
+
+
 def int_set_membership(arr, vals: np.ndarray):
     """Device membership of integer ``arr`` (i32/i64) in a sorted,
     nonempty int array whose values fit arr's dtype.
@@ -533,24 +561,17 @@ def int_set_membership(arr, vals: np.ndarray):
     (ops/filters._in) and the compiled-expression tier (_in_list)."""
     lo_v, hi_v = int(vals[0]), int(vals[-1])
     span = hi_v - lo_v + 1
-    if len(vals) <= 2 * _CHAIN_MAX_RANGES or span <= 4 * len(vals):
-        # small or near-contiguous sets: fused range-compare chain beats
-        # any gather (a 6M-row gather is ~40ms on v5e; compares are free)
-        runs = []
-        arr64 = vals.astype(np.int64)
-        brk = np.nonzero(np.diff(arr64) > 1)[0]
-        starts = np.concatenate([[0], brk + 1])
-        ends = np.concatenate([brk, [len(arr64) - 1]])
-        runs = [(int(arr64[s]), int(arr64[e]))
-                for s, e in zip(starts, ends)]
-        if len(runs) <= _CHAIN_MAX_RANGES:
-            lit = (lambda v: jnp.asarray(v, arr.dtype))
-            out = None
-            for lo, hi in runs:
-                m = (arr == lit(lo)) if lo == hi \
-                    else ((arr >= lit(lo)) & (arr <= lit(hi)))
-                out = m if out is None else (out | m)
-            return out
+    # small or near-contiguous sets: fused range-compare chain beats
+    # any gather (a 6M-row gather is ~40ms on v5e; compares are free)
+    runs = int_set_runs(vals)
+    if runs is not None:
+        lit = (lambda v: jnp.asarray(v, arr.dtype))
+        out = None
+        for lo, hi in runs:
+            m = (arr == lit(lo)) if lo == hi \
+                else ((arr >= lit(lo)) & (arr <= lit(hi)))
+            out = m if out is None else (out | m)
+        return out
     # bitmap only when reasonably DENSE (or small): a sparse thousand-key
     # set under the span cap would bake megabytes of mostly-zero constant
     # into the program where binary search needs kilobytes

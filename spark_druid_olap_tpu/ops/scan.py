@@ -148,6 +148,18 @@ def array_dtype(ds: Datasource, key: str):
     return np.int32
 
 
+def _stacked_by_key(ds: Datasource, key: str) -> np.ndarray:
+    """The [S, R] stacked tensor behind one array key (S = local segments
+    on a multi-host partial store)."""
+    if key == ROW_VALID_KEY:
+        return ds.stacked_row_validity()
+    if key == TIME_MS_KEY:
+        return ds.stacked_time_ms()
+    if key.startswith(NULL_VALID_PREFIX):
+        return ds.stacked_null_validity(key[len(NULL_VALID_PREFIX):])
+    return ds.stacked(key)
+
+
 def build_array(ds: Datasource, key: str,
                 segment_indices: Optional[np.ndarray] = None,
                 pad_segments_to: Optional[int] = None) -> np.ndarray:
@@ -158,24 +170,53 @@ def build_array(ds: Datasource, key: str,
     stable across prunings (compile-cache friendliness) and divisible by the
     mesh size.
     """
-    if key == ROW_VALID_KEY:
-        arr = ds.stacked_row_validity()
-    elif key == TIME_MS_KEY:
-        arr = ds.stacked_time_ms()
-    elif key.startswith(NULL_VALID_PREFIX):
-        arr = ds.stacked_null_validity(key[len(NULL_VALID_PREFIX):])
+    if ds.is_partial:
+        # global segment ids -> local block (only this host's segments may
+        # be requested; the multi-host layout guarantees that). The
+        # "all segments" default means the LOCAL set here — the only set
+        # this process can materialize.
+        idx = ds.local_seg_ids if segment_indices is None \
+            else np.asarray(segment_indices, np.int64)
+        arr = build_array_blocks(ds, key, idx)
     else:
-        arr = ds.stacked(key)
-    if segment_indices is not None and (
-            len(segment_indices) != ds.num_segments
-            or not np.array_equal(segment_indices,
-                                  np.arange(ds.num_segments))):
-        arr = arr[segment_indices]
+        arr = _stacked_by_key(ds, key)
+        if segment_indices is not None and (
+                len(segment_indices) != ds.num_segments
+                or not np.array_equal(segment_indices,
+                                      np.arange(ds.num_segments))):
+            arr = arr[segment_indices]
     if pad_segments_to is not None and arr.shape[0] < pad_segments_to:
         pad = np.zeros((pad_segments_to - arr.shape[0],) + arr.shape[1:],
                        dtype=arr.dtype)
         arr = np.concatenate([arr, pad], axis=0)
     return arr
+
+
+def build_array_blocks(ds: Datasource, key: str,
+                       seg_ids: np.ndarray) -> np.ndarray:
+    """[len(seg_ids), R] host block for a multi-host layout slice: global
+    segment ids; ``-1`` entries are padding (zero rows, row-validity
+    False). On a partial store, a non-padding id not held locally is a
+    layout bug and raises (the callback must never fabricate remote
+    data)."""
+    seg_ids = np.asarray(seg_ids, np.int64)
+    arr = _stacked_by_key(ds, key)
+    if ds.is_partial:
+        pos = np.where(
+            seg_ids >= 0,
+            ds._local_pos[np.clip(seg_ids, 0, ds.num_segments - 1)], -1)
+        missing = (seg_ids >= 0) & (pos < 0)
+        if missing.any():
+            raise RuntimeError(
+                f"host {ds.host_id} asked for non-local segments "
+                f"{seg_ids[missing][:8].tolist()} of {ds.name!r}")
+    else:
+        pos = seg_ids
+    out = np.zeros((len(seg_ids),) + arr.shape[1:], dtype=arr.dtype)
+    ok = pos >= 0
+    if ok.any():
+        out[ok] = arr[pos[ok]]
+    return out
 
 
 def required_arrays(ds: Datasource, columns, need_time_ms: bool,

@@ -86,6 +86,9 @@ def _filter_selectivity(f: Optional[S.FilterSpec], ds) -> float:
     return 0.5  # ExprFilter: unknown
 
 
+_PATTERN_FRAC_BOUND = 256
+
+
 def _pattern_fraction(f: S.PatternFilter, ds) -> Optional[float]:
     """Matching-dictionary fraction as the pattern's selectivity
     (uniform-frequency assumption). One regex pass over the dictionary,
@@ -97,12 +100,14 @@ def _pattern_fraction(f: S.PatternFilter, ds) -> Optional[float]:
     dim = getattr(ds, "dims", {}).get(f.dimension)
     if dim is None:
         return None
+    from collections import OrderedDict
     cache = getattr(ds, "_pattern_frac_cache", None)
     if cache is None:
-        cache = ds._pattern_frac_cache = {}
+        cache = ds._pattern_frac_cache = OrderedDict()
     key = (f.dimension, f.kind, f.pattern)
     hit = cache.get(key)
     if hit is not None:
+        cache.move_to_end(key)
         return hit
     vals = dim.dictionary
     n = len(vals)
@@ -123,6 +128,10 @@ def _pattern_fraction(f: S.PatternFilter, ds) -> Optional[float]:
         return None
     frac = max(cnt / n, 1.0 / (2 * n))
     cache[key] = frac
+    # LRU-bounded like the session result caches: ad-hoc dashboards /
+    # fuzzers emit unbounded distinct patterns (ADVICE r3)
+    while len(cache) > _PATTERN_FRAC_BOUND:
+        cache.popitem(last=False)
     return frac
 
 
@@ -346,7 +355,10 @@ def _explain_scan_plan(ctx, q: S.QuerySpec) -> str:
     m = eng._plan_compact_m(ds, seg_idx, cheap, sharded=False)
     if m is None:
         return ""
-    line = f"\nscan: late-materialize to [{m:,}] survivors"
+    # ESTIMATE: the execution-time decision additionally sees the agg
+    # routes ('ffl' Pallas ceiling), sharding, and overflow memory —
+    # none of which exist at explain time (ADVICE r3)
+    line = f"\nscan: late-materialize to [{m:,}] survivors (estimate)"
     if exp is not None:
         n_exp = len(exp.fields) if isinstance(exp, S.LogicalFilter) \
             and exp.op == "and" else 1

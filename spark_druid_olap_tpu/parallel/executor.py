@@ -87,6 +87,19 @@ except ValueError:
     _PROFILE_N = 0
 
 
+def set_profile_dispatch(n: Optional[int]) -> None:
+    """Runtime override of SDOT_PROFILE_DISPATCH (None restores the env
+    value) — bench.py profiles one rep per query this way so scan GB/s is
+    denominated in measured device time, not RTT-contaminated wall."""
+    global _PROFILE_N
+    if n is None:
+        try:
+            n = int(_os.environ.get("SDOT_PROFILE_DISPATCH", "0"))
+        except ValueError:
+            n = 0
+    _PROFILE_N = int(n)
+
+
 class EngineFallback(Exception):
     """Query (or part) can't run on the device path; planner must evaluate a
     host residual instead. ≈ the reference leaving unpushable predicates
@@ -726,15 +739,25 @@ class QueryEngine:
         self.dispatch_counts[kind] += n
 
     def _profile_dispatch(self, fn, args):
-        """See _PROFILE_N: amortized device time of one compiled program."""
-        if not _PROFILE_N:
+        """See _PROFILE_N: amortized device time of one compiled program.
+
+        Syncs are data-dependent fetches, not ``block_until_ready`` — the
+        tunneled axon plugin's block can return before the dispatch
+        retires (see docs/bench/README.md), which would charge ~0ms to
+        arbitrarily expensive programs."""
+        if _PROFILE_N <= 0:
             return
-        jax.block_until_ready(fn(args))
+
+        def sync(r):
+            leaf = jax.tree_util.tree_leaves(r)[0]
+            np.asarray(jax.numpy.ravel(leaf)[:1])
+
+        sync(fn(args))
         t0 = _time.perf_counter()
         r = None
         for _ in range(_PROFILE_N):
             r = fn(args)
-        jax.block_until_ready(r)
+        sync(r)
         st = self.last_stats
         st["profile_device_ms"] = round(
             st.get("profile_device_ms", 0.0)
@@ -1123,7 +1146,7 @@ class QueryEngine:
                     found[0] = True
                 if isinstance(n, E.InList) \
                         and isinstance(n.values, E.FrozenIntSet) \
-                        and len(n.values.array) > 2 * EC._CHAIN_MAX_RANGES:
+                        and not EC.int_set_lowers_to_chain(n.values.array):
                     found[0] = True
                 return n
             E.transform(e, visit)
@@ -1132,7 +1155,7 @@ class QueryEngine:
         def is_expensive(x):
             if isinstance(x, S.InFilter) \
                     and isinstance(x.values, E.FrozenIntSet) \
-                    and len(x.values.array) > 2 * EC._CHAIN_MAX_RANGES:
+                    and not EC.int_set_lowers_to_chain(x.values.array):
                 return True
             if isinstance(x, S.ExprFilter):
                 return expr_has_gather(x.expr)
@@ -1434,6 +1457,7 @@ class QueryEngine:
                                 agg_plans, routes, metric, ascending,
                                 k_cand, k_sel, T))
                         self._tick()
+                        self._profile_dispatch(gfn, table)
                         _tf = _time.perf_counter()
                         raw = unpackB(gfn(table))
                         self._stamp("fetch_ms", _tf)
@@ -1448,6 +1472,7 @@ class QueryEngine:
                         lambda kg=kg: self._build_hash_gather_program(
                             agg_plans, routes, kg, T, sharded))
                     self._tick()
+                    self._profile_dispatch(gfn, table)
                     _tf = _time.perf_counter()
                     raw = unpackB(gfn(table))
                     self._stamp("fetch_ms", _tf)
@@ -3090,6 +3115,7 @@ def _pad_segments(s: int, n_dev: int) -> int:
 def _host_column_values(ds: Datasource, name: str,
                         idx: Optional[np.ndarray]):
     """Decoded host values of a column (optionally row-subset)."""
+    ds.require_complete("host-tier column materialization")
     if name in ds.dims:
         col = ds.dims[name]
         codes = col.codes if idx is None else col.codes[idx]

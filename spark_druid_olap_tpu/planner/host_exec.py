@@ -77,6 +77,7 @@ def datasource_frame(ctx, name: str, columns=None) -> pd.DataFrame:
     if name in SYS_VIEWS and name not in ctx.store.names():
         return SYS_VIEWS[name](ctx)
     ds = ctx.store.get(name)
+    ds.require_complete("host-tier frame materialization")
     names = ds.column_names()
     if columns is not None:
         names = [c for c in names if c in columns]

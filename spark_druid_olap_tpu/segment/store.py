@@ -60,7 +60,8 @@ class Datasource:
     def __init__(self, name: str, time: Optional[TimeColumn],
                  dims: Dict[str, DimColumn], metrics: Dict[str, MetricColumn],
                  segments: List[Segment],
-                 spatial: Optional[Dict[str, Tuple[str, ...]]] = None):
+                 spatial: Optional[Dict[str, Tuple[str, ...]]] = None,
+                 host_assignment=None, host_id: int = 0):
         self.name = name
         self.time = time
         self.dims = dims
@@ -72,8 +73,45 @@ class Datasource:
             k: tuple(v) for k, v in (spatial or {}).items()}
         self._stacked_cache: Dict[str, np.ndarray] = {}
         self._bounds_cache: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        # multi-host partial store (parallel/multihost.py): ``segments``
+        # stays the GLOBAL metadata list (planning must be deterministic
+        # across processes); column arrays cover only rows of the segments
+        # assigned to ``host_id``, concatenated in ascending global order.
+        # ``host_assignment`` is the global [S] -> host map (≈ Druid's
+        # segment->historical assignment, DruidMetadataCache.scala:105-148).
+        self.host_id = int(host_id)
+        if host_assignment is None:
+            self.host_assignment = None
+            self.local_seg_ids = None
+            self._local_pos = None
+        else:
+            self.host_assignment = np.asarray(host_assignment, np.int32)
+            if len(self.host_assignment) != len(segments):
+                raise ValueError("host_assignment length != num segments")
+            self.local_seg_ids = np.nonzero(
+                self.host_assignment == self.host_id)[0].astype(np.int64)
+            pos = np.full(len(segments), -1, dtype=np.int64)
+            pos[self.local_seg_ids] = np.arange(len(self.local_seg_ids))
+            self._local_pos = pos
+        # padded_rows from GLOBAL metadata — identical on every host
         n = max((s.num_rows for s in segments), default=0)
         self.padded_rows = max(ROW_ALIGN, -(-n // ROW_ALIGN) * ROW_ALIGN)
+
+    # -- multi-host partial stores -------------------------------------------
+    @property
+    def is_partial(self) -> bool:
+        """True when this process holds only its host's segment data."""
+        return self.local_seg_ids is not None
+
+    def require_complete(self, what: str = "this operation") -> None:
+        """Host-tier paths materialize full columns; on a partial store
+        that would silently compute over ONE host's rows."""
+        if self.is_partial:
+            raise RuntimeError(
+                f"{what} requires the complete datasource, but "
+                f"{self.name!r} holds only host {self.host_id}'s "
+                f"{len(self.local_seg_ids)}/{self.num_segments} segments "
+                f"(multi-host partial store)")
 
     # -- basic shape ----------------------------------------------------------
     @property
@@ -142,12 +180,24 @@ class Datasource:
 
     # -- stacked tensors ------------------------------------------------------
     def _boundaries(self):
-        return [(s.start_row, s.end_row) for s in self.segments]
+        """Per-stacked-row (start, end) into the column arrays. Complete
+        store: global row ranges, one per segment. Partial store: LOCAL
+        row ranges (columns hold only local rows), one per local segment
+        — derived from global segment sizes, so the layout contract with
+        the per-host ingest is metadata-only."""
+        if not self.is_partial:
+            return [(s.start_row, s.end_row) for s in self.segments]
+        sizes = np.asarray([self.segments[int(i)].num_rows
+                            for i in self.local_seg_ids], dtype=np.int64)
+        starts = np.concatenate([[0], np.cumsum(sizes)[:-1]]) \
+            if len(sizes) else np.zeros(0, np.int64)
+        return [(int(s), int(s + n)) for s, n in zip(starts, sizes)]
 
     def _stack(self, values: np.ndarray, fill=0) -> np.ndarray:
-        out = np.full((self.num_segments, self.padded_rows), fill,
+        bounds = self._boundaries()
+        out = np.full((len(bounds), self.padded_rows), fill,
                       dtype=values.dtype)
-        for i, (s, e) in enumerate(self._boundaries()):
+        for i, (s, e) in enumerate(bounds):
             out[i, : e - s] = values[s:e]
         return out
 
@@ -176,11 +226,13 @@ class Datasource:
         return self._stacked_cache[key]
 
     def stacked_row_validity(self) -> np.ndarray:
-        """[S, R] bool: True for real rows, False for padding."""
+        """[S, R] bool: True for real rows, False for padding (S = local
+        segments on a partial store)."""
         key = "__rows__"
         if key not in self._stacked_cache:
-            out = np.zeros((self.num_segments, self.padded_rows), dtype=bool)
-            for i, (s, e) in enumerate(self._boundaries()):
+            bounds = self._boundaries()
+            out = np.zeros((len(bounds), self.padded_rows), dtype=bool)
+            for i, (s, e) in enumerate(bounds):
                 out[i, : e - s] = True
             self._stacked_cache[key] = out
         return self._stacked_cache[key]
@@ -206,6 +258,7 @@ class Datasource:
         """([S] min, [S] max) of a numeric metric column per segment (NaNs /
         null rows ignored) — zone-map pruning metadata, and the bounding-box
         analog of the reference's spatial index."""
+        self.require_complete("zone-map bounds")
         hit = self._bounds_cache.get(name)
         if hit is not None:
             return hit
@@ -241,7 +294,11 @@ class Datasource:
             keep = np.zeros(self.num_segments, dtype=bool)
             for lo, hi in intervals:
                 keep |= (maxs >= lo) & (mins < hi)
-        if filter_spec is not None and keep.any():
+        if filter_spec is not None and keep.any() and not self.is_partial:
+            # zone maps read column data — on a partial store they would
+            # differ per process, and a divergent pruning decision changes
+            # program shapes (mesh deadlock). Time pruning above is
+            # metadata-only and stays; the row-level filter still runs.
             keep &= self._filter_keep_mask(filter_spec)
         return np.nonzero(keep)[0]
 
@@ -278,6 +335,47 @@ class Datasource:
             except (TypeError, ValueError):
                 return ones
         return ones
+
+
+def restrict_to_host(ds: Datasource, host_assignment,
+                     host_id: int) -> Datasource:
+    """Partial copy of a complete datasource holding only ``host_id``'s
+    segment rows (the in-memory analog of per-host streamed ingest — each
+    test process ingests the same frame deterministically, then drops the
+    rows it doesn't own). Metric min/max bounds are computed GLOBALLY
+    before slicing and injected, so cost-model selectivity stays identical
+    on every process."""
+    import dataclasses as _dc
+
+    assignment = np.asarray(host_assignment, np.int32)
+    local = np.nonzero(assignment == int(host_id))[0]
+    ranges = [(ds.segments[int(i)].start_row, ds.segments[int(i)].end_row)
+              for i in local]
+
+    def _slice(arr):
+        if arr is None or not ranges:
+            return None if arr is None else arr[:0]
+        return np.concatenate([arr[s:e] for s, e in ranges])
+
+    dims = {}
+    for k, d in ds.dims.items():
+        dims[k] = _dc.replace(d, codes=_slice(d.codes),
+                              validity=_slice(d.validity))
+    mets = {}
+    for k, m in ds.metrics.items():
+        gmin, gmax = m.min, m.max            # global, pre-slice
+        mm = _dc.replace(m, values=_slice(m.values),
+                         validity=_slice(m.validity))
+        mm._bounds_cache = (gmin, gmax)
+        mets[k] = mm
+    time = None
+    if ds.time is not None:
+        time = _dc.replace(ds.time, days=_slice(ds.time.days),
+                           ms_in_day=_slice(ds.time.ms_in_day))
+    return Datasource(name=ds.name, time=time, dims=dims, metrics=mets,
+                      segments=list(ds.segments),
+                      spatial=dict(ds.spatial),
+                      host_assignment=assignment, host_id=int(host_id))
 
 
 class SegmentStore:

@@ -86,6 +86,18 @@ def resolve_lookups(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
                        for o in stmt.order_by))
 
 
+class _NegativePlan:
+    """Negative plan-cache entry: the builder deterministically rejects the
+    statement under the current (store, config). A dedicated type — the
+    old structural sentinel (a bare ('unsupported', msg) tuple) would
+    silently misclassify any future tuple-shaped plan (ADVICE r3)."""
+
+    __slots__ = ("reason",)
+
+    def __init__(self, reason: str):
+        self.reason = reason
+
+
 def run_sql(ctx, sql: str, query_id: Optional[str] = None) -> QueryResult:
     if query_id is not None:
         # register BEFORE planning so a cancel landing at any point in the
@@ -280,11 +292,11 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
         plan_cached = pq is not None
         if plan_cached:
             _pcache.move_to_end(_pkey)
-            if isinstance(pq, tuple) and pq[0] == "unsupported":
+            if isinstance(pq, _NegativePlan):
                 # negative entry: the builder deterministically rejects
                 # this statement under the current store/config — skip
                 # straight to the composite/host tiers
-                raise PlanUnsupported(pq[1])
+                raise PlanUnsupported(pq.reason)
         else:
             _tr = _time.perf_counter()
             stmt2 = trace("merge_derived", stmt, merge_derived(ctx, stmt))
@@ -300,7 +312,7 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
                 pq = B.build(ctx, stmt2)
             except PlanUnsupported as pe:
                 host_exec.result_cache_put(_pcache, _pkey,
-                                           ("unsupported", str(pe)))
+                                           _NegativePlan(str(pe)))
                 raise
             _mark("stmt_build_ms", _tb)
             host_exec.result_cache_put(_pcache, _pkey, pq)

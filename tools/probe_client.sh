@@ -3,10 +3,11 @@
 # Prints the response JSON when it lands.
 set -eu
 id="$1"; name="$2"; reps="${3:-3}"
-out="/tmp/sdot_probe_out.${id}.json"
+dir="${SDOT_PROBE_DIR:-$HOME/.sdot_probe}"
+out="${dir}/out.${id}.json"
 rm -f "$out"
 printf '{"id": %s, "name": "%s", "reps": %s}\n' "$id" "$name" "$reps" \
-  > /tmp/sdot_probe_cmd.json
+  > "${dir}/cmd.json"
 for _ in $(seq 600); do
   [ -f "$out" ] && { sleep 0.2; cat "$out"; exit 0; }
   sleep 1

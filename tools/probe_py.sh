@@ -4,11 +4,12 @@
 set -eu
 id="$1"
 code="$(cat)"
-out="/tmp/sdot_probe_out.${id}.json"
+dir="${SDOT_PROBE_DIR:-$HOME/.sdot_probe}"
+out="${dir}/out.${id}.json"
 rm -f "$out"
-python - "$id" "$code" <<'PYEOF'
+python - "$id" "$code" "$dir" <<'PYEOF'
 import json, sys
-with open("/tmp/sdot_probe_cmd.json", "w") as f:
+with open(sys.argv[3] + "/cmd.json", "w") as f:
     json.dump({"id": int(sys.argv[1]), "py": sys.argv[2]}, f)
 PYEOF
 for _ in $(seq 600); do

@@ -5,23 +5,42 @@ file so per-query experiments cost seconds, not a fresh 90s ingest +
 cold-compile suite (the tunneled-chip equivalent of keeping a warmed
 thriftserver session open, ≈ scripts/start-sparklinedatathriftserver.sh).
 
-Protocol: write JSON to /tmp/sdot_probe_cmd.json:
+Protocol: write JSON to $SDOT_PROBE_DIR/cmd.json (default
+``~/.sdot_probe`` — a 0700 user-owned dir, NOT a fixed world-writable
+/tmp path: any local user could write the command file and exec code in
+the probe process, ADVICE r3):
     {"id": 1, "name": "q21", "reps": 3}          # TPC-H query by name
     {"id": 2, "sql": "select ...", "reps": 2}    # raw SQL
     {"id": 3, "quit": true}
-Response lands in /tmp/sdot_probe_out.<id>.json with wall times and the
+Response lands in $SDOT_PROBE_DIR/out.<id>.json with wall times and the
 statement's history stats (n_dispatch / n_transfer / bytes_scanned ...).
 """
 
 import json
 import os
+import stat
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-CMD = "/tmp/sdot_probe_cmd.json"
-OUT = "/tmp/sdot_probe_out.{}.json"
+
+def probe_dir() -> str:
+    """The private command/response directory: 0700, user-owned, not a
+    symlink. Shared contract with tools/probe_client.sh / probe_py.sh."""
+    d = os.environ.get("SDOT_PROBE_DIR") \
+        or os.path.join(os.path.expanduser("~"), ".sdot_probe")
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    st = os.lstat(d)
+    if stat.S_ISLNK(st.st_mode) or st.st_uid != os.getuid():
+        raise RuntimeError(f"probe dir {d!r} is a symlink or not ours")
+    os.chmod(d, 0o700)
+    return d
+
+
+_DIR = probe_dir()
+CMD = os.path.join(_DIR, "cmd.json")
+OUT = os.path.join(_DIR, "out.{}.json")
 
 
 def main():
