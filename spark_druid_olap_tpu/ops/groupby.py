@@ -124,6 +124,11 @@ class Route:
                     (self.name + ".c", n_keys * self.n_lanes, "f32")]
         if self.tag == "limbs":
             return [(self.name + ".limbs", n_keys * N_LIMBS, "i32")]
+        if self.tag == "s64":
+            # sorted-run wide int sums (ops/sorted_groupby.py): an exact
+            # 64-bit total as an (hi: i32, lo: u32-bitcast-i32) limb pair
+            return [(self.name + ".hi", n_keys, "i32"),
+                    (self.name + ".lo", n_keys, "i32")]
         if self.tag == "i32":
             return [(self.name, n_keys, "i32")]
         return [(self.name, n_keys, "f32")]
@@ -254,6 +259,11 @@ def combine_route(route: Route, out: Dict[str, np.ndarray],
         tot = (acc + c).sum(axis=0).reshape(n_keys, ln)
         scale = np.float64(256.0) ** np.arange(ln)
         return tot @ scale
+    if route.tag == "s64":
+        hi = np.asarray(out[route.name + ".hi"]).astype(np.int64)
+        lo = np.asarray(out[route.name + ".lo"]).view(np.uint32) \
+            .astype(np.int64)
+        return (hi << 32) | lo
     if route.tag == "limbs":
         limbs = np.asarray(out[route.name + ".limbs"]) \
             .reshape(n_keys, N_LIMBS).astype(np.int64)
@@ -739,6 +749,11 @@ def route_score(route: Route, out: Dict[str, object], n_keys: int,
         scale = jnp.float32(65536.0) ** jnp.arange(
             N_LIMBS, dtype=jnp.float32)
         v = (limbs * scale[None, :]).sum(axis=1)
+    elif t == "s64":
+        hi = out[route.name + ".hi"].astype(jnp.float32)
+        lo = jax.lax.bitcast_convert_type(
+            out[route.name + ".lo"], jnp.uint32).astype(jnp.float32)
+        v = hi * jnp.float32(4294967296.0) + lo
     elif t == "i32":
         v = out[route.name].astype(jnp.float32)
     else:

@@ -1,0 +1,314 @@
+"""Sorted-run aggregation for the hashed group-by tier.
+
+The hashed tier's slot assignment (``hash_groupby.build_slots``) already
+pays ONE ``lax.sort`` over the fused key pairs — ~1.3ms/6M rows on a v5e,
+plus ~4ms per extra payload operand. The existing aggregation then
+scatters every aggregation's values into its slot (~40ms per 6M-row
+scatter on v5e, XLA's measured cost regardless of index order) — q18-class
+programs stack ~6 of those. This module replaces the scatters entirely:
+
+- **Ride the aggregation values as sort payloads.** After the sort, every
+  group's rows are one contiguous run.
+- **Sums** become prefix-sum + run-boundary difference. Integer sums run
+  in (emulated) int64 — two's-complement prefix wrap-around cancels in
+  the difference, so any per-group total that fits i64 is EXACT (wider
+  than the 4-limb route's practical range, with no chunked carry scan).
+  Counts fit i32 by construction.
+- **Float sums** use a SEGMENTED compensated scan (TwoSum carry inside an
+  ``associative_scan`` that resets at run starts) — per-group error stays
+  ~log2(run) ulps of the GROUP total. A plain prefix-sum difference would
+  carry the PREFIX magnitude's cancellation error into small groups,
+  which is why the naive version is wrong and this one is not.
+- **min/max** use a segmented scan with the same reset flag.
+- **Per-group finals** sit at each run's LAST row; a ``searchsorted``
+  over the (sorted, nondecreasing) group-id vector finds the T run-end
+  positions — log2(N) rounds of T-probe 1D gathers (take1d discipline),
+  ~log2(6M) * T probes total, versus 6M scatter updates per agg.
+
+Outputs keep the hashed tier's existing contracts (``groupby.Route``
+outputs / ``combine_route`` / host key-wise merge): ``i32`` for counts
+and provably-in-range int sums, the new ``s64`` hi/lo pair for wide int
+sums, the ``ff`` (acc, c) pair for float sums, ``i32``/``f32``(/x64
+``i64``/``f64``) sentinel min-max. Table keys/'__unres__' match
+``build_slots`` exactly (sorted occupied prefix, EMPTY padding).
+
+Backend economics: on TPU the sort is ~30x cheaper than one scatter, so
+this path wins whenever >=1 aggregation exists; the CPU fallback's x64
+sort is the expensive op (~0.3s/M rows measured) while its scatters are
+cheap, so the executor gates this to TPU backends (config-overridable —
+tests force it on CPU for differential coverage).
+
+≈ reference scope: the groupBy v2 per-segment aggregation the reference
+delegated to Druid historicals (``DruidQuerySpec.scala:638-683``); the
+sort-based formulation is original TPU design.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_druid_olap_tpu.ops import hash_groupby as H
+from spark_druid_olap_tpu.ops.groupby import (
+    AggInput,
+    F32_MAX,
+    I32_MAX,
+    I32_MIN,
+    I64_MAX,
+    I64_MIN,
+    Route,
+    _x64,
+)
+
+SUPPORTED_KINDS = ("count", "sum", "min", "max")
+
+
+def plan_sorted_routes(inputs: List[AggInput],
+                       n_rows: Optional[int] = None) -> Optional[Dict[str, Route]]:
+    """Routes for the sorted-run core, or None when some aggregation kind
+    is outside its reach (sketches -> caller keeps the scatter path).
+    Static — callable at plan time."""
+    out: Dict[str, Route] = {}
+    for a in inputs:
+        if a.kind not in SUPPORTED_KINDS:
+            return None
+        if a.kind in ("min", "max"):
+            if _x64():
+                out[a.name] = Route(a.name, a.kind,
+                                    "i64" if a.is_int else "f64")
+            else:
+                out[a.name] = Route(a.name, a.kind,
+                                    "i32" if a.is_int else "f32")
+        elif a.kind == "count":
+            out[a.name] = Route(a.name, a.kind,
+                                "i64" if _x64() else "i32")
+        elif a.is_int:
+            if _x64():
+                out[a.name] = Route(a.name, a.kind, "i64")
+            elif n_rows is not None and a.maxabs is not None \
+                    and a.maxabs * n_rows < 2**31:
+                out[a.name] = Route(a.name, a.kind, "i32")
+            else:
+                out[a.name] = Route(a.name, a.kind, "s64")
+        else:
+            out[a.name] = Route(a.name, a.kind,
+                                "f64" if _x64() else "ff", merged=False)
+    return out
+
+
+def _seg_scan(flag, vals, combine_vals):
+    """Segmented scan: inclusive scan of ``vals`` that RESETS wherever
+    ``flag`` is True (run starts). Classic associative segmented-scan
+    lifting: op((f1,v1),(f2,v2)) = (f1|f2, f2 ? v2 : combine(v1,v2))."""
+    def op(a, b):
+        fa, va = a[0], a[1:]
+        fb, vb = b[0], b[1:]
+        keep_b = fb
+        merged = combine_vals(va, vb)
+        vals_out = tuple(jnp.where(keep_b, y, m)
+                         for y, m in zip(vb, merged))
+        return (fa | fb,) + vals_out
+
+    res = jax.lax.associative_scan(op, (flag,) + tuple(vals))
+    return res[1:]
+
+
+def _two_sum(a, b):
+    """Knuth TwoSum: s + e == a + b exactly (f32)."""
+    s = a + b
+    bb = s - a
+    e = (a - (s - bb)) + (b - bb)
+    return s, e
+
+
+def _end_positions(gid_sorted, T: int):
+    """Run-end position of each of the first ``T`` group ids — binary
+    search over the nondecreasing [N] gid vector: log2(N) rounds of
+    T-probe 1D gathers (cheap) instead of any N-update scatter."""
+    n = gid_sorted.shape[0]
+    q = jnp.arange(T, dtype=jnp.int32)
+    lo = jnp.zeros((T,), jnp.int32)
+    hi = jnp.full((T,), n, jnp.int32)
+    steps = int(np.ceil(np.log2(max(n, 2)))) + 1
+
+    def body(_, st):
+        lo_, hi_ = st
+        mid = (lo_ + hi_) // 2
+        mid_c = jnp.clip(mid, 0, n - 1)
+        gv = jnp.take(gid_sorted, mid_c)     # 1D gather (take1d shape)
+        less_eq = gv <= q
+        lo_ = jnp.where(less_eq & (lo_ < hi_), mid + 1, lo_)
+        hi_ = jnp.where((~less_eq) & (lo_ < hi_), mid, hi_)
+        return lo_, hi_
+
+    lo, _ = jax.lax.fori_loop(0, steps, body, (lo, hi))
+    # lo = first index with gid > g == one past run end
+    return jnp.clip(lo - 1, 0, n - 1), lo
+
+
+def _cumsum64(v32):
+    """Inclusive prefix sum of i32 values in TRUE 64-bit on a 32-bit
+    backend (jnp.int64 silently canonicalizes to i32 there): the value is
+    a (hi: i32, lo: u32) limb pair combined with add-with-carry in an
+    associative scan. 64-bit limb addition is associative, so the scan is
+    exact; the run-boundary difference then subtracts with borrow."""
+    lo = v32.astype(jnp.uint32)
+    hi = jnp.where(v32 < 0, jnp.int32(-1), jnp.int32(0))
+
+    def op(a, b):
+        ahi, alo = a
+        bhi, blo = b
+        slo = alo + blo                       # u32 wrap
+        carry = (slo < alo).astype(jnp.int32)
+        return ahi + bhi + carry, slo
+
+    return jax.lax.associative_scan(op, (hi, lo))
+
+
+def _sub64(ahi, alo, bhi, blo):
+    """(a - b) on (hi i32, lo u32) pairs, with borrow."""
+    lo = alo - blo
+    borrow = (alo < blo).astype(jnp.int32)
+    return ahi - bhi - borrow, lo
+
+
+def sorted_hash_groupby(khi, klo, valid, T: int, inputs: List[AggInput],
+                        routes: Dict[str, Route]) -> Dict[str, object]:
+    """One-sort hashed group-by: returns the same output dict the
+    ``build_slots`` + ``dense_groupby`` pair produces — route outputs per
+    ``Route.outputs(T)`` plus '__tkhi__', '__tklo__', '__unres__'."""
+    x64 = _x64()
+    n = khi.reshape(-1).shape[0]
+    khi_f = jnp.where(valid.reshape(-1), khi.reshape(-1).astype(jnp.int32),
+                      H.EMPTY)
+    klo_f = jnp.where(valid.reshape(-1), klo.reshape(-1).astype(jnp.int32),
+                      H.EMPTY)
+
+    # payloads: pre-masked per-agg value vectors (masking BEFORE the sort
+    # keeps the per-agg filter masks off the sort operand list)
+    payloads = []
+    meta = []                      # (agg, route, payload slice indices)
+    for a in inputs:
+        r = routes[a.name]
+        base = valid.reshape(-1)
+        am = base if a.mask is None else (base & a.mask.reshape(-1))
+        if a.kind == "count":
+            payloads.append(am.astype(jnp.int32))
+            meta.append((a, r, (len(payloads) - 1,)))
+            continue
+        v = a.values.reshape(-1)
+        if a.kind in ("min", "max"):
+            if r.tag == "i32":
+                sent = I32_MAX if a.kind == "min" else I32_MIN
+                v = jnp.where(am, v.astype(jnp.int32), sent)
+            elif r.tag == "i64":
+                sent = I64_MAX if a.kind == "min" else I64_MIN
+                v = jnp.where(am, v.astype(jnp.int64), sent)
+            elif r.tag == "f64":
+                sent = jnp.float64(np.inf if a.kind == "min" else -np.inf)
+                v = jnp.where(am, v.astype(jnp.float64), sent)
+            else:
+                sent = F32_MAX if a.kind == "min" else -F32_MAX
+                v = jnp.where(am, v.astype(jnp.float32), sent)
+        else:
+            if r.tag in ("i32", "s64", "i64"):
+                v = jnp.where(am, v.astype(
+                    jnp.int64 if (x64 and r.tag == "i64")
+                    else jnp.int32), 0)
+            else:
+                v = jnp.where(am, v.astype(
+                    jnp.float64 if r.tag == "f64" else jnp.float32), 0.0)
+        payloads.append(v)
+        meta.append((a, r, (len(payloads) - 1,)))
+
+    ops = jax.lax.sort((khi_f, klo_f) + tuple(payloads), num_keys=2)
+    skh, skl = ops[0], ops[1]
+    sorted_payloads = ops[2:]
+
+    new = (skh != jnp.roll(skh, 1)) | (skl != jnp.roll(skl, 1))
+    new = new.at[0].set(True)
+    gid = jnp.cumsum(new.astype(jnp.int32)) - 1
+    occupied_row = skh != H.EMPTY
+    unresolved = jnp.sum((occupied_row & (gid >= T)).astype(jnp.int32))
+
+    end_pos, first_after = _end_positions(gid, T)
+    # group g occupied iff some row has gid == g AND its key is real
+    g_occ = (first_after > jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), first_after[:-1]])) \
+        & (jnp.take(skh, end_pos) != H.EMPTY)
+    tk_hi = jnp.where(g_occ, jnp.take(skh, end_pos), H.EMPTY)
+    tk_lo = jnp.where(g_occ, jnp.take(skl, end_pos), H.EMPTY)
+
+    prev_end = jnp.concatenate(
+        [jnp.full((1,), -1, jnp.int32), end_pos[:-1]])
+
+    out: Dict[str, object] = {}
+    for (a, r, pidx) in meta:
+        v = sorted_payloads[pidx[0]]
+        if a.kind in ("min", "max"):
+            comb = (lambda x, y: tuple(jnp.minimum(a_, b_)
+                                       for a_, b_ in zip(x, y))) \
+                if a.kind == "min" else \
+                (lambda x, y: tuple(jnp.maximum(a_, b_)
+                                    for a_, b_ in zip(x, y)))
+            scanned, = _seg_scan(new, (v,), comb)
+            finals = jnp.take(scanned, end_pos)
+            if r.tag == "i32":
+                sent = I32_MAX if a.kind == "min" else I32_MIN
+            elif r.tag == "i64":
+                sent = I64_MAX if a.kind == "min" else I64_MIN
+            elif r.tag == "f64":
+                sent = jnp.float64(np.inf if a.kind == "min" else -np.inf)
+            else:
+                sent = F32_MAX if a.kind == "min" else -F32_MAX
+            out[r.name] = jnp.where(g_occ, finals, sent)
+        elif r.tag in ("i32",) and a.kind in ("count", "sum"):
+            # wrap-exact mod 2^32: per-group totals fit i32 by the route
+            # gate, so the two's-complement prefix difference is exact
+            c = jnp.cumsum(v.astype(jnp.int32))
+            tot = jnp.take(c, end_pos) - jnp.where(
+                prev_end < 0, 0, jnp.take(c, jnp.maximum(prev_end, 0)))
+            out[r.name] = jnp.where(g_occ, tot, 0)
+        elif r.tag == "i64":
+            # x64 CPU: native 64-bit prefix sums, exact at any magnitude
+            c = jnp.cumsum(v.astype(jnp.int64))
+            tot = jnp.take(c, end_pos) - jnp.where(
+                prev_end < 0, jnp.int64(0),
+                jnp.take(c, jnp.maximum(prev_end, 0)))
+            out[r.name] = jnp.where(g_occ, tot, jnp.int64(0))
+        elif r.tag == "s64":
+            chi, clo = _cumsum64(v.astype(jnp.int32))
+            ehi = jnp.take(chi, end_pos)
+            elo = jnp.take(clo, end_pos)
+            phi = jnp.where(prev_end < 0, jnp.int32(0),
+                            jnp.take(chi, jnp.maximum(prev_end, 0)))
+            plo = jnp.where(prev_end < 0, jnp.uint32(0),
+                            jnp.take(clo, jnp.maximum(prev_end, 0)))
+            thi, tlo = _sub64(ehi, elo, phi, plo)
+            out[r.name + ".hi"] = jnp.where(g_occ, thi, 0)
+            out[r.name + ".lo"] = jax.lax.bitcast_convert_type(
+                jnp.where(g_occ, tlo, jnp.uint32(0)), jnp.int32)
+        elif r.tag == "f64":
+            scanned, = _seg_scan(new, (v,),
+                                 lambda x, y: (x[0] + y[0],))
+            out[r.name] = jnp.where(g_occ, jnp.take(scanned, end_pos), 0.0)
+        else:
+            # float sums: segmented COMPENSATED scan — (sum, err) pairs
+            # combined with TwoSum so the error term never carries the
+            # prefix magnitude into a small group's total
+            def comb(xa, xb):
+                s, e = _two_sum(xa[0], xb[0])
+                return (s, e + xa[1] + xb[1])
+            acc, comp = _seg_scan(new, (v, jnp.zeros_like(v)), comb)
+            out[r.name + ".acc"] = jnp.where(
+                g_occ, jnp.take(acc, end_pos), 0.0)
+            out[r.name + ".c"] = jnp.where(
+                g_occ, jnp.take(comp, end_pos), 0.0)
+
+    out["__tkhi__"] = tk_hi
+    out["__tklo__"] = tk_lo
+    out["__unres__"] = unresolved.reshape(1)
+    return out

@@ -33,19 +33,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_druid_olap_tpu.ir import expr as E
 from spark_druid_olap_tpu.ir import spec as S
+from spark_druid_olap_tpu.parallel import multihost as MH
 from spark_druid_olap_tpu.ops import expr_compile as EC
 from spark_druid_olap_tpu.ops import filters as F
 from spark_druid_olap_tpu.ops import groupby as G
 from spark_druid_olap_tpu.ops import hash_groupby as H
 from spark_druid_olap_tpu.ops import hll as HLL
+from spark_druid_olap_tpu.ops import pallas_groupby as PG_tpu
+from spark_druid_olap_tpu.ops import sorted_groupby as SG
 from spark_druid_olap_tpu.ops import theta as TH
 from spark_druid_olap_tpu.ops import time_ops as T
 from spark_druid_olap_tpu.ops import timezone as TZ
 from spark_druid_olap_tpu.ops.scan import (
     CompactScanContext,
     ScanContext,
+    array_dtype,
     array_names,
     build_array,
+    build_array_blocks,
     ROW_VALID_KEY,
     NULL_VALID_PREFIX,
     TIME_MS_KEY,
@@ -66,6 +71,7 @@ from spark_druid_olap_tpu.utils.config import (
     SCAN_COMPACT_MIN_ROWS,
     GROUPBY_HASH_COMPACT_MIN,
     GROUPBY_HASH_MAX_SLOTS,
+    GROUPBY_HASH_SORTED,
     GROUPBY_HASH_SLOTS,
     GROUPBY_MATMUL_MAX_KEYS,
     GROUPBY_PALLAS_MAX_KEYS,
@@ -963,11 +969,20 @@ class QueryEngine:
             C.wave_budget_bytes(self.config), self.config, n_keys,
             len(agg_plans))
         s_pad = spw if n_waves > 1 else _pad_segments(len(seg_idx), n_dev)
+        n_seg_sel = len(seg_idx)
+        multihost = sharded and MH.is_multihost()
+        if multihost:
+            seg_idx, s_pad, spw, n_waves = self._multihost_layout(
+                ds, seg_idx, n_waves)
         sketch_plans = [p for p in agg_plans if p.kind in ("hll", "theta")]
         topk = self._plan_device_topk(limit, having, agg_plans, n_keys) \
             if n_waves == 1 and not no_topk else None
         having_dev = self._plan_device_having(having, routes, agg_plans,
-                                              n_keys, topk, n_waves)
+                                              n_keys, topk, n_waves) \
+            if not multihost else None
+        # (multi-host: the having/table-resident two-dispatch path keeps
+        # finals per-chip — the host HAVING epilogue over the replicated
+        # merge is correct and cheap; revisit if profiling says otherwise)
         n_out = topk[1] if topk else n_keys
 
         top_idx = None
@@ -1121,11 +1136,11 @@ class QueryEngine:
 
         self._stamp("decode_ms", _tdec)
         self.last_stats.update({
-            "datasource": ds.name, "segments": int(len(seg_idx)),
+            "datasource": ds.name, "segments": int(n_seg_sel),
             "sharded": sharded, "groups": int(len(sel)),
             "rows_scanned": int(ds.num_rows), "waves": int(n_waves),
             "segments_per_wave": int(spw),
-            "bytes_scanned": int(seg_bytes) * int(len(seg_idx)),
+            "bytes_scanned": int(seg_bytes) * int(n_seg_sel),
             "topk_device": int(topk[1]) if topk else 0,
             "having_device": int(n_out) if having_dev else 0})
         return QueryResult(columns, data)
@@ -1202,7 +1217,8 @@ class QueryEngine:
         if not self.config.get(SCAN_COMPACT):
             return None
         min_rows = int(self.config.get(SCAN_COMPACT_MIN_ROWS))
-        rows = int(sum(ds.segments[int(si)].num_rows for si in seg_idx))
+        rows = int(sum(ds.segments[int(si)].num_rows for si in seg_idx
+                       if si >= 0))   # -1 = multihost padding slot
         from spark_druid_olap_tpu.ops import pallas_groupby as PG
         if min_rows > 0 and not PG._tpu_backend() and rows < (1 << 24):
             # On TPU the compaction sort is ~0.2ms/M rows vs ~7ms/M-update
@@ -1354,6 +1370,11 @@ class QueryEngine:
             C.wave_budget_bytes(self.config), self.config,
             min(rows_sel, T), len(agg_plans))
         s_pad = spw if n_waves > 1 else _pad_segments(len(seg_idx), n_dev)
+        n_seg_sel = len(seg_idx)
+        multihost = sharded and MH.is_multihost()
+        if multihost:
+            seg_idx, s_pad, spw, n_waves = self._multihost_layout(
+                ds, seg_idx, n_waves)
         wave_segs = [seg_idx[i: i + s_pad]
                      for i in range(0, len(seg_idx), s_pad)]
         sharding = NamedSharding(self.mesh, P(SEGMENT_AXIS, None)) \
@@ -1367,7 +1388,11 @@ class QueryEngine:
                                                   n_dev, n_waves) \
             if not no_topk else None
         exch_plan = None
-        if topk_plan is None and n_dev > 1 and n_waves == 1:
+        if topk_plan is None and n_dev > 1 and n_waves == 1 \
+                and not multihost:
+            # (multi-host: the exchange program's per-chip output would
+            # need its own gather wiring; the all_gathered full-table
+            # merge is correct — revisit for the ordered-limit hot path)
             exch_plan = self._plan_hash_topk_exchange(q, limit, having,
                                                       agg_plans)
 
@@ -1388,15 +1413,28 @@ class QueryEngine:
                 else None
             exch = exch_plan if exch_plan and exch_plan[1] * 4 <= T \
                 else None
-            compact = (topk is None and exch is None
+            compact = (topk is None and exch is None and not multihost
                        and T >= self.config.get(GROUPBY_HASH_COMPACT_MIN))
+            # (multi-host: the table-resident two-dispatch path would
+            # all_gather the full [T] table; the single-dispatch program
+            # transfers the same bytes with none of the wiring)
             k_out = topk[1] if topk else T
-            routes = G.plan_routes(
-                metas, T, self.config.get(GROUPBY_MATMUL_MAX_KEYS),
-                n_rows=int(ds.padded_rows) * int(ds.num_segments))
+            n_rows_dev = int(ds.padded_rows) * int(ds.num_segments)
+            sorted_run = False
+            sr_mode = str(self.config.get(GROUPBY_HASH_SORTED))
+            if sr_mode != "off" and (sr_mode == "on"
+                                     or PG_tpu._tpu_backend()):
+                sroutes = SG.plan_sorted_routes(metas, n_rows=n_rows_dev)
+                if sroutes is not None:
+                    routes = sroutes
+                    sorted_run = True
+            if not sorted_run:
+                routes = G.plan_routes(
+                    metas, T, self.config.get(GROUPBY_MATMUL_MAX_KEYS),
+                    n_rows=n_rows_dev)
             sig = ("hashagg", ds.name, id(ds), _cache_repr(q), s_pad,
                    ds.padded_rows, min_day, max_day, sharded, n_dev, T,
-                   tuple(names), topk, compact, lm,
+                   tuple(names), topk, compact, lm, sorted_run,
                    self.config.get(TZ_ID),
                    jax.default_backend(), bool(jax.config.jax_enable_x64))
 
@@ -1405,11 +1443,11 @@ class QueryEngine:
                     return self._build_hash_table_program(
                         ds, dim_plans, parts, agg_plans, filter_spec,
                         intervals, min_day, max_day, T, sharded, routes,
-                        compact_m=lm)
+                        compact_m=lm, sorted_run=sorted_run)
                 return self._build_hash_program(
                     ds, dim_plans, parts, agg_plans, filter_spec,
                     intervals, min_day, max_day, T, sharded, routes,
-                    topk=topk, compact_m=lm)
+                    topk=topk, compact_m=lm, sorted_run=sorted_run)
 
             prog = self._cached_program(sig, build)
 
@@ -1558,10 +1596,10 @@ class QueryEngine:
                     intervals, t0, no_topk=True)
 
         self.last_stats.update({
-            "datasource": ds.name, "segments": int(len(seg_idx)),
+            "datasource": ds.name, "segments": int(n_seg_sel),
             "sharded": sharded, "groups": int(len(keys)),
             "rows_scanned": int(ds.num_rows), "waves": int(len(wave_segs)),
-            "bytes_scanned": int(seg_bytes) * int(len(seg_idx)),
+            "bytes_scanned": int(seg_bytes) * int(n_seg_sel),
             "segments_per_wave": int(s_pad), "hashed": True,
             "hash_slots": int(T), "hash_compact_k": int(kg_used),
             "topk_device": int(topk[1]) if topk
@@ -1596,7 +1634,7 @@ class QueryEngine:
 
     def _hash_core(self, ds, dim_plans, parts, agg_plans, filter_spec,
                    intervals, min_day, max_day, T, routes,
-                   compact_m=None):
+                   compact_m=None, sorted_run=False):
         """The shared hash scan body: scan -> filter -> per-dim codes ->
         two-part key -> slot claim -> exact scatter aggregation into [T]
         buffers. Returns the raw out dict incl. '__tkhi__'/'__tklo__' key
@@ -1640,19 +1678,29 @@ class QueryEngine:
             khi = H.fuse_part(codes, cards, parts[0])
             klo = H.fuse_part(codes, cards, parts[1]) if len(parts) > 1 \
                 else jnp.zeros_like(khi)
-            slot, tk_hi, tk_lo, unresolved = H.build_slots(khi, klo, base, T)
             inputs = []
             for p in agg_plans:
                 inputs.append(G.AggInput(p.spec.name, p.kind,
                                          p.build_values(ctx),
                                          p.build_mask(ctx),
                                          is_int=p.is_int, maxabs=p.maxabs))
-            out = G.dense_groupby(slot, base, T, inputs, routes, matmul_max)
-            out["__tkhi__"] = tk_hi
-            out["__tklo__"] = tk_lo
+            if sorted_run:
+                # sorted-run tier: the slot sort rides the agg values as
+                # payloads; prefix scans + run-boundary reads replace
+                # every per-agg scatter (ops/sorted_groupby.py)
+                out = SG.sorted_hash_groupby(khi, klo, base, T, inputs,
+                                             routes)
+            else:
+                slot, tk_hi, tk_lo, unresolved = H.build_slots(
+                    khi, klo, base, T)
+                out = G.dense_groupby(slot, base, T, inputs, routes,
+                                      matmul_max)
+                out["__tkhi__"] = tk_hi
+                out["__tklo__"] = tk_lo
+                out["__unres__"] = unresolved.reshape(1)
             if n_over is not None:
-                unresolved = unresolved + n_over
-            out["__unres__"] = unresolved.reshape(1)
+                out["__unres__"] = (out["__unres__"].reshape(-1)[0]
+                                    + n_over).reshape(1)
             return out
 
         return core
@@ -1691,16 +1739,49 @@ class QueryEngine:
 
         return pack, unpack
 
+    def _multihost_layout(self, ds, seg_idx, n_waves):
+        """Re-order a (pruned) segment selection into per-host blocks so
+        each host's devices scan exactly the segments that host stores
+        (parallel/multihost.layout_segments). Returns the executor-shape
+        tuple ``(ordered_seg_idx, s_pad, spw, n_waves)`` — ordered may
+        contain ``-1`` padding slots (zero rows, validity False)."""
+        if n_waves > 1:
+            raise RuntimeError(
+                "multi-host wave mode is not supported yet: raise "
+                "sdot.engine.wave.budget.bytes or shrink the scan")
+        n_hosts, dph = MH.host_blocks(self.mesh)
+        assignment = ds.host_assignment
+        if assignment is None:
+            # complete (replicated) datasource: derive the same contiguous
+            # row-balanced split every process computes from metadata
+            rows = np.array([s.num_rows for s in ds.segments], np.int64)
+            assignment = MH.assign_segments_to_hosts(rows, n_hosts)
+        ordered, _ = MH.layout_segments(assignment, seg_idx, n_hosts, dph)
+        return ordered, len(ordered), len(ordered), 1
+
     def _shard_wrap(self, fn, in_spec, out_spec):
         if self.mesh is None:
             return jax.jit(fn)
+        if MH.is_multihost() and out_spec == P(SEGMENT_AXIS):
+            # per-chip outputs are not fetchable across processes: an
+            # in-mesh all_gather replicates them (chips-major, exactly the
+            # layout the host-side key-wise merge already expects)
+            inner = fn
+
+            def fn(x):
+                out = inner(x)
+                return jax.tree.map(
+                    lambda y: jax.lax.all_gather(y, SEGMENT_AXIS,
+                                                 tiled=True), out)
+            out_spec = P()
         smfn = jax.shard_map(fn, mesh=self.mesh, in_specs=(in_spec,),
                              out_specs=out_spec, check_vma=False)
         return jax.jit(smfn)
 
     def _build_hash_program(self, ds, dim_plans, parts, agg_plans,
                             filter_spec, intervals, min_day, max_day, T,
-                            sharded, routes, topk=None, compact_m=None):
+                            sharded, routes, topk=None, compact_m=None,
+                            sorted_run=False):
         """Single-dispatch hash program (full-table or topk-gathered
         transfer). Outputs stay per-chip in sharded mode (slot layouts
         differ per chip; the key-wise merge is host-side). With ``topk``
@@ -1708,7 +1789,7 @@ class QueryEngine:
         _plan_device_topk_hashed)."""
         core = self._hash_core(ds, dim_plans, parts, agg_plans, filter_spec,
                                intervals, min_day, max_day, T, routes,
-                               compact_m=compact_m)
+                               compact_m=compact_m, sorted_run=sorted_run)
         k_out = topk[1] if topk else T
         pack, unpack = self._hash_packers(agg_plans, routes, k_out, True,
                                           with_score=bool(topk))
@@ -1728,13 +1809,14 @@ class QueryEngine:
 
     def _build_hash_table_program(self, ds, dim_plans, parts, agg_plans,
                                   filter_spec, intervals, min_day, max_day,
-                                  T, sharded, routes, compact_m=None):
+                                  T, sharded, routes, compact_m=None,
+                                  sorted_run=False):
         """Compaction dispatch 1 of 2: build the table, leave it DEVICE-
         RESIDENT, transfer only '__stats__' = [unresolved, occupied] per
         chip. The host sizes the gather dispatch from the occupancy."""
         core = self._hash_core(ds, dim_plans, parts, agg_plans, filter_spec,
                                intervals, min_day, max_day, T, routes,
-                               compact_m=compact_m)
+                               compact_m=compact_m, sorted_run=sorted_run)
 
         def run(arrays):
             out = core(arrays)
@@ -2178,9 +2260,25 @@ class QueryEngine:
                     merged["__over__"] = jax.lax.psum(over, SEGMENT_AXIS)
                 return pack(merged)
 
+            if MH.is_multihost():
+                # the per-chip partials buffer (ff/lanes pairs, host-side
+                # lane combine) must replicate so every process can fetch;
+                # fully-merged programs emit a ZERO-length one (all_gather
+                # rejects zero-size dims — leave it, it decodes to nothing)
+                inner_core = sharded_core
+
+                def sharded_core(arrays):
+                    rep, per_chip = inner_core(arrays)
+                    if per_chip.size:
+                        per_chip = jax.lax.all_gather(
+                            per_chip, SEGMENT_AXIS, tiled=True)
+                    return rep, per_chip
+                out_specs = (P(), P())
+            else:
+                out_specs = (P(), P(SEGMENT_AXIS))
             smfn = jax.shard_map(sharded_core, mesh=mesh,
                                  in_specs=(P(SEGMENT_AXIS, None),),
-                                 out_specs=(P(), P(SEGMENT_AXIS)),
+                                 out_specs=out_specs,
                                  check_vma=False)
             fn = jax.jit(lambda arrays: smfn(arrays))
 
@@ -2457,6 +2555,9 @@ class QueryEngine:
     # -- select path ----------------------------------------------------------
     def _run_select(self, q: S.SelectQuerySpec) -> QueryResult:
         ds = self.store.get(q.datasource)
+        # select pages materialize rows host-side; a partial store would
+        # need a cross-host row exchange (future work) — fail fast
+        ds.require_complete("select scan")
         cols = list(q.columns) or ds.column_names()
         seg_idx = ds.prune_segments(q.intervals, q.filter)
         if len(seg_idx) == 0:
@@ -2497,6 +2598,8 @@ class QueryEngine:
 
     def _run_search(self, q: S.SearchQuerySpec) -> QueryResult:
         ds = self.store.get(q.datasource)
+        # host-side dictionary-occurrence counting reads full columns
+        ds.require_complete("search scan")
         mask = self._host_mask(ds, q.filter, q.intervals)
         needle = q.query if q.case_sensitive else q.query.lower()
         dims_out, vals_out, counts_out = [], [], []
@@ -2629,6 +2732,16 @@ class QueryEngine:
         return mask
 
     def _should_shard(self, q, ds, seg_idx) -> bool:
+        if ds.is_partial:
+            # a partial store's data exists only across the pod: the
+            # sharded path is the ONLY path (host/single-device would
+            # need remote rows)
+            if self.mesh is None or mesh_size(self.mesh) <= 1:
+                raise RuntimeError(
+                    f"partial datasource {ds.name!r} requires a multi-host "
+                    f"mesh (engine has {mesh_size(self.mesh)} device(s))")
+            self.last_stats["shard_decision"] = "partial-store"
+            return True
         if self.mesh is None or mesh_size(self.mesh) <= 1:
             return False
         pref = q.context.prefer_sharded if hasattr(q, "context") else None
@@ -2650,31 +2763,61 @@ class QueryEngine:
         """Fetch-or-build the device arrays a program binds. Cached per
         (datasource, array, segment selection, layout) so repeated dashboard
         queries never re-upload host data (≈ segments staying resident on
-        Druid historicals between queries)."""
+        Druid historicals between queries).
+
+        Multi-host: ``seg_idx`` is the per-host block layout (global ids
+        with -1 padding) and each process provides only the shards its
+        devices own — ``jax.make_array_from_callback`` invokes the block
+        builder per locally-addressable device, so no process ever
+        materializes (or ships) another host's rows."""
         sharding = NamedSharding(self.mesh, P(SEGMENT_AXIS, None)) \
             if sharded else None
+        multihost = sharded and MH.is_multihost()
         seg_sig = (len(seg_idx), hash(seg_idx.tobytes()))
         out = {}
         for k in names:
-            key = (id(ds), k, s_pad, seg_sig, bool(sharded))
+            key = (id(ds), k, s_pad, seg_sig, bool(sharded), multihost)
             dev = self._device_arrays.get(key)   # lock-free warm path
             if dev is None:
                 with self._compile_lock:
                     dev = self._device_arrays.get(key)
                     if dev is None:
-                        host = _build_array_checked(ds, k, seg_idx, s_pad)
+                        if multihost:
+                            dt = array_dtype(ds, k)
+                            if dt == np.int64 and not G._x64():
+                                raise EngineFallback(
+                                    f"wide integer column {k!r} on a "
+                                    f"32-bit backend")
+                            # account what THIS process holds (its own
+                            # devices' shards), not the global array
+                            nbytes = len(seg_idx) * ds.padded_rows \
+                                * np.dtype(dt).itemsize \
+                                // max(jax.process_count(), 1)
+                            host = None
+                        else:
+                            host = _build_array_checked(ds, k, seg_idx,
+                                                        s_pad)
+                            nbytes = int(host.nbytes)
                         # bound device residency: distinct segment
                         # selections (paged selects, shifting intervals)
-                        # would otherwise pin fresh copies until OOM
+                        # would otherwise pin fresh copies until OOM.
+                        # Evict BEFORE the upload so peak residency never
+                        # exceeds cap + one array.
                         cap = int(self.config.get(DEVICE_CACHE_BYTES))
-                        if self._device_bytes + host.nbytes > cap \
+                        if self._device_bytes + nbytes > cap \
                                 and self._device_arrays:
                             self._device_arrays.clear()
                             self._device_bytes = 0
                         self._tick(1)
-                        dev = _device_put_retry(host, sharding)
+                        if multihost:
+                            dev = MH.put_sharded_blocks(
+                                lambda ids, k=k: build_array_blocks(
+                                    ds, k, ids),
+                                seg_idx, ds.padded_rows, dt, sharding)
+                        else:
+                            dev = _device_put_retry(host, sharding)
                         self._device_arrays[key] = dev
-                        self._device_bytes += int(host.nbytes)
+                        self._device_bytes += nbytes
             out[k] = dev
         return out
 

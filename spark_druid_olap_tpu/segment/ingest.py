@@ -63,6 +63,8 @@ def ingest_dataframe(
     metric_kinds: Optional[Dict[str, ColumnKind]] = None,
     spatial_dims: Optional[Dict[str, Iterable[str]]] = None,
     drop_columns: Optional[Iterable[str]] = None,
+    n_hosts: Optional[int] = None,
+    host_id: Optional[int] = None,
 ) -> Datasource:
     """Ingest a DataFrame as a datasource.
 
@@ -179,8 +181,22 @@ def ingest_dataframe(
                     f"column of {name!r}")
         spatial[sname] = axes
 
-    return Datasource(name=name, time=time_col, dims=dims, metrics=mets,
-                      segments=segments, spatial=spatial)
+    ds = Datasource(name=name, time=time_col, dims=dims, metrics=mets,
+                    segments=segments, spatial=spatial)
+    if n_hosts is not None and n_hosts > 1:
+        # multi-host partial ingest (in-memory path): every process
+        # ingests the same frame deterministically, then keeps only its
+        # host's segment rows. The streamed path
+        # (stream_ingest.ingest_parquet_stream) never materializes remote
+        # rows at all — this one trades that for simplicity at
+        # in-memory scale.
+        from spark_druid_olap_tpu.parallel.multihost import (
+            assign_segments_to_hosts)
+        from spark_druid_olap_tpu.segment.store import restrict_to_host
+        rows = np.array([s.num_rows for s in segments], np.int64)
+        assignment = assign_segments_to_hosts(rows, int(n_hosts))
+        ds = restrict_to_host(ds, assignment, int(host_id or 0))
+    return ds
 
 
 def ingest_parquet(name: str, path: str, **kwargs) -> Datasource:

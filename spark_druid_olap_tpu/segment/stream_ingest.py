@@ -82,8 +82,18 @@ def ingest_parquet_stream(
     target_rows: int = 1 << 20,
     batch_rows: int = 1 << 20,
     metric_kinds: Optional[Dict[str, ColumnKind]] = None,
+    n_hosts: Optional[int] = None,
+    host_id: Optional[int] = None,
 ) -> Datasource:
-    """Stream a Parquet file into a datasource without materializing it."""
+    """Stream a Parquet file into a datasource without materializing it.
+
+    With ``n_hosts``/``host_id`` this becomes the multi-host per-process
+    ingest (≈ each Druid middle-manager indexing only its own time
+    chunks): pass A (dictionaries, ranges, histogram) still streams the
+    whole file — its products are the GLOBAL metadata every process must
+    agree on and they are tiny — but pass B allocates and scatters ONLY
+    the rows of this host's segments, so per-host peak memory is
+    ~1/n_hosts of the dataset (plus one in-flight batch)."""
     dim_names = set(dimensions) if dimensions is not None else None
     metric_names = set(metrics) if metrics is not None else None
     metric_kinds = metric_kinds or {}
@@ -97,6 +107,10 @@ def ingest_parquet_stream(
     has_null: Dict[str, bool] = {c: False for c in cols}
     int_min: Dict[str, int] = {}
     int_max: Dict[str, int] = {}
+    # global float/date ranges: injected as the partial datasource's
+    # metric bounds so cost-model selectivity is identical on every host
+    flt_min: Dict[str, float] = {}
+    flt_max: Dict[str, float] = {}
     day_counts: Dict[int, int] = {}
     first = True
     for batch in batches:
@@ -140,8 +154,19 @@ def ingest_parquet_stream(
                     int_min[c] = min(int_min.get(c, lo), lo)
                     int_max[c] = max(int_max.get(c, hi), hi)
             elif k == ColumnKind.DOUBLE:
-                has_null[c] |= bool(
-                    np.isnan(s.to_numpy(np.float64, na_value=np.nan)).any())
+                v = s.to_numpy(np.float64, na_value=np.nan)
+                has_null[c] |= bool(np.isnan(v).any())
+                v = v[~np.isnan(v)]
+                if len(v):
+                    lo, hi = float(v.min()), float(v.max())
+                    flt_min[c] = min(flt_min.get(c, lo), lo)
+                    flt_max[c] = max(flt_max.get(c, hi), hi)
+            elif k == ColumnKind.DATE:
+                d = np.floor_divide(_to_epoch_millis(s), MILLIS_PER_DAY)
+                if len(d):
+                    lo, hi = int(d.min()), int(d.max())
+                    int_min[c] = min(int_min.get(c, lo), lo)
+                    int_max[c] = max(int_max.get(c, hi), hi)
         first = False
 
     # -- segment partitioning over the day histogram --------------------------
@@ -166,6 +191,21 @@ def ingest_parquet_stream(
         seg_of_day = None
     seg_starts = np.concatenate([[0], np.cumsum(seg_rows)[:-1]])
 
+    # -- multi-host: this process materializes only its segments --------------
+    assignment = None
+    local_of_seg = None          # [n_seg] local row start, -1 when remote
+    n_alloc = int(n_total)
+    if n_hosts is not None and int(n_hosts) > 1:
+        from spark_druid_olap_tpu.parallel.multihost import (
+            assign_segments_to_hosts)
+        assignment = assign_segments_to_hosts(seg_rows, int(n_hosts))
+        is_local = assignment == int(host_id or 0)
+        local_sizes = np.where(is_local, seg_rows, 0)
+        local_starts = np.concatenate([[0], np.cumsum(local_sizes)[:-1]]) \
+            if len(local_sizes) else np.zeros(0, np.int64)
+        local_of_seg = np.where(is_local, local_starts, -1)
+        n_alloc = int(local_sizes.sum())
+
     # -- preallocate final columns -------------------------------------------
     ii = np.iinfo(np.int32)
 
@@ -185,18 +225,18 @@ def ingest_parquet_stream(
     dicts: Dict[str, np.ndarray] = {}
     for c in cols:
         if c == time_column:
-            out["__days__"] = np.zeros(n_total, np.int32)
-            out["__ms__"] = np.zeros(n_total, np.int32)
+            out["__days__"] = np.zeros(n_alloc, np.int32)
+            out["__ms__"] = np.zeros(n_alloc, np.int32)
             continue
         if kinds[c] == ColumnKind.DIM:
             from spark_druid_olap_tpu.segment.column import narrow_int_dtype
             dicts[c] = uniques.get(c, np.array([], dtype=object))
-            out[c] = np.zeros(n_total, narrow_int_dtype(
+            out[c] = np.zeros(n_alloc, narrow_int_dtype(
                 0, max(len(dicts[c]) - 1, 0)))
         else:
-            out[c] = np.zeros(n_total, metric_dtype(c))
+            out[c] = np.zeros(n_alloc, metric_dtype(c))
         if has_null[c]:
-            validity[c] = np.zeros(n_total, bool)
+            validity[c] = np.zeros(n_alloc, bool)
 
     # -- pass B: encode + scatter into destination slots ----------------------
     cursors = seg_starts.copy()
@@ -221,6 +261,8 @@ def ingest_parquet_stream(
                 dest[order[st: st + cnt]] = cursors[s_] + np.arange(cnt)
                 cursors[s_] += cnt
                 m = ms[order[st: st + cnt]]
+                # GLOBAL segment time bounds: every process computes all
+                # of them (metadata must agree across hosts)
                 seg_min_ms[s_] = min(seg_min_ms[s_], int(m.min()))
                 seg_max_ms[s_] = max(seg_max_ms[s_], int(m.max()))
         else:
@@ -228,10 +270,20 @@ def ingest_parquet_stream(
             start = int(cursors[0])
             dest = np.arange(start, start + bn)
             cursors[0] += bn
+            seg_idx = np.searchsorted(seg_starts, dest, side="right") - 1
+
+        if local_of_seg is not None:
+            # keep only this host's rows; global dest -> local dest
+            lstart = local_of_seg[seg_idx]
+            keep = lstart >= 0
+            dest = (lstart + (dest - seg_starts[seg_idx]))[keep]
+        else:
+            keep = slice(None)
 
         if time_column is not None:
-            out["__days__"][dest] = days.astype(np.int32)
-            out["__ms__"][dest] = (ms - days * MILLIS_PER_DAY) \
+            out["__days__"][dest] = days[keep].astype(np.int32)
+            out["__ms__"][dest] = (ms[keep]
+                                   - days[keep] * MILLIS_PER_DAY) \
                 .astype(np.int32)
         for c in cols:
             if c == time_column:
@@ -239,7 +291,7 @@ def ingest_parquet_stream(
             s = _series_of(batch, c)
             k = kinds[c]
             if k == ColumnKind.DIM:
-                raw = s.to_numpy(dtype=object)
+                raw = s.to_numpy(dtype=object)[keep]
                 valid = _valid_mask(raw)
                 safe = np.where(valid, raw, "").astype(str)
                 codes = np.searchsorted(dicts[c], safe)
@@ -250,11 +302,11 @@ def ingest_parquet_stream(
                 if c in validity:
                     validity[c][dest] = valid
             elif k == ColumnKind.DATE:
-                msd = _to_epoch_millis(s)
+                msd = _to_epoch_millis(s)[keep]
                 out[c][dest] = np.floor_divide(
                     msd, MILLIS_PER_DAY).astype(np.int32)
             else:
-                v = s.to_numpy()
+                v = s.to_numpy()[keep]
                 if c in validity:
                     # null-free batches surface as int dtype: still valid
                     if np.issubdtype(v.dtype, np.floating):
@@ -283,6 +335,7 @@ def ingest_parquet_stream(
                                    validity=validity.get(c),
                                    kind=kinds[c])
     segments = []
+    kept_assignment = []
     for i, (st, cnt) in enumerate(zip(seg_starts.tolist(),
                                       seg_rows.tolist())):
         if cnt <= 0:
@@ -294,8 +347,23 @@ def ingest_parquet_stream(
         segments.append(Segment(id=f"{name}_{i:05d}", start_row=int(st),
                                 end_row=int(st + cnt), min_millis=lo,
                                 max_millis=hi))
-    return Datasource(name=name, time=time_col, dims=dims, metrics=mets,
-                      segments=segments, spatial={})
+        if assignment is not None:
+            kept_assignment.append(int(assignment[i]))
+    ds = Datasource(name=name, time=time_col, dims=dims, metrics=mets,
+                    segments=segments, spatial={},
+                    host_assignment=(np.asarray(kept_assignment, np.int32)
+                                     if assignment is not None else None),
+                    host_id=int(host_id or 0))
+    if assignment is not None:
+        # inject GLOBAL metric bounds from pass A — local values would
+        # give each host a different cost-model selectivity (and thus
+        # divergent program shapes: a mesh deadlock)
+        for c, m in mets.items():
+            if kinds[c] == ColumnKind.DOUBLE:
+                m._bounds_cache = (flt_min.get(c), flt_max.get(c))
+            else:
+                m._bounds_cache = (int_min.get(c), int_max.get(c))
+    return ds
 
 
 def flatten_join_stream(base_path: str, out_path: str, joins,

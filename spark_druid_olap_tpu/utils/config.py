@@ -195,6 +195,14 @@ TOPN_DEVICE_MIN_KEYS = _entry(
     "runs its top-k selection on device (lax.top_k over the merged "
     "partials, transferring only the candidate rows). Below it the full "
     "[K] result transfers and the host sorts (cheap at small K).")
+GROUPBY_HASH_SORTED = _entry(
+    "sdot.engine.groupby.hash.sortedrun", "auto",
+    "Sorted-run aggregation for the hashed group-by tier "
+    "(ops/sorted_groupby.py): ride agg values as sort payloads and "
+    "replace per-agg scatters with prefix scans + run-boundary reads. "
+    "'auto' = on for TPU backends (the sort is ~30x cheaper than one "
+    "scatter there) and off on the CPU fallback (x64 sort dominates); "
+    "'on'/'off' force it (tests force 'on' for differential coverage).")
 GROUPBY_HASH_COMPACT_MIN = _entry(
     "sdot.engine.groupby.hash.compact.min.slots", 1 << 18,
     "Min hash-table slot count before the hashed group-by compacts on "

@@ -1,0 +1,154 @@
+"""Worker process for the multi-host integration tests.
+
+Each worker is one "host": it joins the ``jax.distributed`` runtime
+(virtual 4-CPU-device backend — the multi-process extension of
+conftest.py's 8-device single-process mesh), ingests ONLY its host's
+segment rows (``n_hosts``/``host_id`` partial ingest), builds the global
+mesh over all processes' devices, and runs the query list. Process 0
+writes results JSON for the parent test to diff against a single-process
+run of the same data.
+
+Usage: python tests/multihost_worker.py <pid> <nproc> <port> <out.json>
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEVICES_PER_PROCESS = 4
+
+
+def make_frame():
+    import numpy as np
+    import pandas as pd
+    rng = np.random.default_rng(42)
+    n = 60_000
+    return pd.DataFrame({
+        "ts": pd.Timestamp("2021-01-01")
+        + pd.to_timedelta(rng.integers(0, 365, n), unit="D"),
+        "region": rng.choice(["east", "west", "north", "south"], n),
+        "sku": rng.integers(0, 2000, n).astype(str),     # high-card dim
+        "qty": rng.integers(0, 50, n),
+        "price": rng.normal(20.0, 5.0, n).round(3),
+        "wide": rng.integers(-1_000_000, 1_000_000, n),
+    })
+
+
+QUERIES = {
+    # dense group-by, filter, order
+    "dense": ("select region, sum(qty) as q, count(*) as c, "
+              "min(price) as mn, max(price) as mx from sales "
+              "where qty > 10 group by region order by region"),
+    # hashed tier: high-cardinality key
+    "hashed": ("select sku, sum(qty) as q from sales "
+               "where qty > 30 group by sku order by q desc, sku limit 25"),
+    # time bucketing
+    "timeseries": ("select date_trunc('month', ts) as m, sum(price) as p, "
+                   "count(*) as c from sales group by 1 order by 1"),
+    # avg decomposition + having epilogue
+    "having": ("select region, avg(price) as ap from sales group by region "
+               "having count(*) > 100 order by region"),
+    # interval pruning (prunes whole hosts under contiguous assignment)
+    "pruned": ("select region, count(*) as c from sales "
+               "where ts >= timestamp '2021-10-01' group by region "
+               "order by region"),
+    # count distinct (HLL register merges across processes)
+    "hll": ("select approx_count_distinct(sku) as d from sales"),
+}
+
+
+def run_queries(ctx):
+    import pandas as pd
+    out = {}
+    for name, sql in QUERIES.items():
+        r = ctx.sql(sql).to_pandas()
+        st = ctx.history.entries()[-1].stats
+        out[name] = {
+            "columns": list(r.columns),
+            "rows": json.loads(r.to_json(orient="values",
+                                         date_format="iso")),
+            "mode": st.get("mode", "engine"),
+            "sharded": bool(st.get("sharded")),
+        }
+    return out
+
+
+def spawn_workers(n_processes: int, outpath: str,
+                  devices_per_process: int = DEVICES_PER_PROCESS,
+                  timeout_s: float = 600.0):
+    """Run ``n_processes`` worker processes to completion (the shared rig
+    for tests/test_multihost.py and __graft_entry__.dryrun_multiprocess).
+    Returns the parsed results JSON; raises AssertionError with worker
+    logs on failure."""
+    import socket
+    import subprocess
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    worker = os.path.abspath(__file__)
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(pid), str(n_processes), str(port),
+         str(outpath), str(devices_per_process)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for pid in range(n_processes)]
+    logs = []
+    try:
+        for p in procs:
+            stdout, _ = p.communicate(timeout=timeout_s)
+            logs.append(stdout.decode(errors="replace"))
+    finally:
+        for p in procs:
+            p.kill()
+    assert all(p.returncode == 0 for p in procs), \
+        "multihost worker failed:\n" + "\n====\n".join(logs)
+    with open(outpath) as f:
+        return json.load(f)
+
+
+def main():
+    pid, nproc = int(sys.argv[1]), int(sys.argv[2])
+    port, outpath = sys.argv[3], sys.argv[4]
+    devs = int(sys.argv[5]) if len(sys.argv) > 5 else DEVICES_PER_PROCESS
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["TZ"] = "UTC"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from spark_druid_olap_tpu.parallel import multihost as MH
+    MH.initialize(f"127.0.0.1:{port}", nproc, pid,
+                  local_device_count=devs)
+    assert jax.process_count() == nproc
+    assert len(jax.devices()) == nproc * devs
+
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+
+    ctx = sdot.Context(mesh=make_mesh())
+    ds = ctx.ingest_dataframe("sales", make_frame(), time_column="ts",
+                              target_rows=4096, n_hosts=nproc, host_id=pid)
+    assert ds.is_partial
+    n_local = len(ds.local_seg_ids)
+    assert 0 < n_local < ds.num_segments, \
+        f"host {pid} holds {n_local}/{ds.num_segments} segments"
+
+    results = run_queries(ctx)
+    results["_meta"] = {
+        "pid": pid, "n_local_segments": n_local,
+        "n_segments": ds.num_segments,
+        "devices": len(jax.devices()),
+    }
+    # every process computes replicated results; process 0 publishes
+    if pid == 0:
+        with open(outpath, "w") as f:
+            json.dump(results, f, indent=1)
+    print(f"[worker {pid}] done ({n_local}/{ds.num_segments} local "
+          f"segments)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,192 @@
+"""Sorted-run hashed aggregation (ops/sorted_groupby.py): the one-sort
+payload-riding tier that replaces the hashed path's per-agg scatters.
+
+Differential contract: with ``sdot.engine.groupby.hash.sortedrun`` forced
+on (CPU default is off — the x64 sort dominates there), every hashed
+query must produce bit-identical int results and ~1e-6 float results
+against both the scatter tier and a pandas oracle — including wide int
+sums, filtered aggregations, FD-demoted anyvalue dims, NULL-bearing
+min/max, overflow retry, and the sharded mesh.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.ops import groupby as G
+from spark_druid_olap_tpu.ops import hash_groupby as H
+from spark_druid_olap_tpu.ops import sorted_groupby as SG
+
+HASHED_CONF = {"sdot.engine.groupby.dense.max.keys": 512,
+               "sdot.engine.groupby.hash.sortedrun": "on"}
+
+
+def _frame(n=50_000, seed=9, n_keys=8000):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "k": rng.integers(0, n_keys, n).astype(str),
+        "q": rng.integers(0, 50, n),
+        "wide": rng.integers(-10**9, 10**9, n),
+        "price": rng.normal(100, 30, n).round(4),
+        "nul": np.where(rng.random(n) < 0.15, np.nan,
+                        rng.normal(5, 2, n)),
+        "flag": rng.choice(["a", "b"], n),
+    })
+
+
+SQL = ("select k, sum(q) as s, sum(wide) as w, sum(price) as p, "
+       "min(nul) as mn, max(nul) as mx, count(*) as c, "
+       "sum(case when flag = 'a' then q else 0 end) as fq "
+       "from t group by k order by k")
+
+
+def _run(conf, df):
+    ctx = sdot.Context(config=conf)
+    ctx.ingest_dataframe("t", df)
+    r = ctx.sql(SQL).to_pandas()
+    st = ctx.history.entries()[-1].stats
+    assert st.get("hashed"), st
+    return r
+
+
+def test_sorted_run_matches_scatter_and_pandas():
+    df = _frame()
+    on = _run(HASHED_CONF, df)
+    off = _run({**HASHED_CONF,
+                "sdot.engine.groupby.hash.sortedrun": "off"}, df)
+    pd.testing.assert_frame_equal(on, off, check_dtype=False, rtol=1e-9,
+                                  atol=1e-9)
+    o = df.assign(fqv=np.where(df.flag == "a", df.q, 0)).groupby("k").agg(
+        s=("q", "sum"), w=("wide", "sum"), p=("price", "sum"),
+        mn=("nul", "min"), mx=("nul", "max"), c=("q", "size"),
+        fq=("fqv", "sum")).reset_index().sort_values("k") \
+        .reset_index(drop=True)
+    assert on.s.astype(int).tolist() == o.s.tolist()
+    assert on.w.astype(int).tolist() == o.w.tolist()
+    assert on.c.astype(int).tolist() == o.c.tolist()
+    assert on.fq.astype(int).tolist() == o.fq.tolist()
+    assert np.allclose(on.p, o.p, rtol=1e-6)
+    assert np.allclose(on.mn.fillna(-9), o.mn.fillna(-9), rtol=1e-6)
+    assert np.allclose(on.mx.fillna(-9), o.mx.fillna(-9), rtol=1e-6)
+
+
+def test_sorted_run_sharded_matches():
+    df = _frame(n=40_000, seed=4)
+    conf = {**HASHED_CONF, "sdot.querycostmodel.enabled": False}
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+    ctx = sdot.Context(config=conf, mesh=make_mesh())
+    ctx.ingest_dataframe("t", df, target_rows=4096)
+    r = ctx.sql(SQL).to_pandas()
+    st = ctx.history.entries()[-1].stats
+    assert st.get("hashed") and st.get("sharded"), st
+    want = _run({**HASHED_CONF}, df)
+    pd.testing.assert_frame_equal(r, want, check_dtype=False, rtol=1e-6,
+                                  atol=1e-9)
+
+
+def test_sorted_run_overflow_retry():
+    df = _frame(n=20_000, seed=7, n_keys=6000)
+    conf = {**HASHED_CONF, "sdot.engine.groupby.hash.slots": 1024}
+    r = _run(conf, df)
+    want = _run(HASHED_CONF, df)
+    pd.testing.assert_frame_equal(r, want, check_dtype=False, rtol=1e-9)
+
+
+def test_fd_demoted_anyvalue_dims():
+    # c_name is functionally determined by k: rides as an anyvalue agg
+    df = _frame(n=30_000, seed=12, n_keys=4000)
+    df["kname"] = "name_" + df.k
+    conf = HASHED_CONF
+    ctx = sdot.Context(config=conf)
+    ctx.ingest_dataframe("t", df)
+    r = ctx.sql("select k, kname, sum(q) as s from t "
+                "group by k, kname order by k").to_pandas()
+    assert ctx.history.entries()[-1].stats.get("hashed")
+    o = df.groupby(["k", "kname"]).agg(s=("q", "sum")).reset_index() \
+        .sort_values("k").reset_index(drop=True)
+    assert len(r) == len(o)
+    assert r.kname.tolist() == o.kname.tolist()
+    assert r.s.astype(int).tolist() == o.s.tolist()
+
+
+# -- s64 emulated 64-bit prefix sums (the TPU wide-sum route) ----------------
+
+def test_cumsum64_crosses_32bit_boundaries():
+    rng = np.random.default_rng(0)
+    v = rng.integers(-2**31 + 1, 2**31 - 1, 5000).astype(np.int32)
+    hi, lo = SG._cumsum64(jnp.asarray(v))
+    got = (np.asarray(hi).astype(np.int64) << 32) \
+        | np.asarray(lo).astype(np.int64)
+    want = np.cumsum(v.astype(np.int64))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sub64_borrow():
+    a = np.int64(3) << 33
+    b = np.int64(5)
+    ahi, alo = jnp.int32(a >> 32), jnp.uint32(a & 0xFFFFFFFF)
+    bhi, blo = jnp.int32(0), jnp.uint32(5)
+    rhi, rlo = SG._sub64(ahi, alo, bhi, blo)
+    got = (int(rhi) << 32) | int(np.uint32(rlo))
+    assert got == int(a - b)
+
+
+def test_s64_route_kernel_direct():
+    """Force the s64 plan (as a 32-bit TPU backend would choose) and diff
+    the kernel output against numpy — covers the emulated path the
+    x64 test process would otherwise never take."""
+    rng = np.random.default_rng(5)
+    n, T = 30_000, 1 << 12
+    keys = rng.integers(0, 3000, n).astype(np.int32)
+    vals = rng.integers(-2**30, 2**30, n).astype(np.int32)
+    valid = rng.random(n) < 0.9
+    inputs = [G.AggInput("w", "sum", jnp.asarray(vals), None,
+                         is_int=True, maxabs=2.0**30)]
+    routes = {"w": G.Route("w", "sum", "s64")}
+    out = SG.sorted_hash_groupby(jnp.asarray(keys),
+                                 jnp.zeros(n, jnp.int32),
+                                 jnp.asarray(valid), T, inputs, routes)
+    assert int(out["__unres__"][0]) == 0
+    khi = np.asarray(out["__tkhi__"])
+    occ = khi != H.EMPTY
+    got = np.asarray(G.combine_route(routes["w"],
+                                     {k: np.asarray(v)
+                                      for k, v in out.items()}, T))[occ]
+    df = pd.DataFrame({"k": keys[valid], "v": vals[valid].astype(np.int64)})
+    o = df.groupby("k").v.sum()
+    np.testing.assert_array_equal(np.sort(khi[occ]), o.index.to_numpy())
+    # table keys are sorted ascending -> group order == key order
+    np.testing.assert_array_equal(got, o.to_numpy())
+
+
+def test_float_sums_small_groups_after_large_prefix():
+    """The segmented compensated scan must NOT leak the prefix magnitude
+    into later small groups (the failure mode of a naive prefix-sum
+    difference in f32)."""
+    n_big, n_small = 4000, 1000
+    big = np.full(n_big, 1.0e7, np.float32)          # huge first group
+    rng = np.random.default_rng(2)
+    small = rng.normal(1e-3, 1e-4, n_small).astype(np.float32)
+    keys = np.concatenate([np.zeros(n_big, np.int32),
+                           np.arange(1, n_small + 1, dtype=np.int32)
+                           .repeat(1)])
+    vals = np.concatenate([big, small])
+    inputs = [G.AggInput("v", "sum", jnp.asarray(vals), None)]
+    routes = {"v": G.Route("v", "sum", "ff", merged=False)}
+    out = SG.sorted_hash_groupby(
+        jnp.asarray(keys), jnp.zeros(len(keys), jnp.int32),
+        jnp.ones(len(keys), bool), 1 << 11, inputs, routes)
+    acc = np.asarray(out["v.acc"]).astype(np.float64)
+    c = np.asarray(out["v.c"]).astype(np.float64)
+    khi = np.asarray(out["__tkhi__"])
+    occ = khi != H.EMPTY
+    got = (acc + c)[occ]
+    want = np.concatenate([[big.astype(np.float64).sum()],
+                           small.astype(np.float64)])
+    # keys 0..n_small in sorted order == table order
+    assert np.allclose(got, want, rtol=1e-5), \
+        np.abs((got - want) / want).max()
