@@ -42,20 +42,42 @@ def post_sql(url, sql, timeout=60):
         return json.loads(r.read().decode())
 
 
-def run(url, queries, n_threads, duration):
+def make_http_caller(url):
+    return lambda sql: post_sql(url, sql)
+
+
+def make_flight_caller(url):
+    """Per-thread Arrow Flight SQL caller: the same CommandStatementQuery
+    envelope ADBC/JDBC-Flight drivers emit (get_flight_info -> do_get),
+    so p95s here measure the BI wire path, not just HTTP JSON."""
+    import pyarrow.flight as fl
+    sys.path.insert(0, ".")
+    from spark_druid_olap_tpu.server.flight import encode_statement_query
+    client = fl.connect(url)
+
+    def call(sql):
+        desc = fl.FlightDescriptor.for_command(encode_statement_query(sql))
+        info = client.get_flight_info(desc)
+        return client.do_get(info.endpoints[0].ticket).read_all()
+
+    return call
+
+
+def run(make_caller, queries, n_threads, duration):
     stop = time.monotonic() + duration
     lat = defaultdict(list)
     errors = [0]
     lock = threading.Lock()
 
     def worker(tid):
+        call = make_caller()
         i = tid
         while time.monotonic() < stop:
             sql = queries[i % len(queries)]
             i += 1
             t0 = time.perf_counter()
             try:
-                post_sql(url, sql)
+                call(sql)
             except Exception:
                 with lock:
                     errors[0] += 1
@@ -86,12 +108,21 @@ def run(url, queries, n_threads, duration):
 
 
 def main():
+    import os
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        # the env var alone does not displace the axon TPU plugin, and
+        # with the tunnel down the plugin's init hangs the process
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     ap = argparse.ArgumentParser()
     ap.add_argument("--url", default="http://127.0.0.1:8082")
     ap.add_argument("--threads", type=int, default=8)
     ap.add_argument("--duration", type=float, default=30.0)
     ap.add_argument("--sql", action="append", default=None,
                     help="query to run (repeatable); default: built-in mix")
+    ap.add_argument("--flight", action="store_true",
+                    help="drive the Arrow Flight SQL endpoint (the BI "
+                    "wire path) instead of HTTP JSON")
     ap.add_argument("--selfcontained", action="store_true",
                     help="start an in-process server on a synthetic dataset")
     args = ap.parse_args()
@@ -117,17 +148,43 @@ def main():
         })
         ctx = sdot.Context()
         ctx.ingest_dataframe("sales", df, time_column="ts")
-        server = SqlServer(ctx, port=0)
-        server.start()
-        args.url = f"http://127.0.0.1:{server.port}"
+        if args.flight:
+            from spark_druid_olap_tpu.server.flight import SdotFlightServer
+            # FlightServerBase serves from construction; .serve() would
+            # just block this thread
+            server = SdotFlightServer(ctx, "grpc://127.0.0.1:0")
+            args.url = f"grpc://127.0.0.1:{server.port}"
+        else:
+            server = SqlServer(ctx, port=0)
+            server.start()
+            args.url = f"http://127.0.0.1:{server.port}"
+        warm = make_flight_caller(args.url) if args.flight \
+            else make_http_caller(args.url)
         for q in queries:        # compile/warm before measuring
-            post_sql(args.url, q)
+            warm(q)
+
+    if args.flight:
+        if args.url.startswith("http://"):
+            # flight is gRPC; the HTTP default (or a pasted http URL)
+            # would fail on the scheme in every worker thread
+            args.url = "grpc://" + args.url[len("http://"):]
+            print(f"[loadtest] --flight: using {args.url}")
+
+        def make_caller(url=args.url):
+            return make_flight_caller(url)
+    else:
+        def make_caller(url=args.url):
+            return make_http_caller(url)
 
     try:
-        total, errs = run(args.url, queries, args.threads, args.duration)
+        total, errs = run(make_caller, queries, args.threads,
+                          args.duration)
     finally:
         if server is not None:
-            server.stop()
+            try:
+                server.stop()
+            except Exception:   # noqa: BLE001 — flight server shutdown
+                server.shutdown()
     sys.exit(1 if (total == 0 or errs > total * 0.01) else 0)
 
 

@@ -71,6 +71,13 @@ class Context:
             from spark_druid_olap_tpu.utils.modules import install_from_config
             self.modules = install_from_config(self, mods_csv)
 
+    def reshard(self, devices=None) -> None:
+        """Rebuild the engine's device mesh over the currently-live (or
+        given) devices — topology elasticity after chip loss/restore
+        (≈ the reference re-planning on ZooKeeper server-list changes)."""
+        self.engine.reshard(devices)
+        self.mesh = self.engine.mesh
+
     def install_module(self, module) -> None:
         """Install an extension module programmatically (≈ adding to
         spark.sparklinedata.modules)."""

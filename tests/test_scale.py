@@ -1,0 +1,118 @@
+"""SF1-scale sharded execution + skew stress (VERDICT r3 weak 7 /
+item 7): the virtual 8-device mesh runs the dryrun suite shapes at REAL
+data scale (6M rows, not the 1024-row dryrun shapes), plus a
+deliberately skewed key distribution (one group = 50% of rows) with
+waves engaged — the correctness/perf evidence tiny shapes cannot give.
+
+Excluded from the default suite (pytest.ini: -m "not scale"); run as
+  python -m pytest tests/ -m scale -q
+Wall times land in docs/bench/SCALE_SHARDED_CPU_r04.json.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.parallel.mesh import make_mesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.scale
+
+
+def _record(name, payload):
+    out = os.path.join(REPO, "docs", "bench",
+                       "SCALE_SHARDED_CPU_r04.json")
+    data = {}
+    if os.path.exists(out):
+        with open(out) as f:
+            data = json.load(f)
+    data[name] = payload
+    with open(out, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+
+
+@pytest.fixture(scope="module")
+def sf1_ctx():
+    import bench
+    ctx, n_rows = bench.setup(1.0)
+    # swap in the sharded engine over the virtual mesh, cost model off so
+    # every shape REALLY shards
+    ctx.config.set("sdot.querycostmodel.enabled", False)
+    ctx.engine.reshard()
+    assert ctx.engine.mesh is not None
+    return ctx, n_rows
+
+
+def test_sf1_sharded_dryrun_shapes(sf1_ctx):
+    """The dryrun suite's collective shapes at SF1 over the 8-device
+    mesh; single-engine rerun is the oracle."""
+    import __graft_entry__ as GE
+    ctx, n_rows = sf1_ctx
+    single = sdot.Context()
+    single.store = ctx.store               # same ingested data
+    from spark_druid_olap_tpu.parallel.executor import QueryEngine
+    single.engine = QueryEngine(ctx.store, single.config, None)
+
+    walls = {}
+    for name, sql in GE.DRYRUN_SUITE.items():
+        if name in ("correlated_lookup", "exists_minmax"):
+            continue                       # minutes-long on a 1-core host
+        t0 = time.perf_counter()
+        got = ctx.sql(sql).to_pandas()
+        walls[name] = round((time.perf_counter() - t0) * 1000, 1)
+        st = ctx.history.entries()[-1].stats
+        assert st["mode"] == "engine", (name, st["mode"])
+        assert st.get("sharded") is True, (name, st)
+        want = single.sql(sql).to_pandas()
+        cols = list(got.columns)
+        g = got.sort_values(cols).reset_index(drop=True)
+        w = want.sort_values(cols).reset_index(drop=True)
+        pd.testing.assert_frame_equal(g, w, check_dtype=False,
+                                      rtol=1e-5, atol=1e-8, obj=name)
+    _record("sf1_dryrun_shapes_ms", {"rows": n_rows, **walls})
+
+
+def test_sf1_skewed_key_distribution_with_waves():
+    """One key owns 50% of 6M rows; hashed tier, sharded, wave mode
+    forced by a small wave budget. The skewed shard's table must carry
+    the hot group without overflow lies, and waves must merge exactly."""
+    rng = np.random.default_rng(77)
+    n = 6_000_000
+    hot = rng.random(n) < 0.5
+    keys = np.where(hot, 0, rng.integers(1, 200_000, n)).astype(np.int64)
+    df = pd.DataFrame({
+        "k": keys.astype(str),
+        "v": rng.integers(0, 100, n).astype(np.int64),
+    })
+    ctx = sdot.Context(config={
+        "sdot.querycostmodel.enabled": False,
+        "sdot.engine.groupby.dense.max.keys": 4096,
+        # ~1.5MB/device/wave -> several waves over 23 segments x 8 devs
+        "sdot.engine.wave.max.bytes": 1 << 20,
+    }, mesh=make_mesh())
+    ctx.ingest_dataframe("skew", df, target_rows=1 << 18)
+
+    t0 = time.perf_counter()
+    r = ctx.sql("select k, sum(v) as s, count(*) as c from skew "
+                "group by k order by c desc, k limit 10").to_pandas()
+    wall = round((time.perf_counter() - t0) * 1000, 1)
+    st = ctx.history.entries()[-1].stats
+    assert st.get("hashed") and st.get("sharded"), st
+    assert st.get("waves", 1) > 1, f"wave mode not engaged: {st}"
+    o = df.groupby("k").agg(s=("v", "sum"), c=("v", "size")) \
+        .reset_index().sort_values(["c", "k"], ascending=[False, True]) \
+        .head(10).reset_index(drop=True)
+    assert r.k.tolist()[0] == "0"
+    assert int(r.c.iloc[0]) == int(hot.sum())
+    assert r.k.tolist() == o.k.tolist()
+    assert r.s.astype(int).tolist() == o.s.tolist()
+    assert r.c.astype(int).tolist() == o.c.tolist()
+    _record("skew_hot50_waves", {
+        "rows": n, "wall_ms": wall, "waves": int(st.get("waves", 1)),
+        "hot_rows": int(hot.sum())})

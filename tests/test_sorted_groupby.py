@@ -190,3 +190,49 @@ def test_float_sums_small_groups_after_large_prefix():
     # keys 0..n_small in sorted order == table order
     assert np.allclose(got, want, rtol=1e-5), \
         np.abs((got - want) / want).max()
+
+
+# -- medium-K reroute (dense-range K onto the sorted-run tier) ---------------
+
+def test_medium_k_reroutes_to_sorted_run_and_matches():
+    """K above sorted.min.keys but far below dense.max.keys: with the
+    backend constants saying sort-is-cheap (forced here), the dense
+    query must route hashed/sorted-run and match the dense answer."""
+    df = _frame(n=40_000, seed=20, n_keys=3000)
+    sql = ("select k, sum(q) as s, sum(price) as p, count(*) as c "
+           "from t group by k order by k")
+
+    dense_ctx = sdot.Context(
+        config={"sdot.engine.groupby.sorted.min.keys": 0})
+    dense_ctx.ingest_dataframe("t", df)
+    dense = dense_ctx.sql(sql).to_pandas()
+    assert not dense_ctx.history.entries()[-1].stats.get("hashed")
+
+    ctx = sdot.Context(config={
+        "sdot.engine.groupby.sorted.min.keys": 1024,
+        "sdot.engine.groupby.hash.sortedrun": "on",
+        # force the sort-is-cheap verdict regardless of backend
+        "sdot.querycostmodel.sort.payload.seconds.per.row": 1e-12,
+        "sdot.querycostmodel.scatter.seconds.per.update": 1e-8,
+    })
+    ctx.ingest_dataframe("t", df)
+    r = ctx.sql(sql).to_pandas()
+    st = ctx.history.entries()[-1].stats
+    assert st.get("hashed"), st
+    pd.testing.assert_frame_equal(r, dense, check_dtype=False, rtol=1e-6,
+                                  atol=1e-9)
+
+
+def test_medium_k_reroute_skips_sketches():
+    df = _frame(n=20_000, seed=22, n_keys=3000)
+    ctx = sdot.Context(config={
+        "sdot.engine.groupby.sorted.min.keys": 1024,
+        "sdot.querycostmodel.sort.payload.seconds.per.row": 1e-12,
+        "sdot.querycostmodel.scatter.seconds.per.update": 1e-8,
+    })
+    ctx.ingest_dataframe("t", df)
+    r = ctx.sql("select k, approx_count_distinct(flag) as d from t "
+                "group by k order by k").to_pandas()
+    st = ctx.history.entries()[-1].stats
+    assert st["mode"] == "engine" and not st.get("hashed"), st
+    assert len(r) == df.k.nunique()
