@@ -277,13 +277,56 @@ def measure_floor(ctx, reps: int) -> float:
 
 def setup_ssb(sf: float):
     """SSB suite (SDOT_BENCH_SUITE=ssb): 13 star-join queries on the
-    denormalized lineorder index (BASELINE config 3)."""
+    denormalized lineorder index (BASELINE config 3). At/above
+    SDOT_BENCH_STREAM_SF (SF30 = 180M rows) the lineorder fact and the
+    flat index are generated and ingested out-of-core, cached in
+    .bench_cache like the TPC-H SF10 path."""
     import spark_druid_olap_tpu as sdot
     from spark_druid_olap_tpu.tools import ssb
     ctx = sdot.Context()
     t0 = time.perf_counter()
-    tables, flat = ssb.setup_context(ctx, sf=sf, target_rows=1 << 20)
-    n = len(flat)
+    if sf >= _stream_sf():
+        import pandas as pd
+        import pyarrow.parquet as pq
+        d = cache_dir()
+        lo_path = os.path.join(d, f"ssb_lineorder_sf{sf}.parquet")
+        flat_path = os.path.join(d, f"ssb_flat_sf{sf}.parquet")
+        dim_names = ["date", "customer", "supplier", "part"]
+        dim_paths = {n: os.path.join(d, f"ssb_{n}_sf{sf}.parquet")
+                     for n in dim_names}
+        cached = all(os.path.exists(p) for p in
+                     [flat_path, *dim_paths.values()])
+        if cached:
+            log(f"loading cached SSB SF{sf} from {d}")
+            dims = {n: pd.read_parquet(p) for n, p in dim_paths.items()}
+        else:
+            dims, n_lo = ssb.generate_stream(sf, lo_path)
+            log(f"ssb SF{sf}: streamed {n_lo:,} lineorder rows in "
+                f"{time.perf_counter() - t0:.1f}s")
+            t1 = time.perf_counter()
+            n_flat = ssb.flatten_stream(dims, lo_path, flat_path,
+                                        batch_rows=1 << 21)
+            log(f"streamed flatten: {n_flat:,} rows in "
+                f"{time.perf_counter() - t1:.1f}s")
+            try:
+                for n, p in dim_paths.items():
+                    dims[n].to_parquet(p)
+            except Exception as e:   # noqa: BLE001
+                log(f"dim cache write failed ({e}); continuing")
+        n = pq.ParquetFile(flat_path).metadata.num_rows
+        ctx.ingest_parquet_stream("ssb_flat", flat_path,
+                                  time_column="lo_orderdate",
+                                  target_rows=1 << 20,
+                                  batch_rows=1 << 21)
+        # base lineorder (raw 6M*sf fact) is NOT ingested in the
+        # out-of-core regime: all 13 SSB queries are star joins that
+        # collapse onto the flat index (bench asserts mode=engine)
+        for name, df in dims.items():
+            ctx.ingest_dataframe(name, df, target_rows=1 << 20)
+        ctx.register_star_schema(ssb.star_schema("ssb_flat"))
+    else:
+        tables, flat = ssb.setup_context(ctx, sf=sf, target_rows=1 << 20)
+        n = len(flat)
     log(f"ssb SF{sf}: {n:,} lineorder rows, ingest+gen "
         f"{time.perf_counter() - t0:.1f}s")
     return ctx, n, ssb.QUERIES
@@ -444,6 +487,34 @@ def main():
                   f"{type(e).__name__}: {e}", diags)
         return
 
+    # measured unit costs (VERDICT r4 item 1: calibrate BEFORE bench).
+    # SDOT_BENCH_UNIT_COSTS points at scripts/calibrate_chip.py output —
+    # the perf gates (compaction, sorted-run, ffl ceiling) then run on
+    # constants fit on THIS backend instead of the r3 probe defaults.
+    unit_costs = None
+    uc_path = os.environ.get("SDOT_BENCH_UNIT_COSTS", "").strip()
+    if uc_path:
+        try:
+            with open(uc_path) as f:
+                doc = json.load(f)
+            # validate BEFORE the first config.set: a malformed entry must
+            # not leave the session half-calibrated while the snapshot
+            # claims defaults were used
+            fitted = {k: float(v) for k, v in doc.get("fitted", {}).items()}
+            if doc.get("backend") not in (None, jax.default_backend()):
+                log(f"unit costs in {uc_path} were fit on "
+                    f"'{doc.get('backend')}' but this run is "
+                    f"'{jax.default_backend()}'; NOT applying")
+            else:
+                for k, v in fitted.items():
+                    ctx.config.set(k, v)
+                unit_costs = {"source": uc_path, "values": fitted}
+                log(f"applied {len(fitted)} measured unit costs "
+                    f"from {uc_path}")
+        except Exception as e:   # noqa: BLE001 — calibration is optional
+            log(f"unit-cost load failed ({type(e).__name__}: {e}); "
+                f"continuing with per-backend defaults")
+
     # parallel prewarm (VERDICT r2 #10 compile diet): compile-heavy first
     # executions overlap across a thread pool — per-signature compile
     # ownership lets different programs compile concurrently (largely
@@ -538,7 +609,11 @@ def main():
         # nonsense (e.g. "1140GB/s") when the floor estimate overshoots a
         # short query (VERDICT r3 weak #2). Falls back to adjusted wall
         # (marked) only when the profiled rep fails.
-        bs = ctx.history.entries()[-1].stats.get("bytes_scanned")
+        # capture the MEASURED rep's stats before the profiling rep below
+        # appends its own history entry (ADVICE r4: reading entries()[-1]
+        # after that rep would report the profiling run's counters)
+        meas_stats = dict(ctx.history.entries()[-1].stats)
+        bs = meas_stats.get("bytes_scanned")
         gb = ""
         if mode == "engine" and bs:
             dev_ms = None
@@ -561,16 +636,16 @@ def main():
                 gbps[name] = round(bs / (adj / 1000.0) / 1e9, 2)
                 gbps_basis[name] = "adjusted_wall"
                 gb = f", {gbps[name]:.1f}GB/s (wall-est)"
-        nd = ctx.history.entries()[-1].stats.get("n_dispatch")
-        nt = ctx.history.entries()[-1].stats.get("n_transfer")
+        nd = meas_stats.get("n_dispatch")
+        nt = meas_stats.get("n_transfer")
         dd = ""
         if nd is not None:
             ndisp[name] = int(nd)
             dd = f", {nd}+{nt}rt"   # program dispatches + host->dev transfers
-        cm = ctx.history.entries()[-1].stats.get("compact_m")
+        cm = meas_stats.get("compact_m")
         if cm:
             dd += f", lm={cm}"      # late-materialization budget engaged
-        if ctx.history.entries()[-1].stats.get("compact_overflow"):
+        if meas_stats.get("compact_overflow"):
             dd += ", lm-overflow"
         log(f"{name}: {wall:.1f}ms wall ({adj:.1f}ms floor-adjusted, cold "
             f"{cold:.2f}s, mode={mode}, {len(r)} rows{gb}{dd})")
@@ -620,6 +695,8 @@ def main():
         "cold_total_s": round(cold_total_s + prewarm_s, 1),
         "prewarm_s": round(prewarm_s, 1),
     }
+    if unit_costs is not None:
+        out["unit_costs"] = unit_costs
     if ndisp:
         # device round trips per query: on the tunneled chip each costs
         # the dispatch floor, so this is wall time's dominant term made

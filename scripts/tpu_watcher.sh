@@ -1,13 +1,18 @@
 #!/usr/bin/env bash
-# Detached TPU-tunnel watcher (VERDICT r2 item 1).
+# Detached TPU-tunnel watcher (VERDICT r2 item 1; r4 item 1: calibrate
+# FIRST, then bench).
 #
 # Probes the axon backend every PROBE_INTERVAL seconds (subprocess, hard
 # timeout — an in-process init hang is unrecoverable, see
-# docs/bench/README.md). The moment the chip answers, runs the full
-# bench suite on it and snapshots JSON + log into docs/bench/ with a
-# round-4 name (SF1 TPC-H, then SSB, then SF10 TPC-H), then keeps
-# watching so later code improvements can be re-benched by touching
-# $RERUN_FLAG.
+# docs/bench/README.md). The moment the chip answers:
+#   1. scripts/calibrate_chip.py fits the unit costs ON the chip and the
+#      fitted JSON is committed (the sorted-run auto-gate, compaction
+#      gate, and slot ceilings then run measured, not assumed);
+#   2. the bench legs run with SDOT_BENCH_UNIT_COSTS pointing at it:
+#      TPC-H SF1, SSB SF1, TPC-H SF10, SSB SF30 — each snapshotted into
+#      docs/bench/ with an r05 tag and committed.
+# Then keeps watching so later code improvements can be re-benched by
+# touching $RERUN_FLAG.
 #
 # Usage: nohup scripts/tpu_watcher.sh >/tmp/tpu_watcher.log 2>&1 &
 set -u
@@ -16,6 +21,7 @@ PROBE_INTERVAL="${PROBE_INTERVAL:-180}"
 PROBE_TIMEOUT="${PROBE_TIMEOUT:-120}"
 RERUN_FLAG="/tmp/sdot_rebench_requested"
 STAMP_DIR="docs/bench"
+CALIB_FILE=""
 
 probe() {
   timeout "$((PROBE_TIMEOUT + 10))" python - <<'EOF'
@@ -28,6 +34,25 @@ sys.exit(0 if ok else 1)
 EOF
 }
 
+run_calibration() {
+  local tag="$1"
+  local out="${STAMP_DIR}/CALIBRATION_TPU_${tag}.json"
+  echo "[watcher] $(date -u +%FT%TZ) calibrating unit costs on chip"
+  if SDOT_CALIB_PLATFORM=axon timeout 900 python scripts/calibrate_chip.py "$out" \
+      > "/tmp/calib_${tag}.log" 2>&1 \
+      && grep -q '"ok": true' "$out"; then
+    git add "$out"
+    git commit -m "On-chip unit-cost calibration ${tag}" --no-verify -- "$out" \
+      >/dev/null 2>&1 || echo "[watcher] calib commit failed"
+    CALIB_FILE="$out"
+    echo "[watcher] calibration committed: $out"
+    return 0
+  fi
+  echo "[watcher] calibration failed (see /tmp/calib_${tag}.log); benching with defaults"
+  CALIB_FILE=""
+  return 1
+}
+
 run_bench() {
   local tag="$1"
   local suite="${BENCH_SUITE:-tpch}"
@@ -38,7 +63,8 @@ run_bench() {
   echo "[watcher] $(date -u +%FT%TZ) chip up — running bench tag=${tag} suite=${suite}"
   SDOT_BENCH_PLATFORM=axon SDOT_BENCH_SUITE="$suite" SDOT_BENCH_SF="$sf" \
     SDOT_BENCH_TIME_BUDGET="${BENCH_TIME_BUDGET:-3000}" \
-    timeout 5400 python bench.py >"$out" 2>"$log"
+    SDOT_BENCH_UNIT_COSTS="$CALIB_FILE" \
+    timeout "${BENCH_HARD_TIMEOUT:-5400}" python bench.py >"$out" 2>"$log"
   local rc=$?
   echo "[watcher] bench rc=$rc"
   if [ $rc -eq 0 ] && grep -q '"platform": *"axon"' "$out"; then
@@ -60,18 +86,24 @@ n=0
 while true; do
   if probe; then
     n=$((n + 1))
-    tag="r04_$(date -u +%H%M)"
+    run_calibration "r05_$(date -u +%H%M)" || true
+    tag="r05_$(date -u +%H%M)"
     if ! run_bench "$tag"; then
       echo "[watcher] bench attempt failed; re-probing"
       sleep "$PROBE_INTERVAL"
       continue
     fi
     # SSB snapshot rides the same window (13 queries, much quicker)
-    BENCH_SUITE=ssb run_bench "r04_$(date -u +%H%M)" \
+    BENCH_SUITE=ssb run_bench "r05_$(date -u +%H%M)" \
       || echo "[watcher] ssb bench failed (tpch snapshot already saved)"
     # SF10 rides the same window too (table cache pre-built in .bench_cache/)
-    BENCH_SF=10.0 BENCH_TIME_BUDGET=4800 run_bench "r04_$(date -u +%H%M)" \
+    BENCH_SF=10.0 BENCH_TIME_BUDGET=4800 run_bench "r05_$(date -u +%H%M)" \
       || echo "[watcher] sf10 bench failed (sf1 snapshots already saved)"
+    # SSB SF30 (BASELINE config 3): 180M-row out-of-core store; the
+    # parquet cache is pre-built on CPU so the window pays ingest only
+    BENCH_SUITE=ssb BENCH_SF=30.0 BENCH_TIME_BUDGET=4800 \
+      BENCH_HARD_TIMEOUT=7200 run_bench "r05_$(date -u +%H%M)" \
+      || echo "[watcher] ssb sf30 bench failed (earlier snapshots saved)"
     # After a successful run, only re-bench when explicitly requested.
     while [ ! -e "$RERUN_FLAG" ]; do sleep 60; done
     rm -f "$RERUN_FLAG"

@@ -45,13 +45,17 @@ def _nation_city(rng, n):
     return region, nation, city
 
 
-def generate(sf: float = 0.01, seed: int = 20260729) -> Dict[str, pd.DataFrame]:
-    rng = np.random.default_rng(seed)
+def _sizes(sf: float):
     n_lo = max(1000, int(6_000_000 * sf))
     n_cust = max(60, int(30_000 * sf))
     n_supp = max(40, int(2_000 * sf))
     n_part = max(80, int(200_000 * min(1.0, 1 + np.log2(max(sf, 1e-6)) / 10)
                          * sf + 2000 * (sf ** 0.5)))
+    return n_lo, n_cust, n_supp, n_part
+
+
+def _gen_dims(rng, sf: float) -> Dict[str, pd.DataFrame]:
+    _, n_cust, n_supp, n_part = _sizes(sf)
 
     dates = pd.date_range("1992-01-01", "1998-12-31", freq="D")
     nd = len(dates)
@@ -104,14 +108,25 @@ def generate(sf: float = 0.01, seed: int = 20260729) -> Dict[str, pd.DataFrame]:
                               n_part),
         "p_size": rng.integers(1, 51, n_part).astype(np.int64),
     })
+    return {"date": date, "customer": customer, "supplier": supplier,
+            "part": part}
 
+
+def _gen_lineorder(rng, dims: Dict[str, pd.DataFrame], n_lo: int,
+                   start_key: int = 1) -> pd.DataFrame:
+    dates = pd.DatetimeIndex(dims["date"]["d_datekey"])
+    nd = len(dates)
+    n_cust = len(dims["customer"])
+    n_supp = len(dims["supplier"])
+    n_part = len(dims["part"])
     od = rng.integers(0, nd, n_lo)
     qty = rng.integers(1, 51, n_lo).astype(np.int64)
     eprice = np.round(rng.uniform(90.0, 105_000.0, n_lo), 2)
     disc = rng.integers(0, 11, n_lo).astype(np.int64)
     rev = np.round(eprice * (100 - disc) / 100.0, 2)
-    lineorder = pd.DataFrame({
-        "lo_orderkey": np.arange(1, n_lo + 1, dtype=np.int64),
+    return pd.DataFrame({
+        "lo_orderkey": np.arange(start_key, start_key + n_lo,
+                                 dtype=np.int64),
         "lo_custkey": rng.integers(1, n_cust + 1, n_lo).astype(np.int64),
         "lo_partkey": rng.integers(1, n_part + 1, n_lo).astype(np.int64),
         "lo_suppkey": rng.integers(1, n_supp + 1, n_lo).astype(np.int64),
@@ -124,8 +139,60 @@ def generate(sf: float = 0.01, seed: int = 20260729) -> Dict[str, pd.DataFrame]:
         "lo_shipmode": rng.choice(["AIR", "FOB", "MAIL", "RAIL", "SHIP",
                                    "TRUCK", "REG AIR"], n_lo),
     })
-    return {"lineorder": lineorder, "date": date, "customer": customer,
-            "supplier": supplier, "part": part}
+
+
+def generate(sf: float = 0.01, seed: int = 20260729) -> Dict[str, pd.DataFrame]:
+    rng = np.random.default_rng(seed)
+    n_lo, _, _, _ = _sizes(sf)
+    dims = _gen_dims(rng, sf)
+    lineorder = _gen_lineorder(rng, dims, n_lo)
+    return {"lineorder": lineorder, **dims}
+
+
+def generate_stream(sf: float, lineorder_path: str, seed: int = 20260729,
+                    batch_rows: int = 1 << 22):
+    """Out-of-core generator for SF where the 6M*sf-row lineorder (and a
+    fortiori the ~30-column flat index) must not materialize in pandas —
+    SF30 is 180M rows. Dimensions stay in memory (largest is part, ~6M
+    rows at SF30); lineorder is generated chunk-by-chunk straight into a
+    Parquet file. Returns (dims, n_lineorder_rows)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(seed)
+    n_lo, _, _, _ = _sizes(sf)
+    dims = _gen_dims(rng, sf)
+    writer = None
+    written = 0
+    try:
+        while written < n_lo:
+            n = min(int(batch_rows), n_lo - written)
+            chunk = _gen_lineorder(rng, dims, n, start_key=written + 1)
+            table = pa.Table.from_pandas(chunk, preserve_index=False)
+            if writer is None:
+                writer = pq.ParquetWriter(lineorder_path, table.schema)
+            writer.write_table(table)
+            written += n
+    finally:
+        if writer is not None:
+            writer.close()
+    return dims, written
+
+
+def flatten_stream(dims: Dict[str, pd.DataFrame], lineorder_path: str,
+                   out_path: str, batch_rows: int = 1 << 20) -> int:
+    """Chunked star-join of the streamed lineorder against the in-memory
+    dimensions (same machinery as the TPC-H SF10 out-of-core flatten).
+    Returns flat rows written."""
+    from spark_druid_olap_tpu.segment.stream_ingest import (
+        flatten_join_stream)
+    joins = [
+        (dims["date"], "lo_orderdate", "d_datekey"),
+        (dims["customer"], "lo_custkey", "c_custkey"),
+        (dims["supplier"], "lo_suppkey", "s_suppkey"),
+        (dims["part"], "lo_partkey", "p_partkey"),
+    ]
+    return flatten_join_stream(lineorder_path, out_path, joins,
+                               batch_rows=batch_rows)
 
 
 def flatten(tables) -> pd.DataFrame:
