@@ -559,12 +559,19 @@ def int_set_membership(arr, vals: np.ndarray):
     program as a constant). Wider spans binary-search the sorted
     constant (~log2 n gather rounds). Shared by the filter tier
     (ops/filters._in) and the compiled-expression tier (_in_list)."""
+    if len(vals) == 0:
+        # constant-false (ADVICE r4: empty set used to crash on vals[0])
+        return jnp.zeros(arr.shape, dtype=jnp.bool_)
     lo_v, hi_v = int(vals[0]), int(vals[-1])
     span = hi_v - lo_v + 1
     # small or near-contiguous sets: fused range-compare chain beats
     # any gather (a 6M-row gather is ~40ms on v5e; compares are free)
     runs = int_set_runs(vals)
     if runs is not None:
+        if not runs:
+            # empty set: membership is constant-false (ADVICE r4 — the
+            # nonempty precondition used to make this an unbound 'out')
+            return jnp.zeros(arr.shape, dtype=jnp.bool_)
         lit = (lambda v: jnp.asarray(v, arr.dtype))
         out = None
         for lo, hi in runs:

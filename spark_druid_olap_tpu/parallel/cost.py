@@ -39,13 +39,16 @@ class CostEstimate:
     scan_bytes: int = 0            # est. device bytes the scan binds
     segments_per_wave: int = 0     # 0 = everything in one wave
     n_waves: int = 1
+    xhost_bytes: int = 0           # est. cross-host result replication
 
     def table(self) -> str:
         wave = "" if self.n_waves <= 1 else \
             f"  waves={self.n_waves}x{self.segments_per_wave}seg"
+        xh = "" if not self.xhost_bytes else \
+            f" xhost_bytes={self.xhost_bytes:,}"
         return (f"rows={self.rows:,} sel={self.selectivity:.3f} "
                 f"est_groups={self.output_groups:,} "
-                f"scan_bytes={self.scan_bytes:,}\n"
+                f"scan_bytes={self.scan_bytes:,}{xh}\n"
                 f"single-chip cost={self.single_cost:.4g}  "
                 f"sharded({self.n_devices})={self.sharded_cost:.4g}  "
                 f"-> {'SHARDED' if self.recommend_sharded else 'SINGLE'}"
@@ -326,9 +329,22 @@ def estimate(ctx_or_engine, q: S.QuerySpec) -> CostEstimate:
     # efficiency — a virtual mesh on shared cores splits nothing) + ICI
     # merge of [K] partials per agg
     n_aggs = max(1, len(S.query_aggregations(q)))
+    # cross-host replication bytes (multi-host pods only): result rows
+    # travel DCN/ICI once per peer host so every process can fetch the
+    # replicated merge — O(groups x n_aggs), the two-dispatch compacted
+    # transfer (VERDICT r4 item 3; the full-[T]-table gather this
+    # replaced would be O(slots x n_aggs))
+    import jax as _jax
+    try:
+        n_hosts = _jax.process_count()
+    except Exception:   # noqa: BLE001 — uninitialized backend
+        n_hosts = 1
+    xhost_bytes = groups * n_aggs * 8 * max(0, n_hosts - 1) \
+        if n_hosts > 1 else 0
     sharded = (rows / max(n_dev * eff, 1e-9)) * scan_c \
         + groups * n_aggs * merge_c \
         + groups * byte_c * 16 \
+        + xhost_bytes * byte_c \
         + compile_c * 0.1  # sharded programs compile slower
     recommend = n_dev > 1 and sharded < single
     if not conf.get(COST_MODEL_ENABLED):
@@ -354,7 +370,7 @@ def estimate(ctx_or_engine, q: S.QuerySpec) -> CostEstimate:
                             wave_budget_bytes(conf), conf, groups, n_aggs)
     return CostEstimate(rows, sel, groups, single, sharded, n_dev, recommend,
                         scan_bytes=scan_bytes, segments_per_wave=spw,
-                        n_waves=waves)
+                        n_waves=waves, xhost_bytes=int(xhost_bytes))
 
 
 def explain_cost(ctx, q: S.QuerySpec) -> str:

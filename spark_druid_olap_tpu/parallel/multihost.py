@@ -137,6 +137,85 @@ def layout_segments(assignment: np.ndarray, seg_idx: np.ndarray,
     return ordered, per_host
 
 
+def layout_segments_waves(assignment: np.ndarray, seg_idx: np.ndarray,
+                          n_hosts: int, devs_per_host: int, n_waves: int):
+    """Wave-mode variant of ``layout_segments`` (VERDICT r4 item 2: waves
+    must compose with multi-host — SF100's overflow valve).
+
+    Each WAVE is itself a host-blocked layout: wave ``w`` holds the
+    ``w``-th chunk of every host's pruned segment list, padded to a common
+    per-host-per-wave count that divides ``devs_per_host``. Returns
+    ``(ordered, spw)``: ``ordered`` is [n_waves_eff * spw] with ``-1``
+    padding; contiguous ``spw``-slices of it are exactly the per-wave
+    layouts the executor's wave loop already slices, so ``_run_waves``
+    needs no multi-host awareness beyond the shard-aware bind. Every
+    process computes this identically from global metadata."""
+    seg_idx = np.asarray(seg_idx, dtype=np.int64)
+    per_host_lists = [seg_idx[assignment[seg_idx] == h]
+                      for h in range(n_hosts)]
+    longest = max((len(x) for x in per_host_lists), default=0)
+    longest = max(longest, 1)
+    n_waves = max(1, min(int(n_waves), longest))
+    phw = -(-longest // n_waves)                   # per host per wave
+    phw = -(-phw // devs_per_host) * devs_per_host
+    n_waves_eff = -(-longest // phw)
+    spw = n_hosts * phw
+    ordered = np.full(n_waves_eff * spw, -1, dtype=np.int64)
+    for h, lst in enumerate(per_host_lists):
+        for w in range(n_waves_eff):
+            blk = lst[w * phw: (w + 1) * phw]
+            base = w * spw + h * phw
+            ordered[base: base + len(blk)] = blk
+    return ordered, spw
+
+
+def exchange_block(local: np.ndarray):
+    """All-gather a VARIABLE-LENGTH per-process numpy array; returns one
+    array per process (ascending process id). The cross-process host-data
+    exchange under select paging, search counts, and the host-tier
+    gather on partial stores (≈ the reference's Spark-side fallback scan
+    pulling rows off historicals, ``DruidRDD.getPartitions:244-277``).
+
+    Works on numeric/bool arrays only (dimensions travel as dictionary
+    CODES and decode against the replicated global dictionary). int64
+    payloads travel as (2x int32) words so the exchange survives non-x64
+    backends, where jnp silently canonicalizes int64 to int32."""
+    from jax.experimental import multihost_utils as mhu
+    local = np.ascontiguousarray(local)
+    n_proc = jax.process_count()
+    if n_proc <= 1:
+        return [local]
+    orig_dtype = local.dtype
+    orig_trailing = local.shape[1:]
+    if orig_dtype == np.bool_:
+        local = local.astype(np.uint8)
+    elif orig_dtype in (np.dtype(np.int64), np.dtype(np.uint64),
+                        np.dtype(np.float64)) \
+            and not jax.config.jax_enable_x64:
+        local = local.view(np.int32).reshape(local.shape + (2,))
+    sizes = np.asarray(mhu.process_allgather(
+        np.asarray([local.shape[0]], np.int32))).reshape(-1)
+    m = int(sizes.max()) if sizes.size else 0
+    if m == 0:
+        return [np.empty((0,) + orig_trailing, orig_dtype)
+                for _ in range(n_proc)]
+    if local.shape[0] < m:
+        pad = np.zeros((m - local.shape[0],) + local.shape[1:],
+                       local.dtype)
+        local = np.concatenate([local, pad], axis=0)
+    out = np.asarray(mhu.process_allgather(local))   # [P, m, ...]
+    blocks = []
+    for p in range(out.shape[0]):
+        blk = out[p, : int(sizes[p])]
+        if orig_dtype == np.bool_:
+            blk = blk.astype(np.bool_)
+        elif blk.dtype != orig_dtype and blk.shape[-1:] == (2,):
+            blk = np.ascontiguousarray(blk).view(orig_dtype) \
+                .reshape(blk.shape[:-1])
+        blocks.append(blk)
+    return blocks
+
+
 def put_sharded_blocks(build_block, ordered: np.ndarray, row_dim: int,
                        dtype, sharding) -> jax.Array:
     """Assemble the global [len(ordered), row_dim] device array, providing

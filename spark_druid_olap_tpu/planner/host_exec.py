@@ -77,7 +77,11 @@ def datasource_frame(ctx, name: str, columns=None) -> pd.DataFrame:
     if name in SYS_VIEWS and name not in ctx.store.names():
         return SYS_VIEWS[name](ctx)
     ds = ctx.store.get(name)
-    ds.require_complete("host-tier frame materialization")
+    # multi-host partial store: assemble the complete view by a
+    # cross-process exchange (cached) — the host tier serves ANY query
+    # shape on partial stores at O(table) transfer once (VERDICT r4
+    # item 2; ≈ DruidRelation.scala:111's Spark-side fallback scan)
+    ds = ds.complete()
     names = ds.column_names()
     if columns is not None:
         names = [c for c in names if c in columns]

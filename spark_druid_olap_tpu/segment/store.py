@@ -113,6 +113,131 @@ class Datasource:
                 f"{len(self.local_seg_ids)}/{self.num_segments} segments "
                 f"(multi-host partial store)")
 
+    @property
+    def local_num_rows(self) -> int:
+        """Rows THIS process holds (== num_rows on a complete store)."""
+        if not self.is_partial:
+            return self.num_rows
+        return int(sum(self.segments[int(i)].num_rows
+                       for i in self.local_seg_ids))
+
+    def local_to_global_rows(self) -> np.ndarray:
+        """[local_num_rows] -> global row id (ascending; local column
+        arrays are the local segments' rows in ascending global order)."""
+        if not self.is_partial:
+            return np.arange(self.num_rows, dtype=np.int64)
+        parts = [np.arange(self.segments[int(i)].start_row,
+                           self.segments[int(i)].end_row, dtype=np.int64)
+                 for i in self.local_seg_ids]
+        return np.concatenate(parts) if parts \
+            else np.empty(0, dtype=np.int64)
+
+    def global_to_local_rows(self, gids: np.ndarray) -> np.ndarray:
+        """Global row ids (all owned by this host) -> local row offsets."""
+        gids = np.asarray(gids, dtype=np.int64)
+        if not self.is_partial:
+            return gids
+        starts = np.array([s.start_row for s in self.segments],
+                          dtype=np.int64)
+        seg_of = np.searchsorted(starts, gids, side="right") - 1
+        local_rows = np.array(
+            [self.segments[int(i)].num_rows for i in self.local_seg_ids],
+            dtype=np.int64)
+        base = np.concatenate([[0], np.cumsum(local_rows)[:-1]]) \
+            if len(local_rows) else np.empty(0, np.int64)
+        lpos = self._local_pos[seg_of]
+        if (lpos < 0).any():
+            raise ValueError("global_to_local_rows: row not owned by "
+                             f"host {self.host_id}")
+        return base[lpos] + (gids - starts[seg_of])
+
+    def owner_of_rows(self, gids: np.ndarray) -> np.ndarray:
+        """Global row ids -> owning host id (via segment assignment)."""
+        gids = np.asarray(gids, dtype=np.int64)
+        starts = np.array([s.start_row for s in self.segments],
+                          dtype=np.int64)
+        seg_of = np.searchsorted(starts, gids, side="right") - 1
+        if self.host_assignment is None:
+            return np.zeros(len(gids), dtype=np.int32)
+        return self.host_assignment[seg_of]
+
+    def complete(self) -> "Datasource":
+        """A COMPLETE view of this datasource: itself when already
+        complete; on a multi-host partial store, a cached clone whose
+        column arrays are assembled by a cross-process exchange
+        (multihost.exchange_block) — the safety valve that lets the host
+        fallback tier serve ANY query shape on a partial store, at
+        O(table) transfer once per datasource (≈ the reference's
+        Spark-side fallback scan pulling all rows off the historicals,
+        ``DruidRelation.scala:111``). Engine paths never call this."""
+        if not self.is_partial:
+            return self
+        cached = getattr(self, "_complete_cache", None)
+        if cached is not None:
+            return cached
+        from spark_druid_olap_tpu.parallel import multihost as MH
+        if not MH.is_multihost():
+            # single-process partial store (tests): nothing to gather from
+            self.require_complete("cross-host gather")
+        import dataclasses as _dc
+        assignment = self.host_assignment
+        n_hosts = (int(assignment.max()) + 1) if len(assignment) else 1
+        ranges = {h: [(self.segments[int(i)].start_row,
+                       self.segments[int(i)].end_row)
+                      for i in np.nonzero(assignment == h)[0]]
+                  for h in range(n_hosts)}
+        # per-host global row ids, ascending (the write targets)
+        gids = {h: (np.concatenate([np.arange(s, e, dtype=np.int64)
+                                    for s, e in ranges[h]])
+                    if ranges[h] else np.empty(0, np.int64))
+                for h in range(n_hosts)}
+        n_rows = self.num_rows
+        # chunked exchange: the collective stages data through device
+        # memory, so a whole-column gather of a large store would blow
+        # HBM. Chunk count is computed from GLOBAL metadata (max local
+        # rows over hosts) — identical on every process, or the
+        # collectives would mismatch.
+        chunk = 1 << 22
+        max_local = max((int(g.shape[0]) for g in gids.values()),
+                        default=0)
+        n_chunks = max(1, -(-max_local // chunk))
+
+        def _gather(arr):
+            if arr is None:
+                return None
+            out = np.empty((n_rows,) + arr.shape[1:], arr.dtype)
+            offs = {h: 0 for h in range(n_hosts)}
+            for c in range(n_chunks):
+                blocks = MH.exchange_block(arr[c * chunk: (c + 1) * chunk])
+                for h, blk in enumerate(blocks):
+                    if len(blk) == 0:
+                        continue
+                    tgt = gids[h][offs[h]: offs[h] + len(blk)]
+                    out[tgt] = blk
+                    offs[h] += len(blk)
+            return out
+
+        dims = {k: _dc.replace(d, codes=_gather(d.codes),
+                               validity=_gather(d.validity))
+                for k, d in self.dims.items()}
+        mets = {}
+        for k, m in self.metrics.items():
+            gmin, gmax = m.min, m.max
+            mm = _dc.replace(m, values=_gather(m.values),
+                             validity=_gather(m.validity))
+            mm._bounds_cache = (gmin, gmax)
+            mets[k] = mm
+        time = None
+        if self.time is not None:
+            time = _dc.replace(self.time, days=_gather(self.time.days),
+                               ms_in_day=_gather(self.time.ms_in_day))
+        ds = Datasource(name=self.name, time=time, dims=dims,
+                        metrics=mets, segments=list(self.segments),
+                        spatial=dict(self.spatial))
+        ds.gathered_from_partial = True
+        self._complete_cache = ds
+        return ds
+
     # -- basic shape ----------------------------------------------------------
     @property
     def num_rows(self) -> int:

@@ -59,10 +59,9 @@ QUERIES = {
 }
 
 
-def run_queries(ctx):
-    import pandas as pd
+def run_queries(ctx, queries=None):
     out = {}
-    for name, sql in QUERIES.items():
+    for name, sql in (queries or QUERIES).items():
         r = ctx.sql(sql).to_pandas()
         st = ctx.history.entries()[-1].stats
         out[name] = {
@@ -71,13 +70,162 @@ def run_queries(ctx):
                                          date_format="iso")),
             "mode": st.get("mode", "engine"),
             "sharded": bool(st.get("sharded")),
+            "waves": int(st.get("waves", 1)),
+            # hashed-tier transfer accounting: compacted slots that
+            # actually traveled vs table size (the multi-host diet proof)
+            "hash_slots": st.get("hash_slots"),
+            "hash_compact_k": st.get("hash_compact_k"),
+            "topk_exchange": bool(st.get("topk_exchange")),
         }
+    return out
+
+
+CENSUS_SF = 0.02
+
+
+def build_census_tpch(nproc: int, pid: int):
+    """TPC-H store with the FACT indexes partial-ingested
+    (n_hosts/host_id); dimension/base tables replicated. ``nproc=1``,
+    ``pid=0`` builds the complete single-process oracle. Mirrors
+    bench.setup (incl. the wide-column drop from the flat index)."""
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+    from spark_druid_olap_tpu.tools import tpch
+
+    drop = ["l_comment", "o_comment", "c_comment", "s_comment",
+            "ps_comment", "cn_comment", "cr_comment", "sn_comment",
+            "sr_comment", "c_address", "s_address", "o_clerk"]
+    part = {"n_hosts": nproc, "host_id": pid} if nproc > 1 else {}
+    ctx = sdot.Context(mesh=make_mesh())
+    tables = tpch.generate(CENSUS_SF)
+    flat = tpch.flatten(tables)
+    flat = flat.drop(columns=[c for c in drop if c in flat.columns])
+    ctx.ingest_dataframe("tpch_flat", flat, time_column="l_shipdate",
+                         target_rows=1 << 12, **part)
+    for name, df in tables.items():
+        if name in ("nation", "region"):
+            continue
+        tcol = {"lineitem": "l_shipdate",
+                "orders": "o_orderdate"}.get(name)
+        ctx.ingest_dataframe(name, df, time_column=tcol,
+                             target_rows=1 << 14)
+    for name, df in tpch.nation_region_views(tables).items():
+        ctx.ingest_dataframe(name, df)
+    ctx.ingest_dataframe("partsupp_flat", tpch.flatten_partsupp(tables),
+                         target_rows=1 << 12, **part)
+    ctx.register_star_schema(tpch.partsupp_star_schema("partsupp_flat"))
+    ctx.register_star_schema(tpch.star_schema("tpch_flat"))
+
+    # correlated-inequality outer dim: decorrelation can't lift it, so
+    # the statement lands on the host tier and must GATHER the partial
+    # flat store (Datasource.complete) — the fallback-serves-everything
+    # contract (≈ DruidRelation.scala:111's Spark-side fallback scan)
+    import pandas as pd
+    ctx.ingest_dataframe("segdim", pd.DataFrame({
+        "seg_name": ["AUTOMOBILE", "BUILDING", "FURNITURE"],
+        "min_q": [10, 20, 30]}))
+    # a 2-arg session Python function has no device compilation path, so
+    # any statement using it demotes WHOLE to the host tier — the
+    # guaranteed host-mode shape for the partial-store gather proof
+    ctx.functions["hostfn"] = lambda a, b: float(a) * 2 + float(b)
+    return ctx
+
+
+def build_census_ssb(nproc: int, pid: int):
+    """SSB store (separate Context: SSB's customer/supplier/part share
+    names with TPC-H's — one namespace per workload, like bench)."""
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+    from spark_druid_olap_tpu.tools import ssb
+
+    part = {"n_hosts": nproc, "host_id": pid} if nproc > 1 else {}
+    ctx = sdot.Context(mesh=make_mesh())
+    stables = ssb.generate(CENSUS_SF)
+    ctx.ingest_dataframe("ssb_flat", ssb.flatten(stables),
+                         time_column="lo_orderdate",
+                         target_rows=1 << 12, **part)
+    for name, df in stables.items():
+        tcol = {"lineorder": "lo_orderdate"}.get(name)
+        ctx.ingest_dataframe(name, df, time_column=tcol,
+                             target_rows=1 << 14)
+    ctx.register_star_schema(ssb.star_schema("ssb_flat"))
+    return ctx
+
+
+def run_census(ctx, ctx_ssb):
+    """The full TPC-H 22 + SSB 13 census plus the query shapes that need
+    multi-host-specific routing: select paging, search, a forced-waves
+    scan, and a host-tier residual over the partial store."""
+    from spark_druid_olap_tpu.ir import spec as SP
+    from spark_druid_olap_tpu.tools import ssb, tpch
+
+    out = {}
+    out.update({f"tpch_{n}": v for n, v in
+                run_queries(ctx, tpch.QUERIES).items()})
+    out.update({f"ssb_{n}": v for n, v in
+                run_queries(ctx_ssb, ssb.QUERIES).items()})
+    out.update(run_queries(ctx, {
+        # decorrelated correlated-inequality (engine-served — proves the
+        # decorrelation plane works over a partial store)
+        "decorrelated": (
+            "select seg_name from segdim where "
+            "(select count(*) from tpch_flat where c_mktsegment = seg_name"
+            " and l_quantity >= min_q) > 100 order by seg_name"),
+        # session Python UDF: no device path, whole statement demotes to
+        # the host tier, which must GATHER the partial flat store
+        # (Datasource.complete) — fallback-serves-everything
+        "host_gather": (
+            "select l_returnflag, count(*) as n from tpch_flat "
+            "where hostfn(l_quantity, l_discount) > 25 "
+            "group by l_returnflag order by l_returnflag"),
+    }))
+
+    # forced waves on the partial store: the SF100 overflow valve must
+    # compose with multi-host (VERDICT r4 item 2)
+    from spark_druid_olap_tpu.utils.config import WAVE_MAX_BYTES
+    prev = ctx.config.get(WAVE_MAX_BYTES)
+    # below one segment's scan bytes: plan_waves floors at one segment
+    # per device per wave, so the scan is forced into multiple waves
+    ctx.config.set(WAVE_MAX_BYTES.key, 1 << 14)
+    try:
+        out.update({f"waved_{n}": v for n, v in run_queries(ctx, {
+            "dense": ("select l_returnflag, sum(l_quantity) as q, "
+                      "count(*) as c from tpch_flat group by l_returnflag "
+                      "order by l_returnflag"),
+            "hashed": ("select l_orderkey, sum(l_quantity) as q from "
+                       "tpch_flat group by l_orderkey "
+                       "order by q desc, l_orderkey limit 20"),
+        }).items()})
+    finally:
+        ctx.config.set(WAVE_MAX_BYTES.key, prev)
+
+    # select paging + search over the partial store (raw QuerySpecs)
+    sel = ctx.execute(SP.SelectQuerySpec(
+        datasource="tpch_flat",
+        columns=("l_orderkey", "l_quantity", "l_shipmode", "c_mktsegment"),
+        filter=SP.BoundFilter("l_quantity", lower=45.0, numeric=True),
+        page_offset=7, page_size=40)).to_pandas()
+    out["select_page"] = {
+        "columns": list(sel.columns),
+        "rows": json.loads(sel.to_json(orient="values",
+                                       date_format="iso")),
+        "mode": "select",
+    }
+    srch = ctx.execute(SP.SearchQuerySpec(
+        datasource="tpch_flat",
+        dimensions=("l_shipmode", "c_mktsegment"),
+        query="AI")).to_pandas()
+    out["search"] = {
+        "columns": list(srch.columns),
+        "rows": json.loads(srch.to_json(orient="values")),
+        "mode": "search",
+    }
     return out
 
 
 def spawn_workers(n_processes: int, outpath: str,
                   devices_per_process: int = DEVICES_PER_PROCESS,
-                  timeout_s: float = 600.0):
+                  timeout_s: float = 600.0, mode: str = "basic"):
     """Run ``n_processes`` worker processes to completion (the shared rig
     for tests/test_multihost.py and __graft_entry__.dryrun_multiprocess).
     Returns the parsed results JSON; raises AssertionError with worker
@@ -94,7 +242,7 @@ def spawn_workers(n_processes: int, outpath: str,
     worker = os.path.abspath(__file__)
     procs = [subprocess.Popen(
         [sys.executable, worker, str(pid), str(n_processes), str(port),
-         str(outpath), str(devices_per_process)],
+         str(outpath), str(devices_per_process), mode],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for pid in range(n_processes)]
     logs = []
@@ -115,10 +263,20 @@ def main():
     pid, nproc = int(sys.argv[1]), int(sys.argv[2])
     port, outpath = sys.argv[3], sys.argv[4]
     devs = int(sys.argv[5]) if len(sys.argv) > 5 else DEVICES_PER_PROCESS
+    mode = sys.argv[6] if len(sys.argv) > 6 else "basic"
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["TZ"] = "UTC"
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # persistent XLA cache: the census compiles ~50 programs per process;
+    # repeat runs (and the single-process oracle) come back warm
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/sdot_mh_xla_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+    except Exception:   # noqa: BLE001 — cache is an optimization only
+        pass
     from spark_druid_olap_tpu.parallel import multihost as MH
     MH.initialize(f"127.0.0.1:{port}", nproc, pid,
                   local_device_count=devs)
@@ -128,15 +286,23 @@ def main():
     import spark_druid_olap_tpu as sdot
     from spark_druid_olap_tpu.parallel.mesh import make_mesh
 
-    ctx = sdot.Context(mesh=make_mesh())
-    ds = ctx.ingest_dataframe("sales", make_frame(), time_column="ts",
-                              target_rows=4096, n_hosts=nproc, host_id=pid)
-    assert ds.is_partial
-    n_local = len(ds.local_seg_ids)
-    assert 0 < n_local < ds.num_segments, \
-        f"host {pid} holds {n_local}/{ds.num_segments} segments"
-
-    results = run_queries(ctx)
+    if mode == "census":
+        ctx = build_census_tpch(nproc, pid)
+        ctx_ssb = build_census_ssb(nproc, pid)
+        ds = ctx.store.get("tpch_flat")
+        assert ds.is_partial
+        n_local = len(ds.local_seg_ids)
+        results = run_census(ctx, ctx_ssb)
+    else:
+        ctx = sdot.Context(mesh=make_mesh())
+        ds = ctx.ingest_dataframe("sales", make_frame(), time_column="ts",
+                                  target_rows=4096, n_hosts=nproc,
+                                  host_id=pid)
+        assert ds.is_partial
+        n_local = len(ds.local_seg_ids)
+        assert 0 < n_local < ds.num_segments, \
+            f"host {pid} holds {n_local}/{ds.num_segments} segments"
+        results = run_queries(ctx)
     results["_meta"] = {
         "pid": pid, "n_local_segments": n_local,
         "n_segments": ds.num_segments,

@@ -221,12 +221,71 @@ def test_two_process_results_match_single_process(tmp_path):
         assert g["columns"] == r["columns"], name
         assert g["mode"] == "engine", (name, g["mode"])
         assert g["sharded"], name
-        assert len(g["rows"]) == len(r["rows"]), \
-            (name, g["rows"], r["rows"])
-        for grow, rrow in zip(g["rows"], r["rows"]):
-            for gv, rv in zip(grow, rrow):
-                if isinstance(rv, float):
-                    assert gv == pytest.approx(rv, rel=1e-6, abs=1e-9), \
-                        (name, grow, rrow)
-                else:
-                    assert gv == rv, (name, grow, rrow)
+        _rows_equal(name, g, r)
+
+
+# -- integration: full census (VERDICT r4 item 2) -----------------------------
+
+def _rows_equal(name, g, r):
+    assert len(g["rows"]) == len(r["rows"]), \
+        (name, len(g["rows"]), len(r["rows"]))
+    for grow, rrow in zip(g["rows"], r["rows"]):
+        for gv, rv in zip(grow, rrow):
+            if isinstance(rv, float):
+                assert gv == pytest.approx(rv, rel=1e-6, abs=1e-9), \
+                    (name, grow, rrow)
+            else:
+                assert gv == rv, (name, grow, rrow)
+
+
+@pytest.mark.scale
+def test_census_two_process_matches_single_process(tmp_path):
+    """Multi-host serves the WHOLE workload: the full TPC-H 22 + SSB 13
+    census through 2 real processes x 2 devices over per-host partial
+    stores, plus the shapes that need multi-host-specific routing —
+    select paging, search, forced waves (the SF100 overflow valve), and
+    a host-tier residual (gathers the partial store). Every answer must
+    equal a single-process run of the same data. ≈ the reference's
+    contract that every query type executes across historicals with the
+    Spark-side fallback (DruidRelation.scala:111,
+    DruidRDD.getPartitions:244-277)."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import multihost_worker as W
+
+    got = W.spawn_workers(2, str(tmp_path / "census.json"),
+                          devices_per_process=2, timeout_s=2900,
+                          mode="census")
+
+    # single-process oracle: same data, complete stores, 8-device mesh
+    ctx = W.build_census_tpch(1, 0)
+    ctx_ssb = W.build_census_ssb(1, 0)
+    ref = W.run_census(ctx, ctx_ssb)
+
+    n_tpch = n_ssb = 0
+    for name in ref:
+        g, r = got[name], ref[name]
+        assert g["columns"] == r["columns"], name
+        _rows_equal(name, g, r)
+        if name.startswith("tpch_q"):
+            n_tpch += 1
+            assert g["mode"] == "engine", (name, g["mode"])
+            assert g["sharded"], name
+        elif name.startswith("ssb_q"):
+            n_ssb += 1
+            assert g["mode"] == "engine", (name, g["mode"])
+            assert g["sharded"], name
+    assert n_tpch == 22 and n_ssb == 13, (n_tpch, n_ssb)
+
+    # host tier gathered the partial store instead of raising
+    assert got["host_gather"]["mode"].startswith("host"), \
+        got["host_gather"]["mode"]
+    # waves composed with multi-host (the SF100 overflow valve)
+    assert got["waved_dense"]["waves"] > 1
+    assert got["waved_hashed"]["waves"] > 1
+    # hashed-tier transfer diet: when the two-dispatch compacted path
+    # engaged, the slots that traveled are bounded by occupancy, not by
+    # the table size
+    hashed = [v for k, v in got.items()
+              if v.get("hash_slots") and v.get("hash_compact_k")]
+    for v in hashed:
+        assert v["hash_compact_k"] <= v["hash_slots"]
