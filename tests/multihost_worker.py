@@ -131,6 +131,83 @@ def build_census_tpch(nproc: int, pid: int):
     return ctx
 
 
+def _rss_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return round(int(line.split()[1]) / 1024.0, 1)
+    return -1.0
+
+
+def build_sf10_ctx(nproc: int, pid: int):
+    """SF10 (60M-row) TPC-H store from the bench parquet cache with the
+    flat index PARTIAL-ingested per host via the out-of-core streamer —
+    the SF100 ingest mechanism rehearsed at a scale where mistakes show
+    (VERDICT r4 item 4). Requires .bench_cache/tpch_flat_sf10.0.parquet
+    (built by bench.py at SDOT_BENCH_SF=10)."""
+    import pandas as pd
+
+    import bench
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+    from spark_druid_olap_tpu.tools import tpch
+
+    d = bench.cache_dir()
+    flat_path = os.path.join(d, "tpch_flat_sf10.0.parquet")
+    assert os.path.exists(flat_path), \
+        "SF10 cache missing: run SDOT_BENCH_SF=10 bench.py once first"
+    part = {"n_hosts": nproc, "host_id": pid} if nproc > 1 else {}
+    ctx = sdot.Context(mesh=make_mesh())
+    ctx.ingest_parquet_stream("tpch_flat", flat_path,
+                              time_column="l_shipdate",
+                              target_rows=1 << 20, batch_rows=1 << 21,
+                              **part)
+    rss_after_flat = _rss_mb()
+    tables = {n: pd.read_parquet(
+        os.path.join(d, f"tpch_{n}_sf10.0.parquet"))
+        for n in ("lineitem", "orders", "partsupp", "part", "supplier",
+                  "customer", "nation", "region")}
+    for name, df in tables.items():
+        if name in ("nation", "region"):
+            continue
+        tcol = {"lineitem": "l_shipdate",
+                "orders": "o_orderdate"}.get(name)
+        ctx.ingest_dataframe(name, df, time_column=tcol,
+                             target_rows=1 << 20)
+    for name, df in tpch.nation_region_views(tables).items():
+        ctx.ingest_dataframe(name, df)
+    ctx.ingest_dataframe("partsupp_flat", tpch.flatten_partsupp(tables),
+                         target_rows=1 << 20, **part)
+    del tables
+    ctx.register_star_schema(tpch.partsupp_star_schema("partsupp_flat"))
+    ctx.register_star_schema(tpch.star_schema("tpch_flat"))
+    return ctx, rss_after_flat
+
+
+def run_sf10(ctx):
+    """The TPC-H 22 census at SF10 with walls (the SSB side of the
+    census is covered at census scale; SF10's flat cache is TPC-H)."""
+    import time
+
+    from spark_druid_olap_tpu.tools import tpch
+    out = {}
+    for name in ("q1", "q2", "q3", "q4", "q5", "q6", "q7", "q8", "q9",
+                 "q10", "q11", "q12", "q13", "q14", "q15", "q16", "q17",
+                 "q18", "q19", "q20", "q21", "q22"):
+        t0 = time.time()
+        r = ctx.sql(tpch.QUERIES[name]).to_pandas()
+        st = ctx.history.entries()[-1].stats
+        out[f"tpch_{name}"] = {
+            "columns": list(r.columns),
+            "rows": json.loads(r.to_json(orient="values",
+                                         date_format="iso")),
+            "mode": st.get("mode", "engine"),
+            "sharded": bool(st.get("sharded")),
+            "wall_ms": round((time.time() - t0) * 1000, 1),
+        }
+    return out
+
+
 def build_census_ssb(nproc: int, pid: int):
     """SSB store (separate Context: SSB's customer/supplier/part share
     names with TPC-H's — one namespace per workload, like bench)."""
@@ -293,6 +370,18 @@ def main():
         assert ds.is_partial
         n_local = len(ds.local_seg_ids)
         results = run_census(ctx, ctx_ssb)
+    elif mode == "sf10":
+        ctx, rss_flat = build_sf10_ctx(nproc, pid)
+        ds = ctx.store.get("tpch_flat")
+        # nproc == 1 is the like-for-like single-process RSS baseline
+        assert ds.is_partial == (nproc > 1)
+        n_local = len(ds.local_seg_ids) if ds.is_partial \
+            else ds.num_segments
+        results = run_sf10(ctx)
+        results["_rss"] = {"after_flat_ingest_mb": rss_flat,
+                           "after_queries_mb": _rss_mb(),
+                           "local_rows": int(ds.local_num_rows),
+                           "total_rows": int(ds.num_rows)}
     else:
         ctx = sdot.Context(mesh=make_mesh())
         ds = ctx.ingest_dataframe("sales", make_frame(), time_column="ts",

@@ -6,7 +6,7 @@ waves engaged — the correctness/perf evidence tiny shapes cannot give.
 
 Excluded from the default suite (pytest.ini: -m "not scale"); run as
   python -m pytest tests/ -m scale -q
-Wall times land in docs/bench/SCALE_SHARDED_CPU_r04.json.
+Wall times land in docs/bench/SCALE_SHARDED_CPU_r05.json.
 """
 
 import json
@@ -27,7 +27,7 @@ pytestmark = pytest.mark.scale
 
 def _record(name, payload):
     out = os.path.join(REPO, "docs", "bench",
-                       "SCALE_SHARDED_CPU_r04.json")
+                       "SCALE_SHARDED_CPU_r05.json")
     data = {}
     if os.path.exists(out):
         with open(out) as f:
@@ -76,43 +76,147 @@ def test_sf1_sharded_dryrun_shapes(sf1_ctx):
         pd.testing.assert_frame_equal(g, w, check_dtype=False,
                                       rtol=1e-5, atol=1e-8, obj=name)
     _record("sf1_dryrun_shapes_ms", {"rows": n_rows, **walls})
+    # relative perf bounds (VERDICT r4 item 7: assert, don't record):
+    # having_device is the same scan as hashed_highcard plus a device
+    # HAVING mask — the r4 outlier (5.5x: a [1.5M] top_k in the gather
+    # dispatch) must stay fixed. 2.5x leaves shared-core noise headroom.
+    assert walls["having_device"] <= 2.5 * walls["hashed_highcard"], walls
 
 
-def test_sf1_skewed_key_distribution_with_waves():
-    """One key owns 50% of 6M rows; hashed tier, sharded, wave mode
-    forced by a small wave budget. The skewed shard's table must carry
-    the hot group without overflow lies, and waves must merge exactly."""
-    rng = np.random.default_rng(77)
+def _skew_run(hot_frac: float, seed: int):
+    """6M-row hashed group-by, sharded, waves forced; one key owns
+    ``hot_frac`` of the rows (0 = uniform). Returns (wall_ms, stats,
+    result_df, oracle_df, n_hot, wave_budget, scan_bytes_per_seg)."""
+    from spark_druid_olap_tpu.parallel import cost as C
+
+    rng = np.random.default_rng(seed)
     n = 6_000_000
-    hot = rng.random(n) < 0.5
+    hot = rng.random(n) < hot_frac
     keys = np.where(hot, 0, rng.integers(1, 200_000, n)).astype(np.int64)
     df = pd.DataFrame({
         "k": keys.astype(str),
         "v": rng.integers(0, 100, n).astype(np.int64),
     })
+    budget = 1 << 20
     ctx = sdot.Context(config={
         "sdot.querycostmodel.enabled": False,
         "sdot.engine.groupby.dense.max.keys": 4096,
         # ~1.5MB/device/wave -> several waves over 23 segments x 8 devs
-        "sdot.engine.wave.max.bytes": 1 << 20,
+        "sdot.engine.wave.max.bytes": budget,
     }, mesh=make_mesh())
     ctx.ingest_dataframe("skew", df, target_rows=1 << 18)
+    ds = ctx.store.get("skew")
+    seg_bytes = C.bytes_per_segment(ds, ["k", "v", "__rows__"])
 
     t0 = time.perf_counter()
     r = ctx.sql("select k, sum(v) as s, count(*) as c from skew "
                 "group by k order by c desc, k limit 10").to_pandas()
     wall = round((time.perf_counter() - t0) * 1000, 1)
     st = ctx.history.entries()[-1].stats
-    assert st.get("hashed") and st.get("sharded"), st
-    assert st.get("waves", 1) > 1, f"wave mode not engaged: {st}"
     o = df.groupby("k").agg(s=("v", "sum"), c=("v", "size")) \
         .reset_index().sort_values(["c", "k"], ascending=[False, True]) \
         .head(10).reset_index(drop=True)
+    return wall, st, r, o, int(hot.sum()), budget, seg_bytes
+
+
+def test_sf1_skewed_key_distribution_with_waves():
+    """One key owns 50% of 6M rows; hashed tier, sharded, wave mode
+    forced by a small wave budget. The skewed shard's table must carry
+    the hot group without overflow lies, waves must merge exactly, the
+    per-wave bind must respect the byte budget, and the hot-key shape
+    must stay within a small factor of the uniform shape (VERDICT r4
+    item 7: assert, don't record)."""
+    wall, st, r, o, n_hot, budget, seg_bytes = _skew_run(0.5, 77)
+    assert st.get("hashed") and st.get("sharded"), st
+    assert st.get("waves", 1) > 1, f"wave mode not engaged: {st}"
+    # the wave planner actually bounded per-device bind bytes: a wave
+    # binds segments_per_wave segments across 8 devices, each device's
+    # share must fit the budget (+1 segment of rounding slack)
+    n_dev = 8
+    spw = int(st.get("segments_per_wave", 0))
+    assert spw > 0
+    per_dev_bytes = (spw // n_dev + (1 if spw % n_dev else 0)) * seg_bytes
+    assert per_dev_bytes <= budget + seg_bytes, \
+        (spw, seg_bytes, per_dev_bytes, budget)
     assert r.k.tolist()[0] == "0"
-    assert int(r.c.iloc[0]) == int(hot.sum())
+    assert int(r.c.iloc[0]) == n_hot
     assert r.k.tolist() == o.k.tolist()
     assert r.s.astype(int).tolist() == o.s.tolist()
     assert r.c.astype(int).tolist() == o.c.tolist()
     _record("skew_hot50_waves", {
-        "rows": n, "wall_ms": wall, "waves": int(st.get("waves", 1)),
-        "hot_rows": int(hot.sum())})
+        "rows": 6_000_000, "wall_ms": wall,
+        "waves": int(st.get("waves", 1)), "hot_rows": n_hot})
+
+    # hot-key shape must not serialize: within 4x of the uniform-key
+    # shape (same rows, same waves, no hot group; generous for a
+    # contended 1-core host — the failure mode being guarded is a
+    # many-fold blowup from hot-group serialization)
+    wall_u, st_u, r_u, o_u, _, _, _ = _skew_run(0.0, 78)
+    assert st_u.get("waves", 1) > 1, st_u
+    assert r_u.k.tolist() == o_u.k.tolist()
+    _record("skew_uniform_reference", {
+        "rows": 6_000_000, "wall_ms": wall_u,
+        "waves": int(st_u.get("waves", 1))})
+    assert wall <= 4.0 * max(wall_u, 1.0), (wall, wall_u)
+
+
+@pytest.mark.scale
+@pytest.mark.skipif(not os.environ.get("SDOT_SCALE_SF10"),
+                    reason="~1h on a 1-core host: set SDOT_SCALE_SF10=1 "
+                           "(SF10 parquet cache required; the committed "
+                           "sf10_multihost_rehearsal entry in docs/bench/"
+                           "SCALE_SHARDED_CPU_r05.json is the recorded "
+                           "run)")
+def test_sf10_two_process_rehearsal(tmp_path):
+    """The SF100 mechanism at a scale where mistakes show (VERDICT r4
+    item 4): per-host STREAMED ingest (n_hosts=2) of the 60M-row SF10
+    flat parquet, the TPC-H 22 census through the 2-process rig, RSS per
+    process recorded, answers equal to a single-process run."""
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import multihost_worker as W
+
+    got = W.spawn_workers(2, str(tmp_path / "sf10.json"),
+                          devices_per_process=2, timeout_s=5000,
+                          mode="sf10")
+    rss2 = got["_rss"]
+    assert rss2["local_rows"] < rss2["total_rows"]
+
+    # like-for-like baseline: the single-process oracle runs in its OWN
+    # spawned worker, so its RSS is not inflated by this pytest
+    # process's earlier sf1 fixtures/compiled programs
+    ref = W.spawn_workers(1, str(tmp_path / "sf10_single.json"),
+                          devices_per_process=4, timeout_s=5000,
+                          mode="sf10")
+    rss_flat_1 = ref["_rss"]["after_flat_ingest_mb"]
+
+    n_q = 0
+    for name, r in ref.items():
+        if name.startswith("_"):
+            continue
+        g = got[name]
+        assert g["columns"] == r["columns"], name
+        assert len(g["rows"]) == len(r["rows"]), name
+        for grow, rrow in zip(g["rows"], r["rows"]):
+            for gv, rv in zip(grow, rrow):
+                if isinstance(rv, float):
+                    assert gv == pytest.approx(rv, rel=1e-5, abs=1e-6), \
+                        (name, grow, rrow)
+                else:
+                    assert gv == rv, (name, grow, rrow)
+        n_q += 1
+    assert n_q == 22
+    # per-host flat-ingest memory ~ half of single-process (the partial
+    # streamer never allocates remote rows; base tables are replicated,
+    # so only the after-flat-ingest number is halvable)
+    assert rss2["after_flat_ingest_mb"] < 0.75 * rss_flat_1, \
+        (rss2, rss_flat_1)
+    _record("sf10_multihost_rehearsal", {
+        "rows": rss2["total_rows"],
+        "per_host_rss_after_flat_mb": rss2["after_flat_ingest_mb"],
+        "single_rss_after_flat_mb": rss_flat_1,
+        "walls_2proc_ms": {k: v["wall_ms"] for k, v in got.items()
+                           if k.startswith("tpch_")},
+        "walls_single_ms": {k: v["wall_ms"] for k, v in ref.items()
+                            if k.startswith("tpch_")},
+        "answers_equal": True})
