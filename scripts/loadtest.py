@@ -104,7 +104,87 @@ def run(make_caller, queries, n_threads, duration):
               f"p95={np.percentile(a, 95):7.1f}ms "
               f"p99={np.percentile(a, 99):7.1f}ms n={len(a):5d}  "
               f"{sql[:70]}")
-    return total, errors[0]
+    return total, errors[0], elapsed, lat
+
+
+# interactive BI-dashboard shapes over the flat index (the reference's
+# JMeter plans hammer exactly this class: filtered aggregates, a trend
+# line, a topN — docs/bi-benchmark/snap-sales-demo.jmx)
+TPCH_DASHBOARD = [
+    "select l_returnflag, l_linestatus, sum(l_quantity) as sq, "
+    "count(*) as n from lineitem where l_shipdate <= date '1998-09-02' "
+    "group by l_returnflag, l_linestatus",
+    "select sum(l_extendedprice * l_discount) as revenue from lineitem "
+    "where l_shipdate >= date '1994-01-01' "
+    "and l_shipdate < date '1995-01-01' "
+    "and l_discount between 0.05 and 0.07 and l_quantity < 24",
+    "select l_shipmode, count(*) as c from lineitem "
+    "group by l_shipmode order by l_shipmode",
+    "select p_brand, sum(l_quantity) as s from lineitem "
+    "join part on l_partkey = p_partkey "
+    "group by p_brand order by s desc limit 10",
+    "select o_orderpriority, count(*) as c from orders "
+    "where o_orderdate >= date '1993-07-01' "
+    "and o_orderdate < date '1993-10-01' group by o_orderpriority",
+]
+
+
+def _summarize(lat_total_errs):
+    total, errs, elapsed, lat = lat_total_errs
+    alllat = np.concatenate([np.array(v) for v in lat.values()]) \
+        if lat else np.array([0.0])
+    return {"qps": round(total / max(elapsed, 1e-9), 1),
+            "n": int(total), "errors": int(errs),
+            "p50_ms": round(float(np.percentile(alllat, 50)), 1),
+            "p95_ms": round(float(np.percentile(alllat, 95)), 1),
+            "p99_ms": round(float(np.percentile(alllat, 99)), 1)}
+
+
+def run_tpch_compare(args):
+    """One TPC-H context served over BOTH endpoints; the same dashboard
+    mix hammers each in turn. Prints a side-by-side + one JSON line for
+    docs/bench/."""
+    sys.path.insert(0, ".")
+    import bench
+    from spark_druid_olap_tpu.server.flight import SdotFlightServer
+    from spark_druid_olap_tpu.server.http import SqlServer
+
+    ctx, n_rows = bench.setup(args.tpch)
+    http_server = SqlServer(ctx, port=0)
+    http_server.start()
+    http_url = f"http://127.0.0.1:{http_server.port}"
+    flight_server = SdotFlightServer(ctx, "grpc://127.0.0.1:0")
+    flight_url = f"grpc://127.0.0.1:{flight_server.port}"
+
+    queries = args.sql or TPCH_DASHBOARD
+    for q in queries:                      # compile/warm before measuring
+        post_sql(http_url, q, timeout=300)
+
+    results = {}
+    try:
+        for name, mk in [("http", lambda: make_http_caller(http_url)),
+                         ("flight",
+                          lambda: make_flight_caller(flight_url))]:
+            print(f"\n=== {name} leg ({args.threads} threads x "
+                  f"{args.duration:.0f}s) ===")
+            results[name] = _summarize(
+                run(mk, queries, args.threads, args.duration))
+    finally:
+        try:
+            http_server.stop()
+        except Exception:   # noqa: BLE001
+            pass
+        try:
+            flight_server.shutdown()
+        except Exception:   # noqa: BLE001
+            pass
+    out = {"suite": "tpch_dashboard", "sf": args.tpch, "rows": n_rows,
+           "threads": args.threads, "duration_s": args.duration,
+           "legs": results}
+    print("\n" + json.dumps(out))
+    ok = all(r["n"] > 0 and r["errors"] <= r["n"] * 0.01
+             for r in results.values())
+    sys.exit(0 if ok else 1)
 
 
 def main():
@@ -125,7 +205,15 @@ def main():
                     "wire path) instead of HTTP JSON")
     ap.add_argument("--selfcontained", action="store_true",
                     help="start an in-process server on a synthetic dataset")
+    ap.add_argument("--tpch", type=float, default=None, metavar="SF",
+                    help="serve the TPC-H store from the bench cache at "
+                    "this scale factor and run a BI dashboard query mix "
+                    "through BOTH HTTP and Flight on the same data, "
+                    "reporting the two side by side (VERDICT r4 item 6)")
     args = ap.parse_args()
+
+    if args.tpch is not None:
+        return run_tpch_compare(args)
 
     queries = args.sql or DEFAULT_QUERIES
     server = None
@@ -177,8 +265,8 @@ def main():
             return make_http_caller(url)
 
     try:
-        total, errs = run(make_caller, queries, args.threads,
-                          args.duration)
+        total, errs, _, _ = run(make_caller, queries, args.threads,
+                                args.duration)
     finally:
         if server is not None:
             try:

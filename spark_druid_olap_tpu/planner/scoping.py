@@ -176,6 +176,179 @@ def _iter_relation_conditions(rel):
         yield from _iter_relation_conditions(rel.right)
 
 
+def _disambiguate_join_duplicates(ctx, q):
+    """Same-scope duplicate-column joins (self-joins): columns bind by
+    bare name, so ``t a join t b`` exposes every column of ``t`` twice
+    and ``a.x < b.x`` would collapse to ``x < x`` (an unbound-column
+    error at best, a silently-degenerate predicate at worst). Every
+    duplicated TableRef leaf AFTER a column's first owner is wrapped in
+    a derived table that RENAMES the duplicated columns REFERENCED
+    THROUGH ITS QUALIFIER (pruned to the referenced set); those
+    references follow the rename — nested subquery scopes that rebind
+    the alias are left alone. Unqualified references keep the legacy
+    bind-by-global-name behavior: the star-schema convention
+    deliberately duplicates dimension columns between the flat index
+    and its members (StarSchemaInfo's globally-unique-name contract),
+    so ONLY qualifier-distinguished duplicates are rewritten. ≈ Spark's
+    analyzer deduplicating attribute ids on self-join, which the
+    reference's planner relies on upstream of its rewrites."""
+    rel = q.relation
+    if not isinstance(rel, A.Join):
+        return q
+
+    leaves = []
+
+    def collect(r):
+        if isinstance(r, A.Join):
+            collect(r.left)
+            collect(r.right)
+        else:
+            leaves.append(r)
+    collect(rel)
+    cols_of = [_try_columns(ctx, lf) or frozenset() for lf in leaves]
+    from collections import Counter
+    cnt = Counter()
+    for cols in cols_of:
+        cnt.update(cols)
+    dup = {c for c, k in cnt.items() if k > 1}
+    if not dup:
+        return q
+
+    # every referenced name in this scope (subquery expressions
+    # included — they may reference our aliases); derived-table bodies
+    # are separate scopes and contribute nothing
+    refs: set = set()
+    quals_used: set = set()
+
+    def scan(e):
+        for n in E.walk(e):
+            if isinstance(n, E.Column) and n.name != "*":
+                refs.add(n.name)
+                if n.qual:
+                    quals_used.add((n.qual, n.name))
+            elif isinstance(n, _SUBQ):
+                for e2 in _iter_stmt_exprs_deep(n.query):
+                    scan(e2)
+    for e in _iter_stmt_exprs(q):
+        scan(e)                 # includes the join ON conditions
+
+    alias_of = [lf.alias or getattr(lf, "name", None) for lf in leaves]
+    seen: set = set()
+    renmaps = []           # per leaf: {bare: renamed} (empty = unwrapped)
+    for i, (lf, cols) in enumerate(zip(leaves, cols_of)):
+        ren = {}
+        if isinstance(lf, A.TableRef):
+            ren = {c: f"__sj{i}_{c}"
+                   for c in sorted(cols & dup & seen)
+                   if (alias_of[i], c) in quals_used}
+        seen |= cols
+        renmaps.append(ren)
+    if not any(renmaps):
+        return q
+    for i, ren in enumerate(renmaps):
+        if ren and alias_of.count(alias_of[i]) > 1:
+            raise SqlSyntaxError(
+                f"self-join of {alias_of[i]!r} needs DISTINCT aliases to "
+                f"disambiguate its duplicated columns")
+
+    if any(it.expr == "*" or (isinstance(it.expr, E.Column)
+                              and it.expr.name == "*")
+           for it in q.items):
+        # SELECT * over a qualifier-disambiguated self-join is
+        # ill-defined (the duplicated columns have no bare names to
+        # expose) — require an explicit list, like the shadow rename
+        raise SqlSyntaxError(
+            "select * cannot combine with a self-join that "
+            "disambiguates duplicated columns via aliases: list the "
+            "needed columns explicitly (qualified)")
+
+    wrapped = {}
+    for i, (lf, cols, ren) in enumerate(zip(leaves, cols_of, renmaps)):
+        if not ren:
+            continue
+        # expose referenced non-duplicated columns bare + the renamed
+        # duplicates; duplicated columns NOT renamed stay unexposed so
+        # the bare copy binds the first owner without a merge collision
+        used = sorted(((refs & cols) - dup) | set(ren)) \
+            or sorted(cols)[:1]
+        body = A.SelectStmt(
+            items=tuple(A.SelectItem(E.Column(c), ren.get(c, c))
+                        for c in used),
+            relation=A.TableRef(lf.name))
+        wrapped[id(lf)] = A.SubqueryRef(body, alias=alias_of[i])
+    ren_by_alias = {alias_of[i]: renmaps[i]
+                    for i in range(len(leaves)) if renmaps[i]}
+
+    def rebuild(r):
+        if isinstance(r, A.Join):
+            cond = r.condition
+            if cond is not None:
+                cond = fix(cond)
+            return A.Join(rebuild(r.left), rebuild(r.right), r.kind,
+                          cond)
+        return wrapped.get(id(r), r)
+
+    def fix(e, nested=()):
+        def fn(n):
+            if isinstance(n, A.ScalarSubquery):
+                return A.ScalarSubquery(_fix_nested(n.query, nested))
+            if isinstance(n, A.Exists):
+                return A.Exists(_fix_nested(n.query, nested), n.negated)
+            if isinstance(n, A.InSubquery):
+                return A.InSubquery(fix(n.child, nested),
+                                    _fix_nested(n.query, nested),
+                                    n.negated)
+            if isinstance(n, E.Column) and n.qual \
+                    and n.qual in ren_by_alias \
+                    and not any(n.qual in na for na in nested):
+                new = ren_by_alias[n.qual].get(n.name)
+                if new is not None:
+                    return E.Column(new)
+            return n
+        return E.transform(e, fn)
+
+    def _fix_nested(q2, nested):
+        if isinstance(q2, A.UnionAll):
+            return dataclasses.replace(
+                q2, parts=tuple(_fix_nested(p, nested)
+                                for p in q2.parts))
+        if not isinstance(q2, A.SelectStmt):
+            return q2
+        nested2 = nested + (_relation_aliases(q2.relation),)
+        f = lambda e: fix(e, nested2)   # noqa: E731
+        rel2 = _map_relation(q2.relation, lambda s: s, f)
+        if rel2 is not q2.relation:
+            q2 = dataclasses.replace(q2, relation=rel2)
+        return _map_stmt_exprs(q2, f)
+
+    q = dataclasses.replace(q, relation=rebuild(rel))
+    # unaliased projections keep the name the user WROTE: 'select
+    # b.region' must come back as column 'region', not '__sj1_region'
+    items = []
+    for it in q.items:
+        alias = it.alias
+        if alias is None and isinstance(it.expr, E.Column) \
+                and it.expr.qual in ren_by_alias \
+                and it.expr.name in ren_by_alias[it.expr.qual]:
+            alias = it.expr.name
+        items.append(A.SelectItem(it.expr, alias))
+    q = dataclasses.replace(q, items=tuple(items))
+    return _map_stmt_exprs(q, fix)
+
+
+def _iter_stmt_exprs_deep(q):
+    """Every expression of ``q`` including nested subquery statements
+    (for reference scans that must see through scope boundaries)."""
+    if isinstance(q, A.UnionAll):
+        for p in q.parts:
+            yield from _iter_stmt_exprs_deep(p)
+        return
+    if not isinstance(q, A.SelectStmt):
+        return
+    yield from _iter_stmt_exprs(q)
+    yield from _iter_relation_conditions(q.relation)
+
+
 def _resolve_scope(ctx, q, outer: Tuple[frozenset, ...]):
     """Resolve a SELECT scope: derived tables are fresh self-contained
     scopes; subquery expressions are nested scopes that see this one."""
@@ -183,6 +356,7 @@ def _resolve_scope(ctx, q, outer: Tuple[frozenset, ...]):
         return dataclasses.replace(
             q, parts=tuple(_resolve_scope(ctx, p, outer)
                            for p in q.parts))
+    q = _disambiguate_join_duplicates(ctx, q)
     aliases = _relation_aliases(q.relation)
     inner = outer + (aliases,)
 

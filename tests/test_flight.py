@@ -95,9 +95,16 @@ def test_concurrent_flight_statements(served):
 def test_adbc_driver_connects(served):
     """A REAL BI-stack client: the ADBC Flight SQL driver (the same
     driver Tableau/PowerBI-adjacent tooling and dbapi users load)
-    connects, issues SQL, and reads an Arrow result. Skipped when the
-    driver wheel is absent from the image — the wire shape it emits
-    (CommandStatementQuery + DoGet) is still covered above either way."""
+    connects, issues SQL, and reads an Arrow result.
+
+    Skipped when the driver wheel is absent: this image is zero-egress
+    and package installation is disallowed, and the
+    ``adbc_driver_flightsql`` wheel is not baked in — to run it, install
+    ``adbc-driver-flightsql`` (pulls ``adbc-driver-manager``) in a
+    networked environment and re-run; no code changes needed. The wire
+    shape the driver emits (CommandStatementQuery + DoGet) is covered
+    by the envelope tests above, and scripts/loadtest.py --tpch drives
+    the same Flight endpoint concurrently next to HTTP either way."""
     adbc = pytest.importorskip("adbc_driver_flightsql.dbapi")
     _, df, server, _ = served
     with adbc.connect(f"grpc://127.0.0.1:{server.port}") as conn:

@@ -213,3 +213,61 @@ def test_union_derived_inside_shadowed_subquery(ctx):
     m = df.groupby("region")["qty"].mean()
     want = int((df.qty > df.region.map(m)).sum())  # exists always true
     assert int(got["n"].iloc[0]) == want
+
+
+# -- same-scope self-joins (duplicate-column disambiguation) ------------------
+
+def test_selfjoin_nonequi_condition(ctx):
+    """t a join t b with a NON-equi qualified condition: without the
+    duplicate rename both sides would collapse to the same bare name
+    (x < x). The b-side duplicates rename through a derived wrap."""
+    df = ctx._test_df
+    got = ctx.sql(
+        "select count(*) as c from sales a join sales b "
+        "on a.cust = b.cust and a.qty < b.qty "
+        "where a.region = b.region").to_pandas()
+    m = df.merge(df, on="cust", suffixes=("_a", "_b"))
+    want = int(((m.qty_a < m.qty_b)
+                & (m.region_a == m.region_b)).sum())
+    assert int(got["c"].iloc[0]) == want
+
+
+def test_selfjoin_projects_both_sides(ctx):
+    """Qualified projections from BOTH sides of a self-join survive the
+    rename and group correctly."""
+    df = ctx._test_df
+    got = ctx.sql(
+        "select a.region as ra, b.region as rb, count(*) as c "
+        "from sales a join sales b on a.cust = b.cust "
+        "and a.qty < b.qty group by a.region, b.region "
+        "order by c desc limit 5").to_pandas()
+    m = df.merge(df, on="cust", suffixes=("_a", "_b"))
+    m = m[m.qty_a < m.qty_b]
+    w = m.groupby(["region_a", "region_b"]).size() \
+        .reset_index(name="c").sort_values("c", ascending=False).head(5)
+    assert got["c"].tolist() == w["c"].tolist()
+
+
+def test_selfjoin_without_distinct_aliases_raises(ctx):
+    from spark_druid_olap_tpu.sql.lexer import SqlSyntaxError
+    with pytest.raises(SqlSyntaxError, match="DISTINCT aliases"):
+        ctx.sql("select count(*) as c from sales join sales "
+                "on sales.qty < sales.qty")
+
+
+def test_star_convention_duplicates_untouched(ctx):
+    """Bare references to columns duplicated across joined relations
+    keep the legacy global-name bind (the star-schema convention — the
+    flat index shares its dimension columns); only qualifier-
+    distinguished duplicates rewrite."""
+    df = ctx._test_df
+    summary = df.groupby("region", as_index=False)["qty"].sum() \
+        .rename(columns={"qty": "rq"})
+    ctx.ingest_dataframe("regionsum",
+                         summary.assign(region=summary.region))
+    got = ctx.sql(
+        "select region, count(*) as c from sales "
+        "join regionsum on sales.region = regionsum.region "
+        "group by region order by region").to_pandas()
+    w = df.groupby("region").size()
+    assert got["c"].tolist() == w.tolist()
