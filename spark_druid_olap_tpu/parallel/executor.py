@@ -2989,14 +2989,18 @@ class QueryEngine:
         n = ds.local_num_rows if local else ds.num_rows
         mask = np.ones(n, dtype=bool)
         if intervals is not None and ds.time is not None:
-            ms = ds.time.millis if local else ds.complete().time.millis
+            ms = ds.time.millis if local \
+                else ds.complete(columns=()).time.millis
             im = np.zeros(n, dtype=bool)
             for lo, hi in intervals:
                 im |= (ms >= lo) & (ms < hi)
             mask &= im
         if filter_spec is not None:
             env = {}
-            for c in _filter_columns_all(filter_spec):
+            # SORTED: on a partial store each column gathers via a
+            # cross-process collective — set iteration order differs
+            # per process (hash randomization) and would deadlock
+            for c in sorted(_filter_columns_all(filter_spec)):
                 env[c] = _host_column_values(ds, c, None, local_ok=local)
             expr = filter_to_expr(filter_spec)
             mask &= host_eval.eval_pred3(expr, env)
@@ -3560,7 +3564,7 @@ def _host_column_values(ds: Datasource, name: str,
     multi-host select/search paths that exchange results instead of
     columns."""
     if not local_ok:
-        ds = ds.complete()
+        ds = ds.complete(columns=(name,))
     if name in ds.dims:
         col = ds.dims[name]
         codes = col.codes if idx is None else col.codes[idx]

@@ -77,14 +77,15 @@ def datasource_frame(ctx, name: str, columns=None) -> pd.DataFrame:
     if name in SYS_VIEWS and name not in ctx.store.names():
         return SYS_VIEWS[name](ctx)
     ds = ctx.store.get(name)
-    # multi-host partial store: assemble the complete view by a
-    # cross-process exchange (cached) — the host tier serves ANY query
-    # shape on partial stores at O(table) transfer once (VERDICT r4
-    # item 2; ≈ DruidRelation.scala:111's Spark-side fallback scan)
-    ds = ds.complete()
     names = ds.column_names()
     if columns is not None:
         names = [c for c in names if c in columns]
+    # multi-host partial store: assemble a complete view of the NEEDED
+    # columns by a cross-process exchange (cached per column) — the
+    # host tier serves ANY query shape on partial stores at O(needed)
+    # transfer (VERDICT r4 item 2; ≈ DruidRelation.scala:111's
+    # Spark-side fallback scan)
+    ds = ds.complete(columns=names)
     data = {c: _host_column_values(ds, c, None) for c in names}
     out = pd.DataFrame(data)
     if len(out.columns) == 0:

@@ -235,12 +235,14 @@ def _disambiguate_join_duplicates(ctx, q):
     alias_of = [lf.alias or getattr(lf, "name", None) for lf in leaves]
     seen: set = set()
     renmaps = []           # per leaf: {bare: renamed} (empty = unwrapped)
+    owned_elsewhere = []   # per leaf: dup columns an EARLIER leaf owns
     for i, (lf, cols) in enumerate(zip(leaves, cols_of)):
         ren = {}
         if isinstance(lf, A.TableRef):
             ren = {c: f"__sj{i}_{c}"
                    for c in sorted(cols & dup & seen)
                    if (alias_of[i], c) in quals_used}
+        owned_elsewhere.append(cols & dup & seen)
         seen |= cols
         renmaps.append(ren)
     if not any(renmaps):
@@ -266,10 +268,13 @@ def _disambiguate_join_duplicates(ctx, q):
     for i, (lf, cols, ren) in enumerate(zip(leaves, cols_of, renmaps)):
         if not ren:
             continue
-        # expose referenced non-duplicated columns bare + the renamed
-        # duplicates; duplicated columns NOT renamed stay unexposed so
-        # the bare copy binds the first owner without a merge collision
-        used = sorted(((refs & cols) - dup) | set(ren)) \
+        # expose bare: referenced columns this leaf FIRST-owns (incl.
+        # duplicated ones a LATER leaf shares — hiding those would
+        # unbind a first-owner reference); plus the renamed duplicates.
+        # Duplicated columns an EARLIER leaf owns stay unexposed unless
+        # renamed, so the bare copy binds that first owner without a
+        # merge collision.
+        used = sorted(((refs & cols) - owned_elsewhere[i]) | set(ren)) \
             or sorted(cols)[:1]
         body = A.SelectStmt(
             items=tuple(A.SelectItem(E.Column(c), ren.get(c, c))
@@ -345,8 +350,8 @@ def _iter_stmt_exprs_deep(q):
         return
     if not isinstance(q, A.SelectStmt):
         return
+    # _iter_stmt_exprs already includes the join ON conditions
     yield from _iter_stmt_exprs(q)
-    yield from _iter_relation_conditions(q.relation)
 
 
 def _resolve_scope(ctx, q, outer: Tuple[frozenset, ...]):

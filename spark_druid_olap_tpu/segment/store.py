@@ -161,50 +161,76 @@ class Datasource:
             return np.zeros(len(gids), dtype=np.int32)
         return self.host_assignment[seg_of]
 
-    def complete(self) -> "Datasource":
+    def complete(self, columns=None) -> "Datasource":
         """A COMPLETE view of this datasource: itself when already
-        complete; on a multi-host partial store, a cached clone whose
-        column arrays are assembled by a cross-process exchange
+        complete; on a multi-host partial store, a clone whose column
+        arrays are assembled by a cross-process exchange
         (multihost.exchange_block) — the safety valve that lets the host
-        fallback tier serve ANY query shape on a partial store, at
-        O(table) transfer once per datasource (≈ the reference's
-        Spark-side fallback scan pulling all rows off the historicals,
-        ``DruidRelation.scala:111``). Engine paths never call this."""
+        fallback tier serve ANY query shape on a partial store (≈ the
+        reference's Spark-side fallback scan pulling rows off the
+        historicals, ``DruidRelation.scala:111``). Engine paths never
+        call this.
+
+        ``columns`` prunes the gather to the NEEDED columns (plus the
+        time column, always) — O(needed), not O(table width); gathered
+        arrays are cached per column so repeated host-tier statements
+        exchange each column once. Gathers run in SORTED column order:
+        every process must issue the identical collective sequence, and
+        callers' set-typed column collections must never dictate it."""
         if not self.is_partial:
             return self
-        cached = getattr(self, "_complete_cache", None)
-        if cached is not None:
-            return cached
         from spark_druid_olap_tpu.parallel import multihost as MH
         if not MH.is_multihost():
             # single-process partial store (tests): nothing to gather from
             self.require_complete("cross-host gather")
         import dataclasses as _dc
-        assignment = self.host_assignment
-        n_hosts = (int(assignment.max()) + 1) if len(assignment) else 1
-        ranges = {h: [(self.segments[int(i)].start_row,
-                       self.segments[int(i)].end_row)
-                      for i in np.nonzero(assignment == h)[0]]
-                  for h in range(n_hosts)}
-        # per-host global row ids, ascending (the write targets)
-        gids = {h: (np.concatenate([np.arange(s, e, dtype=np.int64)
-                                    for s, e in ranges[h]])
-                    if ranges[h] else np.empty(0, np.int64))
-                for h in range(n_hosts)}
+        sel_dims = [k for k in self.dims
+                    if columns is None or k in columns]
+        sel_mets = [k for k in self.metrics
+                    if columns is None or k in columns]
+
+        cache = getattr(self, "_gathered_cols", None)
+        if cache is None:
+            cache = self._gathered_cols = {}
         n_rows = self.num_rows
-        # chunked exchange: the collective stages data through device
-        # memory, so a whole-column gather of a large store would blow
-        # HBM. Chunk count is computed from GLOBAL metadata (max local
-        # rows over hosts) — identical on every process, or the
-        # collectives would mismatch.
-        chunk = 1 << 22
-        max_local = max((int(g.shape[0]) for g in gids.values()),
-                        default=0)
-        n_chunks = max(1, -(-max_local // chunk))
+
+        def _plan():
+            """(gids, n_hosts, n_chunks): per-host global-row write
+            targets + chunk count. O(num_rows) to build — computed on
+            the FIRST cache miss only (a cache-hit complete() call must
+            not pay it; the SF100 host tier calls complete() per column
+            per statement)."""
+            p = getattr(self, "_gather_plan", None)
+            if p is not None:
+                return p
+            assignment = self.host_assignment
+            n_hosts = (int(assignment.max()) + 1) if len(assignment) \
+                else 1
+            ranges = {h: [(self.segments[int(i)].start_row,
+                           self.segments[int(i)].end_row)
+                          for i in np.nonzero(assignment == h)[0]]
+                      for h in range(n_hosts)}
+            # per-host global row ids, ascending (the write targets)
+            gids = {h: (np.concatenate(
+                [np.arange(s, e, dtype=np.int64) for s, e in ranges[h]])
+                if ranges[h] else np.empty(0, np.int64))
+                for h in range(n_hosts)}
+            # chunked exchange: the collective stages data through
+            # device memory, so a whole-column gather of a large store
+            # would blow HBM. Chunk count comes from GLOBAL metadata
+            # (max local rows over hosts) — identical on every process,
+            # or the collectives would mismatch.
+            max_local = max((int(g.shape[0]) for g in gids.values()),
+                            default=0)
+            n_chunks = max(1, -(-max_local // (1 << 22)))
+            p = self._gather_plan = (gids, n_hosts, n_chunks)
+            return p
 
         def _gather(arr):
             if arr is None:
                 return None
+            gids, n_hosts, n_chunks = _plan()
+            chunk = 1 << 22
             out = np.empty((n_rows,) + arr.shape[1:], arr.dtype)
             offs = {h: 0 for h in range(n_hosts)}
             for c in range(n_chunks):
@@ -217,25 +243,40 @@ class Datasource:
                     offs[h] += len(blk)
             return out
 
-        dims = {k: _dc.replace(d, codes=_gather(d.codes),
-                               validity=_gather(d.validity))
-                for k, d in self.dims.items()}
-        mets = {}
-        for k, m in self.metrics.items():
-            gmin, gmax = m.min, m.max
-            mm = _dc.replace(m, values=_gather(m.values),
-                             validity=_gather(m.validity))
-            mm._bounds_cache = (gmin, gmax)
-            mets[k] = mm
+        def col(name, build):
+            hit = cache.get(name)
+            if hit is None:
+                hit = cache[name] = build()
+            return hit
+
         time = None
         if self.time is not None:
-            time = _dc.replace(self.time, days=_gather(self.time.days),
-                               ms_in_day=_gather(self.time.ms_in_day))
+            days, ms = col("\x00time", lambda: (
+                _gather(self.time.days), _gather(self.time.ms_in_day)))
+            time = _dc.replace(self.time, days=days, ms_in_day=ms)
+        dims = {}
+        mets = {}
+        for k in sorted(sel_dims + sel_mets):
+            if k in self.dims:
+                d = self.dims[k]
+                codes, valid = col(k, lambda d=d: (
+                    _gather(d.codes), _gather(d.validity)))
+                dims[k] = _dc.replace(d, codes=codes, validity=valid)
+            else:
+                m = self.metrics[k]
+                gmin, gmax = m.min, m.max
+                values, valid = col(k, lambda m=m: (
+                    _gather(m.values), _gather(m.validity)))
+                mm = _dc.replace(m, values=values, validity=valid)
+                mm._bounds_cache = (gmin, gmax)
+                mets[k] = mm
+        # dict order mirrors the source store (column_names contract)
+        dims = {k: dims[k] for k in self.dims if k in dims}
+        mets = {k: mets[k] for k in self.metrics if k in mets}
         ds = Datasource(name=self.name, time=time, dims=dims,
                         metrics=mets, segments=list(self.segments),
                         spatial=dict(self.spatial))
         ds.gathered_from_partial = True
-        self._complete_cache = ds
         return ds
 
     # -- basic shape ----------------------------------------------------------

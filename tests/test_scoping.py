@@ -271,3 +271,37 @@ def test_star_convention_duplicates_untouched(ctx):
         "group by region order by region").to_pandas()
     w = df.groupby("region").size()
     assert got["c"].tolist() == w.tolist()
+
+
+def test_selfjoin_three_way_first_owner_exposure(ctx):
+    """A wrapped middle leaf must keep exposing duplicated columns it
+    FIRST-owns (a later leaf shares them): hiding them would unbind the
+    first-owner reference (review-found 3-way case)."""
+    import numpy as np
+    import pandas as pd
+    rng = np.random.default_rng(3)
+    ctx.ingest_dataframe("jt1", pd.DataFrame({
+        "x": rng.integers(0, 50, 500)}))
+    ctx.ingest_dataframe("jt2", pd.DataFrame({
+        "x": rng.integers(0, 50, 400), "z": rng.integers(0, 30, 400)}))
+    ctx.ingest_dataframe("jt3", pd.DataFrame({
+        "z": rng.integers(0, 30, 300)}))
+    r = ctx.sql(
+        "select a.x as ax, b.x as bx, b.z as bz, count(*) as n "
+        "from jt1 a join jt2 b on a.x = b.x "
+        "join jt3 c on b.z = c.z "
+        "group by a.x, b.x, b.z order by ax, bx, bz limit 5").to_pandas()
+    t1 = pd.DataFrame({"ax": np.asarray(
+        ctx.store.get("jt1").metrics["x"].values)})
+    # oracle via pandas on the same frames
+    m = t1.merge(
+        pd.DataFrame({
+            "bx": np.asarray(ctx.store.get("jt2").metrics["x"].values),
+            "bz": np.asarray(ctx.store.get("jt2").metrics["z"].values)}),
+        left_on="ax", right_on="bx")
+    m = m.merge(pd.DataFrame({
+        "z": np.asarray(ctx.store.get("jt3").metrics["z"].values)}),
+        left_on="bz", right_on="z")
+    w = m.groupby(["ax", "bx", "bz"]).size().reset_index(name="n") \
+        .sort_values(["ax", "bx", "bz"]).head(5)
+    assert r.values.tolist() == w.values.tolist()
