@@ -144,9 +144,13 @@ def test_negative_plan_entry_not_tuple_sentinel():
     df = pd.DataFrame({"k": ["a", "b"], "v": [1.0, 2.0]})
     ctx = sdot.Context()
     ctx.ingest_dataframe("neg", df)
-    # a statement the builder deterministically rejects (join without a
-    # registered star schema -> host tier)
-    sql = "select a.k from neg a join neg b on a.k = b.k"
+    # a statement the builder deterministically rejects: a session
+    # Python UDF has no device compilation path (a plain equi self-join
+    # now runs ENGINE mode via the round-5 disambiguation + composite
+    # pushdown, so it no longer demotes)
+    ctx.functions["negfn"] = lambda a, b: float(a) + float(b)
+    sql = ("select k, count(*) as n from neg where negfn(v, v) > 0 "
+           "group by k order by k")
     r1 = ctx.sql(sql)
     assert ctx.history.entries()[-1].stats["mode"].startswith("host")
     plan_cache = getattr(ctx, "_result_cache", {}).get("plan", {})
