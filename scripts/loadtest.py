@@ -46,6 +46,52 @@ def make_http_caller(url):
     return lambda sql: post_sql(url, sql)
 
 
+def get_json(url, path, timeout=30):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def run_hotcold(call, queries, url, iters=20):
+    """Cold→warm loop over the result cache: each query once cold, then
+    ``iters`` warm repeats; reports hit rate (from /metadata/cache) and
+    cold vs warm p50/p99 side by side."""
+    before = get_json(url, "/metadata/cache")
+    cold, warm = [], []
+    for sql in queries:
+        t0 = time.perf_counter()
+        call(sql)
+        cold.append((time.perf_counter() - t0) * 1000)
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            call(sql)
+            warm.append((time.perf_counter() - t0) * 1000)
+    after = get_json(url, "/metadata/cache")
+    served = len(cold) + len(warm)
+    hits = (after["hits"] - before["hits"]) \
+        + (after["subsumed"] - before["subsumed"])
+    c, w = np.array(cold), np.array(warm)
+    print(f"\n=== hot/cold ({len(queries)} queries x (1 cold + {iters} "
+          f"warm)) ===")
+    print(f"  hit rate: {hits}/{served} = {hits / served:.1%} "
+          f"(cache enabled={after['enabled']}, "
+          f"entries={after['entries']}, bytes={after['bytes']})")
+    print(f"  cold p50={np.percentile(c, 50):7.1f}ms "
+          f"p99={np.percentile(c, 99):7.1f}ms n={len(c)}")
+    print(f"  warm p50={np.percentile(w, 50):7.1f}ms "
+          f"p99={np.percentile(w, 99):7.1f}ms n={len(w)}")
+    speedup = np.percentile(c, 50) / max(np.percentile(w, 50), 1e-9)
+    print(f"  warm p50 speedup: {speedup:.1f}x")
+    out = {"mode": "hotcold", "queries": len(queries), "iters": iters,
+           "hit_rate": round(hits / served, 4),
+           "cold_p50_ms": round(float(np.percentile(c, 50)), 2),
+           "cold_p99_ms": round(float(np.percentile(c, 99)), 2),
+           "warm_p50_ms": round(float(np.percentile(w, 50)), 2),
+           "warm_p99_ms": round(float(np.percentile(w, 99)), 2),
+           "warm_p50_speedup": round(float(speedup), 1)}
+    print(json.dumps(out))
+    return hits > 0
+
+
 def make_flight_caller(url):
     """Per-thread Arrow Flight SQL caller: the same CommandStatementQuery
     envelope ADBC/JDBC-Flight drivers emit (get_flight_info -> do_get),
@@ -157,6 +203,14 @@ def run_tpch_compare(args):
     flight_url = f"grpc://127.0.0.1:{flight_server.port}"
 
     queries = args.sql or TPCH_DASHBOARD
+    if args.hotcold:
+        try:
+            ok = run_hotcold(make_http_caller(http_url), queries,
+                             http_url, iters=args.hotcold)
+        finally:
+            http_server.stop()
+            flight_server.shutdown()
+        sys.exit(0 if ok else 1)
     for q in queries:                      # compile/warm before measuring
         post_sql(http_url, q, timeout=300)
 
@@ -210,6 +264,11 @@ def main():
                     "this scale factor and run a BI dashboard query mix "
                     "through BOTH HTTP and Flight on the same data, "
                     "reporting the two side by side (VERDICT r4 item 6)")
+    ap.add_argument("--hotcold", type=int, default=0, metavar="N",
+                    help="repeated-query result-cache loop: each query "
+                    "once cold then N warm repeats; reports hit rate "
+                    "(from /metadata/cache) and cold vs warm p50/p99 "
+                    "(HTTP only; first cold run includes compile)")
     args = ap.parse_args()
 
     if args.tpch is not None:
@@ -246,10 +305,23 @@ def main():
             server = SqlServer(ctx, port=0)
             server.start()
             args.url = f"http://127.0.0.1:{server.port}"
-        warm = make_flight_caller(args.url) if args.flight \
-            else make_http_caller(args.url)
-        for q in queries:        # compile/warm before measuring
-            warm(q)
+        if not args.hotcold:
+            warm = make_flight_caller(args.url) if args.flight \
+                else make_http_caller(args.url)
+            for q in queries:    # compile/warm before measuring
+                warm(q)
+
+    if args.hotcold:
+        if args.flight:
+            sys.exit("--hotcold drives the HTTP endpoint "
+                     "(it reads /metadata/cache)")
+        try:
+            ok = run_hotcold(make_http_caller(args.url), queries,
+                             args.url, iters=args.hotcold)
+        finally:
+            if server is not None:
+                server.stop()
+        sys.exit(0 if ok else 1)
 
     if args.flight:
         if args.url.startswith("http://"):

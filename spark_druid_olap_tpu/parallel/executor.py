@@ -722,6 +722,11 @@ class QueryEngine:
         # bounded re-attach probe runs at most once per cooldown window
         self._backend_lost_at: Optional[float] = None
         self._backend_retry_at: float = 0.0
+        # semantic result cache (cache/): exact + subsumption reuse of
+        # materialized aggregate results, keyed on the per-datasource
+        # ingest version (structural invalidation, no TTL)
+        from spark_druid_olap_tpu.cache.result_cache import SemanticResultCache
+        self.result_cache = SemanticResultCache(self.config)
 
     @property
     def last_stats(self) -> Dict[str, object]:
@@ -843,12 +848,30 @@ class QueryEngine:
             # holder releases
             self.register_query(qid)
         try:
+            cache = self.result_cache
+            use_cache = cache.enabled and cache.cacheable(q)
+            if use_cache:
+                # lookup precedes the backend-loss gate on purpose: a
+                # cached answer needs no device, so hits keep serving at
+                # full speed while the host tier covers the misses
+                ds_version = self.store.datasource_version(q.datasource)
+                served, status = cache.lookup(q, ds_version)
+                if served is not None:
+                    self.last_stats["cache"] = status
+                    self.last_stats["datasource"] = q.datasource
+                    self.last_stats["total_ms"] = \
+                        (_time.perf_counter() - t0) * 1000
+                    return served
             if self._backend_lost_at is not None \
                     and not self._try_reattach():
                 self.last_stats["backend_lost"] = True
                 raise EngineFallback(
                     "backend_lost (device unreachable; host tier serving)")
-            return self._execute_inner(q, t0)
+            r = self._execute_inner(q, t0)
+            if use_cache:
+                cache.put(q, ds_version, r)
+                self.last_stats["cache"] = "miss"
+            return r
         except EC.Unsupported as e:
             # expression/filter compilation is lazy (trace time), so an
             # unsupported node can surface only here — demote it to the
@@ -1027,7 +1050,7 @@ class QueryEngine:
         multihost = sharded and MH.is_multihost()
         if multihost:
             seg_idx, s_pad, spw, n_waves = self._multihost_layout(
-                ds, seg_idx, n_waves)
+                ds, seg_idx, n_waves, seg_bytes)
         sketch_plans = [p for p in agg_plans if p.kind in ("hll", "theta")]
         topk = self._plan_device_topk(limit, having, agg_plans, n_keys) \
             if n_waves == 1 and not no_topk else None
@@ -1477,7 +1500,7 @@ class QueryEngine:
         multihost = sharded and MH.is_multihost()
         if multihost:
             seg_idx, s_pad, spw, n_waves = self._multihost_layout(
-                ds, seg_idx, n_waves)
+                ds, seg_idx, n_waves, seg_bytes)
         wave_segs = [seg_idx[i: i + s_pad]
                      for i in range(0, len(seg_idx), s_pad)]
         sharding = NamedSharding(self.mesh, P(SEGMENT_AXIS, None)) \
@@ -1856,7 +1879,7 @@ class QueryEngine:
         return C.unit_cost(self.config, CF.COST_SORT_PAYLOAD_ROW) \
             < C.unit_cost(self.config, CF.COST_SCATTER_UPDATE)
 
-    def _multihost_layout(self, ds, seg_idx, n_waves):
+    def _multihost_layout(self, ds, seg_idx, n_waves, seg_bytes: int = 0):
         """Re-order a (pruned) segment selection into per-host blocks so
         each host's devices scan exactly the segments that host stores
         (parallel/multihost.layout_segments). Returns the executor-shape
@@ -1874,8 +1897,13 @@ class QueryEngine:
             rows = np.array([s.num_rows for s in ds.segments], np.int64)
             assignment = MH.assign_segments_to_hosts(rows, n_hosts)
         if n_waves > 1:
+            # pass the byte budget down so a skewed assignment (one host
+            # owning most of the pruned segments) cannot overshoot the
+            # per-device wave budget the caller's n_waves assumed
             ordered, spw = MH.layout_segments_waves(
-                assignment, seg_idx, n_hosts, dph, n_waves)
+                assignment, seg_idx, n_hosts, dph, n_waves,
+                seg_bytes=int(seg_bytes),
+                wave_budget=int(C.wave_budget_bytes(self.config) or 0))
             return ordered, spw, spw, len(ordered) // spw
         ordered, _ = MH.layout_segments(assignment, seg_idx, n_hosts, dph)
         return ordered, len(ordered), len(ordered), 1
@@ -3123,6 +3151,7 @@ class QueryEngine:
         self._compact_overflowed.clear()
         self._device_arrays.clear()
         self._device_bytes = 0
+        self.result_cache.clear()
 
 
 _LOST_MARKERS = ("unavailable", "deadline_exceeded", "deadline exceeded",

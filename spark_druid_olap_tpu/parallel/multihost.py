@@ -138,7 +138,8 @@ def layout_segments(assignment: np.ndarray, seg_idx: np.ndarray,
 
 
 def layout_segments_waves(assignment: np.ndarray, seg_idx: np.ndarray,
-                          n_hosts: int, devs_per_host: int, n_waves: int):
+                          n_hosts: int, devs_per_host: int, n_waves: int,
+                          seg_bytes: int = 0, wave_budget: int = 0):
     """Wave-mode variant of ``layout_segments`` (VERDICT r4 item 2: waves
     must compose with multi-host — SF100's overflow valve).
 
@@ -158,6 +159,14 @@ def layout_segments_waves(assignment: np.ndarray, seg_idx: np.ndarray,
     n_waves = max(1, min(int(n_waves), longest))
     phw = -(-longest // n_waves)                   # per host per wave
     phw = -(-phw // devs_per_host) * devs_per_host
+    if seg_bytes and wave_budget:
+        # cap per-host-per-wave from the byte budget DIRECTLY: the
+        # caller's n_waves assumed a balanced assignment, so a host
+        # owning more than its share would bind phw/devs_per_host
+        # segments past the per-device budget (the HBM-overflow valve)
+        phw_budget = max(1, int(wave_budget) // int(seg_bytes)) \
+            * devs_per_host
+        phw = min(phw, max(phw_budget, devs_per_host))
     n_waves_eff = -(-longest // phw)
     spw = n_hosts * phw
     ordered = np.full(n_waves_eff * spw, -1, dtype=np.int64)

@@ -85,7 +85,14 @@ def datasource_frame(ctx, name: str, columns=None) -> pd.DataFrame:
     # host tier serves ANY query shape on partial stores at O(needed)
     # transfer (VERDICT r4 item 2; ≈ DruidRelation.scala:111's
     # Spark-side fallback scan)
+    src = ds
     ds = ds.complete(columns=names)
+    if getattr(ds, "gathered_from_partial", False):
+        gathered = getattr(src, "_gathered_cols", None)
+        if gathered is not None:
+            # observable memory guarantee of the (byte-bounded) gather
+            # cache — surfaced per statement like the engine's counters
+            ctx.engine.last_stats["gathered_bytes"] = int(gathered.bytes)
     data = {c: _host_column_values(ds, c, None) for c in names}
     out = pd.DataFrame(data)
     if len(out.columns) == 0:

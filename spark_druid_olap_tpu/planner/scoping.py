@@ -176,6 +176,31 @@ def _iter_relation_conditions(rel):
         yield from _iter_relation_conditions(rel.right)
 
 
+def _equi_key_refs(rel):
+    """(qualifier, column) pairs the JOIN LAYER binds by itself: the
+    qualified columns of top-level AND-ed ``a.x = b.y`` equality keys in
+    ON conditions. The downstream merge resolves these by qualifier and
+    collapses the key pair into one output column, so for
+    different-table joins they must not trigger a scope rename (and must
+    stay exposed under their bare names)."""
+    out = set()
+
+    def eq_terms(c):
+        if isinstance(c, E.And):
+            for p in c.parts:
+                eq_terms(p)
+        elif (isinstance(c, E.Comparison) and c.op == "="
+              and isinstance(c.left, E.Column)
+              and isinstance(c.right, E.Column)
+              and c.left.qual and c.right.qual):
+            out.add((c.left.qual, c.left.name))
+            out.add((c.right.qual, c.right.name))
+
+    for cond in _iter_relation_conditions(rel):
+        eq_terms(cond)
+    return out
+
+
 def _disambiguate_join_duplicates(ctx, q):
     """Same-scope duplicate-column joins (self-joins): columns bind by
     bare name, so ``t a join t b`` exposes every column of ``t`` twice
@@ -213,6 +238,18 @@ def _disambiguate_join_duplicates(ctx, q):
     dup = {c for c, k in cnt.items() if k > 1}
     if not dup:
         return q
+    # TRUE self-joins: the SAME base table appearing twice. Two
+    # DIFFERENT tables sharing column names (t1 a join t2 b on a.id =
+    # b.id) are the star-schema convention — their equi-join keys bind
+    # by qualifier at the join layer (the merge collapses them), so ON
+    # key references must neither rename nor star-raise there; only
+    # duplicated columns referenced OUTSIDE the ON keys (a.x, b.x in
+    # the select list) still need the rename to survive the merge's
+    # bare-name suffixing.
+    base_cnt = Counter(lf.name for lf in leaves
+                       if isinstance(lf, A.TableRef))
+    self_joined = {t for t, k in base_cnt.items() if k > 1}
+    on_keys = _equi_key_refs(rel)
 
     # every referenced name in this scope (subquery expressions
     # included — they may reference our aliases); derived-table bodies
@@ -220,15 +257,29 @@ def _disambiguate_join_duplicates(ctx, q):
     refs: set = set()
     quals_used: set = set()
 
-    def scan(e):
+    def scan(e, nested=()):
         for n in E.walk(e):
             if isinstance(n, E.Column) and n.name != "*":
                 refs.add(n.name)
-                if n.qual:
+                # a qualifier REBOUND by a nested FROM belongs to that
+                # scope: 'exists (select 1 from u b where b.x ...)' must
+                # not mark OUR leaf b's x as qualifier-referenced (the
+                # same guard fix()/_fix_nested apply on the rewrite side)
+                if n.qual and not any(n.qual in na for na in nested):
                     quals_used.add((n.qual, n.name))
             elif isinstance(n, _SUBQ):
-                for e2 in _iter_stmt_exprs_deep(n.query):
-                    scan(e2)
+                _scan_nested(n.query, nested)
+
+    def _scan_nested(q2, nested):
+        if isinstance(q2, A.UnionAll):
+            for p in q2.parts:
+                _scan_nested(p, nested)
+            return
+        if not isinstance(q2, A.SelectStmt):
+            return
+        nested2 = nested + (_relation_aliases(q2.relation),)
+        for e2 in _iter_stmt_exprs(q2):
+            scan(e2, nested2)
     for e in _iter_stmt_exprs(q):
         scan(e)                 # includes the join ON conditions
 
@@ -239,9 +290,17 @@ def _disambiguate_join_duplicates(ctx, q):
     for i, (lf, cols) in enumerate(zip(leaves, cols_of)):
         ren = {}
         if isinstance(lf, A.TableRef):
-            ren = {c: f"__sj{i}_{c}"
-                   for c in sorted(cols & dup & seen)
-                   if (alias_of[i], c) in quals_used}
+            if lf.name in self_joined:
+                # a self-join duplicates EVERY column: any qualified
+                # reference (ON keys included) needs the rename
+                ren = {c: f"__sj{i}_{c}"
+                       for c in sorted(cols & dup & seen)
+                       if (alias_of[i], c) in quals_used}
+            else:
+                ren = {c: f"__sj{i}_{c}"
+                       for c in sorted(cols & dup & seen)
+                       if (alias_of[i], c) in quals_used
+                       and (alias_of[i], c) not in on_keys}
         owned_elsewhere.append(cols & dup & seen)
         seen |= cols
         renmaps.append(ren)
@@ -253,16 +312,21 @@ def _disambiguate_join_duplicates(ctx, q):
                 f"self-join of {alias_of[i]!r} needs DISTINCT aliases to "
                 f"disambiguate its duplicated columns")
 
-    if any(it.expr == "*" or (isinstance(it.expr, E.Column)
-                              and it.expr.name == "*")
-           for it in q.items):
-        # SELECT * over a qualifier-disambiguated self-join is
+    star = any(it.expr == "*" or (isinstance(it.expr, E.Column)
+                                  and it.expr.name == "*")
+               for it in q.items)
+    if star and any(ren and leaves[i].name in self_joined
+                    for i, ren in enumerate(renmaps)):
+        # SELECT * over a qualifier-disambiguated SELF-join is
         # ill-defined (the duplicated columns have no bare names to
-        # expose) — require an explicit list, like the shadow rename
+        # expose) — require an explicit list, like the shadow rename.
+        # Different-table joins never hit this: their renamed leaves
+        # keep full exposure under star below.
         raise SqlSyntaxError(
-            "select * cannot combine with a self-join that "
-            "disambiguates duplicated columns via aliases: list the "
-            "needed columns explicitly (qualified)")
+            f"select * cannot combine with a self-join of "
+            f"{sorted(self_joined)} that disambiguates duplicated "
+            f"columns via aliases: list the needed columns explicitly "
+            f"(qualified)")
 
     wrapped = {}
     for i, (lf, cols, ren) in enumerate(zip(leaves, cols_of, renmaps)):
@@ -270,12 +334,19 @@ def _disambiguate_join_duplicates(ctx, q):
             continue
         # expose bare: referenced columns this leaf FIRST-owns (incl.
         # duplicated ones a LATER leaf shares — hiding those would
-        # unbind a first-owner reference); plus the renamed duplicates.
-        # Duplicated columns an EARLIER leaf owns stay unexposed unless
-        # renamed, so the bare copy binds that first owner without a
-        # merge collision.
-        used = sorted(((refs & cols) - owned_elsewhere[i]) | set(ren)) \
-            or sorted(cols)[:1]
+        # unbind a first-owner reference); plus the renamed duplicates
+        # and the leaf's ON equi-keys (exposed bare so the merge can
+        # collapse them). Duplicated columns an EARLIER leaf owns stay
+        # unexposed unless renamed, so the bare copy binds that first
+        # owner without a merge collision. Under star the leaf keeps
+        # full exposure (pruning would silently shrink the star).
+        on_i = {c for (al, c) in on_keys
+                if al == alias_of[i] and c in cols}
+        if star:
+            used = sorted(cols)
+        else:
+            used = sorted(((refs & cols) - owned_elsewhere[i])
+                          | set(ren) | on_i) or sorted(cols)[:1]
         body = A.SelectStmt(
             items=tuple(A.SelectItem(E.Column(c), ren.get(c, c))
                         for c in used),
@@ -339,19 +410,6 @@ def _disambiguate_join_duplicates(ctx, q):
         items.append(A.SelectItem(it.expr, alias))
     q = dataclasses.replace(q, items=tuple(items))
     return _map_stmt_exprs(q, fix)
-
-
-def _iter_stmt_exprs_deep(q):
-    """Every expression of ``q`` including nested subquery statements
-    (for reference scans that must see through scope boundaries)."""
-    if isinstance(q, A.UnionAll):
-        for p in q.parts:
-            yield from _iter_stmt_exprs_deep(p)
-        return
-    if not isinstance(q, A.SelectStmt):
-        return
-    # _iter_stmt_exprs already includes the join ON conditions
-    yield from _iter_stmt_exprs(q)
 
 
 def _resolve_scope(ctx, q, outer: Tuple[frozenset, ...]):

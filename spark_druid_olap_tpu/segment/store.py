@@ -191,7 +191,9 @@ class Datasource:
 
         cache = getattr(self, "_gathered_cols", None)
         if cache is None:
-            cache = self._gathered_cols = {}
+            from spark_druid_olap_tpu.cache.result_cache import ByteBudgetLRU
+            cache = self._gathered_cols = \
+                ByteBudgetLRU(GATHERED_CACHE_MAX_BYTES)
         n_rows = self.num_rows
 
         def _plan():
@@ -246,7 +248,8 @@ class Datasource:
         def col(name, build):
             hit = cache.get(name)
             if hit is None:
-                hit = cache[name] = build()
+                hit = build()
+                cache.put(name, hit)
             return hit
 
         time = None
@@ -544,6 +547,13 @@ def restrict_to_host(ds: Datasource, host_assignment,
                       host_assignment=assignment, host_id=int(host_id))
 
 
+# Byte bound on a partial datasource's gathered-column cache (tuples of
+# host arrays rebuilt from the cross-host exchange on miss). Keeps the
+# host tier's residual-gather working set from growing without bound as
+# statements touch ever more columns of a large partial store.
+GATHERED_CACHE_MAX_BYTES = 4 << 30
+
+
 class SegmentStore:
     """Registry of ingested datasources (≈ ``DruidMetadataCache`` — the
     driver-side singleton cache of datasource schemas,
@@ -553,10 +563,16 @@ class SegmentStore:
     def __init__(self):
         self._datasources: Dict[str, Datasource] = {}
         self.version = 0      # bumped on any change; invalidates caches
+        # per-datasource ingest version: the store version at the last
+        # register/drop of that name. Result-cache keys fold it in, so a
+        # re-ingest or stream append structurally invalidates only that
+        # datasource's cached answers.
+        self._versions: Dict[str, int] = {}
 
     def register(self, ds: Datasource) -> None:
         self._datasources[ds.name] = ds
         self.version += 1
+        self._versions[ds.name] = self.version
 
     def get(self, name: str) -> Datasource:
         if name not in self._datasources:
@@ -567,12 +583,18 @@ class SegmentStore:
     def drop(self, name: str) -> None:
         self._datasources.pop(name, None)
         self.version += 1
+        self._versions[name] = self.version
 
     def names(self) -> List[str]:
         return sorted(self._datasources)
+
+    def datasource_version(self, name: str) -> int:
+        """Monotone ingest version of one datasource (0 = never seen)."""
+        return self._versions.get(name, 0)
 
     def clear(self) -> None:
         """≈ ``CLEAR DRUID CACHE`` (reference
         ``DruidMetadataCommands.scala:30-47``)."""
         self._datasources.clear()
         self.version += 1
+        self._versions.clear()

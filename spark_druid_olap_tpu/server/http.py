@@ -162,6 +162,12 @@ class SqlServer:
             return
         if url.path.startswith("/metadata/"):
             kind = url.path[len("/metadata/"):]
+            if kind == "cache":
+                # semantic result cache counters (hit/miss/subsumed/
+                # evictions/bytes) — ≈ Druid's cache metrics endpoint
+                h._send(200, json.dumps(
+                    self.ctx.engine.result_cache.stats()).encode())
+                return
             views = {"datasources": self.ctx.catalog.datasources_view,
                      "segments": self.ctx.catalog.segments_view,
                      "columns": self.ctx.catalog.columns_view}

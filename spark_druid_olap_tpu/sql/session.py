@@ -125,8 +125,11 @@ def _run_sql_inner(ctx, sql: str) -> QueryResult:
     if isinstance(stmt, A.ClearMetadata):
         if stmt.datasource:
             ctx.store.drop(stmt.datasource)
+            # the drop bumps the datasource version (stale keys can never
+            # hit again), but the entries themselves must not linger
+            ctx.engine.result_cache.clear()
         else:
-            ctx.engine.clear_caches()
+            ctx.engine.clear_caches()  # includes the semantic result cache
         return QueryResult(["status"], {"status": np.array(["OK"],
                                                            dtype=object)})
     if isinstance(stmt, A.ExecuteRawQuery):

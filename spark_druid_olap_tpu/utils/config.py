@@ -279,6 +279,27 @@ HLL_LOG2M = _entry(
     "sdot.engine.hll.log2m", 11,
     "log2 of the HLL register count for approximate count-distinct "
     "(reference: Druid hyperUnique uses 2^11 registers).")
+# --- semantic result cache (cache/) -------------------------------------------
+CACHE_ENABLED = _entry(
+    "sdot.cache.enabled", True,
+    "Semantic query-result cache over engine aggregate results "
+    "(cache/result_cache.py): identical queries are served from host "
+    "memory without touching the device. Keys fold in the per-datasource "
+    "ingest version, so staleness is structural — any re-ingest, stream "
+    "append or drop invalidates (≈ Druid's broker/historical result "
+    "caches keyed on segment versions).")
+CACHE_MAX_BYTES = _entry(
+    "sdot.cache.max_bytes", 256 << 20,
+    "Byte budget for materialized results held by the semantic result "
+    "cache; least-recently-used entries evict past it. Results larger "
+    "than the whole budget are never admitted.")
+CACHE_SUBSUMPTION = _entry(
+    "sdot.cache.subsumption", True,
+    "Answer queries from SUPERSET cached entries without re-executing "
+    "(cache/subsume.py): coarser-granularity timeseries from a cached "
+    "finer one, TopN and dim-filtered GroupBy from a cached "
+    "unfiltered/unlimited GroupBy over the same dims, and "
+    "having/limit/post-agg re-evaluation on cached partials.")
 
 
 class Config:

@@ -22,9 +22,23 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+import dataclasses  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pandas as pd  # noqa: E402
 import pytest  # noqa: E402
+
+from spark_druid_olap_tpu.utils import config as _config  # noqa: E402
+
+# Execution-path tests re-run identical specs across a module-scoped
+# engine and assert on per-run engine stats (mode / sharded / dispatch
+# counts); a semantic-cache hit would answer without executing and erase
+# those stats. Pin the result cache OFF by default for the suite — cache
+# semantics get dedicated coverage in test_result_cache.py, which turns
+# it back on per-context.
+_config._REGISTRY["sdot.cache.enabled"] = dataclasses.replace(
+    _config.CACHE_ENABLED, default=False)
+_config.CACHE_ENABLED = _config._REGISTRY["sdot.cache.enabled"]
 
 
 @pytest.fixture(scope="session")
