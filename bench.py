@@ -226,11 +226,21 @@ def build_tables(sf: float):
     return tables, flat, flat_path, len(flat)
 
 
+def _bench_config():
+    """Session config for MEASURED contexts: the semantic result cache and
+    the compiled-statement (plan/cplan) caches would serve warm reps from
+    memory, so the reported latency would measure the cache, not the
+    engine. Set ONCE at context creation — toggling mid-run would change
+    the config fingerprint and thrash the session result caches."""
+    return {"sdot.cache.enabled": False,
+            "sdot.plan.cache.enabled": False}
+
+
 def setup(sf: float):
     import spark_druid_olap_tpu as sdot
     from spark_druid_olap_tpu.tools import tpch
     tables, flat, flat_path, n_rows = build_tables(sf)
-    ctx = sdot.Context()
+    ctx = sdot.Context(_bench_config())
     t0 = time.perf_counter()
     if flat is None:
         ctx.ingest_parquet_stream("tpch_flat", flat_path,
@@ -283,7 +293,7 @@ def setup_ssb(sf: float):
     .bench_cache like the TPC-H SF10 path."""
     import spark_druid_olap_tpu as sdot
     from spark_druid_olap_tpu.tools import ssb
-    ctx = sdot.Context()
+    ctx = sdot.Context(_bench_config())
     t0 = time.perf_counter()
     if sf >= _stream_sf():
         import pandas as pd

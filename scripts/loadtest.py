@@ -33,6 +33,35 @@ DEFAULT_QUERIES = [
     "select approx_count_distinct(product) as np from sales",
 ]
 
+# aggregate shapes the sales_cube rollup can serve (--rollup mode): every
+# grouping dim and filter column is a rollup dimension, every aggregate
+# derives from the stored sum/count partials (avg via sum+count)
+ROLLUP_QUERIES = [
+    "select region, sum(price) as rev from sales group by region",
+    "select region, flag, sum(qty) as q, count(*) as c from sales "
+    "group by region, flag",
+    "select product, sum(price) as rev from sales "
+    "group by product order by rev desc limit 5",
+    "select region, avg(price) as avg_price from sales group by region",
+    "select status, count(*) as c from sales where flag = 'A' "
+    "group by status",
+]
+
+
+def _synthetic_sales(n=200_000):
+    import pandas as pd
+    rng = np.random.default_rng(7)
+    return pd.DataFrame({
+        "ts": (np.datetime64("2015-01-01")
+               + rng.integers(0, 730, n).astype("timedelta64[D]")),
+        "region": rng.choice(["east", "west", "north", "south"], n),
+        "product": rng.choice([f"p{i:03d}" for i in range(50)], n),
+        "flag": rng.choice(["A", "N", "R"], n),
+        "status": rng.choice(["O", "F"], n),
+        "qty": rng.integers(1, 51, n).astype(np.int64),
+        "price": np.round(rng.uniform(1, 1000, n), 2),
+    })
+
 
 def post_sql(url, sql, timeout=60):
     req = urllib.request.Request(
@@ -196,6 +225,11 @@ def run_tpch_compare(args):
     from spark_druid_olap_tpu.server.http import SqlServer
 
     ctx, n_rows = bench.setup(args.tpch)
+    if args.hotcold:
+        # bench.setup disables the result cache for clean latency reps;
+        # the hot/cold loop exists to measure that cache, so turn it
+        # back on BEFORE the first query (one fingerprint for the run)
+        ctx.config.set("sdot.cache.enabled", True)
     http_server = SqlServer(ctx, port=0)
     http_server.start()
     http_url = f"http://127.0.0.1:{http_server.port}"
@@ -241,6 +275,88 @@ def run_tpch_compare(args):
     sys.exit(0 if ok else 1)
 
 
+def _frames_close(a, b) -> bool:
+    """Order-insensitive frame comparison with float tolerance (the
+    rollup leg re-aggregates stored partials; float sums may differ in
+    the last ulps)."""
+    cols = sorted(a.columns)
+    if cols != sorted(b.columns) or len(a) != len(b):
+        return False
+    a = a[cols].sort_values(cols).reset_index(drop=True)
+    b = b[cols].sort_values(cols).reset_index(drop=True)
+    for c in cols:
+        av, bv = a[c].to_numpy(), b[c].to_numpy()
+        if av.dtype.kind in "if" and bv.dtype.kind in "if":
+            if not np.allclose(av.astype(float), bv.astype(float),
+                               rtol=1e-4, atol=1e-6, equal_nan=True):
+                return False
+        elif not (av == bv).all():
+            return False
+    return True
+
+
+def run_rollup(args):
+    """In-process base-vs-rollup comparison: the same aggregate mix runs
+    with the planner rewrite disabled, then enabled, over a context with
+    BOTH the result cache and the statement caches off (every rep
+    replans and re-executes). Reports the rewrite hit rate (per-query
+    ``rollup`` status in sys_queries stats) and p50/p99 side by side,
+    plus a differential check that both legs return the same rows."""
+    sys.path.insert(0, ".")
+    import spark_druid_olap_tpu as sdot
+    ctx = sdot.Context({"sdot.cache.enabled": False,
+                        "sdot.plan.cache.enabled": False})
+    ctx.ingest_dataframe("sales", _synthetic_sales(), time_column="ts")
+    msg = ctx.sql(
+        "create rollup sales_cube on sales "
+        "dimensions (region, product, flag, status) "
+        "aggregations (sum(price), sum(qty), count(*))").to_pandas()
+    rows = ctx.store.get("sales").num_rows
+    print(f"[rollup] {msg['status'][0]} (base {rows:,} rows)")
+    iters = max(1, args.rollup)
+    queries = args.sql or ROLLUP_QUERIES
+    legs, answers, statuses, mismatches = {}, {}, [], []
+    for leg, enabled in (("base", False), ("rollup", True)):
+        ctx.config.set("sdot.mv.rewrite.enabled", enabled)
+        lat = []
+        for sql in queries:
+            df = ctx.sql(sql).to_pandas()      # warm (compile) rep
+            if leg == "base":
+                answers[sql] = df
+            elif not _frames_close(answers[sql], df):
+                mismatches.append(sql)
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                ctx.sql(sql)
+                lat.append((time.perf_counter() - t0) * 1000)
+                if leg == "rollup":
+                    st = ctx.history.entries()[-1].stats
+                    statuses.append(st.get("rollup"))
+        a = np.array(lat)
+        legs[leg] = {"p50_ms": round(float(np.percentile(a, 50)), 2),
+                     "p99_ms": round(float(np.percentile(a, 99)), 2),
+                     "n": len(a)}
+        print(f"  {leg:6s} p50={legs[leg]['p50_ms']:7.1f}ms "
+              f"p99={legs[leg]['p99_ms']:7.1f}ms n={len(a)}")
+    hits = sum(1 for s in statuses
+               if s and str(s).startswith("rollup:"))
+    hit_rate = hits / max(len(statuses), 1)
+    speedup = legs["base"]["p50_ms"] / max(legs["rollup"]["p50_ms"], 1e-9)
+    print(f"  rewrite hit rate: {hits}/{len(statuses)} = {hit_rate:.1%}; "
+          f"p50 speedup {speedup:.2f}x"
+          + (f"; RESULT MISMATCH on {mismatches}" if mismatches else ""))
+    out = {"mode": "rollup", "queries": len(queries), "iters": iters,
+           "rewrite_hit_rate": round(hit_rate, 4),
+           "base_p50_ms": legs["base"]["p50_ms"],
+           "base_p99_ms": legs["base"]["p99_ms"],
+           "rollup_p50_ms": legs["rollup"]["p50_ms"],
+           "rollup_p99_ms": legs["rollup"]["p99_ms"],
+           "p50_speedup": round(float(speedup), 2),
+           "result_mismatches": mismatches}
+    print(json.dumps(out))
+    sys.exit(0 if (hits > 0 and not mismatches) else 1)
+
+
 def main():
     import os
     if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
@@ -269,8 +385,15 @@ def main():
                     "once cold then N warm repeats; reports hit rate "
                     "(from /metadata/cache) and cold vs warm p50/p99 "
                     "(HTTP only; first cold run includes compile)")
+    ap.add_argument("--rollup", type=int, default=0, metavar="N",
+                    help="in-process base-vs-rollup comparison on a "
+                    "synthetic dataset: N timed reps per query with the "
+                    "planner rewrite off, then on (caches disabled); "
+                    "reports rewrite hit rate and p50/p99 side by side")
     args = ap.parse_args()
 
+    if args.rollup:
+        return run_rollup(args)
     if args.tpch is not None:
         return run_tpch_compare(args)
 
@@ -278,23 +401,13 @@ def main():
     server = None
     if args.selfcontained:
         sys.path.insert(0, ".")
-        import pandas as pd
         import spark_druid_olap_tpu as sdot
         from spark_druid_olap_tpu.server.http import SqlServer
-        rng = np.random.default_rng(7)
-        n = 200_000
-        df = pd.DataFrame({
-            "ts": (np.datetime64("2015-01-01")
-                   + rng.integers(0, 730, n).astype("timedelta64[D]")),
-            "region": rng.choice(["east", "west", "north", "south"], n),
-            "product": rng.choice([f"p{i:03d}" for i in range(50)], n),
-            "flag": rng.choice(["A", "N", "R"], n),
-            "status": rng.choice(["O", "F"], n),
-            "qty": rng.integers(1, 51, n).astype(np.int64),
-            "price": np.round(rng.uniform(1, 1000, n), 2),
-        })
-        ctx = sdot.Context()
-        ctx.ingest_dataframe("sales", df, time_column="ts")
+        # statement (plan/cplan) caches off: measured reps must replan,
+        # not replay a compiled-plan lookup (the result cache stays on —
+        # --hotcold measures exactly that layer)
+        ctx = sdot.Context({"sdot.plan.cache.enabled": False})
+        ctx.ingest_dataframe("sales", _synthetic_sales(), time_column="ts")
         if args.flight:
             from spark_druid_olap_tpu.server.flight import SdotFlightServer
             # FlightServerBase serves from construction; .serve() would

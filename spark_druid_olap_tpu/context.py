@@ -59,6 +59,9 @@ class Context:
         # named lookup tables for the SQL LOOKUP(col, 'name') function
         # (≈ Druid registered lookups backing the lookup extraction fn)
         self.lookups: Dict[str, Dict[str, Optional[str]]] = {}
+        # materialized rollup registry: name -> mv.registry.RollupDef;
+        # the planner consults it for automatic rewrite (mv/match.py)
+        self.rollups: Dict[str, object] = {}
         # module extension points (≈ SparklineDataModule/ModuleLoader)
         from spark_druid_olap_tpu.utils import host_eval as _he
         self.functions = _he.EXTRA_FUNCTIONS

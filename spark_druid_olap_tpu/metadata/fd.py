@@ -22,6 +22,10 @@ from spark_druid_olap_tpu.metadata.star import StarSchema
 class FDGraph:
     def __init__(self):
         self._edges: Dict[str, Set[str]] = {}
+        # join-key equality edges only — a strictly stronger relation than
+        # mutual determination (two keys of one table determine each other
+        # but hold different VALUES)
+        self._equiv: Dict[str, Set[str]] = {}
 
     def add(self, a: str, b: str):
         self._edges.setdefault(a, set()).add(b)
@@ -29,6 +33,21 @@ class FDGraph:
     def add_equiv(self, a: str, b: str):
         self.add(a, b)
         self.add(b, a)
+        self._equiv.setdefault(a, set()).add(b)
+        self._equiv.setdefault(b, set()).add(a)
+
+    def equivalents(self, a: str) -> Set[str]:
+        """Columns guaranteed value-equal to ``a`` on the flat datasource:
+        the transitive closure of join-key equalities (includes ``a``)."""
+        seen = {a}
+        stack = [a]
+        while stack:
+            x = stack.pop()
+            for y in self._equiv.get(x, ()):
+                if y not in seen:
+                    seen.add(y)
+                    stack.append(y)
+        return seen
 
     def determines(self, a: str, b: str) -> bool:
         """True if column ``a`` functionally determines ``b``."""

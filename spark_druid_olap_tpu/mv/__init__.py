@@ -1,0 +1,11 @@
+"""Materialized rollup datasources (mv/).
+
+Pre-aggregated, segment-backed rollup datasources declared with
+``CREATE ROLLUP``, built through the existing engine, and transparently
+substituted for the base datasource by the planner when a query is
+answerable from the rollup (mv/match.py). ≈ Druid rollup at ingest plus
+Sparkline rewriting queries onto the rolled-up index.
+"""
+
+from spark_druid_olap_tpu.mv.registry import (  # noqa: F401
+    RollupDef, create_rollup, drop_rollup, refresh_rollup, rollups_view)

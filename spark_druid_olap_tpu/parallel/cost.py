@@ -40,12 +40,15 @@ class CostEstimate:
     segments_per_wave: int = 0     # 0 = everything in one wave
     n_waves: int = 1
     xhost_bytes: int = 0           # est. cross-host result replication
+    host_xhost_bytes: int = 0      # est. host-tier column reassembly bytes
 
     def table(self) -> str:
         wave = "" if self.n_waves <= 1 else \
             f"  waves={self.n_waves}x{self.segments_per_wave}seg"
         xh = "" if not self.xhost_bytes else \
             f" xhost_bytes={self.xhost_bytes:,}"
+        if self.host_xhost_bytes:
+            xh += f" host_xhost_bytes={self.host_xhost_bytes:,}"
         return (f"rows={self.rows:,} sel={self.selectivity:.3f} "
                 f"est_groups={self.output_groups:,} "
                 f"scan_bytes={self.scan_bytes:,}{xh}\n"
@@ -365,12 +368,25 @@ def estimate(ctx_or_engine, q: S.QuerySpec) -> CostEstimate:
     seg_bytes = bytes_per_segment(
         ds, list(names) + ["__rows__"]) if ds.num_segments else 0
     scan_bytes = seg_bytes * len(seg_idx)
+    # host-tier reassembly term (multi-host partial stores): a statement
+    # shape that drops to the host fallback must rebuild each needed
+    # column via the paged allgather — O(rows x column bytes), dwarfing
+    # the engine path's O(groups) replication above. Surfaced so explain
+    # shows WHY the engine path is worth keeping on a partial store.
+    host_xhost = 0
+    if getattr(ds, "is_partial", False) and ds.host_assignment is not None \
+            and len(ds.host_assignment):
+        ds_hosts = int(ds.host_assignment.max()) + 1
+        if ds_hosts > 1:
+            host_xhost = int(ds.num_rows) * \
+                sum(array_itemsize(ds, k) for k in names)
     eff_dev = n_dev if recommend else 1
     spw, waves = plan_waves(len(seg_idx), eff_dev, seg_bytes,
                             wave_budget_bytes(conf), conf, groups, n_aggs)
     return CostEstimate(rows, sel, groups, single, sharded, n_dev, recommend,
                         scan_bytes=scan_bytes, segments_per_wave=spw,
-                        n_waves=waves, xhost_bytes=int(xhost_bytes))
+                        n_waves=waves, xhost_bytes=int(xhost_bytes),
+                        host_xhost_bytes=int(host_xhost))
 
 
 def explain_cost(ctx, q: S.QuerySpec) -> str:

@@ -823,6 +823,7 @@ class Builder:
             # post-aggs must evaluate after the phase-2 merge
             deferred_posts = list(posts)
             posts = ()
+        rollup_used = None
         for s_ in resolved_sets:
             set_dim_names = [self._dim_by_expr[E.to_sql(g)] for g in s_]
             dimlist = [d for d in self._dim_specs
@@ -835,6 +836,13 @@ class Builder:
                 filter=filter_spec, having=having_spec,
                 limit=limit_spec if not multi_set else None,
                 intervals=intervals)
+            # materialized-rollup rewrite, BEFORE spec transforms so a
+            # rewritten GroupBy can still become timeseries/topN/search
+            from spark_druid_olap_tpu.mv import match as MV
+            q2, mv_name = MV.try_rewrite(self.ctx, q)
+            if q2 is not None:
+                q = q2
+                rollup_used = mv_name
             q = QT.transform(q, self.ctx.config,
                              getattr(self.ctx, "spec_rules", ()))
             specs.append(q)
@@ -863,7 +871,8 @@ class Builder:
             order_applied_in_spec=order_in_spec,
             distinct_phase2=self.distinct2,
             deferred_posts=deferred_posts,
-            residual=residual_expr)
+            residual=residual_expr,
+            rollup=rollup_used)
 
     def _plan_output_item(self, item: A.SelectItem, idx: int) -> str:
         e = item.expr

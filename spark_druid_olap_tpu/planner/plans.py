@@ -47,3 +47,6 @@ class PlannedQuery:
     # over dim OUTPUT names (agg path) or source columns (select path);
     # ≈ the Spark FilterExec the reference leaves above the Druid scan
     residual: Optional[object] = None
+    # name of the materialized rollup the specs were rewritten onto
+    # (mv/match.py); None = specs scan the base datasource
+    rollup: Optional[str] = None

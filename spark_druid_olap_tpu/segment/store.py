@@ -161,7 +161,7 @@ class Datasource:
             return np.zeros(len(gids), dtype=np.int32)
         return self.host_assignment[seg_of]
 
-    def complete(self, columns=None) -> "Datasource":
+    def complete(self, columns=None, page_bytes=None) -> "Datasource":
         """A COMPLETE view of this datasource: itself when already
         complete; on a multi-host partial store, a clone whose column
         arrays are assembled by a cross-process exchange
@@ -176,7 +176,12 @@ class Datasource:
         arrays are cached per column so repeated host-tier statements
         exchange each column once. Gathers run in SORTED column order:
         every process must issue the identical collective sequence, and
-        callers' set-typed column collections must never dictate it."""
+        callers' set-typed column collections must never dictate it.
+
+        ``page_bytes`` bounds the staging footprint of ONE exchange page
+        (sdot.host.gather.page.bytes at the session layer); page row
+        counts derive from the column's per-row footprint plus GLOBAL
+        metadata, so every process pages identically."""
         if not self.is_partial:
             return self
         from spark_druid_olap_tpu.parallel import multihost as MH
@@ -197,11 +202,11 @@ class Datasource:
         n_rows = self.num_rows
 
         def _plan():
-            """(gids, n_hosts, n_chunks): per-host global-row write
-            targets + chunk count. O(num_rows) to build — computed on
-            the FIRST cache miss only (a cache-hit complete() call must
-            not pay it; the SF100 host tier calls complete() per column
-            per statement)."""
+            """(gids, n_hosts, max_local): per-host global-row write
+            targets + the paging denominator. O(num_rows) to build —
+            computed on the FIRST cache miss only (a cache-hit
+            complete() call must not pay it; the SF100 host tier calls
+            complete() per column per statement)."""
             p = getattr(self, "_gather_plan", None)
             if p is not None:
                 return p
@@ -217,26 +222,35 @@ class Datasource:
                 [np.arange(s, e, dtype=np.int64) for s, e in ranges[h]])
                 if ranges[h] else np.empty(0, np.int64))
                 for h in range(n_hosts)}
-            # chunked exchange: the collective stages data through
-            # device memory, so a whole-column gather of a large store
-            # would blow HBM. Chunk count comes from GLOBAL metadata
-            # (max local rows over hosts) — identical on every process,
-            # or the collectives would mismatch.
+            # max local rows over hosts comes from GLOBAL metadata —
+            # identical on every process, or the collectives would
+            # mismatch.
             max_local = max((int(g.shape[0]) for g in gids.values()),
                             default=0)
-            n_chunks = max(1, -(-max_local // (1 << 22)))
-            p = self._gather_plan = (gids, n_hosts, n_chunks)
+            p = self._gather_plan = (gids, n_hosts, max_local)
             return p
+
+        budget = int(page_bytes) if page_bytes \
+            else DEFAULT_GATHER_PAGE_BYTES
 
         def _gather(arr):
             if arr is None:
                 return None
-            gids, n_hosts, n_chunks = _plan()
-            chunk = 1 << 22
+            gids, n_hosts, max_local = _plan()
+            # byte-budgeted paging: the collective stages data through
+            # device memory, so a whole-column gather of a large store
+            # would blow HBM. Page rows derive from the column's per-row
+            # footprint (dtype + trailing dims are schema, identical on
+            # every host), NOT a fixed row count — a fixed 4M-row chunk
+            # lets one f64 column stage 8x the bytes an i8 validity does.
+            row_bytes = int(arr.dtype.itemsize) * int(
+                np.prod(arr.shape[1:], dtype=np.int64))
+            page = max(1, budget // max(1, row_bytes))
+            n_pages = max(1, -(-max_local // page))
             out = np.empty((n_rows,) + arr.shape[1:], arr.dtype)
             offs = {h: 0 for h in range(n_hosts)}
-            for c in range(n_chunks):
-                blocks = MH.exchange_block(arr[c * chunk: (c + 1) * chunk])
+            for c in range(n_pages):
+                blocks = MH.exchange_block(arr[c * page: (c + 1) * page])
                 for h, blk in enumerate(blocks):
                     if len(blk) == 0:
                         continue
@@ -552,6 +566,11 @@ def restrict_to_host(ds: Datasource, host_assignment,
 # host tier's residual-gather working set from growing without bound as
 # statements touch ever more columns of a large partial store.
 GATHERED_CACHE_MAX_BYTES = 4 << 30
+
+# Fallback staging budget for one paged gather when the caller doesn't
+# thread sdot.host.gather.page.bytes through (engine-internal callers
+# gathering a single small column).
+DEFAULT_GATHER_PAGE_BYTES = 32 << 20
 
 
 class SegmentStore:

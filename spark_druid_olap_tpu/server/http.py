@@ -168,9 +168,11 @@ class SqlServer:
                 h._send(200, json.dumps(
                     self.ctx.engine.result_cache.stats()).encode())
                 return
+            from spark_druid_olap_tpu.mv.registry import rollups_view
             views = {"datasources": self.ctx.catalog.datasources_view,
                      "segments": self.ctx.catalog.segments_view,
-                     "columns": self.ctx.catalog.columns_view}
+                     "columns": self.ctx.catalog.columns_view,
+                     "rollups": lambda: rollups_view(self.ctx)}
             if kind not in views:
                 h._send(404, b'{"error": "unknown metadata view"}')
                 return

@@ -133,5 +133,29 @@ class ExecuteRawQuery:
     use_sharded: bool = False
 
 
+# -- materialized rollup DDL (mv/) --------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class CreateRollup:
+    """``CREATE ROLLUP <name> ON <datasource> DIMENSIONS (..) AGGREGATIONS
+    (..) [GRANULARITY <g>]`` — aggregations are parsed aggregate-call
+    expressions (merge-closed kinds only; validated at build time)."""
+    name: str
+    base: str
+    dimensions: Tuple[str, ...]
+    aggregations: Tuple[E.Expr, ...]
+    granularity: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class DropRollup:
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class RefreshRollup:
+    name: str
+
+
 Statement = Union[SelectStmt, UnionAll, ExplainRewrite, ClearMetadata,
-                  ExecuteRawQuery]
+                  ExecuteRawQuery, CreateRollup, DropRollup, RefreshRollup]

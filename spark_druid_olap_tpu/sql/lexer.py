@@ -28,7 +28,8 @@ KEYWORDS = {
     "interval", "date", "timestamp", "extract", "union", "all", "grouping",
     "sets", "cube", "rollup", "true", "false", "explain", "rewrite", "clear",
     "metadata", "execute", "query", "using", "datasource", "druiddatasource",
-    "substring", "for", "approx", "with", "offset",
+    "substring", "for", "approx", "with", "offset", "create", "drop",
+    "refresh",
 }
 
 _TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "||"}

@@ -141,6 +141,34 @@ class Parser:
                 ds = self.next().value
             self._expect_eof()
             return A.ClearMetadata(ds)
+        if self.at_kw("create"):
+            self.next()
+            self.expect_kw("rollup")
+            name = self._ident()
+            self.expect_kw("on")
+            base = self._ident()
+            self._expect_word("dimensions")
+            dims = self._parse_paren_ident_list()
+            self._expect_word("aggregations")
+            aggs = self._parse_paren_expr_list()
+            gran = None
+            if self._at_word("granularity"):
+                self.next()
+                gran = self._ident().lower()
+            self._expect_eof()
+            return A.CreateRollup(name, base, dims, aggs, gran)
+        if self.at_kw("drop"):
+            self.next()
+            self.expect_kw("rollup")
+            name = self._ident()
+            self._expect_eof()
+            return A.DropRollup(name)
+        if self.at_kw("refresh"):
+            self.next()
+            self.expect_kw("rollup")
+            name = self._ident()
+            self._expect_eof()
+            return A.RefreshRollup(name)
         t = self.peek()
         if t.kind == "kw" and t.value == "with":
             q = self.parse_with()
@@ -151,6 +179,41 @@ class Parser:
             self._expect_eof()
             return q
         raise SqlSyntaxError(f"cannot parse statement at {t.pos}: {t.value!r}")
+
+    # -- rollup DDL helpers (DIMENSIONS/AGGREGATIONS/GRANULARITY are soft
+    # words, not reserved keywords) -------------------------------------------
+    def _at_word(self, word: str) -> bool:
+        t = self.peek()
+        return t.kind == "ident" and t.value.lower() == word
+
+    def _expect_word(self, word: str):
+        if not self._at_word(word):
+            t = self.peek()
+            raise SqlSyntaxError(
+                f"expected {word.upper()} at {t.pos}, got {t.value!r}")
+        self.next()
+
+    def _parse_paren_ident_list(self):
+        self.expect_op("(")
+        out = []
+        if not self.at_op(")"):
+            out.append(self._ident())
+            while self.at_op(","):
+                self.next()
+                out.append(self._ident())
+        self.expect_op(")")
+        return tuple(out)
+
+    def _parse_paren_expr_list(self):
+        self.expect_op("(")
+        out = []
+        if not self.at_op(")"):
+            out.append(self.parse_expr())
+            while self.at_op(","):
+                self.next()
+                out.append(self.parse_expr())
+        self.expect_op(")")
+        return tuple(out)
 
     def parse_with(self):
         """WITH name AS (select), ... <select|union> — CTEs desugar to

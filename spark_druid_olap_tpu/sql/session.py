@@ -123,14 +123,22 @@ def _run_sql_inner(ctx, sql: str) -> QueryResult:
             return r
     stmt = parse_statement(sql)
     if isinstance(stmt, A.ClearMetadata):
+        from spark_druid_olap_tpu.mv.registry import clear_rollups
         if stmt.datasource:
             ctx.store.drop(stmt.datasource)
+            clear_rollups(ctx, stmt.datasource)
             # the drop bumps the datasource version (stale keys can never
             # hit again), but the entries themselves must not linger
             ctx.engine.result_cache.clear()
         else:
+            clear_rollups(ctx)
             ctx.engine.clear_caches()  # includes the semantic result cache
         return QueryResult(["status"], {"status": np.array(["OK"],
+                                                           dtype=object)})
+    if isinstance(stmt, (A.CreateRollup, A.DropRollup, A.RefreshRollup)):
+        from spark_druid_olap_tpu.mv.registry import handle_statement
+        msg = handle_statement(ctx, stmt)
+        return QueryResult(["status"], {"status": np.array([msg],
                                                            dtype=object)})
     if isinstance(stmt, A.ExecuteRawQuery):
         from spark_druid_olap_tpu.ir.serde import query_from_json
@@ -206,6 +214,10 @@ def explain_text(ctx, stmt: A.SelectStmt, sql: str) -> str:
     lines.append(f"pushdown: YES -> datasource {pq.datasource!r}, "
                  f"{len(pq.specs)} engine quer"
                  f"{'y' if len(pq.specs) == 1 else 'ies'}")
+    if pq.rollup is not None:
+        lines.append(f"rollup rewrite: {pq.rollup} -> scans "
+                     f"{pq.specs[0].datasource!r} instead of the base "
+                     f"datasource")
     from spark_druid_olap_tpu.parallel.cost import explain_cost
     for i, q in enumerate(pq.specs):
         lines.append(f"  [{i}] {type(q).__name__}: dims="
@@ -279,6 +291,7 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
     stmt = resolve_alias_scopes(ctx, stmt)
     stmt = resolve_lookups(ctx, stmt)
     trace = _transform_tracer(ctx)
+    rollup_status = None  # engine path only: 'rollup:<name>' | 'base'
     try:
         from spark_druid_olap_tpu.planner.decorrelate import (
             decorrelate_semijoins, inline_correlated_scalars,
@@ -290,8 +303,10 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
         # result is deterministic given (store version, config), both
         # folded into the key by result_cache. Inlined subquery RESULTS
         # embedded in the plan stay valid under the same key.
+        from spark_druid_olap_tpu.utils.config import PLAN_CACHE_ENABLED
+        _pc_on = ctx.config.get(PLAN_CACHE_ENABLED)
         _pcache, _pkey = host_exec.result_cache(ctx, "plan", stmt)
-        pq = _pcache.get(_pkey)
+        pq = _pcache.get(_pkey) if _pc_on else None
         plan_cached = pq is not None
         if plan_cached:
             _pcache.move_to_end(_pkey)
@@ -314,15 +329,18 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
             try:
                 pq = B.build(ctx, stmt2)
             except PlanUnsupported as pe:
-                host_exec.result_cache_put(_pcache, _pkey,
-                                           _NegativePlan(str(pe)))
+                if _pc_on:
+                    host_exec.result_cache_put(_pcache, _pkey,
+                                               _NegativePlan(str(pe)))
                 raise
             _mark("stmt_build_ms", _tb)
-            host_exec.result_cache_put(_pcache, _pkey, pq)
+            if _pc_on:
+                host_exec.result_cache_put(_pcache, _pkey, pq)
         _te = _time.perf_counter()
         df = execute_planned(ctx, pq)
         _mark("stmt_exec_ms", _te)
         mode = "engine"
+        rollup_status = f"rollup:{pq.rollup}" if pq.rollup else "base"
     except (PlanUnsupported, EngineFallback) as e:
         df = mode = None
         if isinstance(e, PlanUnsupported):
@@ -336,15 +354,20 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
                 # plan derived tables through its own chain. Same plan
                 # cache contract as the pushdown path (store version +
                 # config fingerprint in the key).
+                from spark_druid_olap_tpu.utils.config import (
+                    PLAN_CACHE_ENABLED)
+                _cc_on = ctx.config.get(PLAN_CACHE_ENABLED)
                 _ccache, _ckey = host_exec.result_cache(ctx, "cplan", stmt)
-                cp = _ccache.get(_ckey)
+                cp = _ccache.get(_ckey) if _cc_on else None
                 if cp is not None:
                     _ccache.move_to_end(_ckey)
                 else:
                     cp = composite.build_composite(ctx, stmt)
-                    host_exec.result_cache_put(_ccache, _ckey, cp)
+                    if _cc_on:
+                        host_exec.result_cache_put(_ccache, _ckey, cp)
                 df = composite.execute_composite(ctx, cp)
                 mode = "engine"
+                rollup_status = "base"
             except (PlanUnsupported, EngineFallback,
                     host_exec.HostExecError):
                 df = None
@@ -355,6 +378,8 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
         df = df.iloc[offset:].reset_index(drop=True)
     stats = dict(ctx.engine.last_stats)
     stats["mode"] = mode
+    if rollup_status is not None:
+        stats["rollup"] = rollup_status
     stats["total_ms"] = (_time.perf_counter() - t0) * 1000
     dc1 = ctx.engine.dispatch_counts
     stats["n_dispatch"] = dc1[0] - dc0[0]

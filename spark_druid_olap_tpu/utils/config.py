@@ -300,6 +300,29 @@ CACHE_SUBSUMPTION = _entry(
     "finer one, TopN and dim-filtered GroupBy from a cached "
     "unfiltered/unlimited GroupBy over the same dims, and "
     "having/limit/post-agg re-evaluation on cached partials.")
+# --- materialized rollup datasources (mv/) ------------------------------------
+MV_REWRITE_ENABLED = _entry(
+    "sdot.mv.rewrite.enabled", True,
+    "Automatically rewrite eligible GroupBy queries onto a registered "
+    "materialized rollup datasource (mv/match.py): grouping dims covered "
+    "by the rollup dims (join-key equivalences count), merge-closed "
+    "derivable aggregations, dim-only filters, cleanly-coarsening "
+    "granularity. Stale rollups (base re-ingested since the build) are "
+    "bypassed, never served (≈ Sparkline rewriting onto the Druid "
+    "rollup index).")
+PLAN_CACHE_ENABLED = _entry(
+    "sdot.plan.cache.enabled", True,
+    "Statement plan cache (pushdown + composite plans keyed on store "
+    "version and config fingerprint). Benchmarks disable it so measured "
+    "reps time the full rewrite/build/execute path instead of a "
+    "statement-cache hit.")
+# --- host-tier safety valve ---------------------------------------------------
+HOST_GATHER_PAGE_BYTES = _entry(
+    "sdot.host.gather.page.bytes", 32 << 20,
+    "Byte budget for ONE paged cross-process gather when "
+    "Datasource.complete() reassembles a partial store's column on the "
+    "host tier; larger columns exchange in multiple bounded pages "
+    "instead of one unbounded allgather.")
 
 
 class Config:

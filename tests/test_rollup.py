@@ -1,0 +1,482 @@
+"""Materialized rollup datasources: DDL lifecycle, automatic planner
+rewrite, staleness, and the surfacing/metadata contract.
+
+Differential strategy mirrors test_tpch/test_ssb: every eligible suite
+query runs twice over the SAME context — once with the rewrite disabled
+(base scan) and once enabled (rollup scan) — and the frames must match to
+assert_frames_equal tolerance. The base leg is the oracle: the rollup path
+re-aggregates stored partials through the same engine, so any derivability
+bug (a non-merge-closed agg served, a split bucket, an uncovered filter
+column) shows up as a value diff, not just a plan diff.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.tools import ssb, tpch
+
+from conftest import assert_frames_equal, make_sales_df
+
+REWRITE = "sdot.mv.rewrite.enabled"
+
+TPCH_CUBE = (
+    "create rollup tpch_cube on tpch_flat dimensions ("
+    "l_returnflag, l_linestatus, l_shipmode, l_receiptdate, l_commitdate, "
+    "o_orderpriority, o_orderdate, o_orderkey, o_shippriority, "
+    "c_mktsegment, cn_name, sn_name, sr_name, cr_name, p_type) "
+    "aggregations (sum(l_quantity), sum(l_extendedprice), sum(l_discount), "
+    "sum(l_extendedprice * (1 - l_discount)), "
+    "sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)), "
+    "sum(l_extendedprice * l_discount), count(*), "
+    "sum(case when o_orderpriority = '1-URGENT' "
+    "or o_orderpriority = '2-HIGH' then 1 else 0 end), "
+    "sum(case when o_orderpriority <> '1-URGENT' "
+    "and o_orderpriority <> '2-HIGH' then 1 else 0 end), "
+    "sum(case when p_type like 'PROMO%' "
+    "then l_extendedprice * (1 - l_discount) else 0 end), "
+    "sum(case when sn_name = 'BRAZIL' "
+    "then l_extendedprice * (1 - l_discount) else 0 end)"
+    ") granularity day")
+
+LI_CUBE = (
+    "create rollup li_cube on lineitem dimensions ("
+    "l_returnflag, l_linestatus, l_shipmode, l_discount, l_quantity) "
+    "aggregations (sum(l_quantity), sum(l_extendedprice), sum(l_discount), "
+    "sum(l_extendedprice * l_discount), "
+    "sum(l_extendedprice * (1 - l_discount)), "
+    "sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)), "
+    "count(*)) granularity day")
+
+SSB_CUBE = (
+    "create rollup ssb_cube on ssb_flat dimensions ("
+    "d_year, d_yearmonthnum, d_weeknuminyear, d_yearmonth, "
+    "c_city, c_nation, c_region, s_city, s_nation, s_region, "
+    "p_mfgr, p_category, p_brand1, lo_discount, lo_quantity) "
+    "aggregations (sum(lo_extendedprice * lo_discount), sum(lo_revenue), "
+    "sum(lo_revenue - lo_supplycost), count(*))")
+
+# which rollup each TPC-H suite query must be served from; everything
+# else must report "base" (ineligible shapes stay on the base scan)
+TPCH_EXPECT = {
+    "q1": "li_cube", "shipdate_range": "li_cube", "q6": "li_cube",
+    "filters_range": "tpch_cube", "q3": "tpch_cube", "q5": "tpch_cube",
+    "q7": "tpch_cube", "q8": "tpch_cube", "q12": "tpch_cube",
+    "q14": "tpch_cube",
+}
+
+
+def _last_rollup_status(ctx):
+    return ctx.history.entries()[-1].stats.get("rollup")
+
+
+def _run_both(ctx, sql):
+    """(base frame, rollup-leg frame, rollup-leg status)."""
+    ctx.config.set(REWRITE, False)
+    base = ctx.sql(sql).to_pandas()
+    ctx.config.set(REWRITE, True)
+    got = ctx.sql(sql).to_pandas()
+    return base, got, _last_rollup_status(ctx)
+
+
+# -----------------------------------------------------------------------------
+# suite differentials
+# -----------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tctx():
+    ctx = sdot.Context({"sdot.plan.cache.enabled": False})
+    tpch.setup_context(ctx, sf=0.002, target_rows=4096)
+    assert "created" in ctx.sql(TPCH_CUBE).to_pandas()["status"][0]
+    assert "created" in ctx.sql(LI_CUBE).to_pandas()["status"][0]
+    return ctx
+
+
+@pytest.mark.parametrize("name", list(tpch.QUERIES))
+def test_tpch_rollup_differential(tctx, name):
+    try:
+        base, got, status = _run_both(tctx, tpch.QUERIES[name])
+    except Exception:
+        tctx.config.set(REWRITE, True)
+        raise
+    want = f"rollup:{TPCH_EXPECT[name]}" if name in TPCH_EXPECT else "base"
+    assert status == want, f"{name}: served from {status}, want {want}"
+    assert_frames_equal(got, base)
+
+
+@pytest.fixture(scope="module")
+def sctx():
+    ctx = sdot.Context({"sdot.plan.cache.enabled": False})
+    ssb.setup_context(ctx, sf=0.003, target_rows=4096)
+    assert "created" in ctx.sql(SSB_CUBE).to_pandas()["status"][0]
+    return ctx
+
+
+@pytest.mark.parametrize("name", list(ssb.QUERIES))
+def test_ssb_rollup_differential(sctx, name):
+    try:
+        base, got, status = _run_both(sctx, ssb.QUERIES[name])
+    except Exception:
+        sctx.config.set(REWRITE, True)
+        raise
+    # the SSB cube covers every dim/filter/agg of all 13 queries
+    assert status == "rollup:ssb_cube", f"{name}: served from {status}"
+    assert_frames_equal(got, base)
+
+
+# -----------------------------------------------------------------------------
+# lifecycle: staleness, refresh, drop
+# -----------------------------------------------------------------------------
+
+def _sales_ctx(**cfg):
+    ctx = sdot.Context({"sdot.plan.cache.enabled": False, **cfg})
+    ctx.ingest_dataframe("sales", make_sales_df(n=6000), time_column="ts",
+                         target_rows=2048)
+    return ctx
+
+
+def test_staleness_bypass_and_refresh():
+    ctx = _sales_ctx()
+    ctx.sql("create rollup cube1 on sales dimensions (region, status) "
+            "aggregations (sum(price), sum(qty), count(*)) granularity day")
+    q = "select region, sum(price) as rev, count(*) as c from sales " \
+        "group by region"
+    fresh = ctx.sql(q).to_pandas()
+    assert _last_rollup_status(ctx) == "rollup:cube1"
+
+    # base re-ingest bumps the datasource version: the rollup is stale,
+    # NEVER served, and the query reflects the new data immediately
+    df2 = make_sales_df(n=6000)
+    df2["price"] = df2["price"] * 3
+    ctx.ingest_dataframe("sales", df2, time_column="ts", target_rows=2048)
+    stale = ctx.sql(q).to_pandas()
+    assert _last_rollup_status(ctx) == "base"
+    assert not np.allclose(
+        stale.sort_values("region")["rev"].to_numpy(),
+        fresh.sort_values("region")["rev"].to_numpy())
+    view = ctx.sql("select name, fresh from sys_rollups").to_pandas()
+    assert view["fresh"].tolist() == [False]
+
+    # REFRESH rebuilds from the current base; serving resumes and the
+    # partials agree with the post-re-ingest base scan
+    ctx.sql("refresh rollup cube1")
+    again = ctx.sql(q).to_pandas()
+    assert _last_rollup_status(ctx) == "rollup:cube1"
+    assert_frames_equal(again, stale)
+    assert ctx.sql("select fresh from sys_rollups") \
+        .to_pandas()["fresh"].tolist() == [True]
+
+
+def test_drop_rollup_removes_backing():
+    ctx = _sales_ctx()
+    ctx.sql("create rollup cube1 on sales dimensions (region) "
+            "aggregations (sum(price), count(*))")
+    assert "__rollup_cube1" in ctx.store.names()
+    q = "select region, sum(price) as rev from sales group by region"
+    ctx.sql(q)
+    assert _last_rollup_status(ctx) == "rollup:cube1"
+    ctx.sql("drop rollup cube1")
+    assert "__rollup_cube1" not in ctx.store.names()
+    assert ctx.sql("select count(*) as n from sys_rollups") \
+        .to_pandas()["n"][0] == 0
+    ctx.sql(q)
+    assert _last_rollup_status(ctx) == "base"
+
+
+def test_clear_metadata_forgets_rollups():
+    ctx = _sales_ctx()
+    ctx.sql("create rollup cube1 on sales dimensions (region) "
+            "aggregations (count(*))")
+    ctx.sql("clear metadata sales")
+    assert ctx.rollups == {}
+    assert "__rollup_cube1" not in ctx.store.names()
+
+
+# -----------------------------------------------------------------------------
+# eligibility boundaries
+# -----------------------------------------------------------------------------
+
+def test_ineligible_shapes_stay_on_base():
+    ctx = _sales_ctx()
+    ctx.sql("create rollup cube1 on sales dimensions (region, status) "
+            "aggregations (sum(price), count(*)) granularity day")
+    cases = [
+        # filter on a column that is not a rollup dimension
+        "select region, count(*) as c from sales where product = 'p001' "
+        "group by region",
+        # grouping dim not covered
+        "select flag, count(*) as c from sales group by flag",
+        # aggregate with no stored partial (sum(qty) was not declared)
+        "select region, sum(qty) as s from sales group by region",
+        # min over a sum-only rollup
+        "select region, min(price) as m from sales group by region",
+        # sketches are never merge-closed
+        "select region, approx_count_distinct(product) as d from sales "
+        "group by region",
+    ]
+    for sql in cases:
+        ctx.sql(sql)
+        assert _last_rollup_status(ctx) == "base", sql
+
+
+def test_avg_derives_from_declared_sum_and_count():
+    ctx = _sales_ctx()
+    ctx.sql("create rollup cube1 on sales dimensions (region) "
+            "aggregations (sum(price), count(*))")
+    q = "select region, avg(price) as ap from sales group by region"
+    base, got, status = _run_both(ctx, q)
+    assert status == "rollup:cube1"
+    assert_frames_equal(got, base)
+
+
+def test_granularity_coarsening_and_identity_intervals():
+    # ms-resolution timestamps: bucketing is NOT the identity, so only
+    # cleanly-nesting extractions and bucket-aligned intervals rewrite
+    df = make_sales_df(n=6000)
+    df["ts"] = df["ts"] + pd.to_timedelta(
+        np.random.default_rng(3).integers(0, 86_400_000, len(df)), unit="ms")
+    ctx = sdot.Context({"sdot.plan.cache.enabled": False})
+    ctx.ingest_dataframe("sales", df, time_column="ts", target_rows=2048)
+    ctx.sql("create rollup cube1 on sales dimensions (region) "
+            "aggregations (sum(price), count(*)) granularity day")
+    assert not ctx.rollups["cube1"].time_identity
+
+    q = ("select region, year(ts) as y, month(ts) as m, sum(price) as rev "
+         "from sales group by region, year(ts), month(ts)")
+    base, got, status = _run_both(ctx, q)
+    assert status == "rollup:cube1"     # day nests inside month/year
+    assert_frames_equal(got, base)
+
+    # day-aligned interval endpoints rewrite...
+    q_aligned = ("select region, sum(price) as rev from sales "
+                 "where ts >= date '2015-03-01' and ts < date '2015-09-01' "
+                 "group by region")
+    base, got, status = _run_both(ctx, q_aligned)
+    assert status == "rollup:cube1"
+    assert_frames_equal(got, base)
+
+    # ...an intraday endpoint splits a bucket and must NOT
+    q_split = ("select region, sum(price) as rev from sales "
+               "where ts >= timestamp '2015-03-01 12:00:00' "
+               "group by region")
+    base, got, status = _run_both(ctx, q_split)
+    assert status == "base"
+    assert_frames_equal(got, base)
+
+
+def test_day_resolution_identity_serves_arbitrary_time_predicates():
+    # day-resolution data + day granularity: the build proves identity
+    # bucketing, so raw time-column predicates carry over verbatim
+    ctx = _sales_ctx()
+    ctx.sql("create rollup cube1 on sales dimensions (region) "
+            "aggregations (sum(price), count(*)) granularity day")
+    assert ctx.rollups["cube1"].time_identity
+    q = ("select region, sum(price) as rev from sales "
+         "where ts <= date '2016-02-17' group by region")
+    base, got, status = _run_both(ctx, q)
+    assert status == "rollup:cube1"
+    assert_frames_equal(got, base)
+
+
+def test_ddl_validation_errors():
+    ctx = _sales_ctx()
+    for sql, frag in [
+        ("create rollup r on nosuch dimensions (x) aggregations (count(*))",
+         "unknown datasource"),
+        ("create rollup r on sales dimensions (nope) "
+         "aggregations (count(*))", "not a column"),
+        ("create rollup r on sales dimensions (ts) aggregations (count(*))",
+         "time column"),
+        ("create rollup r on sales dimensions (region) "
+         "aggregations (avg(price))", "not merge-closed"),
+        ("create rollup r on sales dimensions (region) "
+         "aggregations (approx_count_distinct(product))",
+         "not merge-closed"),
+        ("create rollup r on sales dimensions (region) "
+         "aggregations (count(*)) granularity hour", "granularity"),
+        ("drop rollup nosuch", "unknown rollup"),
+        ("refresh rollup nosuch", "unknown rollup"),
+    ]:
+        with pytest.raises(ValueError, match=frag):
+            ctx.sql(sql)
+    ctx.sql("create rollup r on sales dimensions (region) "
+            "aggregations (count(*))")
+    with pytest.raises(ValueError, match="already exists"):
+        ctx.sql("create rollup r on sales dimensions (region) "
+                "aggregations (count(*))")
+
+
+# -----------------------------------------------------------------------------
+# result-cache interaction (key collision regression)
+# -----------------------------------------------------------------------------
+
+def test_result_cache_keys_track_rollup_identity():
+    ctx = _sales_ctx(**{"sdot.cache.enabled": True})
+    ctx.sql("create rollup cube1 on sales dimensions (region) "
+            "aggregations (sum(price), count(*))")
+    q = "select region, sum(price) as rev from sales group by region"
+    first = ctx.sql(q).to_pandas()
+    assert _last_rollup_status(ctx) == "rollup:cube1"
+    hits0 = ctx.engine.result_cache.stats()["hits"]
+    again = ctx.sql(q).to_pandas()
+    assert ctx.engine.result_cache.stats()["hits"] == hits0 + 1
+    assert_frames_equal(again, first)
+
+    # re-ingest the base with different values and rebuild the rollup
+    # under the SAME name: the cached rollup-served entry must never be
+    # replayed (backing ingest version is part of the key)
+    df2 = make_sales_df(n=6000)
+    df2["price"] = df2["price"] * 5
+    ctx.ingest_dataframe("sales", df2, time_column="ts", target_rows=2048)
+    ctx.sql("refresh rollup cube1")
+    fresh = ctx.sql(q).to_pandas()
+    assert _last_rollup_status(ctx) == "rollup:cube1"
+    assert not np.allclose(fresh.sort_values("region")["rev"].to_numpy(),
+                           first.sort_values("region")["rev"].to_numpy())
+
+    # base-served and rollup-served answers for the same SQL coexist
+    ctx.config.set(REWRITE, False)
+    base = ctx.sql(q).to_pandas()
+    ctx.config.set(REWRITE, True)
+    assert_frames_equal(base, fresh)
+
+
+# -----------------------------------------------------------------------------
+# surfacing: sys_rollups, EXPLAIN, history, HTTP metadata
+# -----------------------------------------------------------------------------
+
+def test_sys_rollups_view_and_explain():
+    ctx = _sales_ctx()
+    ctx.sql("create rollup cube1 on sales dimensions (region, status) "
+            "aggregations (sum(price), count(*)) granularity day")
+    v = ctx.sql("select * from sys_rollups").to_pandas()
+    assert v["name"].tolist() == ["cube1"]
+    assert v["base"][0] == "sales"
+    assert v["datasource"][0] == "__rollup_cube1"
+    assert v["granularity"][0] == "day"
+    assert bool(v["fresh"][0])
+    assert v["rows"][0] == ctx.store.get("__rollup_cube1").num_rows
+
+    q = "select region, sum(price) as rev from sales group by region"
+    text = ctx.explain(q)
+    assert "rollup rewrite: cube1" in text
+    assert "__rollup_cube1" in text
+    # ineligible statement explains with no rewrite line
+    assert "rollup rewrite" not in ctx.explain(
+        "select flag, count(*) as c from sales group by flag")
+
+    # per-query serving status lands in history stats (sys_queries rows)
+    ctx.sql(q)
+    assert ctx.history.entries()[-1].stats["rollup"] == "rollup:cube1"
+
+
+def test_http_metadata_rollups_endpoint():
+    import json
+    import urllib.request
+    from spark_druid_olap_tpu.server.http import SqlServer
+    ctx = _sales_ctx()
+    ctx.sql("create rollup cube1 on sales dimensions (region) "
+            "aggregations (count(*))")
+    server = SqlServer(ctx, port=0).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metadata/rollups",
+                timeout=30) as r:
+            doc = json.loads(r.read().decode())
+    finally:
+        server.stop()
+    assert doc["numRows"] == 1
+    row = dict(zip([c for c in doc["columns"]],
+                   [doc["rows"][0][c] for c in doc["columns"]])) \
+        if isinstance(doc["rows"][0], dict) else doc["rows"][0]
+    assert doc["rows"][0]["name"] == "cube1"
+    assert doc["rows"][0]["datasource"] == "__rollup_cube1"
+
+
+def test_backing_datasource_is_first_class():
+    ctx = _sales_ctx()
+    ctx.sql("create rollup cube1 on sales dimensions (region, status) "
+            "aggregations (sum(price), count(*))")
+    direct = ctx.sql("select region, status, agg_0, agg_1 "
+                     "from __rollup_cube1 order by region, status limit 3") \
+        .to_pandas()
+    assert len(direct) == 3
+
+
+# -----------------------------------------------------------------------------
+# satellite: byte-budget paged gathers + host-tier cost term
+# -----------------------------------------------------------------------------
+
+def test_complete_paged_gather_respects_page_bytes(monkeypatch):
+    from spark_druid_olap_tpu.parallel import multihost as MH
+    from spark_druid_olap_tpu.segment.ingest import ingest_dataframe
+    from spark_druid_olap_tpu.segment.store import restrict_to_host
+
+    ds = ingest_dataframe("sales", make_sales_df(n=6000), time_column="ts",
+                          target_rows=1024)
+    assignment = np.zeros(ds.num_segments, dtype=np.int32)
+    part = restrict_to_host(ds, assignment, 0)   # owns everything, partial
+
+    calls = []
+
+    def fake_exchange(block):
+        calls.append(np.asarray(block).nbytes)
+        return [np.asarray(block)]
+
+    monkeypatch.setattr(MH, "is_multihost", lambda: True)
+    monkeypatch.setattr(MH, "exchange_block", fake_exchange)
+
+    # large budget: one page per gathered array
+    full = part.complete(columns={"qty"}, page_bytes=1 << 30)
+    np.testing.assert_array_equal(full.metrics["qty"].values,
+                                  ds.metrics["qty"].values)
+    one_page_calls = len(calls)
+
+    # small budget on a fresh partial (per-datasource gather cache):
+    # strictly more, byte-bounded exchanges reassembling the same column
+    part2 = restrict_to_host(ds, assignment, 0)
+    calls.clear()
+    full2 = part2.complete(columns={"qty"}, page_bytes=1 << 10)
+    np.testing.assert_array_equal(full2.metrics["qty"].values,
+                                  ds.metrics["qty"].values)
+    assert len(calls) > one_page_calls
+    assert max(calls) <= 1 << 10
+
+
+def test_cost_estimate_host_xhost_bytes():
+    from spark_druid_olap_tpu.ir import spec as S
+    from spark_druid_olap_tpu.parallel import cost
+    from spark_druid_olap_tpu.segment.store import restrict_to_host
+
+    ctx = _sales_ctx()
+    ds = ctx.store.get("sales")
+    q = S.GroupByQuerySpec(
+        datasource="sales",
+        dimensions=(S.DimensionSpec("region", "region"),),
+        aggregations=(S.AggregationSpec("doublesum", "rev", field="price"),))
+
+    est = cost.estimate(ctx, q)
+    assert est.host_xhost_bytes == 0           # complete store: no term
+    assert "host_xhost_bytes" not in est.table()
+
+    assignment = np.arange(ds.num_segments, dtype=np.int32) % 2
+    ctx.store.register(restrict_to_host(ds, assignment, 0))
+    est2 = cost.estimate(ctx, q)
+    # every referenced column (region, price) re-assembles over the wire
+    per_row = sum(cost.array_itemsize(ds, k) for k in ("region", "price"))
+    assert est2.host_xhost_bytes == ds.num_rows * per_row
+    assert "host_xhost_bytes" in est2.table()
+
+
+def test_bench_config_disables_statement_caches():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(os.path.dirname(__file__), os.pardir,
+                                  "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    cfg = bench._bench_config()
+    assert cfg["sdot.cache.enabled"] is False
+    assert cfg["sdot.plan.cache.enabled"] is False
