@@ -19,6 +19,7 @@ import json
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 from collections import defaultdict
 
@@ -357,6 +358,135 @@ def run_rollup(args):
     sys.exit(0 if (hits > 0 and not mismatches) else 1)
 
 
+# WLM overload mix: cheap dashboard probes (the interactive lane's
+# traffic) vs heavy scans that would otherwise monopolize the engine
+WLM_INTERACTIVE = [
+    "select count(*) as c from sales where status = 'O'",
+    "select region, count(*) as c from sales group by region",
+    "select count(*) as c from sales where qty >= 25",
+]
+WLM_HEAVY = [
+    "select product, flag, status, sum(price) as rev, sum(qty) as q, "
+    "count(*) as c from sales group by product, flag, status",
+    "select product, approx_count_distinct(region) as nr, "
+    "sum(price * (1 - 0.04)) as rev from sales group by product "
+    "order by rev desc limit 20",
+]
+
+
+def run_wlm(args):
+    """Overload comparison: the same interactive+heavy mix hammers the
+    HTTP server at ~4x the interactive lane's concurrency, with WLM off
+    then on (fixed seed, result/plan caches off — every rep executes).
+    Heavy queries are tagged for the batch lane; with laning on they are
+    capped at the batch slots and excess sheds as 429 + Retry-After
+    instead of piling onto the engine. Reports per-class p50/p99 and
+    shed rate per leg; exits 0 when the interactive p99 improves and no
+    lane ever exceeded its concurrency cap."""
+    sys.path.insert(0, ".")
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.server.http import SqlServer
+    int_slots, batch_slots = 4, 1
+    ctx = sdot.Context({
+        "sdot.cache.enabled": False,          # cache-bypass hygiene: a
+        "sdot.plan.cache.enabled": False,     # hit would fake the p99s
+        "sdot.wlm.lanes":
+            f"interactive:slots={int_slots},queue=64;"
+            f"batch:slots={batch_slots},queue=2,wait_ms=250"})
+    ctx.ingest_dataframe("sales", _synthetic_sales(), time_column="ts")
+    server = SqlServer(ctx, port=0).start()
+    url = f"http://127.0.0.1:{server.port}"
+    for q in WLM_INTERACTIVE + WLM_HEAVY:    # compile/warm both shapes
+        post_sql(url, q, timeout=300)
+
+    def post_lane(sql, lane):
+        req = urllib.request.Request(
+            url + "/sql",
+            data=json.dumps({"sql": sql, "lane": lane}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read().decode())
+
+    # 4x overload on the interactive lane + a heavy-scan backlog
+    n_int, n_heavy = 4 * int_slots, 6
+    duration = args.duration
+    legs = {}
+    for leg, enabled in (("wlm_off", False), ("wlm_on", True)):
+        ctx.config.set("sdot.wlm.enabled", enabled)
+        lat = {"interactive": [], "heavy": []}
+        shed = {"interactive": 0, "heavy": 0}
+        errors = [0]
+        lock = threading.Lock()
+        stop = time.monotonic() + duration
+
+        def worker(tid, cls, queries, lane):
+            i = tid                            # deterministic round-robin
+            while time.monotonic() < stop:
+                sql = queries[i % len(queries)]
+                i += 1
+                t0 = time.perf_counter()
+                try:
+                    post_lane(sql, lane)
+                except urllib.error.HTTPError as e:
+                    if e.code == 429:
+                        retry = min(
+                            float(e.headers.get("Retry-After") or 1), 0.25)
+                        with lock:
+                            shed[cls] += 1
+                        time.sleep(retry)      # honor the hint (bounded)
+                        continue
+                    with lock:
+                        errors[0] += 1
+                    continue
+                except Exception:   # noqa: BLE001
+                    with lock:
+                        errors[0] += 1
+                    continue
+                with lock:
+                    lat[cls].append((time.perf_counter() - t0) * 1000)
+
+        threads = [threading.Thread(
+            target=worker, args=(t, "interactive", WLM_INTERACTIVE,
+                                 "interactive"), daemon=True)
+            for t in range(n_int)]
+        threads += [threading.Thread(
+            target=worker, args=(t, "heavy", WLM_HEAVY, "batch"),
+            daemon=True) for t in range(n_heavy)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        leg_out = {"errors": errors[0]}
+        for cls in ("interactive", "heavy"):
+            a = np.array(lat[cls]) if lat[cls] else np.array([0.0])
+            served = len(lat[cls])
+            leg_out[cls] = {
+                "n": served, "shed": shed[cls],
+                "shed_rate": round(shed[cls] / max(served + shed[cls], 1),
+                                   4),
+                "p50_ms": round(float(np.percentile(a, 50)), 1),
+                "p99_ms": round(float(np.percentile(a, 99)), 1)}
+            print(f"  [{leg}] {cls:11s} p50={leg_out[cls]['p50_ms']:7.1f}ms"
+                  f" p99={leg_out[cls]['p99_ms']:7.1f}ms n={served:5d}"
+                  f" shed={shed[cls]}")
+        legs[leg] = leg_out
+    wlm_meta = get_json(url, "/metadata/wlm")
+    server.stop()
+    caps_held = all(ln["max_active_seen"] <= ln["slots"]
+                    for ln in wlm_meta["lanes"])
+    p99_off = legs["wlm_off"]["interactive"]["p99_ms"]
+    p99_on = legs["wlm_on"]["interactive"]["p99_ms"]
+    out = {"mode": "wlm", "overload": 4, "threads_interactive": n_int,
+           "threads_heavy": n_heavy, "duration_s": duration,
+           "legs": legs, "caps_held": caps_held,
+           "interactive_p99_improvement":
+               round(p99_off / max(p99_on, 1e-9), 2)}
+    print(json.dumps(out))
+    ok = caps_held and p99_on < p99_off \
+        and legs["wlm_on"]["interactive"]["n"] > 0
+    sys.exit(0 if ok else 1)
+
+
 def main():
     import os
     if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
@@ -390,8 +520,16 @@ def main():
                     "synthetic dataset: N timed reps per query with the "
                     "planner rewrite off, then on (caches disabled); "
                     "reports rewrite hit rate and p50/p99 side by side")
+    ap.add_argument("--wlm", action="store_true",
+                    help="in-process overload comparison: interactive + "
+                    "heavy query mix at 4x the interactive lane's "
+                    "concurrency with workload management off then on; "
+                    "reports per-class p50/p99 and shed rate (caches "
+                    "off, fixed seed)")
     args = ap.parse_args()
 
+    if args.wlm:
+        return run_wlm(args)
     if args.rollup:
         return run_rollup(args)
     if args.tpch is not None:

@@ -139,13 +139,16 @@ class Context:
         self.history.record(q, dict(self.engine.last_stats))
         return r
 
-    def sql(self, query: str, query_id: Optional[str] = None) -> QueryResult:
+    def sql(self, query: str, query_id: Optional[str] = None,
+            lane: Optional[str] = None, tenant: Optional[str] = None,
+            priority: Optional[int] = None) -> QueryResult:
         try:
             from spark_druid_olap_tpu.sql.session import run_sql
         except ImportError as e:
             raise NotImplementedError(
                 "SQL front end not available in this build") from e
-        return run_sql(self, query, query_id=query_id)
+        return run_sql(self, query, query_id=query_id, lane=lane,
+                       tenant=tenant, priority=priority)
 
     def explain(self, query: str) -> str:
         try:

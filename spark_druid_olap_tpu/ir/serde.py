@@ -324,11 +324,21 @@ def query_to_dict(q: S.QuerySpec) -> dict:
     ctxq = getattr(q, "context", None)
     if ctxq is not None and (ctxq.query_id is not None
                              or ctxq.timeout_millis is not None
-                             or ctxq.prefer_sharded is not None):
-        # ≈ Druid's query "context" (QuerySpecContext :558-571)
+                             or ctxq.prefer_sharded is not None
+                             or ctxq.lane is not None
+                             or ctxq.tenant is not None
+                             or ctxq.priority is not None):
+        # ≈ Druid's query "context" (QuerySpecContext :558-571; lane ≈
+        # Druid's context "lane"/"priority" laning keys)
         base["context"] = {"queryId": ctxq.query_id,
                            "timeout": ctxq.timeout_millis,
                            "preferSharded": ctxq.prefer_sharded}
+        if ctxq.lane is not None:
+            base["context"]["lane"] = ctxq.lane
+        if ctxq.tenant is not None:
+            base["context"]["tenant"] = ctxq.tenant
+        if ctxq.priority is not None:
+            base["context"]["priority"] = ctxq.priority
     if isinstance(q, S.GroupByQuerySpec):
         base.update({
             "queryType": "groupBy",
@@ -417,7 +427,9 @@ def query_from_dict(d: dict, default_ds: Optional[str] = None) -> S.QuerySpec:
     filt = filter_from_dict(d.get("filter"))
     cd = d.get("context") or {}
     qctx = S.QueryContext(cd.get("queryId"), cd.get("timeout"),
-                          cd.get("preferSharded")) if cd else S.QueryContext()
+                          cd.get("preferSharded"), cd.get("lane"),
+                          cd.get("tenant"), cd.get("priority")) \
+        if cd else S.QueryContext()
     if qt == "groupBy":
         limit = None
         if d.get("limitSpec"):

@@ -242,6 +242,11 @@ class QueryContext:
     query_id: Optional[str] = None
     timeout_millis: Optional[int] = None
     prefer_sharded: Optional[bool] = None  # force mesh execution on/off
+    # workload management (wlm/): admission lane, quota tenant, queue
+    # priority (higher first). None = classified by the WorkloadManager.
+    lane: Optional[str] = None
+    tenant: Optional[str] = None
+    priority: Optional[int] = None
 
 
 class QuerySpec:

@@ -28,6 +28,59 @@ class QueryExecutionRecord:
                 **self.stats}
 
 
+class InflightRegistry:
+    """Live queries by admission state (``queued`` → ``running``),
+    surfaced by ``sys_queries`` next to the completed-history rows so
+    in-flight load is observable while it is happening (≈ Druid's
+    broker `sys.queries` / running-query endpoint)."""
+
+    __slots__ = ("_lock", "_rows", "_next")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}
+        self._next = 0
+
+    def begin(self, query_id, datasource, query_type) -> int:
+        with self._lock:
+            tok = self._next
+            self._next += 1
+            self._rows[tok] = {
+                "query_id": query_id, "datasource": datasource,
+                "query_type": query_type, "state": "queued",
+                "lane": None, "tenant": None,
+                "started_at": time.time(), "t0": time.perf_counter(),
+                "queued_ms": 0.0}
+            return tok
+
+    def running(self, tok: int, lane=None, tenant=None,
+                queued_ms: float = 0.0) -> None:
+        with self._lock:
+            row = self._rows.get(tok)
+            if row is not None:
+                row["state"] = "running"
+                row["lane"] = lane
+                row["tenant"] = tenant
+                row["queued_ms"] = queued_ms
+
+    def done(self, tok: int) -> None:
+        with self._lock:
+            self._rows.pop(tok, None)
+
+    def snapshot(self) -> List[dict]:
+        now = time.perf_counter()
+        with self._lock:
+            out = []
+            for row in self._rows.values():
+                d = dict(row)
+                d["wall_ms"] = (now - d.pop("t0")) * 1000.0
+                if d["state"] == "queued":
+                    # still accruing; report the live wait
+                    d["queued_ms"] = d["wall_ms"]
+                out.append(d)
+            return out
+
+
 class QueryHistory:
     def __init__(self, max_size: int = 500):
         self._q = collections.deque(maxlen=max_size)

@@ -727,6 +727,13 @@ class QueryEngine:
         # ingest version (structural invalidation, no TTL)
         from spark_druid_olap_tpu.cache.result_cache import SemanticResultCache
         self.result_cache = SemanticResultCache(self.config)
+        # workload management (wlm/): lane admission + tenant quotas in
+        # front of every spec this engine executes; shed queries raise
+        # AdmissionRejected here and never reach planning/dispatch
+        from spark_druid_olap_tpu.metadata.history import InflightRegistry
+        from spark_druid_olap_tpu.wlm.admit import WorkloadManager
+        self.wlm = WorkloadManager(self.config)
+        self.inflight = InflightRegistry()
 
     @property
     def last_stats(self) -> Dict[str, object]:
@@ -847,6 +854,45 @@ class QueryEngine:
             # concurrent statements) stay cancellable until the LAST
             # holder releases
             self.register_query(qid)
+        ticket = None
+        tok = self.inflight.begin(qid, getattr(q, "datasource", None),
+                                  type(q).__name__)
+        try:
+            if self.wlm.enabled:
+                # admission BEFORE any planning/cache/dispatch work: a
+                # shed query must cost nothing, and queue wait counts
+                # against the deadline (t0 is already ticking). Specs of
+                # one statement admit sequentially (never hold-and-wait),
+                # so nested plans cannot deadlock on lane slots.
+                cancel_ev = self._cancel_flags.get(qid) \
+                    if qid is not None else None
+                ticket = self.wlm.admit(self, q, t0, cancel_ev)
+                if ticket.timeout_millis is not None \
+                        and getattr(q.context, "timeout_millis",
+                                    None) is None:
+                    # lane default timeout rides the spec so every
+                    # downstream _stage_check honors it (context is
+                    # stripped from cache keys and compile signatures,
+                    # so the replace is cache-neutral)
+                    import dataclasses as _dc
+                    q = _dc.replace(q, context=_dc.replace(
+                        q.context or S.QueryContext(),
+                        timeout_millis=ticket.timeout_millis))
+                self.last_stats["wlm"] = ticket.stats()
+                self.inflight.running(tok, lane=ticket.lane,
+                                      tenant=ticket.tenant,
+                                      queued_ms=ticket.queued_ms)
+            else:
+                self.inflight.running(tok)
+            return self._execute_admitted(q, t0)
+        finally:
+            if ticket is not None:
+                self.wlm.release(ticket)
+            self.inflight.done(tok)
+            if qid is not None:
+                self.release_query(qid)
+
+    def _execute_admitted(self, q: S.QuerySpec, t0: float) -> QueryResult:
         try:
             cache = self.result_cache
             use_cache = cache.enabled and cache.cacheable(q)
@@ -884,9 +930,6 @@ class QueryEngine:
                     f"backend_lost ({type(e).__name__}: "
                     f"{str(e)[:120]})") from e
             raise
-        finally:
-            if qid is not None:
-                self.release_query(qid)
 
     def _mark_backend_lost(self):
         """Invalidate everything referencing dead device buffers; the

@@ -36,12 +36,37 @@ def _sys_rollups(ctx):
     return rollups_view(ctx)
 
 
+def _sys_queries(ctx):
+    """In-flight queries (state queued/running, live from the engine's
+    inflight registry) ahead of the completed history, with uniform
+    state / lane / queued_ms / wall_ms columns so load is observable
+    while it is happening."""
+    rows = []
+    for r in ctx.engine.inflight.snapshot():
+        rows.append({"state": r["state"], "queryType": r["query_type"],
+                     "datasource": r["datasource"],
+                     "query_id": r["query_id"], "lane": r["lane"],
+                     "tenant": r["tenant"], "startedAt": r["started_at"],
+                     "queued_ms": round(r["queued_ms"], 2),
+                     "wall_ms": round(r["wall_ms"], 2)})
+    for rec in ctx.history.entries():
+        d = rec.to_dict()
+        wlm = d.get("wlm") or {}
+        d.setdefault("state", "completed")
+        d.setdefault("lane", wlm.get("lane"))
+        d.setdefault("tenant", wlm.get("tenant"))
+        d.setdefault("queued_ms", wlm.get("queued_ms", 0.0))
+        d.setdefault("wall_ms", d.get("total_ms"))
+        rows.append(d)
+    return pd.DataFrame(rows)
+
+
 SYS_VIEWS = {
     "sys_datasources": lambda ctx: ctx.catalog.datasources_view(),
     "sys_segments": lambda ctx: ctx.catalog.segments_view(),
     "sys_columns": lambda ctx: ctx.catalog.columns_view(),
-    "sys_queries": lambda ctx: pd.DataFrame(
-        [r.to_dict() for r in ctx.history.entries()]),
+    "sys_queries": _sys_queries,
+    "sys_lanes": lambda ctx: ctx.engine.wlm.lanes_view(),
     "sys_rollups": _sys_rollups,
 }
 

@@ -156,7 +156,15 @@ if _FLIGHT_OK:
             return sql
 
         def _execute(self, sql: str) -> pa.Table:
-            df = self.ctx.sql(sql).to_pandas()
+            from spark_druid_olap_tpu.wlm.lanes import AdmissionRejected
+            try:
+                df = self.ctx.sql(sql).to_pandas()
+            except AdmissionRejected as e:
+                # gRPC's RESOURCE_EXHAUSTED is the 429 analog; the retry
+                # hint rides the message (Flight carries no headers here)
+                raise flight.FlightServerError(
+                    f"admission rejected (retry after "
+                    f"{e.retry_after_s:.1f}s): {e}") from e
             return pa.Table.from_pandas(df, preserve_index=False)
 
         # -- Flight handlers -------------------------------------------------

@@ -11,7 +11,15 @@ views. Here the endpoint is HTTP:
 - ``GET  /explain?sql=``  rewrite + cost explanation (≈ EXPLAIN REWRITE)
 - ``GET  /status``        liveness + device inventory
 - ``GET  /metadata/datasources|segments|columns``  catalog views
+- ``GET  /metadata/wlm``  workload-management state (lanes, tenants)
 - ``GET  /history``       query history (≈ the Druid-queries UI tab)
+
+Workload management (wlm/) fronts every query: the request's lane /
+tenant / priority come from the JSON body (``lane``/``tenant``/
+``priority``) or the ``X-Sdot-Lane`` / ``X-Sdot-Tenant`` /
+``X-Sdot-Priority`` headers, and a load-shed admission rejection maps
+to **429 Too Many Requests** with a ``Retry-After`` hint (≈ Druid's
+QueryCapacityExceededException → 429 at the broker).
 
 The Arrow IPC-stream response format is the binary wire analog of the
 reference's Jackson **Smile** protocol (``SmileJson4sScalaModule.scala``):
@@ -80,6 +88,7 @@ class SqlServer:
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._handler_threads: set = set()
         # queries run CONCURRENTLY (one thread per request, like the
         # reference thriftserver's pooled sessions, DruidClient.scala:46-74);
         # the engine serializes only compile-cache population internally,
@@ -92,6 +101,17 @@ class SqlServer:
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # quiet
                 pass
+
+            def handle(self):
+                # track live handler threads so stop() can join them with
+                # a bound instead of leaking sockets (daemon_threads alone
+                # abandons in-flight connections at interpreter exit)
+                t = threading.current_thread()
+                server._handler_threads.add(t)
+                try:
+                    super().handle()
+                finally:
+                    server._handler_threads.discard(t)
 
             def _send(self, code: int, body: bytes,
                       ctype: str = "application/json"):
@@ -126,6 +146,12 @@ class SqlServer:
                     self._error(500, e)
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        # handler threads must not pin the process (tests start/stop many
+        # servers; a hung client connection would otherwise block exit),
+        # and server_close() must not join them unboundedly either —
+        # stop() does its own bounded join over the tracked set
+        self._httpd.daemon_threads = True
+        self._httpd.block_on_close = False
         self.port = self._httpd.server_address[1]
         if background:
             self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -135,11 +161,25 @@ class SqlServer:
             self._httpd.serve_forever()
         return self
 
-    def stop(self):
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+    def stop(self, join_timeout_s: float = 5.0):
+        """Idempotent shutdown that cannot leak the listen socket:
+        stop accepting, close the socket, then give in-flight handler
+        threads and the serve loop a bounded join."""
+        httpd, self._httpd = self._httpd, None
+        if httpd is None:
+            return
+        httpd.shutdown()           # stop the serve_forever loop
+        httpd.server_close()       # release the listen socket NOW
+        deadline = __import__("time").monotonic() + join_timeout_s
+        for t in list(self._handler_threads):
+            remaining = deadline - __import__("time").monotonic()
+            if remaining <= 0:
+                break
+            t.join(remaining)      # daemons: a hung one won't pin exit
+        if self._thread is not None:
+            self._thread.join(max(0.0, deadline
+                                  - __import__("time").monotonic()))
+            self._thread = None
 
     # -- handlers -------------------------------------------------------------
     def _handle_get(self, h):
@@ -167,6 +207,12 @@ class SqlServer:
                 # evictions/bytes) — ≈ Druid's cache metrics endpoint
                 h._send(200, json.dumps(
                     self.ctx.engine.result_cache.stats()).encode())
+                return
+            if kind == "wlm":
+                # lanes (occupancy, sheds, high-water marks) + tenant
+                # quota state — ≈ Druid's query-scheduler lane metrics
+                h._send(200, json.dumps(
+                    self.ctx.engine.wlm.stats()).encode())
                 return
             from spark_druid_olap_tpu.mv.registry import rollups_view
             views = {"datasources": self.ctx.catalog.datasources_view,
@@ -231,6 +277,39 @@ class SqlServer:
         raw = h.rfile.read(n) if n else b"{}"
         return json.loads(raw.decode())
 
+    @staticmethod
+    def _wlm_request(h, req: dict):
+        """Lane / tenant / priority for admission: JSON body fields win,
+        ``X-Sdot-*`` headers cover clients that can't touch the body
+        (BI-tool gateways tagging traffic per tool/user)."""
+        lane = req.get("lane") or h.headers.get("X-Sdot-Lane")
+        tenant = req.get("tenant") or h.headers.get("X-Sdot-Tenant")
+        prio = req.get("priority")
+        if prio is None:
+            prio = h.headers.get("X-Sdot-Priority")
+        try:
+            prio = int(prio) if prio is not None else None
+        except (TypeError, ValueError):
+            prio = None
+        return lane, tenant, prio
+
+    @staticmethod
+    def _send_shed(h, e, qid=None):
+        """AdmissionRejected -> 429 + Retry-After (≈ Druid's
+        QueryCapacityExceededException at the broker)."""
+        retry_after = max(1, int(-(-e.retry_after_s // 1)))  # ceil, >= 1s
+        body = {"error": type(e).__name__, "message": str(e),
+                "retryAfterSeconds": retry_after}
+        if qid is not None:
+            body["queryId"] = qid
+        payload = json.dumps(body).encode()
+        h.send_response(429)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Retry-After", str(retry_after))
+        h.send_header("Content-Length", str(len(payload)))
+        h.end_headers()
+        h.wfile.write(payload)
+
     def _handle_post(self, h):
         url = urlparse(h.path)
         if url.path == "/sql":
@@ -252,13 +331,19 @@ class SqlServer:
             from spark_druid_olap_tpu.sql.lexer import SqlSyntaxError
             from spark_druid_olap_tpu.parallel.executor import (
                 QueryCancelled, QueryTimeout)
+            from spark_druid_olap_tpu.wlm.lanes import AdmissionRejected
+            lane, tenant, prio = self._wlm_request(h, req)
             try:
-                r = self.ctx.sql(sql, query_id=qid)
+                r = self.ctx.sql(sql, query_id=qid, lane=lane,
+                                 tenant=tenant, priority=prio)
             except SqlSyntaxError as e:
                 h._error(400, e)
                 return
             except KeyError as e:
                 h._error(404, e)
+                return
+            except AdmissionRejected as e:
+                self._send_shed(h, e, qid)
                 return
             except (QueryCancelled, QueryTimeout) as e:
                 body = json.dumps({"error": type(e).__name__,
@@ -285,8 +370,20 @@ class SqlServer:
         if url.path == "/query":
             req = self._read_json(h)
             from spark_druid_olap_tpu.ir.serde import query_from_dict
+            from spark_druid_olap_tpu.wlm.lanes import AdmissionRejected
             q = query_from_dict(req)
-            r = self.ctx.execute(q)
+            lane, tenant, prio = self._wlm_request(h, req.get("context")
+                                                   or {})
+            if lane or tenant or prio is not None:
+                self.ctx.engine.wlm.push_request(lane, tenant, prio)
+            try:
+                r = self.ctx.execute(q)
+            except AdmissionRejected as e:
+                self._send_shed(h, e)
+                return
+            finally:
+                if lane or tenant or prio is not None:
+                    self.ctx.engine.wlm.pop_request()
             h._send(200, _df_to_json_rows(r.to_pandas()))
             return
         if url.path == "/sql/cancel":

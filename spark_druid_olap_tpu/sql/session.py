@@ -98,7 +98,18 @@ class _NegativePlan:
         self.reason = reason
 
 
-def run_sql(ctx, sql: str, query_id: Optional[str] = None) -> QueryResult:
+def run_sql(ctx, sql: str, query_id: Optional[str] = None,
+            lane: Optional[str] = None, tenant: Optional[str] = None,
+            priority: Optional[int] = None) -> QueryResult:
+    if lane is not None or tenant is not None or priority is not None:
+        # the request's lane/tenant/priority ride wlm thread-local state
+        # down to every spec this statement executes (incl. subqueries
+        # and composite sub-plans) — same channel as query_id below
+        ctx.engine.wlm.push_request(lane, tenant, priority)
+        try:
+            return run_sql(ctx, sql, query_id=query_id)
+        finally:
+            ctx.engine.wlm.pop_request()
     if query_id is not None:
         # register BEFORE planning so a cancel landing at any point in the
         # statement's life is honored; current id rides thread-local state

@@ -316,6 +316,37 @@ PLAN_CACHE_ENABLED = _entry(
     "version and config fingerprint). Benchmarks disable it so measured "
     "reps time the full rewrite/build/execute path instead of a "
     "statement-cache hit.")
+# --- workload management (wlm/) -----------------------------------------------
+WLM_ENABLED = _entry(
+    "sdot.wlm.enabled", True,
+    "Admission control in front of the engine (wlm/): every query is "
+    "classified into a named lane with bounded concurrency and queue "
+    "depth; overload sheds with a retryable rejection (HTTP 429 + "
+    "Retry-After) instead of melting every in-flight query (≈ Druid "
+    "query laning / QueryScheduler).")
+WLM_LANES = _entry(
+    "sdot.wlm.lanes",
+    "interactive:slots=8,queue=64;reporting:slots=4,queue=32;"
+    "batch:slots=2,queue=16",
+    "Lane layout: 'name:slots=N,queue=N,wait_ms=N,timeout_ms=N,"
+    "priority=N;...'. slots = concurrent queries in the lane, queue = "
+    "bounded wait-queue depth past which admissions shed, wait_ms = max "
+    "queue-wait budget (0 = only the query's own timeout bounds it), "
+    "timeout_ms = default QueryContext timeout applied when the client "
+    "set none, priority = default admission priority (higher first).")
+WLM_DEFAULT_LANE = _entry(
+    "sdot.wlm.default.lane", "interactive",
+    "Lane for queries with no explicit context.lane (before cost-based "
+    "demotion is considered).")
+WLM_BATCH_COST = _entry(
+    "sdot.wlm.batch.cost.threshold", 0.5,
+    "Estimated single-chip cost units (parallel/cost.estimate) at or "
+    "above which a query without an explicit lane is demoted to the "
+    "'batch' lane (≈ Druid HiLoQueryLaningStrategy). 0 disables "
+    "cost-based demotion. Per-tenant quotas ride the same config "
+    "channel as free-form keys: 'sdot.wlm.quota.<tenant>' = "
+    "'concurrent=N,budget=F,refill=F' ('default' is the template for "
+    "tenants without an explicit entry).", float)
 # --- host-tier safety valve ---------------------------------------------------
 HOST_GATHER_PAGE_BYTES = _entry(
     "sdot.host.gather.page.bytes", 32 << 20,
@@ -373,6 +404,13 @@ class Config:
         """Per-session overrides of datasource options (tier 3)."""
         p = self.DATASOURCE_OVERRIDE_PREFIX
         return {k[len(p):]: v for k, v in self._values.items() if k.startswith(p)}
+
+    def prefixed(self, prefix: str) -> Dict[str, Any]:
+        """Every explicitly-set key under ``prefix`` (free-form config
+        families like ``sdot.wlm.quota.<tenant>`` ride the unknown-key
+        channel and enumerate themselves this way)."""
+        return {k: v for k, v in self._values.items()
+                if k.startswith(prefix)}
 
     def copy(self) -> "Config":
         c = Config()

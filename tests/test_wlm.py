@@ -1,0 +1,522 @@
+"""Workload management (wlm/): lanes, admission, shedding, quotas.
+
+≈ the reference broker's query-laning guarantees (Druid QueryScheduler
+tests): concurrency caps hold under a thread storm, overload sheds with
+a retryable 429 instead of executing, cancel works while queued, and
+tenant budgets recover after refill.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from conftest import make_sales_df
+from spark_druid_olap_tpu.ir import spec as S
+from spark_druid_olap_tpu.parallel.executor import (QueryCancelled,
+                                                    QueryTimeout)
+from spark_druid_olap_tpu.result import QueryResult
+from spark_druid_olap_tpu.wlm import (LaneFullError, TokenBucket,
+                                      parse_lanes)
+from spark_druid_olap_tpu.wlm.quota import QuotaExceededError, QuotaManager
+
+
+def _ctx(lanes, **conf):
+    ctx = sdot.Context(config={"sdot.wlm.lanes": lanes, **conf})
+    ctx.ingest_dataframe("sales", make_sales_df(2000), time_column="ts")
+    return ctx
+
+
+def _spec(ds="sales", qid=None, **ctx_kw):
+    return S.TimeseriesQuerySpec(
+        datasource=ds, intervals=(), granularity=S.Granularity("all"),
+        aggregations=(S.AggregationSpec("count", "c", None),),
+        context=S.QueryContext(query_id=qid, **ctx_kw))
+
+
+class _FakeExec:
+    """Replaces QueryEngine._execute_admitted: counts concurrent entries
+    (the cap proof), blocks on an optional gate, and proves shed queries
+    never execute."""
+
+    def __init__(self, gate=None, sleep_s=0.0):
+        self.gate = gate
+        self.sleep_s = sleep_s
+        self.lock = threading.Lock()
+        self.active = 0
+        self.max_active = 0
+        self.calls = 0
+        self.seen = []
+
+    def __call__(self, q, t0):
+        with self.lock:
+            self.calls += 1
+            self.active += 1
+            self.max_active = max(self.max_active, self.active)
+            self.seen.append(q)
+        try:
+            if self.gate is not None:
+                assert self.gate.wait(10.0), "test gate never opened"
+            if self.sleep_s:
+                time.sleep(self.sleep_s)
+            return QueryResult(["c"], {"c": np.array([1])})
+        finally:
+            with self.lock:
+                self.active -= 1
+
+
+# -- grammar / primitives ------------------------------------------------------
+
+def test_parse_lanes_grammar():
+    lanes = parse_lanes("a:slots=2,queue=4,wait_ms=50,timeout_ms=1000,"
+                        "priority=9; b ; c:slots=1")
+    assert lanes["a"].slots == 2 and lanes["a"].max_queue == 4
+    assert lanes["a"].max_wait_ms == 50.0
+    assert lanes["a"].timeout_millis == 1000 and lanes["a"].priority == 9
+    assert lanes["b"].slots == 4          # defaults
+    assert lanes["c"].slots == 1
+    with pytest.raises(ValueError, match="unknown lane option"):
+        parse_lanes("a:slotz=2")
+
+
+def test_token_bucket_fake_clock():
+    t = [0.0]
+    b = TokenBucket(10.0, 2.0, now_fn=lambda: t[0])
+    assert b.try_charge(8.0) and not b.try_charge(4.0)
+    assert b.seconds_until(4.0) == pytest.approx(1.0)   # (4-2)/2
+    t[0] = 4.0                                          # refills to cap
+    assert b.tokens() == pytest.approx(10.0)
+    assert b.seconds_until(12.0) == float("inf")        # > capacity
+
+
+# -- concurrency cap -----------------------------------------------------------
+
+def test_lane_cap_never_exceeded_under_storm():
+    ctx = _ctx("fast:slots=2,queue=64", **{"sdot.wlm.default.lane": "fast"})
+    fake = _FakeExec(sleep_s=0.01)
+    ctx.engine._execute_admitted = fake
+    errs = []
+
+    def worker():
+        try:
+            ctx.engine.execute(_spec())
+        except Exception as e:      # noqa: BLE001 — collected for assert
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    assert not errs
+    assert fake.calls == 16
+    assert fake.max_active <= 2                       # the cap proof
+    lane = ctx.engine.wlm.stats()["lanes"]
+    fast = next(ln for ln in lane if ln["lane"] == "fast")
+    assert fast["max_active_seen"] <= 2
+    assert fast["admitted"] == 16 and fast["active"] == 0
+
+
+# -- shedding ------------------------------------------------------------------
+
+def test_queue_depth_shed_never_reaches_executor():
+    ctx = _ctx("only:slots=1,queue=0", **{"sdot.wlm.default.lane": "only"})
+    gate = threading.Event()
+    fake = _FakeExec(gate=gate)
+    ctx.engine._execute_admitted = fake
+    holder = threading.Thread(target=lambda: ctx.engine.execute(_spec()))
+    holder.start()
+    for _ in range(200):                      # wait until the slot is held
+        if fake.active == 1:
+            break
+        time.sleep(0.005)
+    with pytest.raises(LaneFullError) as ei:
+        ctx.engine.execute(_spec())
+    assert ei.value.retry_after_s > 0
+    gate.set()
+    holder.join(10.0)
+    assert fake.calls == 1                    # shed query never executed
+    st = ctx.engine.wlm.stats()
+    assert st["shed"] == 1
+
+
+def test_queue_wait_budget_shed():
+    ctx = _ctx("only:slots=1,queue=8,wait_ms=40",
+               **{"sdot.wlm.default.lane": "only"})
+    gate = threading.Event()
+    fake = _FakeExec(gate=gate)
+    ctx.engine._execute_admitted = fake
+    holder = threading.Thread(target=lambda: ctx.engine.execute(_spec()))
+    holder.start()
+    for _ in range(200):
+        if fake.active == 1:
+            break
+        time.sleep(0.005)
+    t0 = time.perf_counter()
+    with pytest.raises(LaneFullError, match="queue-wait budget"):
+        ctx.engine.execute(_spec())
+    assert (time.perf_counter() - t0) < 5.0
+    gate.set()
+    holder.join(10.0)
+    assert fake.calls == 1
+    only = ctx.engine.wlm.stats()["lanes"][0]
+    assert only["timed_out"] == 1 and only["active"] == 0
+
+
+# -- cancel / timeout while queued ---------------------------------------------
+
+def test_cancel_while_queued_releases_cleanly():
+    ctx = _ctx("only:slots=1,queue=8", **{"sdot.wlm.default.lane": "only"})
+    gate = threading.Event()
+    fake = _FakeExec(gate=gate)
+    ctx.engine._execute_admitted = fake
+    holder = threading.Thread(target=lambda: ctx.engine.execute(_spec()))
+    holder.start()
+    for _ in range(200):
+        if fake.active == 1:
+            break
+        time.sleep(0.005)
+    got = []
+
+    def queued():
+        try:
+            ctx.engine.execute(_spec(qid="q-queued"))
+        except BaseException as e:  # noqa: BLE001
+            got.append(e)
+
+    qt = threading.Thread(target=queued)
+    qt.start()
+    for _ in range(200):                      # until q-queued is registered
+        if "q-queued" in ctx.engine._cancel_flags:
+            break
+        time.sleep(0.005)
+    assert ctx.engine.cancel("q-queued")
+    qt.join(10.0)
+    assert got and isinstance(got[0], QueryCancelled)
+    gate.set()
+    holder.join(10.0)
+    assert fake.calls == 1                    # the cancelled one never ran
+    only = ctx.engine.wlm.stats()["lanes"][0]
+    assert only["cancelled_queued"] == 1 and only["active"] == 0
+    # the lane still works: slot accounting survived the unhook
+    r = ctx.engine.execute(_spec())
+    assert r is not None and fake.calls == 2
+
+
+def test_queued_wait_counts_against_deadline():
+    # lane default timeout (timeout_ms) applies while QUEUED too
+    ctx = _ctx("only:slots=1,queue=8,timeout_ms=60",
+               **{"sdot.wlm.default.lane": "only"})
+    gate = threading.Event()
+    fake = _FakeExec(gate=gate)
+    ctx.engine._execute_admitted = fake
+    holder = threading.Thread(target=lambda: ctx.engine.execute(_spec()))
+    holder.start()
+    for _ in range(200):
+        if fake.active == 1:
+            break
+        time.sleep(0.005)
+    with pytest.raises(QueryTimeout):
+        ctx.engine.execute(_spec())
+    gate.set()
+    holder.join(10.0)
+    assert fake.calls == 1
+
+
+def test_lane_default_timeout_propagates_into_context():
+    ctx = _ctx("only:slots=4,queue=8,timeout_ms=120000",
+               **{"sdot.wlm.default.lane": "only"})
+    fake = _FakeExec()
+    ctx.engine._execute_admitted = fake
+    ctx.engine.execute(_spec())
+    assert fake.seen[0].context.timeout_millis == 120000
+    # an explicit client timeout wins over the lane default
+    ctx.engine.execute(_spec(timeout_millis=5000))
+    assert fake.seen[1].context.timeout_millis == 5000
+
+
+# -- classification ------------------------------------------------------------
+
+def test_cost_demotion_to_batch():
+    ctx = _ctx("interactive:slots=4;batch:slots=2,queue=8")
+    fake = _FakeExec()
+    ctx.engine._execute_admitted = fake
+    ctx.engine.wlm._estimate_cost = lambda engine, q: 9.9   # expensive
+    ctx.engine.execute(_spec())
+    assert ctx.engine.last_stats["wlm"]["lane"] == "batch"
+    assert ctx.engine.last_stats["wlm"]["demoted"] is True
+    # explicit lane wins over demotion
+    ctx.engine.execute(_spec(lane="interactive"))
+    assert ctx.engine.last_stats["wlm"]["lane"] == "interactive"
+    # cheap query stays interactive
+    ctx.engine.wlm._estimate_cost = lambda engine, q: 1e-6
+    ctx.engine.execute(_spec())
+    assert ctx.engine.last_stats["wlm"]["lane"] == "interactive"
+    batch = next(ln for ln in ctx.engine.wlm.stats()["lanes"]
+                 if ln["lane"] == "batch")
+    assert batch["demoted_in"] == 1
+
+
+def test_priority_orders_the_queue():
+    ctx = _ctx("only:slots=1,queue=8", **{"sdot.wlm.default.lane": "only"})
+    gate = threading.Event()
+    fake = _FakeExec(gate=gate)
+    ctx.engine._execute_admitted = fake
+    order = []
+    olock = threading.Lock()
+
+    def run(prio):
+        ctx.engine.execute(_spec(priority=prio))
+        with olock:
+            order.append(prio)
+
+    holder = threading.Thread(target=lambda: ctx.engine.execute(_spec()))
+    holder.start()
+    for _ in range(200):
+        if fake.active == 1:
+            break
+        time.sleep(0.005)
+    lo = threading.Thread(target=run, args=(1,))
+    lo.start()
+    time.sleep(0.1)                 # lo is queued first (FIFO seq smaller)
+    hi = threading.Thread(target=run, args=(5,))
+    hi.start()
+    time.sleep(0.1)
+    gate.set()                      # holder finishes; grants go by priority
+    holder.join(10.0)
+    lo.join(10.0)
+    hi.join(10.0)
+    assert order == [5, 1]          # higher priority granted first
+
+
+# -- quotas --------------------------------------------------------------------
+
+def test_quota_concurrent_cap():
+    ctx = _ctx("only:slots=8,queue=8", **{
+        "sdot.wlm.default.lane": "only",
+        "sdot.wlm.quota.acme": "concurrent=1"})
+    gate = threading.Event()
+    fake = _FakeExec(gate=gate)
+    ctx.engine._execute_admitted = fake
+    holder = threading.Thread(
+        target=lambda: ctx.engine.execute(_spec(tenant="acme")))
+    holder.start()
+    for _ in range(200):
+        if fake.active == 1:
+            break
+        time.sleep(0.005)
+    with pytest.raises(QuotaExceededError, match="concurrent-query cap"):
+        ctx.engine.execute(_spec(tenant="acme"))
+    # other tenants are unaffected while acme still holds its slot
+    # (the holder keeps blocking on its captured Event)
+    fake.gate = None
+    ctx.engine.execute(_spec(tenant="other"))
+    gate.set()
+    holder.join(10.0)
+    # cap recovers once the in-flight query releases
+    ctx.engine.execute(_spec(tenant="acme"))
+    assert fake.calls == 3
+
+
+def test_quota_budget_exhaustion_recovers_after_refill():
+    ctx = _ctx("only:slots=8,queue=8", **{
+        "sdot.wlm.default.lane": "only",
+        "sdot.wlm.quota.acme": "budget=1.0,refill=0.5"})
+    fake = _FakeExec()
+    ctx.engine._execute_admitted = fake
+    clock = [0.0]
+    ctx.engine.wlm.quotas = QuotaManager(now_fn=lambda: clock[0])
+    ctx.engine.wlm._estimate_cost = lambda engine, q: 0.6
+    ctx.engine.execute(_spec(tenant="acme"))            # 1.0 -> 0.4
+    with pytest.raises(QuotaExceededError) as ei:
+        ctx.engine.execute(_spec(tenant="acme"))        # needs 0.6 > 0.4
+    assert ei.value.retry_after_s == pytest.approx(0.4, abs=0.05)
+    clock[0] = 2.0                                      # +1.0 refilled
+    ctx.engine.execute(_spec(tenant="acme"))            # recovers
+    assert fake.calls == 2
+    snap = ctx.engine.wlm.stats()["tenants"][0]
+    assert snap["tenant"] == "acme" and snap["rejected"] == 1
+    assert snap["cost_charged"] == pytest.approx(1.2)
+
+
+def test_quota_default_template_applies_to_unknown_tenants():
+    ctx = _ctx("only:slots=8,queue=8", **{
+        "sdot.wlm.default.lane": "only",
+        "sdot.wlm.quota.default": "concurrent=1"})
+    gate = threading.Event()
+    fake = _FakeExec(gate=gate)
+    ctx.engine._execute_admitted = fake
+    holder = threading.Thread(
+        target=lambda: ctx.engine.execute(_spec(tenant="anyone")))
+    holder.start()
+    for _ in range(200):
+        if fake.active == 1:
+            break
+        time.sleep(0.005)
+    with pytest.raises(QuotaExceededError):
+        ctx.engine.execute(_spec(tenant="anyone"))
+    gate.set()
+    holder.join(10.0)
+
+
+# -- observability -------------------------------------------------------------
+
+def test_sys_lanes_and_sys_queries_views():
+    ctx = _ctx("interactive:slots=8;batch:slots=2")
+    ctx.sql("SELECT COUNT(*) FROM sales")
+    lanes = ctx.sql("SELECT lane, slots, active, admitted, max_active_seen "
+                    "FROM sys_lanes").to_pandas()
+    assert set(lanes["lane"]) == {"interactive", "batch"}
+    inter = lanes[lanes["lane"] == "interactive"].iloc[0]
+    assert inter["slots"] == 8 and inter["admitted"] >= 1
+    q = ctx.sql("SELECT state, lane, queued_ms, wall_ms "
+                "FROM sys_queries").to_pandas()
+    assert len(q) >= 1
+    assert (q["state"] == "completed").any()
+    assert (q["queued_ms"] >= 0).all() and (q["wall_ms"] >= 0).all()
+
+
+def test_inflight_registry_states():
+    ctx = _ctx("only:slots=1,queue=8", **{"sdot.wlm.default.lane": "only"})
+    gate = threading.Event()
+    fake = _FakeExec(gate=gate)
+    ctx.engine._execute_admitted = fake
+    holder = threading.Thread(target=lambda: ctx.engine.execute(_spec()))
+    queued = threading.Thread(target=lambda: ctx.engine.execute(_spec()))
+    holder.start()
+    for _ in range(200):
+        if fake.active == 1:
+            break
+        time.sleep(0.005)
+    queued.start()
+    states = set()
+    for _ in range(200):
+        states = {r["state"] for r in ctx.engine.inflight.snapshot()}
+        if states == {"running", "queued"}:
+            break
+        time.sleep(0.005)
+    assert states == {"running", "queued"}
+    gate.set()
+    holder.join(10.0)
+    queued.join(10.0)
+    assert ctx.engine.inflight.snapshot() == []
+
+
+def test_wlm_disabled_is_transparent():
+    ctx = _ctx("only:slots=1,queue=0", **{
+        "sdot.wlm.default.lane": "only", "sdot.wlm.enabled": False})
+    fake = _FakeExec(sleep_s=0.01)
+    ctx.engine._execute_admitted = fake
+    threads = [threading.Thread(target=lambda: ctx.engine.execute(_spec()))
+               for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10.0)
+    assert fake.calls == 6                    # nothing shed, nothing queued
+    assert "wlm" not in ctx.engine.last_stats
+    assert ctx.engine.wlm.stats()["admitted"] == 0
+
+
+def test_session_lane_kwargs_flow_to_stats():
+    ctx = _ctx("interactive:slots=8;reporting:slots=2")
+    ctx.sql("SELECT COUNT(*) FROM sales", lane="reporting", tenant="bi")
+    rep = next(ln for ln in ctx.engine.wlm.stats()["lanes"]
+               if ln["lane"] == "reporting")
+    assert rep["admitted"] >= 1
+    tenants = ctx.engine.wlm.stats()["tenants"]
+    assert any(t["tenant"] == "bi" for t in tenants)
+
+
+# -- HTTP serving layer --------------------------------------------------------
+
+@pytest.fixture()
+def wlm_server():
+    from spark_druid_olap_tpu.server.http import SqlServer
+    ctx = _ctx("only:slots=1,queue=0", **{"sdot.wlm.default.lane": "only"})
+    s = SqlServer(ctx, port=0).start()
+    yield s
+    s.stop()
+
+
+def _post(server, path, payload, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req) as r:
+        return r.status, dict(r.headers), json.loads(r.read().decode())
+
+
+def test_http_shed_gets_429_with_retry_after(wlm_server):
+    ctx = wlm_server.ctx
+    gate = threading.Event()
+    fake = _FakeExec(gate=gate)
+    ctx.engine._execute_admitted = fake
+
+    results = []
+
+    def slow():
+        results.append(_post(wlm_server, "/sql",
+                             {"sql": "SELECT COUNT(*) FROM sales"}))
+
+    holder = threading.Thread(target=slow)
+    holder.start()
+    for _ in range(400):
+        if fake.active == 1:
+            break
+        time.sleep(0.005)
+    assert fake.active == 1
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(wlm_server, "/sql", {"sql": "SELECT COUNT(*) FROM sales"})
+    assert ei.value.code == 429
+    assert int(ei.value.headers["Retry-After"]) >= 1
+    body = json.loads(ei.value.read().decode())
+    assert body["error"] == "LaneFullError"
+    assert body["retryAfterSeconds"] >= 1
+    gate.set()
+    holder.join(10.0)
+    assert results and results[0][0] == 200
+    assert fake.calls == 1                    # shed request never executed
+
+
+def test_http_lane_and_tenant_headers(wlm_server):
+    ctx = wlm_server.ctx
+    ctx.config.set("sdot.wlm.lanes", "only:slots=1,queue=0;vip:slots=4")
+    code, headers, body = _post(
+        wlm_server, "/sql", {"sql": "SELECT COUNT(*) FROM sales"},
+        headers={"X-Sdot-Lane": "vip", "X-Sdot-Tenant": "acme"})
+    assert code == 200
+    vip = next(ln for ln in ctx.engine.wlm.stats()["lanes"]
+               if ln["lane"] == "vip")
+    assert vip["admitted"] >= 1
+    assert any(t["tenant"] == "acme"
+               for t in ctx.engine.wlm.stats()["tenants"])
+
+
+def test_metadata_wlm_endpoint(wlm_server):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{wlm_server.port}/metadata/wlm") as r:
+        body = json.loads(r.read().decode())
+    assert body["enabled"] is True
+    assert {ln["lane"] for ln in body["lanes"]} >= {"only"}
+    assert {"slots", "active", "queued", "shed",
+            "max_active_seen"} <= set(body["lanes"][0])
+
+
+def test_server_stop_is_idempotent_and_restartable():
+    from spark_druid_olap_tpu.server.http import SqlServer
+    ctx = _ctx("only:slots=4")
+    for _ in range(3):
+        s = SqlServer(ctx, port=0).start()
+        code, _, body = _post(s, "/sql",
+                              {"sql": "SELECT COUNT(*) FROM sales"})
+        assert code == 200
+        s.stop()
+        s.stop()                              # second stop is a no-op
+        assert s._httpd is None
