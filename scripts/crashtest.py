@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Crash-recovery differential: kill -9 a streaming ingest mid-append.
+
+The durability acceptance test for persist/ (ISSUE 4): a child process
+streams deterministic batches into a persisted datasource and records an
+acknowledgement marker after each commit returns; the parent SIGKILLs it
+at a random instant (possibly mid-WAL-append), restarts the engine over
+the same persist root, and asserts
+
+  1. every ACKNOWLEDGED batch survived (recovered batches >= markers —
+     the WAL fsync commit point precedes the acknowledgement),
+  2. at most ONE unacknowledged batch appears (the one whose commit was
+     in flight when the kill landed),
+  3. the recovered store answers a query mix BYTE-IDENTICALLY to a
+     reference store built in memory from the same recovered batch
+     prefix (batch i is a pure function of (seed, i), so the reference
+     is reconstructible from the recovered row count alone).
+
+Usage:
+  python scripts/crashtest.py [--rounds 3] [--batches 40] [--rows 500]
+
+Exit 0 when every round passes. The child re-executes this file with
+--child; tests run it as a subprocess (not tier-1: it needs real
+processes to kill).
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BATCH_ROWS_DEFAULT = 500
+
+QUERIES = [
+    "select region, sum(qty) as q, count(*) as n from events "
+    "group by region order by region",
+    "select product, sum(price) as p, min(qty) as mn, max(qty) as mx "
+    "from events group by product order by product",
+    "select count(*) as n from events where product is null",
+]
+
+
+def make_batch(i, rows, seed=1234):
+    """Batch ``i`` as a pure function of (seed, i) — the parent rebuilds
+    the exact recovered prefix without any channel from the child."""
+    import numpy as np
+    import pandas as pd
+    r = np.random.default_rng(seed + i)
+    start = np.datetime64("2024-01-01")
+    df = pd.DataFrame({
+        "ts": (start + r.integers(0, 365, rows).astype("timedelta64[D]")
+               ).astype("datetime64[ns]"),
+        "region": r.choice(["east", "west", "north", "south"], rows),
+        "product": r.choice([f"p{k:02d}" for k in range(20)], rows),
+        "qty": r.integers(0, 1000, rows),
+        "price": np.round(r.uniform(0, 100, rows), 2),
+    })
+    df.loc[df.index[::41], "product"] = None    # nullable dim
+    return df
+
+
+INGEST = dict(time_column="ts", dimensions=["region", "product"],
+              metrics=["qty", "price"])
+
+
+def child_main(args):
+    """Stream batches forever; after each commit RETURNS, append its
+    index to the marker file and fsync (the acknowledgement)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, ROOT)
+    import spark_druid_olap_tpu as sdot
+
+    ctx = sdot.Context({"sdot.persist.path": args.persist_root})
+    with open(args.marker, "a") as mf:
+        for i in range(args.batches):
+            ctx.stream_ingest("events", make_batch(i, args.rows), **INGEST)
+            mf.write(f"{i}\n")
+            mf.flush()
+            os.fsync(mf.fileno())
+    # finished every batch before the kill landed: tell the parent so it
+    # can shorten the fuse next round
+    print("CHILD_DONE", flush=True)
+    ctx.close()
+
+
+def run_round(rnd, args, tmpdir):
+    import numpy as np  # noqa: F401 — jax below needs the import order
+    import spark_druid_olap_tpu as sdot
+
+    persist_root = os.path.join(tmpdir, f"round{rnd}")
+    marker = os.path.join(tmpdir, f"round{rnd}.marker")
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child",
+         "--persist-root", persist_root, "--marker", marker,
+         "--batches", str(args.batches), "--rows", str(args.rows)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    # kill once a randomized number of commits are acknowledged, plus a
+    # sub-commit jitter — different rounds land in different spots
+    # (between commits, mid-WAL-append, mid-register). Adaptive on the
+    # marker file, not wall time: child startup (imports + jax init)
+    # dwarfs per-batch time, so a timed fuse misses the stream entirely.
+    rng = __import__("random").Random(9000 + rnd)
+    kill_after = rng.randrange(2, max(3, args.batches - 2))
+    deadline = time.monotonic() + args.warmup_s + 60.0   # hang backstop
+
+    def _acks():
+        try:
+            with open(marker) as f:
+                return sum(1 for ln in f if ln.strip())
+        except OSError:
+            return 0
+
+    while time.monotonic() < deadline and child.poll() is None \
+            and _acks() < kill_after:
+        time.sleep(0.002)
+    time.sleep(rng.uniform(0.0, 0.02))      # land inside the next commit
+    if child.poll() is None:
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait()
+        killed = True
+    else:
+        killed = False       # child finished every batch first
+        print(f"  [round {rnd}] child finished before the kill "
+              f"(consider more --batches)")
+
+    acked = 0
+    if os.path.exists(marker):
+        with open(marker) as f:
+            acked = sum(1 for ln in f if ln.strip())
+
+    # restart over the same root; recovery runs in Context.__init__
+    ctx = sdot.Context({"sdot.persist.path": persist_root})
+    try:
+        n_rows = ctx.store.get("events").num_rows
+    except KeyError:
+        n_rows = 0
+    assert n_rows % args.rows == 0, \
+        f"recovered {n_rows} rows is not a whole number of batches"
+    recovered = n_rows // args.rows
+
+    info = dict(ctx.store.recovery_info.get("events") or {})
+    print(f"  [round {rnd}] killed={killed} acked={acked} "
+          f"recovered={recovered} batches ({n_rows} rows) "
+          f"source={info.get('source')} "
+          f"wal_records={info.get('wal_records')}")
+
+    # (1) durability: every acknowledged commit survived
+    assert recovered >= acked, \
+        f"LOST COMMITTED DATA: {acked} acked but {recovered} recovered"
+    # (2) at most the one in-flight batch beyond the acks
+    assert recovered <= acked + 1, \
+        f"recovered {recovered} > acked {acked} + 1 (phantom batches)"
+
+    # (3) full differential vs an in-memory reference of the same prefix
+    ref = sdot.Context()
+    for i in range(recovered):
+        ref.stream_ingest("events", make_batch(i, args.rows), **INGEST)
+    mismatches = []
+    for q in QUERIES if recovered else []:
+        got = ctx.sql(q).to_pandas()
+        want = ref.sql(q).to_pandas()
+        if not got.equals(want):
+            mismatches.append(q)
+    assert not mismatches, f"recovered answers differ on: {mismatches}"
+    ctx.close()
+    return {"round": rnd, "killed": killed, "acked": acked,
+            "recovered": recovered, "source": info.get("source"),
+            "wal_records": info.get("wal_records")}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--batches", type=int, default=200,
+                    help="batches the child TRIES to stream before the "
+                    "kill lands")
+    ap.add_argument("--rows", type=int, default=BATCH_ROWS_DEFAULT)
+    ap.add_argument("--warmup-s", type=float, default=4.0,
+                    help="minimum child lifetime before the kill (child "
+                    "startup = imports + jax init)")
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--persist-root", help=argparse.SUPPRESS)
+    ap.add_argument("--marker", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.child:
+        return child_main(args)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, ROOT)
+    import tempfile
+    results = []
+    with tempfile.TemporaryDirectory(prefix="sdot-crashtest-") as tmpdir:
+        for rnd in range(args.rounds):
+            results.append(run_round(rnd, args, tmpdir))
+    n_killed = sum(1 for r in results if r["killed"])
+    out = {"mode": "crashtest", "rounds": len(results),
+           "killed": n_killed, "results": results}
+    print(json.dumps(out))
+    if n_killed == 0:
+        print("WARNING: no round actually killed the child mid-stream; "
+              "raise --batches or lower --warmup-s", file=sys.stderr)
+        sys.exit(2)
+    print(f"OK: {len(results)} rounds, {n_killed} mid-stream kills, "
+          f"zero lost commits, all differentials byte-identical")
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    main()

@@ -358,6 +358,86 @@ def run_rollup(args):
     sys.exit(0 if (hits > 0 and not mismatches) else 1)
 
 
+def run_coldstart(args):
+    """Warm vs cold startup-to-first-result (persist/): build + checkpoint
+    a synthetic store, then compare the first-query latency of the live
+    (warm) context against a FRESH context that must recover the store
+    from deep storage first (snapshot load + checksum verify + WAL
+    replay). Differential: the cold context's answers must match the warm
+    context's byte-for-byte."""
+    import shutil
+    import tempfile
+    sys.path.insert(0, ".")
+    import spark_druid_olap_tpu as sdot
+
+    root = tempfile.mkdtemp(prefix="sdot-coldstart-")
+    cfg = {"sdot.persist.path": root, "sdot.plan.cache.enabled": False,
+           "sdot.cache.enabled": False}
+    queries = args.sql or DEFAULT_QUERIES
+    try:
+        ctx = sdot.Context(cfg)
+        df = _synthetic_sales()
+        t0 = time.perf_counter()
+        ctx.stream_ingest("sales", df, time_column="ts")
+        ingest_ms = (time.perf_counter() - t0) * 1000
+        t0 = time.perf_counter()
+        summary = ctx.checkpoint("sales")[0]
+        ckpt_ms = (time.perf_counter() - t0) * 1000
+        for q in queries:        # compile once; both legs measure steady
+            ctx.sql(q)           # state, not XLA compilation
+        warm_lat, answers = [], {}
+        for q in queries:
+            t0 = time.perf_counter()
+            answers[q] = ctx.sql(q).to_pandas()
+            warm_lat.append((time.perf_counter() - t0) * 1000)
+        ctx.close()
+
+        t0 = time.perf_counter()
+        ctx2 = sdot.Context(cfg)          # recovery runs in __init__
+        recover_ms = (time.perf_counter() - t0) * 1000
+        t0 = time.perf_counter()
+        first = ctx2.sql(queries[0]).to_pandas()
+        cold_first_ms = (time.perf_counter() - t0) * 1000
+        pstat = dict(ctx2.engine.last_stats.get("persist") or {})
+        mismatches = [] if first.equals(answers[queries[0]]) else [queries[0]]
+        cold_lat = [cold_first_ms]
+        for q in queries[1:]:
+            t0 = time.perf_counter()
+            got = ctx2.sql(q).to_pandas()
+            cold_lat.append((time.perf_counter() - t0) * 1000)
+            if not got.equals(answers[q]):
+                mismatches.append(q)
+        ctx2.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    w, c = np.array(warm_lat), np.array(cold_lat)
+    print(f"\n=== coldstart ({len(df):,} rows, snapshot "
+          f"{summary['bytes']:,} bytes) ===")
+    print(f"  ingest {ingest_ms:8.1f}ms   checkpoint {ckpt_ms:8.1f}ms")
+    print(f"  warm  first-result p50={np.percentile(w, 50):7.1f}ms "
+          f"(store already in memory)")
+    print(f"  cold  recovery={recover_ms:7.1f}ms "
+          f"(source={pstat.get('source')}, checksum verify "
+          f"{pstat.get('checksum_verify_ms', 0)}ms) "
+          f"+ first query {cold_first_ms:7.1f}ms")
+    print(f"  cold startup-to-first-result: "
+          f"{recover_ms + cold_first_ms:7.1f}ms"
+          + (f"; RESULT MISMATCH on {mismatches}" if mismatches else ""))
+    out = {"mode": "coldstart", "rows": len(df),
+           "snapshot_bytes": int(summary["bytes"]),
+           "checkpoint_ms": round(ckpt_ms, 1),
+           "recover_ms": round(recover_ms, 1),
+           "recovery_source": pstat.get("source"),
+           "checksum_verify_ms": pstat.get("checksum_verify_ms"),
+           "warm_first_ms": round(float(np.percentile(w, 50)), 1),
+           "cold_first_ms": round(cold_first_ms, 1),
+           "cold_startup_to_first_ms": round(recover_ms + cold_first_ms, 1),
+           "result_mismatches": mismatches}
+    print(json.dumps(out))
+    sys.exit(0 if not mismatches else 1)
+
+
 # WLM overload mix: cheap dashboard probes (the interactive lane's
 # traffic) vs heavy scans that would otherwise monopolize the engine
 WLM_INTERACTIVE = [
@@ -520,6 +600,12 @@ def main():
                     "synthetic dataset: N timed reps per query with the "
                     "planner rewrite off, then on (caches disabled); "
                     "reports rewrite hit rate and p50/p99 side by side")
+    ap.add_argument("--coldstart", action="store_true",
+                    help="warm vs cold startup-to-first-result: build + "
+                    "checkpoint a synthetic store, then time a fresh "
+                    "context's deep-storage recovery + first query "
+                    "against the live context's first query "
+                    "(differential: answers must match)")
     ap.add_argument("--wlm", action="store_true",
                     help="in-process overload comparison: interactive + "
                     "heavy query mix at 4x the interactive lane's "
@@ -528,6 +614,8 @@ def main():
                     "off, fixed seed)")
     args = ap.parse_args()
 
+    if args.coldstart:
+        return run_coldstart(args)
     if args.wlm:
         return run_wlm(args)
     if args.rollup:

@@ -21,6 +21,13 @@ The key also folds in the per-datasource ingest version
 so invalidation is structural — any re-ingest, stream append, drop or
 config change moves subsequent queries to fresh keys (≈ Druid's segment
 version in its result-cache keys).
+
+Restart contract (persist/): recovery restores each datasource's ingest
+version EXACTLY as it was at the last commit (``SegmentStore.restore``),
+so version-keyed entries stay coherent across a process restart. An
+in-session ``RESTORE`` instead *rewinds* versions — the session layer
+clears this cache afterwards, since a rewound version could collide with
+entries keyed under the same number but different data.
 """
 
 from __future__ import annotations

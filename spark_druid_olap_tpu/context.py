@@ -73,6 +73,18 @@ class Context:
         if mods_csv:
             from spark_druid_olap_tpu.utils.modules import install_from_config
             self.modules = install_from_config(self, mods_csv)
+        # durable persistence (persist/): deep-storage snapshots + ingest
+        # WAL + startup recovery; None when sdot.persist.path is unset
+        self.persist = None
+        from spark_druid_olap_tpu.utils.config import (
+            PERSIST_ENABLED, PERSIST_PATH, PERSIST_RECOVER)
+        ppath = self.config.get(PERSIST_PATH)
+        if ppath and self.config.get(PERSIST_ENABLED):
+            from spark_druid_olap_tpu.persist.manager import PersistManager
+            self.persist = PersistManager(self, ppath)
+            if self.config.get(PERSIST_RECOVER):
+                self.persist.recover()
+            self.persist.start_background()
 
     def reshard(self, devices=None) -> None:
         """Rebuild the engine's device mesh over the currently-live (or
@@ -127,6 +139,36 @@ class Context:
         ds = ingest_parquet_stream(name, path, **self._ingest_kwargs(kwargs))
         self.store.register(ds)
         return ds
+
+    def stream_ingest(self, name, df, **kwargs):
+        """Streaming append (≈ Druid realtime ingest): create the
+        datasource on the first batch, append rows after. With
+        persistence on (sdot.persist.path) each batch is journaled to
+        the write-ahead log and fsynced BEFORE it becomes queryable, so
+        a committed batch survives kill -9 (persist/wal.py). Returns the
+        new immutable Datasource value."""
+        kwargs = self._ingest_kwargs(kwargs)
+        if self.persist is not None:
+            return self.persist.stream_ingest(name, df, kwargs)
+        from spark_druid_olap_tpu.segment.append import apply_stream_ingest
+        return apply_stream_ingest(self, name, df, kwargs)
+
+    def checkpoint(self, name: Optional[str] = None):
+        """Publish snapshot(s) to deep storage (requires
+        sdot.persist.path). ``name=None`` checkpoints every complete
+        datasource. Returns the checkpoint summaries."""
+        if self.persist is None:
+            raise RuntimeError(
+                "persistence is disabled; set sdot.persist.path")
+        if name is not None:
+            return [self.persist.checkpoint(name)]
+        return self.persist.checkpoint_all()
+
+    def close(self) -> None:
+        """Stop background machinery (the persist checkpointer). Safe to
+        call more than once; the context remains usable for queries."""
+        if self.persist is not None:
+            self.persist.stop()
 
     def register_star_schema(self, star_schema) -> None:
         self.catalog.register_star_schema(star_schema)

@@ -63,6 +63,27 @@ class StarSchema:
             raise StarSchemaError(
                 f"tables not reachable from fact {self.fact_table!r}: {bad}")
 
+    # -- persistence (persist/manager.py catalog.json) -------------------------
+    def to_dict(self) -> dict:
+        return {
+            "factTable": self.fact_table,
+            "flatDatasource": self.flat_datasource,
+            "relations": [
+                {"leftTable": r.left_table, "rightTable": r.right_table,
+                 "joinColumns": [list(p) for p in r.join_columns],
+                 "relationType": r.relation_type}
+                for r in self.relations],
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "StarSchema":
+        rels = [StarRelation(
+            left_table=r["leftTable"], right_table=r["rightTable"],
+            join_columns=tuple((p[0], p[1]) for p in r["joinColumns"]),
+            relation_type=r.get("relationType", "n-1"))
+            for r in d.get("relations", ())]
+        return StarSchema(d["factTable"], d["flatDatasource"], rels)
+
     def tables(self) -> Set[str]:
         out = {self.fact_table}
         for r in self.relations:

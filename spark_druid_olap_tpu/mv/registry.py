@@ -237,6 +237,39 @@ def clear_rollups(ctx, datasource: Optional[str] = None) -> None:
             pass
 
 
+def rollup_to_dict(r: RollupDef) -> dict:
+    """JSON form of a rollup definition for persist/'s catalog.json.
+    ``built_version`` rides along so post-recovery staleness checks
+    compare against the RESTORED base ingest version — a rollup stale at
+    crash time is still stale (and bypassed) after recovery."""
+    from spark_druid_olap_tpu.ir.serde import expr_to_dict
+    return {
+        "name": r.name, "base": r.base, "backing": r.backing,
+        "dims": list(r.dims),
+        "aggs": [expr_to_dict(e) for e in r.agg_exprs],
+        "granularity": r.granularity,
+        "timeColumn": r.time_column,
+        "builtVersion": int(r.built_version),
+        "timeIdentity": bool(r.time_identity),
+        # agg_key tuples are (kind, field, sql|None, filter_repr) — all
+        # JSON scalars; lists round-trip back to tuples below
+        "aggMap": [[list(k), v] for k, v in r.agg_map.items()],
+    }
+
+
+def rollup_from_dict(d: dict) -> RollupDef:
+    from spark_druid_olap_tpu.ir.serde import expr_from_dict
+    return RollupDef(
+        name=d["name"], base=d["base"], backing=d["backing"],
+        dims=tuple(d["dims"]),
+        agg_exprs=tuple(expr_from_dict(e) for e in d["aggs"]),
+        granularity=d.get("granularity"),
+        time_column=d.get("timeColumn"),
+        built_version=int(d.get("builtVersion", -1)),
+        time_identity=bool(d.get("timeIdentity", False)),
+        agg_map={tuple(k): v for k, v in d.get("aggMap", ())})
+
+
 def rollups_view(ctx) -> pd.DataFrame:
     """``sys_rollups`` / ``GET /metadata/rollups`` — one row per rollup."""
     from spark_druid_olap_tpu.mv.match import is_fresh

@@ -894,6 +894,13 @@ class QueryEngine:
 
     def _execute_admitted(self, q: S.QuerySpec, t0: float) -> QueryResult:
         try:
+            pinfo = self.store.recovery_info.get(
+                getattr(q, "datasource", None))
+            if pinfo is not None:
+                # the datasource was rebuilt from deep storage this
+                # session — surface where it came from (snapshot / wal /
+                # both) and what checksum verification cost
+                self.last_stats["persist"] = dict(pinfo)
             cache = self.result_cache
             use_cache = cache.enabled and cache.cacheable(q)
             if use_cache:

@@ -1,0 +1,22 @@
+"""Durable segment persistence: deep storage, ingest WAL, crash recovery.
+
+The in-tree replacement for the durability tier the reference delegates
+to Druid (deep storage + segment publish/handoff + metadata store):
+
+- :mod:`spark_druid_olap_tpu.persist.snapshot` — versioned on-disk
+  snapshot format (per-column binary blobs + JSON manifest with schema,
+  segment map, ingest version, per-file CRC32 checksums), published via
+  atomic temp-dir + rename.
+- :mod:`spark_druid_olap_tpu.persist.wal` — framed, checksummed
+  write-ahead journal for ``stream_ingest`` appends (commit point =
+  journal fsync), torn-tail tolerant replay.
+- :mod:`spark_druid_olap_tpu.persist.manager` — checkpoint / recovery
+  orchestration: background checkpointer, catalog + rollup-registry +
+  ingest-version restore, corrupt-snapshot quarantine, history-driven
+  warmup ordering.
+
+Configured by the ``sdot.persist.*`` family (utils/config.py); disabled
+entirely when ``sdot.persist.path`` is empty.
+"""
+
+from spark_druid_olap_tpu.persist.manager import PersistManager  # noqa: F401

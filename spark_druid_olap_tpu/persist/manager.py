@@ -1,0 +1,536 @@
+"""Checkpoint / recovery orchestration for the persist subsystem.
+
+One :class:`PersistManager` per Context (created when
+``sdot.persist.path`` is set). It owns:
+
+- **Durable stream ingest**: ``Context.stream_ingest`` routes here; the
+  batch is journaled (WAL append + fsync = commit point) BEFORE the
+  in-memory store registers it, so a ``kill -9`` at any instant loses at
+  most the batch whose commit was never acknowledged.
+- **Checkpoints**: fold a datasource's current in-memory state into a
+  published snapshot (persist/snapshot.py) and truncate the WAL records
+  the snapshot now covers. Explicit (``CHECKPOINT`` SQL /
+  ``Context.checkpoint()``) or via the background checkpointer
+  (``sdot.persist.checkpoint.interval.seconds`` cadence,
+  ``sdot.persist.checkpoint.max.bytes`` per-pass byte budget).
+- **Recovery**: at Context creation (``sdot.persist.recover.on.start``),
+  reload snapshots in history-driven warmup order (most recently queried
+  first), verify checksums (quarantining corrupt versions and falling
+  back to older ones), replay each WAL tail, and restore the catalog:
+  star schemas, lookups, rollup definitions, and — critically — the
+  per-datasource *ingest-version counters*, so result-cache invalidation
+  and rollup staleness semantics are exactly what they were before the
+  crash (a rollup stale at kill time is still stale, and bypassed, after
+  recovery).
+
+Version-restore contract: ``SegmentStore.restore`` pins the recovered
+datasource's ingest version to the manifest's value and advances the
+global counter to at least it; WAL-replayed appends then bump versions
+normally. Consequences: (a) a rollup whose ``built_version`` equals the
+manifest version is fresh again after recovery iff no later append
+exists; (b) any WAL tail on the base makes it stale — never served.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Dict, List, Optional
+
+import pandas as pd
+
+from spark_druid_olap_tpu.persist import snapshot as SNAP
+from spark_druid_olap_tpu.persist import wal as WAL
+
+CATALOG_FILE = "catalog.json"
+
+
+def _ds_bytes(ds) -> int:
+    total = 0
+    if ds.time is not None:
+        total += ds.time.days.nbytes + ds.time.ms_in_day.nbytes
+    for d in ds.dims.values():
+        total += d.codes.nbytes
+        if d.validity is not None:
+            total += d.validity.nbytes
+    for m in ds.metrics.values():
+        total += m.values.nbytes
+        if m.validity is not None:
+            total += m.validity.nbytes
+    return total
+
+
+class PersistManager:
+    def __init__(self, ctx, root: str):
+        from spark_druid_olap_tpu.utils.config import (
+            PERSIST_CHECKPOINT_MAX_BYTES,
+            PERSIST_CHECKPOINT_SECONDS,
+            PERSIST_KEEP_SNAPSHOTS,
+            PERSIST_VERIFY_CHECKSUMS,
+            PERSIST_WAL_FSYNC,
+        )
+        self.ctx = ctx
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.lock = threading.RLock()
+        cfg = ctx.config
+        self.wal_fsync = bool(cfg.get(PERSIST_WAL_FSYNC))
+        self.keep = int(cfg.get(PERSIST_KEEP_SNAPSHOTS))
+        self.verify = bool(cfg.get(PERSIST_VERIFY_CHECKSUMS))
+        self.interval_s = float(cfg.get(PERSIST_CHECKPOINT_SECONDS))
+        self.pass_budget = int(cfg.get(PERSIST_CHECKPOINT_MAX_BYTES))
+        self._wals: Dict[str, WAL.WriteAheadLog] = {}
+        self._wal_seq: Dict[str, int] = {}      # last seq written, per ds
+        self._dirty = set()                     # names needing a checkpoint
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.counters = {"checkpoints": 0, "checkpoint_bytes": 0,
+                         "wal_appends": 0, "wal_replayed": 0,
+                         "quarantined": 0, "errors": 0}
+        self.recovery_report: Optional[dict] = None
+        ctx.store.add_listener(self._on_store_event)
+
+    # -- paths ----------------------------------------------------------------
+    def _ds_root(self, name: str) -> str:
+        return os.path.join(self.root, SNAP.sanitize(name))
+
+    def _wal_for(self, name: str) -> WAL.WriteAheadLog:
+        w = self._wals.get(name)
+        if w is None:
+            w = self._wals[name] = WAL.WriteAheadLog(
+                os.path.join(self._ds_root(name), "wal.log"),
+                fsync=self.wal_fsync)
+        return w
+
+    def _next_seq(self, name: str) -> int:
+        seq = self._wal_seq.get(name)
+        if seq is None:
+            seq = self._wal_for(name).last_seq() or 0
+            root = self._ds_root(name)
+            cur = SNAP.current_version(root)
+            if cur is not None:
+                try:
+                    seq = max(seq, int(SNAP.load_manifest(
+                        root, cur).get("wal_seq", 0)))
+                except (OSError, ValueError):
+                    pass
+        seq += 1
+        self._wal_seq[name] = seq
+        return seq
+
+    # -- store events ---------------------------------------------------------
+    def _on_store_event(self, event: str, name: Optional[str]) -> None:
+        # register (ingest / append / replay) marks dirty for the
+        # background checkpointer; restore comes FROM disk and is clean
+        if event == "register":
+            self._dirty.add(name)
+        elif event == "drop":
+            self._dirty.discard(name)
+            self._wal_seq.pop(name, None)
+        elif event == "clear":
+            self._dirty.clear()
+            self._wal_seq.clear()
+
+    # -- durable stream ingest ------------------------------------------------
+    def stream_ingest(self, name: str, df: pd.DataFrame,
+                      kwargs: dict):
+        from spark_druid_olap_tpu.segment.append import (
+            apply_stream_ingest, wal_kwargs_to_dict)
+        with self.lock:
+            store = self.ctx.store
+            existing = store._datasources.get(name)
+            if existing is not None and len(df) == 0:
+                return existing     # no-op: nothing to journal or apply
+            if existing is not None \
+                    and SNAP.current_version(self._ds_root(name)) is None:
+                # first append to a datasource that was batch-ingested in
+                # memory only: a WAL replay needs a base to append onto,
+                # so publish one synchronously before journaling
+                self.checkpoint(name)
+            kind = "create" if existing is None else "append"
+            header = {"seq": self._next_seq(name), "datasource": name,
+                      "kind": kind,
+                      "kwargs": wal_kwargs_to_dict(kwargs)}
+            body = WAL.encode_batch(df)
+            self._wal_for(name).append(header, body)   # <-- commit point
+            self.counters["wal_appends"] += 1
+            return apply_stream_ingest(self.ctx, name, df, kwargs)
+
+    # -- checkpoint -----------------------------------------------------------
+    def checkpoint(self, name: str) -> dict:
+        """Publish one datasource's current state; returns a summary."""
+        with self.lock:
+            ds = self.ctx.store.get(name)
+            ds.require_complete("checkpoint")
+            iv = self.ctx.store.datasource_version(name)
+            wal_seq = self._wal_seq.get(name)
+            if wal_seq is None:
+                wal_seq = self._wal_for(name).last_seq() or 0
+                self._wal_seq[name] = wal_seq
+            manifest = SNAP.write_snapshot(
+                self._ds_root(name), ds, iv, wal_seq, keep=self.keep)
+            # snapshot covers every journaled record — drop them
+            self._wal_for(name).truncate_through(wal_seq)
+            self._dirty.discard(name)
+            self.counters["checkpoints"] += 1
+            self.counters["checkpoint_bytes"] += int(manifest["bytes"])
+            self._write_catalog()
+            return {"datasource": name, "version": iv,
+                    "rows": manifest["num_rows"],
+                    "bytes": manifest["bytes"]}
+
+    def checkpoint_all(self, only_dirty: bool = False,
+                       byte_budget: Optional[int] = None) -> List[dict]:
+        """Checkpoint every (or every dirty) complete datasource; with a
+        byte budget, snapshot in ascending size order until the pass
+        would exceed it (the rest stay dirty for the next pass)."""
+        out = []
+        with self.lock:
+            store = self.ctx.store
+            names = [n for n in store.names()
+                     if not only_dirty or n in self._dirty]
+            sized = []
+            for n in names:
+                try:
+                    ds = store.get(n)
+                except KeyError:
+                    continue
+                if ds.is_partial:
+                    continue        # multi-host partials never checkpoint
+                sized.append((_ds_bytes(ds), n))
+            sized.sort()
+            spent = 0
+            for nbytes, n in sized:
+                if byte_budget and out and spent + nbytes > byte_budget:
+                    break           # always make progress on >= 1 ds
+                try:
+                    out.append(self.checkpoint(n))
+                    spent += nbytes
+                except Exception:   # noqa: BLE001 — one bad ds can't
+                    self.counters["errors"] += 1   # starve the rest
+        return out
+
+    # -- catalog (stars / rollups / lookups / warmup) -------------------------
+    def _warmup_map(self) -> Dict[str, float]:
+        """datasource -> last-queried unix time, merged over the previous
+        catalog file and this session's query history (drives recovery
+        load order: hot datasources first)."""
+        warm: Dict[str, float] = {}
+        old = self._read_catalog()
+        for k, v in (old.get("warmup") or {}).items():
+            warm[k] = float(v)
+        hist = getattr(self.ctx, "history", None)
+        if hist is not None:
+            for rec in hist.entries():
+                # raw engine queries carry the datasource on the record;
+                # SQL statements carry it in the engine stats they copied
+                ds = rec.datasource or (rec.stats or {}).get("datasource")
+                if isinstance(ds, str):
+                    warm[ds] = max(warm.get(ds, 0.0), float(rec.started_at))
+        return warm
+
+    def _write_catalog(self) -> None:
+        from spark_druid_olap_tpu.mv.registry import rollup_to_dict
+        stars = [s.to_dict()
+                 for s in self.ctx.catalog.star_schemas.values()]
+        rollups = [rollup_to_dict(r)
+                   for r in getattr(self.ctx, "rollups", {}).values()]
+        doc = {"format": SNAP.FORMAT_VERSION,
+               "stars": stars, "rollups": rollups,
+               "lookups": dict(getattr(self.ctx, "lookups", {}) or {}),
+               "warmup": self._warmup_map(),
+               "written_at": time.time()}
+        tmp = os.path.join(self.root, CATALOG_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.root, CATALOG_FILE))
+
+    def _read_catalog(self) -> dict:
+        try:
+            with open(os.path.join(self.root, CATALOG_FILE)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    # -- recovery -------------------------------------------------------------
+    def _ds_dirs(self) -> Dict[str, str]:
+        """datasource name -> directory, discovered from manifests (and
+        WAL headers for never-checkpointed datasources)."""
+        out = {}
+        try:
+            entries = sorted(os.listdir(self.root))
+        except OSError:
+            return out
+        for n in entries:
+            p = os.path.join(self.root, n)
+            if not os.path.isdir(p) or n.startswith("."):
+                continue
+            name = None
+            cur = SNAP.current_version(p)
+            if cur is not None:
+                try:
+                    name = SNAP.load_manifest(p, cur)["datasource"]
+                except (OSError, ValueError, KeyError):
+                    name = None
+            if name is None:
+                for h, _ in WAL.WriteAheadLog(
+                        os.path.join(p, "wal.log")).replay():
+                    name = h.get("datasource")
+                    break
+            if name is not None:
+                out[name] = p
+        return out
+
+    def _recover_datasource(self, name: str, dirpath: str,
+                            report: dict) -> Optional[dict]:
+        from spark_druid_olap_tpu.segment.append import (
+            apply_stream_ingest, wal_kwargs_from_dict)
+        manifest = None
+        verify_ms = 0.0
+        loaded_version = None
+        versions = SNAP.list_versions(dirpath)
+        cur = SNAP.current_version(dirpath)
+        candidates = ([cur] if cur is not None else []) \
+            + [v for v in sorted(versions, reverse=True) if v != cur]
+        for v in candidates:
+            try:
+                ds, manifest, verify_ms = SNAP.load_snapshot(
+                    dirpath, v, verify=self.verify)
+                loaded_version = v
+                break
+            except SNAP.SnapshotCorrupt as e:
+                qpath = SNAP.quarantine_version(dirpath, v)
+                self.counters["quarantined"] += 1
+                report["quarantined"].append(
+                    {"datasource": name, "version": v,
+                     "reason": str(e), "moved_to": qpath})
+                manifest = None
+        if manifest is not None:
+            self.ctx.store.restore(ds, int(manifest["ingest_version"]))
+        covered = int(manifest["wal_seq"]) if manifest is not None else 0
+        replayed = 0
+        wal = self._wal_for(name)
+        for header, body in wal.replay():
+            seq = int(header.get("seq", 0))
+            if seq <= covered:
+                continue
+            try:
+                df = WAL.decode_batch(body)
+                kwargs = wal_kwargs_from_dict(header.get("kwargs") or {})
+                apply_stream_ingest(self.ctx, name, df, kwargs)
+            except Exception as e:  # noqa: BLE001 — recovery must finish
+                self.counters["errors"] += 1
+                report["errors"].append(
+                    {"datasource": name, "seq": seq, "reason": str(e)})
+                break
+            replayed += 1
+            self._wal_seq[name] = max(self._wal_seq.get(name, 0), seq)
+        self.counters["wal_replayed"] += replayed
+        if manifest is None and replayed == 0:
+            return None
+        source = ("snapshot+wal" if manifest is not None and replayed
+                  else "snapshot" if manifest is not None else "wal")
+        info = {"source": source,
+                "snapshot_version": loaded_version,
+                "checksum_verify_ms": round(verify_ms, 3),
+                "wal_records": replayed}
+        report["datasources"].append({"datasource": name, **info})
+        return info
+
+    def recover(self) -> dict:
+        """Reload every persisted datasource + the catalog; returns (and
+        stores) a recovery report."""
+        t0 = time.perf_counter()
+        with self.lock:
+            report = {"datasources": [], "quarantined": [], "errors": [],
+                      "order": []}
+            catalog = self._read_catalog()
+            warm = {k: float(v)
+                    for k, v in (catalog.get("warmup") or {}).items()}
+            dirs = self._ds_dirs()
+            # history-driven warmup: most recently queried first, then
+            # rollup backings (queries hit them via rewrite), then name
+            order = sorted(
+                dirs, key=lambda n: (-warm.get(n, 0.0), n))
+            report["order"] = list(order)
+            recovery_info = {}
+            for name in order:
+                info = self._recover_datasource(name, dirs[name], report)
+                if info is not None:
+                    recovery_info[name] = info
+            # catalog: lookups, star schemas, rollup definitions
+            for lname, table in (catalog.get("lookups") or {}).items():
+                self.ctx.lookups.setdefault(lname, table)
+            from spark_druid_olap_tpu.metadata.star import StarSchema
+            for sd in catalog.get("stars") or ():
+                try:
+                    star = StarSchema.from_dict(sd)
+                    self.ctx.catalog.register_star_schema(star)
+                except Exception as e:  # noqa: BLE001
+                    report["errors"].append(
+                        {"star": sd.get("factTable"), "reason": str(e)})
+            from spark_druid_olap_tpu.mv.registry import rollup_from_dict
+            for rd in catalog.get("rollups") or ():
+                try:
+                    r = rollup_from_dict(rd)
+                except Exception as e:  # noqa: BLE001
+                    report["errors"].append(
+                        {"rollup": rd.get("name"), "reason": str(e)})
+                    continue
+                if r.backing in self.ctx.store._datasources:
+                    self.ctx.rollups[r.name] = r
+            self.ctx.store.recovery_info = recovery_info
+            report["total_ms"] = round(
+                (time.perf_counter() - t0) * 1000, 2)
+            self.recovery_report = report
+            return report
+
+    def restore(self, name: Optional[str] = None) -> dict:
+        """In-session ``RESTORE``: rewind the in-memory state to the last
+        published snapshot (+ committed WAL tail). Ingest-version
+        counters rewind with it, so every derived cache must drop — the
+        session layer clears the engine caches after calling this."""
+        with self.lock:
+            dirs = self._ds_dirs()
+            if name is not None:
+                if name not in dirs:
+                    raise KeyError(
+                        f"no snapshot or WAL on disk for {name!r} "
+                        f"under {self.root}")
+                dirs = {name: dirs[name]}
+            report = {"datasources": [], "quarantined": [], "errors": [],
+                      "order": sorted(dirs)}
+            recovery_info = dict(
+                getattr(self.ctx.store, "recovery_info", {}) or {})
+            for n in sorted(dirs):
+                info = self._recover_datasource(n, dirs[n], report)
+                if info is not None:
+                    recovery_info[n] = info
+            self.ctx.store.recovery_info = recovery_info
+            self.recovery_report = report
+            return report
+
+    # -- purge ----------------------------------------------------------------
+    def purge(self, name: Optional[str] = None) -> int:
+        """Delete on-disk snapshots/WALs (CLEAR METADATA ... PURGE).
+        Returns the number of datasource directories removed."""
+        with self.lock:
+            removed = 0
+            if name is not None:
+                p = self._ds_root(name)
+                self._wals.pop(name, None)
+                self._wal_seq.pop(name, None)
+                self._dirty.discard(name)
+                if os.path.isdir(p):
+                    shutil.rmtree(p, ignore_errors=True)
+                    removed = 1
+                return removed
+            for n, p in self._ds_dirs().items():
+                shutil.rmtree(p, ignore_errors=True)
+                removed += 1
+            try:
+                os.remove(os.path.join(self.root, CATALOG_FILE))
+            except OSError:
+                pass
+            self._wals.clear()
+            self._wal_seq.clear()
+            self._dirty.clear()
+            return removed
+
+    # -- background checkpointer ----------------------------------------------
+    def start_background(self) -> None:
+        if self.interval_s <= 0 or self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._bg_loop, name="sdot-checkpointer", daemon=True)
+        self._thread.start()
+
+    def _bg_loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.checkpoint_all(
+                    only_dirty=True,
+                    byte_budget=self.pass_budget or None)
+            except Exception:  # noqa: BLE001 — the loop must survive
+                self.counters["errors"] += 1
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+        with self.lock:
+            for w in self._wals.values():
+                w.close()
+
+    # -- observability --------------------------------------------------------
+    def snapshots_view(self) -> pd.DataFrame:
+        """``sys_snapshots``: one row per published snapshot version plus
+        one per quarantined version."""
+        rows = []
+        with self.lock:
+            for name, dirpath in sorted(self._ds_dirs().items()):
+                cur = SNAP.current_version(dirpath)
+                wal_bytes = self._wal_for(name).size_bytes()
+                for v in SNAP.list_versions(dirpath):
+                    try:
+                        m = SNAP.load_manifest(dirpath, v)
+                    except (OSError, ValueError):
+                        m = {}
+                    rows.append({
+                        "datasource": name, "version": v,
+                        "state": "published",
+                        "current": bool(v == cur),
+                        "rows": int(m.get("num_rows", 0)),
+                        "bytes": int(m.get("bytes", 0)),
+                        "wal_seq": int(m.get("wal_seq", 0)),
+                        "wal_bytes": int(wal_bytes),
+                        "dirty": name in self._dirty,
+                        "created_at": float(m.get("created_at", 0.0)),
+                    })
+                qdir = os.path.join(dirpath, SNAP.QUARANTINE_DIR)
+                if os.path.isdir(qdir):
+                    for q in sorted(os.listdir(qdir)):
+                        rows.append({
+                            "datasource": name, "version": -1,
+                            "state": f"quarantined:{q}",
+                            "current": False, "rows": 0, "bytes": 0,
+                            "wal_seq": 0, "wal_bytes": int(wal_bytes),
+                            "dirty": name in self._dirty,
+                            "created_at": 0.0})
+        cols = ["datasource", "version", "state", "current", "rows",
+                "bytes", "wal_seq", "wal_bytes", "dirty", "created_at"]
+        return pd.DataFrame(rows, columns=cols)
+
+    def stats(self) -> dict:
+        """``GET /metadata/persist`` payload."""
+        with self.lock:
+            per_ds = {}
+            for name, dirpath in self._ds_dirs().items():
+                per_ds[name] = {
+                    "currentVersion": SNAP.current_version(dirpath),
+                    "versions": SNAP.list_versions(dirpath),
+                    "walBytes": self._wal_for(name).size_bytes(),
+                    "dirty": name in self._dirty,
+                }
+            return {
+                "enabled": True,
+                "path": self.root,
+                "datasources": per_ds,
+                "dirty": sorted(self._dirty),
+                "counters": dict(self.counters),
+                "background": {
+                    "intervalSeconds": self.interval_s,
+                    "passByteBudget": self.pass_budget,
+                    "running": self._thread is not None
+                    and self._thread.is_alive(),
+                },
+                "recovery": self.recovery_report,
+            }

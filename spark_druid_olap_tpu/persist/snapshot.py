@@ -1,0 +1,324 @@
+"""Versioned on-disk snapshot format for datasources (deep storage).
+
+Layout under ``<root>/<datasource-dir>/``::
+
+    CURRENT                   # JSON pointer {"version": N}, atomic replace
+    v<NNNNNNNNNN>/            # one published snapshot (N = ingest version)
+      manifest.json           # schema, segment map, versions, checksums
+      time_days.bin ...       # per-column raw little-endian blobs
+      dim_NNNN_dict.json      # sorted global dictionaries (NNNN = dim index)
+    wal.log                   # stream-ingest journal (persist/wal.py)
+    quarantine/               # checksum-failing versions moved aside
+
+Publish protocol (≈ Druid's segment push to deep storage + metadata
+commit): write every blob into a hidden temp dir, fsync each file, then
+``os.replace`` the temp dir to its version name and atomically rewrite
+CURRENT. A crash at any point leaves either the old CURRENT (temp dirs
+are garbage-collected on the next publish) or the new one — never a
+half-published snapshot.
+
+Every blob carries a CRC32 in the manifest; recovery verifies them
+(``sdot.persist.verify.checksums``) and quarantines the version on any
+mismatch instead of serving silently corrupt columns.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+FORMAT_VERSION = 1
+MANIFEST = "manifest.json"
+CURRENT = "CURRENT"
+QUARANTINE_DIR = "quarantine"
+
+
+def sanitize(name: str) -> str:
+    """Datasource name -> filesystem-safe directory name (dotted database
+    prefixes are fine; path separators and leading dots are not)."""
+    out = name.replace(os.sep, "%2F").replace("/", "%2F")
+    return "_" + out if out.startswith(".") else out
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
+
+
+def _write_blob(dirpath: str, rel: str, data: bytes,
+                files: Dict[str, dict], meta: dict) -> None:
+    with open(os.path.join(dirpath, rel), "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    files[rel] = {"crc": zlib.crc32(data), "bytes": len(data), **meta}
+
+
+def _array_blob(dirpath: str, rel: str, arr: np.ndarray,
+                files: Dict[str, dict]) -> None:
+    _write_blob(dirpath, rel, arr.tobytes(),
+                files, {"dtype": arr.dtype.str, "shape": list(arr.shape)})
+
+
+def version_dirname(version: int) -> str:
+    return f"v{int(version):010d}"
+
+
+def list_versions(ds_root: str) -> List[int]:
+    try:
+        names = os.listdir(ds_root)
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        if n.startswith("v") and n[1:].isdigit() \
+                and os.path.isdir(os.path.join(ds_root, n)):
+            out.append(int(n[1:]))
+    return sorted(out)
+
+
+def current_version(ds_root: str) -> Optional[int]:
+    """The published version per CURRENT; falls back to the newest
+    on-disk version dir when the pointer is missing or unreadable."""
+    try:
+        with open(os.path.join(ds_root, CURRENT)) as f:
+            v = int(json.load(f)["version"])
+        if os.path.isdir(os.path.join(ds_root, version_dirname(v))):
+            return v
+    except (OSError, ValueError, KeyError):
+        pass
+    versions = list_versions(ds_root)
+    return versions[-1] if versions else None
+
+
+def write_snapshot(ds_root: str, ds, ingest_version: int,
+                   wal_seq: int, keep: int = 2) -> dict:
+    """Publish one snapshot of a COMPLETE datasource; returns the
+    manifest. Atomic: temp dir -> rename -> CURRENT pointer swap."""
+    ds.require_complete("checkpoint")
+    os.makedirs(ds_root, exist_ok=True)
+    # collect temp dirs a crashed previous publish left behind
+    for n in os.listdir(ds_root):
+        if n.startswith(".tmp-"):
+            shutil.rmtree(os.path.join(ds_root, n), ignore_errors=True)
+    tmp = os.path.join(ds_root, f".tmp-{os.getpid()}-{ingest_version}")
+    os.makedirs(tmp, exist_ok=True)
+
+    files: Dict[str, dict] = {}
+    manifest = {
+        "format": FORMAT_VERSION,
+        "datasource": ds.name,
+        "ingest_version": int(ingest_version),
+        "wal_seq": int(wal_seq),
+        "num_rows": int(ds.num_rows),
+        "created_at": time.time(),
+        "segments": [[s.id, s.start_row, s.end_row,
+                      s.min_millis, s.max_millis] for s in ds.segments],
+        "spatial": {k: list(v) for k, v in ds.spatial.items()},
+        "time": None,
+        "dims": [],
+        "metrics": [],
+    }
+    if ds.time is not None:
+        _array_blob(tmp, "time_days.bin", ds.time.days, files)
+        _array_blob(tmp, "time_ms.bin", ds.time.ms_in_day, files)
+        manifest["time"] = {"name": ds.time.name,
+                            "days": "time_days.bin", "ms": "time_ms.bin"}
+    for i, (name, d) in enumerate(ds.dims.items()):
+        codes_f = f"dim_{i:04d}_codes.bin"
+        dict_f = f"dim_{i:04d}_dict.json"
+        _array_blob(tmp, codes_f, d.codes, files)
+        _write_blob(tmp, dict_f,
+                    json.dumps([str(v) for v in d.dictionary]).encode(),
+                    files, {"json": True})
+        entry = {"name": name, "codes": codes_f, "dictionary": dict_f,
+                 "validity": None}
+        if d.validity is not None:
+            vf = f"dim_{i:04d}_valid.bin"
+            _array_blob(tmp, vf, d.validity, files)
+            entry["validity"] = vf
+        manifest["dims"].append(entry)
+    for i, (name, m) in enumerate(ds.metrics.items()):
+        vals_f = f"met_{i:04d}_values.bin"
+        _array_blob(tmp, vals_f, m.values, files)
+        entry = {"name": name, "kind": m.kind.value, "values": vals_f,
+                 "validity": None}
+        if m.validity is not None:
+            vf = f"met_{i:04d}_valid.bin"
+            _array_blob(tmp, vf, m.validity, files)
+            entry["validity"] = vf
+        manifest["metrics"].append(entry)
+    manifest["files"] = files
+    manifest["bytes"] = sum(e["bytes"] for e in files.values())
+
+    with open(os.path.join(tmp, MANIFEST), "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+
+    final = os.path.join(ds_root, version_dirname(ingest_version))
+    if os.path.exists(final):
+        # re-publish of the same ingest version (e.g. WAL folded in):
+        # replace via a two-step swap; the old dir goes to a temp name
+        old = final + f".old-{os.getpid()}"
+        os.replace(final, old)
+        os.replace(tmp, final)
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.replace(tmp, final)
+    _fsync_dir(ds_root)
+    _write_current(ds_root, int(ingest_version))
+    prune(ds_root, keep=keep, current=int(ingest_version))
+    return manifest
+
+
+def _write_current(ds_root: str, version: int) -> None:
+    tmp = os.path.join(ds_root, CURRENT + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump({"version": int(version)}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(ds_root, CURRENT))
+    _fsync_dir(ds_root)
+
+
+def prune(ds_root: str, keep: int, current: int) -> None:
+    """Retain the newest ``keep`` versions (always including the current
+    one); remove the rest."""
+    keep = max(1, int(keep))
+    versions = list_versions(ds_root)
+    retained = set(sorted(versions)[-keep:]) | {int(current)}
+    for v in versions:
+        if v not in retained:
+            shutil.rmtree(os.path.join(ds_root, version_dirname(v)),
+                          ignore_errors=True)
+
+
+def load_manifest(ds_root: str, version: int) -> dict:
+    with open(os.path.join(ds_root, version_dirname(version),
+                           MANIFEST)) as f:
+        return json.load(f)
+
+
+class SnapshotCorrupt(Exception):
+    """A snapshot file failed checksum / structural verification."""
+
+
+def _read_blob(vdir: str, rel: str, files: dict, verify: bool) -> bytes:
+    try:
+        with open(os.path.join(vdir, rel), "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise SnapshotCorrupt(f"missing blob {rel}: {e}") from e
+    meta = files.get(rel)
+    if meta is None:
+        raise SnapshotCorrupt(f"blob {rel} not in manifest")
+    if len(data) != int(meta["bytes"]):
+        raise SnapshotCorrupt(
+            f"blob {rel}: {len(data)} bytes, manifest says {meta['bytes']}")
+    if verify and zlib.crc32(data) != int(meta["crc"]):
+        raise SnapshotCorrupt(f"blob {rel}: CRC32 mismatch")
+    return data
+
+
+def _read_array(vdir: str, rel: str, files: dict, verify: bool) -> np.ndarray:
+    data = _read_blob(vdir, rel, files, verify)
+    meta = files[rel]
+    arr = np.frombuffer(data, dtype=np.dtype(meta["dtype"]))
+    # writable copy: Datasource caches mutate nothing, but downstream
+    # numpy ops (e.g. in-place sorts in tests) must not hit a read-only
+    # frombuffer view
+    return arr.reshape(meta.get("shape", [-1])).copy()
+
+
+def load_snapshot(ds_root: str, version: int,
+                  verify: bool = True) -> Tuple[object, dict, float]:
+    """(Datasource, manifest, checksum_verify_ms). Raises
+    :class:`SnapshotCorrupt` on any checksum/structure failure."""
+    from spark_druid_olap_tpu.segment.column import (
+        ColumnKind, DimColumn, MetricColumn, TimeColumn)
+    from spark_druid_olap_tpu.segment.store import Datasource, Segment
+
+    t0 = time.perf_counter()
+    try:
+        manifest = load_manifest(ds_root, version)
+    except (OSError, ValueError) as e:
+        raise SnapshotCorrupt(f"unreadable manifest: {e}") from e
+    if int(manifest.get("format", -1)) != FORMAT_VERSION:
+        raise SnapshotCorrupt(
+            f"unknown snapshot format {manifest.get('format')!r}")
+    vdir = os.path.join(ds_root, version_dirname(version))
+    files = manifest.get("files", {})
+
+    time_col = None
+    if manifest["time"] is not None:
+        t = manifest["time"]
+        time_col = TimeColumn(
+            name=t["name"],
+            days=_read_array(vdir, t["days"], files, verify),
+            ms_in_day=_read_array(vdir, t["ms"], files, verify))
+    dims = {}
+    for e in manifest["dims"]:
+        dict_raw = _read_blob(vdir, e["dictionary"], files, verify)
+        try:
+            dictionary = np.asarray(json.loads(dict_raw.decode()),
+                                    dtype=object)
+        except ValueError as ex:
+            raise SnapshotCorrupt(
+                f"dictionary {e['dictionary']}: {ex}") from ex
+        dims[e["name"]] = DimColumn(
+            name=e["name"], dictionary=dictionary,
+            codes=_read_array(vdir, e["codes"], files, verify),
+            validity=None if e["validity"] is None
+            else _read_array(vdir, e["validity"], files, verify))
+    metrics = {}
+    for e in manifest["metrics"]:
+        metrics[e["name"]] = MetricColumn(
+            name=e["name"],
+            values=_read_array(vdir, e["values"], files, verify),
+            validity=None if e["validity"] is None
+            else _read_array(vdir, e["validity"], files, verify),
+            kind=ColumnKind(e["kind"]))
+    segments = [Segment(id=s[0], start_row=int(s[1]), end_row=int(s[2]),
+                        min_millis=int(s[3]), max_millis=int(s[4]))
+                for s in manifest["segments"]]
+    ds = Datasource(name=manifest["datasource"], time=time_col, dims=dims,
+                    metrics=metrics, segments=segments,
+                    spatial={k: tuple(v)
+                             for k, v in manifest["spatial"].items()})
+    if ds.num_rows != int(manifest["num_rows"]):
+        raise SnapshotCorrupt(
+            f"segment map rows {ds.num_rows} != manifest "
+            f"num_rows {manifest['num_rows']}")
+    return ds, manifest, (time.perf_counter() - t0) * 1000.0
+
+
+def quarantine_version(ds_root: str, version: int) -> Optional[str]:
+    """Move a corrupt snapshot version aside (never deleted — an operator
+    may want the evidence) and return its new path."""
+    src = os.path.join(ds_root, version_dirname(version))
+    if not os.path.isdir(src):
+        return None
+    qdir = os.path.join(ds_root, QUARANTINE_DIR)
+    os.makedirs(qdir, exist_ok=True)
+    dst = os.path.join(
+        qdir, f"{int(time.time())}-{version_dirname(version)}")
+    i = 0
+    while os.path.exists(dst):
+        i += 1
+        dst = os.path.join(
+            qdir, f"{int(time.time())}-{version_dirname(version)}.{i}")
+    os.replace(src, dst)
+    return dst

@@ -1,0 +1,151 @@
+"""Write-ahead journal for stream-ingest appends.
+
+One journal file per datasource. A committed batch survives ``kill -9``:
+the commit point is the journal append + fsync, which happens BEFORE the
+in-memory store registers the new rows — crash after the fsync replays
+the batch at recovery; crash before it loses only the uncommitted batch
+(which the caller never saw acknowledged).
+
+Record framing (little-endian):
+
+    [4B magic 'SDWL'][4B u32 header_len][8B u64 body_len]
+    [4B u32 crc32(header + body)][header JSON][body bytes]
+
+The header is a small JSON dict (record seq, datasource, kind, ingest
+kwargs); the body is the batch itself as an Arrow IPC stream. Replay
+reads records until EOF and STOPS at the first short or checksum-failing
+record — a torn tail from a crash mid-append is expected, not an error.
+Everything before it is intact by CRC.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Iterator, List, Optional, Tuple
+
+_MAGIC = b"SDWL"
+_FRAME = struct.Struct("<4sIQI")
+
+
+def encode_batch(df) -> bytes:
+    """pandas DataFrame -> Arrow IPC stream bytes (schema included)."""
+    import pyarrow as pa
+    table = pa.Table.from_pandas(df, preserve_index=False)
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue()
+
+
+def decode_batch(body: bytes):
+    """Arrow IPC stream bytes -> pandas DataFrame."""
+    import pyarrow as pa
+    with pa.ipc.open_stream(io.BytesIO(body)) as r:
+        return r.read_all().to_pandas()
+
+
+class WriteAheadLog:
+    """Append-only framed journal with crash-tolerant replay."""
+
+    def __init__(self, path: str, fsync: bool = True):
+        self.path = path
+        self.fsync = fsync
+        self._f = None
+
+    # -- write ----------------------------------------------------------------
+    def _file(self):
+        if self._f is None or self._f.closed:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            self._f = open(self.path, "ab")
+        return self._f
+
+    def append(self, header: dict, body: bytes) -> None:
+        """Write one record; on return (with fsync on) it is durable."""
+        hdr = json.dumps(header, separators=(",", ":")).encode()
+        crc = zlib.crc32(hdr)
+        crc = zlib.crc32(body, crc)
+        f = self._file()
+        f.write(_FRAME.pack(_MAGIC, len(hdr), len(body), crc))
+        f.write(hdr)
+        f.write(body)
+        f.flush()
+        if self.fsync:
+            os.fsync(f.fileno())
+
+    def close(self) -> None:
+        if self._f is not None and not self._f.closed:
+            self._f.close()
+
+    # -- read -----------------------------------------------------------------
+    def replay(self) -> Iterator[Tuple[dict, bytes]]:
+        """Yield (header, body) for every INTACT record, stopping at the
+        first torn/corrupt one (crash tail). Missing file = no records."""
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            while True:
+                frame = f.read(_FRAME.size)
+                if len(frame) < _FRAME.size:
+                    return                      # clean EOF or torn frame
+                magic, hlen, blen, crc = _FRAME.unpack(frame)
+                if magic != _MAGIC:
+                    return                      # corrupt frame boundary
+                hdr = f.read(hlen)
+                body = f.read(blen)
+                if len(hdr) < hlen or len(body) < blen:
+                    return                      # torn tail
+                c = zlib.crc32(hdr)
+                if zlib.crc32(body, c) != crc:
+                    return                      # bit rot / torn overwrite
+                try:
+                    header = json.loads(hdr.decode())
+                except ValueError:
+                    return
+                yield header, body
+
+    def records(self) -> List[Tuple[dict, bytes]]:
+        return list(self.replay())
+
+    # -- maintenance ----------------------------------------------------------
+    def size_bytes(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def truncate_through(self, seq: int) -> None:
+        """Drop every intact record with ``header['seq'] <= seq`` (they
+        are folded into a published snapshot) by atomically rewriting the
+        journal with the surviving tail. The torn tail (if any) is
+        discarded too — it was never committed."""
+        keep = [(h, b) for h, b in self.replay()
+                if int(h.get("seq", 0)) > seq]
+        self.close()
+        if not keep:
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            for header, body in keep:
+                hdr = json.dumps(header, separators=(",", ":")).encode()
+                c = zlib.crc32(hdr)
+                c = zlib.crc32(body, c)
+                f.write(_FRAME.pack(_MAGIC, len(hdr), len(body), c))
+                f.write(hdr)
+                f.write(body)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def last_seq(self) -> Optional[int]:
+        last = None
+        for h, _ in self.replay():
+            last = int(h.get("seq", 0))
+        return last

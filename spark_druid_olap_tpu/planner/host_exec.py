@@ -61,6 +61,16 @@ def _sys_queries(ctx):
     return pd.DataFrame(rows)
 
 
+def _sys_snapshots(ctx):
+    """Deep-storage state (persist/): empty frame with the view's schema
+    when persistence is off — the view stays queryable either way."""
+    if getattr(ctx, "persist", None) is not None:
+        return ctx.persist.snapshots_view()
+    cols = ["datasource", "version", "state", "current", "rows",
+            "bytes", "wal_seq", "wal_bytes", "dirty", "created_at"]
+    return pd.DataFrame(columns=cols)
+
+
 SYS_VIEWS = {
     "sys_datasources": lambda ctx: ctx.catalog.datasources_view(),
     "sys_segments": lambda ctx: ctx.catalog.segments_view(),
@@ -68,6 +78,7 @@ SYS_VIEWS = {
     "sys_queries": _sys_queries,
     "sys_lanes": lambda ctx: ctx.engine.wlm.lanes_view(),
     "sys_rollups": _sys_rollups,
+    "sys_snapshots": _sys_snapshots,
 }
 
 

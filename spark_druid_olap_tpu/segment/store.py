@@ -587,11 +587,40 @@ class SegmentStore:
         # re-ingest or stream append structurally invalidates only that
         # datasource's cached answers.
         self._versions: Dict[str, int] = {}
+        # change listeners (persist/ dirty tracking): called as
+        # cb(event, name) with event in register|drop|clear|restore
+        self._listeners = []
+        # per-datasource recovery provenance set by persist recovery
+        # (source, snapshot version, checksum-verify ms); surfaced as
+        # stats['persist'] on queries over a recovered datasource
+        self.recovery_info: Dict[str, dict] = {}
+
+    def add_listener(self, cb) -> None:
+        self._listeners.append(cb)
+
+    def _notify(self, event: str, name) -> None:
+        for cb in self._listeners:
+            try:
+                cb(event, name)
+            except Exception:  # noqa: BLE001 — a listener never breaks
+                pass           # the store
 
     def register(self, ds: Datasource) -> None:
         self._datasources[ds.name] = ds
         self.version += 1
         self._versions[ds.name] = self.version
+        self._notify("register", ds.name)
+
+    def restore(self, ds: Datasource, ingest_version: int) -> None:
+        """Recovery-path registration: install ``ds`` under its EXACT
+        pre-crash ingest version instead of bumping. Result-cache keys
+        and rollup built_version freshness compare against these
+        numbers, so restoring them verbatim is what makes staleness
+        semantics hold across restarts (persist/manager.py)."""
+        self._datasources[ds.name] = ds
+        self._versions[ds.name] = int(ingest_version)
+        self.version = max(self.version, int(ingest_version))
+        self._notify("restore", ds.name)
 
     def get(self, name: str) -> Datasource:
         if name not in self._datasources:
@@ -603,6 +632,8 @@ class SegmentStore:
         self._datasources.pop(name, None)
         self.version += 1
         self._versions[name] = self.version
+        self.recovery_info.pop(name, None)
+        self._notify("drop", name)
 
     def names(self) -> List[str]:
         return sorted(self._datasources)
@@ -617,3 +648,5 @@ class SegmentStore:
         self._datasources.clear()
         self.version += 1
         self._versions.clear()
+        self.recovery_info.clear()
+        self._notify("clear", None)

@@ -12,6 +12,8 @@ views. Here the endpoint is HTTP:
 - ``GET  /status``        liveness + device inventory
 - ``GET  /metadata/datasources|segments|columns``  catalog views
 - ``GET  /metadata/wlm``  workload-management state (lanes, tenants)
+- ``GET  /metadata/persist``  deep-storage state (snapshots, WAL,
+                          checkpointer counters, last recovery report)
 - ``GET  /history``       query history (≈ the Druid-queries UI tab)
 
 Workload management (wlm/) fronts every query: the request's lane /
@@ -213,6 +215,15 @@ class SqlServer:
                 # quota state — ≈ Druid's query-scheduler lane metrics
                 h._send(200, json.dumps(
                     self.ctx.engine.wlm.stats()).encode())
+                return
+            if kind == "persist":
+                # deep-storage state: per-ds snapshot versions, WAL
+                # bytes, checkpointer counters, last recovery report
+                if self.ctx.persist is None:
+                    h._send(200, b'{"enabled": false}')
+                    return
+                h._send(200, json.dumps(
+                    self.ctx.persist.stats()).encode())
                 return
             from spark_druid_olap_tpu.mv.registry import rollups_view
             views = {"datasources": self.ctx.catalog.datasources_view,

@@ -124,6 +124,24 @@ class ExplainRewrite:
 @dataclasses.dataclass(frozen=True)
 class ClearMetadata:
     datasource: Optional[str] = None
+    # PURGE: also delete the on-disk snapshots/WAL (deep storage) — a
+    # plain clear drops only the in-memory store, and recovery would
+    # resurrect persisted datasources on the next start
+    purge: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Checkpoint:
+    """``CHECKPOINT [<datasource>]`` — publish snapshot(s) to deep
+    storage (persist/); no datasource = every complete one."""
+    datasource: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Restore:
+    """``RESTORE [<datasource>]`` — rewind in-memory state to the last
+    published snapshot + committed WAL tail."""
+    datasource: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -158,4 +176,5 @@ class RefreshRollup:
 
 
 Statement = Union[SelectStmt, UnionAll, ExplainRewrite, ClearMetadata,
-                  ExecuteRawQuery, CreateRollup, DropRollup, RefreshRollup]
+                  ExecuteRawQuery, CreateRollup, DropRollup, RefreshRollup,
+                  Checkpoint, Restore]

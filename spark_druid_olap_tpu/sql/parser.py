@@ -137,10 +137,31 @@ class Parser:
             self.next()
             self.expect_kw("metadata")
             ds = None
+            purge = False
             if self.peek().kind == "ident":
-                ds = self.next().value
+                w = self.next().value
+                # trailing soft word PURGE also deletes on-disk snapshots;
+                # a datasource literally named "purge" must be cleared via
+                # CLEAR METADATA purge PURGE
+                if w.lower() == "purge" and self.peek().kind == "eof":
+                    purge = True
+                else:
+                    ds = w
+                    if self._at_word("purge"):
+                        self.next()
+                        purge = True
             self._expect_eof()
-            return A.ClearMetadata(ds)
+            return A.ClearMetadata(ds, purge=purge)
+        if self._at_word("checkpoint") or self._at_word("restore"):
+            # soft-word-led persist commands (persist/): CHECKPOINT and
+            # RESTORE stay valid identifiers everywhere else
+            word = self.next().value.lower()
+            ds = None
+            if self.peek().kind != "eof":
+                ds = self._ident()
+            self._expect_eof()
+            return A.Checkpoint(ds) if word == "checkpoint" \
+                else A.Restore(ds)
         if self.at_kw("create"):
             self.next()
             self.expect_kw("rollup")

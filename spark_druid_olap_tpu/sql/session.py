@@ -144,8 +144,14 @@ def _run_sql_inner(ctx, sql: str) -> QueryResult:
         else:
             clear_rollups(ctx)
             ctx.engine.clear_caches()  # includes the semantic result cache
+        if stmt.purge and ctx.persist is not None:
+            # PURGE extends the clear to deep storage — without it the
+            # snapshots survive and recovery resurrects the datasources
+            ctx.persist.purge(stmt.datasource)
         return QueryResult(["status"], {"status": np.array(["OK"],
                                                            dtype=object)})
+    if isinstance(stmt, (A.Checkpoint, A.Restore)):
+        return _run_persist_command(ctx, stmt)
     if isinstance(stmt, (A.CreateRollup, A.DropRollup, A.RefreshRollup)):
         from spark_druid_olap_tpu.mv.registry import handle_statement
         msg = handle_statement(ctx, stmt)
@@ -162,6 +168,29 @@ def _run_sql_inner(ctx, sql: str) -> QueryResult:
         return QueryResult(["plan"],
                            {"plan": np.array(text.split("\n"), dtype=object)})
     return _run_select(ctx, stmt, sql)
+
+
+def _run_persist_command(ctx, stmt) -> QueryResult:
+    """``CHECKPOINT [ds]`` / ``RESTORE [ds]`` (persist/manager.py)."""
+    if ctx.persist is None:
+        raise RuntimeError(
+            "persistence is disabled; set sdot.persist.path")
+    if isinstance(stmt, A.Checkpoint):
+        summaries = ctx.checkpoint(stmt.datasource)
+        msgs = [f"checkpointed {s['datasource']} v{s['version']} "
+                f"({s['rows']} rows, {s['bytes']} bytes)"
+                for s in summaries] or ["nothing to checkpoint"]
+        return QueryResult(["status"],
+                           {"status": np.array(msgs, dtype=object)})
+    report = ctx.persist.restore(stmt.datasource)
+    # the restore rewinds ingest-version counters; cached results keyed
+    # on the pre-restore versions could collide with post-restore keys,
+    # so every derived cache drops
+    ctx.engine.clear_caches()
+    msgs = [f"restored {d['datasource']} from {d['source']}"
+            for d in report["datasources"]] or ["nothing restored"]
+    return QueryResult(["status"],
+                       {"status": np.array(msgs, dtype=object)})
 
 
 def explain_sql(ctx, sql: str) -> str:

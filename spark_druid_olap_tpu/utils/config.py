@@ -347,6 +347,52 @@ WLM_BATCH_COST = _entry(
     "channel as free-form keys: 'sdot.wlm.quota.<tenant>' = "
     "'concurrent=N,budget=F,refill=F' ('default' is the template for "
     "tenants without an explicit entry).", float)
+# --- durable segment persistence (persist/) -----------------------------------
+PERSIST_PATH = _entry(
+    "sdot.persist.path", "",
+    "Root directory of the on-disk snapshot store (deep storage). Empty "
+    "disables persistence entirely: the segment store is volatile, as the "
+    "reference is without its Druid deep-storage tier. Set to a directory "
+    "to enable versioned checkpoints, the stream-ingest WAL, and startup "
+    "recovery.")
+PERSIST_ENABLED = _entry(
+    "sdot.persist.enabled", True,
+    "Master gate for the persist subsystem when sdot.persist.path is set "
+    "(lets an operator keep the path configured but run volatile).")
+PERSIST_RECOVER = _entry(
+    "sdot.persist.recover.on.start", True,
+    "Recover published snapshots + WAL tails into the segment store at "
+    "Context creation. Off = the directory is only written, never read "
+    "(fresh-start semantics with durability still on).")
+PERSIST_WAL_FSYNC = _entry(
+    "sdot.persist.wal.fsync", True,
+    "fsync the write-ahead journal before a stream_ingest batch is "
+    "considered committed. Off trades the kill -9 durability guarantee "
+    "for append throughput (an OS crash can lose the un-synced tail; "
+    "replay still stops cleanly at the first torn record).")
+PERSIST_CHECKPOINT_SECONDS = _entry(
+    "sdot.persist.checkpoint.interval.seconds", 0.0,
+    "Cadence of the background checkpointer folding dirty datasources "
+    "(new/re-ingested, or WAL tail past the byte budget) into fresh "
+    "snapshots. 0 disables the thread; CHECKPOINT statements and "
+    "Context.checkpoint() still work.", float)
+PERSIST_CHECKPOINT_MAX_BYTES = _entry(
+    "sdot.persist.checkpoint.max.bytes", 0,
+    "Byte budget for ONE background checkpoint pass: dirty datasources "
+    "snapshot in ascending size order until the pass would exceed it; "
+    "the rest stay dirty for the next tick (bounds the I/O burst a "
+    "cadence tick can issue). 0 = unbounded.", int)
+PERSIST_KEEP_SNAPSHOTS = _entry(
+    "sdot.persist.keep.snapshots", 2,
+    "Published snapshot versions retained per datasource; older versions "
+    "are pruned after each successful publish. Must be >= 1 (the current "
+    "version is never pruned).")
+PERSIST_VERIFY_CHECKSUMS = _entry(
+    "sdot.persist.verify.checksums", True,
+    "Verify per-file CRC32 checksums against the manifest during "
+    "recovery. A mismatch quarantines that snapshot version and recovery "
+    "falls back to the previous one (or the WAL alone) — the engine "
+    "always starts.")
 # --- host-tier safety valve ---------------------------------------------------
 HOST_GATHER_PAGE_BYTES = _entry(
     "sdot.host.gather.page.bytes", 32 << 20,

@@ -1,0 +1,349 @@
+"""Durable persistence (persist/): snapshots, WAL, crash recovery.
+
+The acceptance bar is differential: a context recovered from deep
+storage must answer queries byte-identically to the context whose state
+was persisted, and the staleness semantics that ride on ingest-version
+counters (result-cache invalidation, rollup bypass) must hold across the
+restart. "Crash" here is simulated in-process — contexts are abandoned
+without checkpointing (the WAL tail is all that survives), WAL files get
+torn tails appended, snapshot blobs get flipped bytes. True kill -9
+coverage lives in scripts/crashtest.py (subprocess; not tier-1).
+"""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.persist import snapshot as SNAP
+from spark_druid_olap_tpu.persist import wal as WAL
+
+from conftest import assert_frames_equal
+
+
+def _events(n=200, seed=3):
+    r = np.random.default_rng(seed)
+    start = np.datetime64("2024-01-01")
+    return pd.DataFrame({
+        "ts": (start + r.integers(0, 90, n).astype("timedelta64[D]")
+               ).astype("datetime64[ns]"),
+        "country": r.choice(["US", "DE", "FR", "JP"], n),
+        "clicks": r.integers(0, 100, n),
+        "price": np.round(r.uniform(0, 50, n), 2),
+    })
+
+
+INGEST = dict(time_column="ts", dimensions=["country"],
+              metrics=["clicks", "price"])
+
+Q = ("select country, sum(clicks) as c, count(*) as n from events "
+     "group by country order by country")
+
+
+def _ctx(root, **extra):
+    return sdot.Context({"sdot.persist.path": str(root), **extra})
+
+
+def test_checkpoint_restart_roundtrip(tmp_path):
+    ctx = _ctx(tmp_path)
+    ctx.stream_ingest("events", _events(), **INGEST)
+    want = ctx.sql(Q).to_pandas()
+    summary = ctx.checkpoint("events")[0]
+    assert summary["rows"] == 200 and summary["version"] >= 1
+    v0 = ctx.store.datasource_version("events")
+    ctx.close()
+
+    ctx2 = _ctx(tmp_path)
+    got = ctx2.sql(Q).to_pandas()
+    assert_frames_equal(got, want)
+    # ingest-version counter restored EXACTLY (cache/rollup contract)
+    assert ctx2.store.datasource_version("events") == v0
+    info = ctx2.engine.last_stats["persist"]
+    assert info["source"] == "snapshot"
+    assert info["checksum_verify_ms"] >= 0
+    ctx2.close()
+
+
+def test_wal_tail_replayed_after_unclean_shutdown(tmp_path):
+    ctx = _ctx(tmp_path)
+    ctx.stream_ingest("events", _events(150), **INGEST)
+    ctx.checkpoint("events")
+    # two committed appends after the snapshot; NO checkpoint, no close:
+    # the WAL tail is the only durable copy (≈ kill -9 after commit)
+    ctx.stream_ingest("events", _events(40, seed=11), **INGEST)
+    ctx.stream_ingest("events", _events(25, seed=12), **INGEST)
+    want = ctx.sql(Q).to_pandas()
+    v_want = ctx.store.datasource_version("events")
+
+    ctx2 = _ctx(tmp_path)
+    assert_frames_equal(ctx2.sql(Q).to_pandas(), want)
+    assert ctx2.store.datasource_version("events") == v_want
+    info = ctx2.engine.last_stats["persist"]
+    assert info["source"] == "snapshot+wal"
+    assert info["wal_records"] == 2
+    ctx2.close()
+
+
+def test_wal_only_recovery_without_snapshot(tmp_path):
+    """First batch journaled, crash before any checkpoint: the create
+    record alone rebuilds the datasource."""
+    ctx = _ctx(tmp_path)
+    ctx.stream_ingest("events", _events(60), **INGEST)
+    want = ctx.sql(Q).to_pandas()
+
+    ctx2 = _ctx(tmp_path)
+    assert_frames_equal(ctx2.sql(Q).to_pandas(), want)
+    assert ctx2.engine.last_stats["persist"]["source"] == "wal"
+    ctx2.close()
+
+
+def test_torn_wal_tail_is_tolerated(tmp_path):
+    ctx = _ctx(tmp_path)
+    ctx.stream_ingest("events", _events(80), **INGEST)
+    ctx.stream_ingest("events", _events(20, seed=9), **INGEST)
+    want = ctx.sql(Q).to_pandas()
+    wal_path = os.path.join(ctx.persist._ds_root("events"), "wal.log")
+
+    # a torn half-written record after the committed ones (power cut
+    # mid-append): replay must stop there, keeping everything before
+    with open(wal_path, "ab") as f:
+        f.write(WAL._MAGIC + struct.pack("<I", 40) + b"\x00" * 7)
+    ctx2 = _ctx(tmp_path)
+    assert_frames_equal(ctx2.sql(Q).to_pandas(), want)
+    ctx2.close()
+
+    # corrupt (bit-flipped) record: same containment
+    with open(wal_path, "rb") as f:
+        raw = bytearray(f.read())
+    raw[-3] ^= 0xFF
+    with open(wal_path, "wb") as f:
+        f.write(raw)
+    ctx3 = _ctx(tmp_path)
+    got = ctx3.sql(Q).to_pandas()
+    # the flipped byte lands in the LAST record's body: the first batch
+    # must still be fully there
+    assert int(got["n"].sum()) >= 80
+    ctx3.close()
+
+
+def test_corrupt_snapshot_quarantined_engine_starts(tmp_path):
+    ctx = _ctx(tmp_path)
+    ctx.stream_ingest("events", _events(100), **INGEST)
+    want = ctx.sql(Q).to_pandas()
+    ctx.checkpoint("events")
+    # second version; then corrupt it on disk
+    ctx.stream_ingest("events", _events(10, seed=5), **INGEST)
+    ctx.checkpoint("events")
+    ds_root = ctx.persist._ds_root("events")
+    cur = SNAP.current_version(ds_root)
+    vdir = os.path.join(ds_root, SNAP.version_dirname(cur))
+    blob = next(p for p in sorted(os.listdir(vdir)) if p.endswith(".bin"))
+    with open(os.path.join(vdir, blob), "r+b") as f:
+        f.seek(0)
+        f.write(b"\xde\xad\xbe\xef")
+    ctx.close()
+
+    ctx2 = _ctx(tmp_path)   # must start despite the corruption
+    rep = ctx2.persist.recovery_report
+    assert len(rep["quarantined"]) == 1
+    assert rep["quarantined"][0]["version"] == cur
+    # fell back to the older intact version
+    assert_frames_equal(ctx2.sql(Q).to_pandas(), want)
+    snaps = ctx2.sql("select state from sys_snapshots").to_pandas()
+    assert any(s.startswith("quarantined:") for s in snaps["state"])
+    qdir = os.path.join(ds_root, SNAP.QUARANTINE_DIR)
+    assert os.path.isdir(qdir) and len(os.listdir(qdir)) == 1
+    ctx2.close()
+
+
+def test_stale_rollup_still_bypassed_after_recovery(tmp_path):
+    """Satellite 1 regression: a rollup stale at crash time (base got an
+    append after the build) must recover as stale and be bypassed."""
+    ctx = _ctx(tmp_path)
+    ctx.stream_ingest("events", _events(120), **INGEST)
+    ctx.sql("create rollup ev_cc on events dimensions (country) "
+            "aggregations (sum(clicks))")
+    ctx.checkpoint()            # snapshot base + backing + catalog
+    # append AFTER the build: rollup goes stale, never rebuilt
+    ctx.stream_ingest("events", _events(30, seed=21), **INGEST)
+    want = ctx.sql(Q).to_pandas()
+    rv = ctx.sql("select name, fresh from sys_rollups").to_pandas()
+    assert bool(rv.loc[rv["name"] == "ev_cc", "fresh"].iloc[0]) is False
+
+    ctx2 = _ctx(tmp_path)
+    rv2 = ctx2.sql("select name, fresh from sys_rollups").to_pandas()
+    assert bool(rv2.loc[rv2["name"] == "ev_cc", "fresh"].iloc[0]) is False
+    r = ctx2.sql("select country, sum(clicks) as c from events "
+                 "group by country order by country")
+    # stale rollup is never served: the statement scanned the base
+    assert ctx2.history.entries()[-1].stats.get("rollup") == "base"
+    assert_frames_equal(r.to_pandas(), want[["country", "c"]])
+    ctx2.close()
+
+
+def test_fresh_rollup_recovers_fresh_and_rewrites(tmp_path):
+    ctx = _ctx(tmp_path)
+    ctx.stream_ingest("events", _events(120), **INGEST)
+    ctx.sql("create rollup ev_cc on events dimensions (country) "
+            "aggregations (sum(clicks))")
+    ctx.checkpoint()
+    ctx.close()
+
+    ctx2 = _ctx(tmp_path)
+    rv = ctx2.sql("select name, fresh from sys_rollups").to_pandas()
+    assert bool(rv.loc[rv["name"] == "ev_cc", "fresh"].iloc[0]) is True
+    ctx2.sql("select country, sum(clicks) as c from events "
+             "group by country order by country")
+    assert ctx2.history.entries()[-1].stats.get("rollup") == "rollup:ev_cc"
+    ctx2.close()
+
+
+def test_result_cache_versions_coherent_after_recovery(tmp_path):
+    ctx = _ctx(tmp_path, **{"sdot.cache.enabled": True})
+    ctx.stream_ingest("events", _events(100), **INGEST)
+    ctx.checkpoint("events")
+    want = ctx.sql(Q).to_pandas()
+    ctx.close()
+
+    ctx2 = _ctx(tmp_path, **{"sdot.cache.enabled": True})
+    assert_frames_equal(ctx2.sql(Q).to_pandas(), want)
+    assert_frames_equal(ctx2.sql(Q).to_pandas(), want)  # cache hit path
+    # an append bumps the restored version: stale entries must not serve
+    ctx2.stream_ingest("events", _events(10, seed=30), **INGEST)
+    got = ctx2.sql(Q).to_pandas()
+    assert int(got["n"].sum()) == 110
+    ctx2.close()
+
+
+def test_checkpoint_restore_sql_and_purge(tmp_path):
+    ctx = _ctx(tmp_path)
+    ctx.stream_ingest("events", _events(50), **INGEST)
+    st = ctx.sql("checkpoint events").to_pandas()
+    assert "checkpointed events" in st["status"][0]
+    want = ctx.sql(Q).to_pandas()
+
+    # mutate in memory, then RESTORE rewinds to the snapshot
+    ctx.store.drop("events")
+    st = ctx.sql("restore events").to_pandas()
+    assert "restored events" in st["status"][0]
+    assert_frames_equal(ctx.sql(Q).to_pandas(), want)
+
+    # CLEAR METADATA without PURGE keeps deep storage
+    ctx.sql("clear metadata")
+    assert os.path.isdir(os.path.join(tmp_path, "events"))
+    ctx.sql("restore")
+    assert_frames_equal(ctx.sql(Q).to_pandas(), want)
+
+    # ... with PURGE deletes it
+    ctx.sql("clear metadata purge")
+    assert not os.path.isdir(os.path.join(tmp_path, "events"))
+    with pytest.raises(KeyError):
+        ctx.sql("restore events")
+    ctx.close()
+
+
+def test_persist_disabled_statements_error(tmp_path):
+    ctx = sdot.Context()
+    ctx.ingest_dataframe("events", _events(20), **INGEST)
+    with pytest.raises(RuntimeError, match="sdot.persist.path"):
+        ctx.sql("checkpoint events")
+    with pytest.raises(RuntimeError, match="sdot.persist.path"):
+        ctx.sql("restore")
+    # the view stays queryable, just empty
+    assert len(ctx.sql("select * from sys_snapshots").to_pandas()) == 0
+    ctx.close()
+
+
+def test_snapshot_pruning_keeps_n(tmp_path):
+    ctx = _ctx(tmp_path, **{"sdot.persist.keep.snapshots": 2})
+    ctx.stream_ingest("events", _events(30), **INGEST)
+    for s in (41, 42, 43):
+        ctx.checkpoint("events")
+        ctx.stream_ingest("events", _events(5, seed=s), **INGEST)
+    ctx.checkpoint("events")
+    vs = SNAP.list_versions(ctx.persist._ds_root("events"))
+    assert len(vs) == 2
+    ctx.close()
+
+
+def test_catalog_restores_stars_and_lookups(tmp_path):
+    from spark_druid_olap_tpu.metadata.star import StarRelation, StarSchema
+    ctx = _ctx(tmp_path)
+    ctx.stream_ingest("events", _events(40), **INGEST)
+    ctx.register_lookup("cc", {"US": "United States", "DE": "Germany"})
+    ctx.register_star_schema(StarSchema(
+        "fact", "events",
+        [StarRelation("fact", "dim_c", (("country", "c_key"),))]))
+    ctx.checkpoint()
+    ctx.close()
+
+    ctx2 = _ctx(tmp_path)
+    assert "cc" in ctx2.lookups
+    assert ctx2.lookups["cc"]["DE"] == "Germany"
+    star = ctx2.catalog.star_schemas["fact"]
+    assert star.flat_datasource == "events"
+    assert star.relations[0].join_columns == (("country", "c_key"),)
+    ctx2.close()
+
+
+def test_http_metadata_persist_endpoint(tmp_path):
+    import urllib.request
+    from spark_druid_olap_tpu.server.http import SqlServer
+    ctx = _ctx(tmp_path)
+    ctx.stream_ingest("events", _events(30), **INGEST)
+    ctx.checkpoint("events")
+    s = SqlServer(ctx, port=0).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{s.port}/metadata/persist") as r:
+            doc = json.loads(r.read())
+        assert doc["enabled"] is True
+        assert "events" in doc["datasources"]
+        assert doc["datasources"]["events"]["currentVersion"] >= 1
+        assert doc["counters"]["checkpoints"] >= 1
+    finally:
+        s.stop()
+        ctx.close()
+
+    ctx2 = sdot.Context()
+    s2 = SqlServer(ctx2, port=0).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{s2.port}/metadata/persist") as r:
+            assert json.loads(r.read()) == {"enabled": False}
+    finally:
+        s2.stop()
+        ctx2.close()
+
+
+def test_background_checkpointer_runs(tmp_path):
+    import time
+    ctx = _ctx(tmp_path,
+               **{"sdot.persist.checkpoint.interval.seconds": 0.05})
+    ctx.stream_ingest("events", _events(30), **INGEST)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if SNAP.current_version(ctx.persist._ds_root("events")):
+            break
+        time.sleep(0.02)
+    assert SNAP.current_version(ctx.persist._ds_root("events")) >= 1
+    assert "events" not in ctx.persist._dirty
+    ctx.close()
+
+
+def test_warmup_order_hot_datasource_first(tmp_path):
+    ctx = _ctx(tmp_path)
+    ctx.stream_ingest("aaa", _events(20, seed=1), **INGEST)
+    ctx.stream_ingest("zzz", _events(20, seed=2), **INGEST)
+    ctx.sql("select count(*) from zzz")   # zzz is the hot one
+    ctx.checkpoint()
+    ctx.close()
+
+    ctx2 = _ctx(tmp_path)
+    order = ctx2.persist.recovery_report["order"]
+    assert order.index("zzz") < order.index("aaa")
+    ctx2.close()

@@ -1,0 +1,222 @@
+"""Streaming append (segment/append.py) edge cases.
+
+Differential backbone: a datasource built by N appends must answer
+queries identically to one batch-ingested from the concatenated frame
+(segmentation differs; results must not). Plus the ISSUE-listed edges:
+empty Arrow batch, all-null column, and an append racing a checkpoint.
+"""
+
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.segment.append import append_dataframe
+
+from conftest import assert_frames_equal
+
+
+def _batch(n, seed, null_product_rate=0.0):
+    r = np.random.default_rng(seed)
+    start = np.datetime64("2023-06-01")
+    df = pd.DataFrame({
+        "ts": (start + r.integers(0, 60, n).astype("timedelta64[D]")
+               ).astype("datetime64[ns]"),
+        "region": r.choice(["east", "west", "north"], n),
+        "product": r.choice([f"p{i}" for i in range(8)], n),
+        "qty": r.integers(0, 50, n),
+        "price": np.round(r.uniform(1, 9, n), 2),
+    })
+    if null_product_rate:
+        df.loc[df.sample(frac=null_product_rate,
+                         random_state=seed).index, "product"] = None
+    return df
+
+
+INGEST = dict(time_column="ts", dimensions=["region", "product"],
+              metrics=["qty", "price"])
+
+QS = [
+    "select region, sum(qty) as q, count(*) as n from sales "
+    "group by region order by region",
+    "select product, sum(price) as p from sales "
+    "where region = 'east' group by product order by product",
+    "select count(*) as n from sales where product is null",
+]
+
+
+def test_append_differential_vs_batch_ingest():
+    batches = [_batch(500, 1), _batch(300, 2, 0.1), _batch(200, 3)]
+    ctx_a = sdot.Context()
+    for b in batches:
+        ctx_a.stream_ingest("sales", b, **INGEST)
+    ctx_b = sdot.Context()
+    ctx_b.ingest_dataframe(
+        "sales", pd.concat(batches, ignore_index=True), **INGEST)
+    for q in QS:
+        assert_frames_equal(ctx_a.sql(q).to_pandas(),
+                            ctx_b.sql(q).to_pandas())
+
+
+def test_empty_batch_is_noop():
+    ctx = sdot.Context()
+    ds0 = ctx.stream_ingest("sales", _batch(100, 4), **INGEST)
+    v0 = ctx.store.datasource_version("sales")
+    ds1 = ctx.stream_ingest("sales", _batch(0, 5).iloc[0:0], **INGEST)
+    assert ds1 is ds0                       # same object: nothing changed
+    assert ctx.store.datasource_version("sales") == v0   # no version bump
+
+
+def test_empty_batch_writes_no_wal_record(tmp_path):
+    ctx = sdot.Context({"sdot.persist.path": str(tmp_path)})
+    ctx.stream_ingest("sales", _batch(50, 6), **INGEST)
+    appends0 = ctx.persist.counters["wal_appends"]
+    ctx.stream_ingest("sales", _batch(10, 7).iloc[0:0], **INGEST)
+    assert ctx.persist.counters["wal_appends"] == appends0
+    ctx.close()
+
+
+def test_all_null_dim_column_append():
+    ctx = sdot.Context()
+    base = _batch(60, 8)
+    ctx.stream_ingest("sales", base, **INGEST)
+    nb = _batch(40, 9)
+    nb["product"] = None
+    ctx.stream_ingest("sales", nb, **INGEST)
+    got = ctx.sql("select count(*) as n from sales "
+                  "where product is null").to_pandas()
+    assert int(got["n"][0]) == 40
+    # and the reverse: a base whose dim starts all-null gains values
+    ctx2 = sdot.Context()
+    b0 = _batch(30, 10)
+    b0["product"] = None
+    ctx2.stream_ingest("t", b0, **INGEST)
+    ctx2.stream_ingest("t", _batch(20, 11), **INGEST)
+    got = ctx2.sql("select count(*) as n from t "
+                   "where product is not null").to_pandas()
+    assert int(got["n"][0]) == 20
+
+
+def test_all_null_metric_column_append():
+    ctx = sdot.Context()
+    ctx.stream_ingest("sales", _batch(50, 12), **INGEST)
+    nb = _batch(25, 13)
+    nb["qty"] = None
+    ctx.stream_ingest("sales", nb, **INGEST)
+    got = ctx.sql("select count(qty) as n, count(*) as m "
+                  "from sales").to_pandas()
+    assert int(got["n"][0]) == 50 and int(got["m"][0]) == 75
+
+
+def test_missing_column_appends_as_null():
+    ctx = sdot.Context()
+    ctx.stream_ingest("sales", _batch(40, 14), **INGEST)
+    ctx.stream_ingest("sales", _batch(10, 15).drop(columns=["price"]),
+                      **INGEST)
+    got = ctx.sql("select count(price) as n, count(*) as m "
+                  "from sales").to_pandas()
+    assert int(got["n"][0]) == 40 and int(got["m"][0]) == 50
+
+
+def test_unknown_column_rejected():
+    ctx = sdot.Context()
+    ds = ctx.stream_ingest("sales", _batch(20, 16), **INGEST)
+    bad = _batch(5, 17)
+    bad["surprise"] = 1
+    with pytest.raises(ValueError, match="surprise"):
+        append_dataframe(ds, bad)
+
+
+def test_dictionary_merge_remaps_old_codes():
+    ctx = sdot.Context()
+    b1 = _batch(50, 18)
+    b1["region"] = np.random.default_rng(18).choice(["m", "z"], 50)
+    ctx.stream_ingest("sales", b1, **INGEST)
+    b2 = _batch(50, 19)
+    b2["region"] = np.random.default_rng(19).choice(["a", "q"], 50)
+    ctx.stream_ingest("sales", b2, **INGEST)
+    ds = ctx.store.get("sales")
+    d = ds.dims["region"]
+    assert list(d.dictionary) == sorted(d.dictionary)  # stays sorted
+    # order-preserving codes: range pushdown must still be right
+    got = ctx.sql("select count(*) as n from sales "
+                  "where region > 'l'").to_pandas()
+    want = int((pd.concat([b1, b2])["region"] > "l").sum())
+    assert int(got["n"][0]) == want
+
+
+def test_metric_dtype_widens_on_append():
+    ctx = sdot.Context()
+    b1 = _batch(30, 20)
+    b1["qty"] = np.arange(30, dtype=np.int64)          # narrow
+    ctx.stream_ingest("sales", b1, **INGEST)
+    assert ctx.store.get("sales").metrics["qty"].values.dtype.itemsize <= 2
+    b2 = _batch(10, 21)
+    b2["qty"] = np.int64(3_000_000_000) + np.arange(10)  # needs int64
+    ctx.stream_ingest("sales", b2, **INGEST)
+    assert ctx.store.get("sales").metrics["qty"].values.dtype == np.int64
+    got = ctx.sql("select max(qty) as m from sales").to_pandas()
+    assert int(got["m"][0]) == 3_000_000_009
+
+
+def test_append_bumps_version_and_marks_rollup_stale():
+    ctx = sdot.Context()
+    ctx.stream_ingest("sales", _batch(80, 22), **INGEST)
+    ctx.sql("create rollup s_r on sales dimensions (region) "
+            "aggregations (sum(qty))")
+    v0 = ctx.store.datasource_version("sales")
+    ctx.stream_ingest("sales", _batch(20, 23), **INGEST)
+    assert ctx.store.datasource_version("sales") > v0
+    rv = ctx.sql("select fresh from sys_rollups").to_pandas()
+    assert bool(rv["fresh"][0]) is False
+
+
+def test_append_racing_checkpoint(tmp_path):
+    """Concurrent appends and checkpoints must serialize under the
+    manager lock: every committed batch lands exactly once, and the
+    final on-disk state recovers to the final in-memory state."""
+    ctx = sdot.Context({"sdot.persist.path": str(tmp_path)})
+    ctx.stream_ingest("sales", _batch(100, 24), **INGEST)
+    errors = []
+    stop = threading.Event()
+
+    def checkpoints():
+        while not stop.is_set():
+            try:
+                ctx.checkpoint("sales")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    t = threading.Thread(target=checkpoints)
+    t.start()
+    try:
+        for i in range(10):
+            ctx.stream_ingest("sales", _batch(20, 100 + i), **INGEST)
+    finally:
+        stop.set()
+        t.join()
+    assert not errors
+    want = ctx.sql("select region, sum(qty) as q, count(*) as n "
+                   "from sales group by region order by region").to_pandas()
+    assert int(want["n"].sum()) == 300
+    ctx.close()
+
+    ctx2 = sdot.Context({"sdot.persist.path": str(tmp_path)})
+    got = ctx2.sql("select region, sum(qty) as q, count(*) as n "
+                   "from sales group by region order by region").to_pandas()
+    assert_frames_equal(got, want)
+    ctx2.close()
+
+
+def test_append_without_time_column():
+    ctx = sdot.Context()
+    df1 = pd.DataFrame({"k": ["a", "b"], "v": [1, 2]})
+    ctx.stream_ingest("kv", df1, dimensions=["k"], metrics=["v"])
+    ctx.stream_ingest("kv", pd.DataFrame({"k": ["c"], "v": [9]}),
+                      dimensions=["k"], metrics=["v"])
+    got = ctx.sql("select k, sum(v) as v from kv "
+                  "group by k order by k").to_pandas()
+    assert list(got["k"]) == ["a", "b", "c"]
+    assert list(got["v"]) == [1, 2, 9]
