@@ -4,9 +4,12 @@ One :class:`PersistManager` per Context (created when
 ``sdot.persist.path`` is set). It owns:
 
 - **Durable stream ingest**: ``Context.stream_ingest`` routes here; the
-  batch is journaled (WAL append + fsync = commit point) BEFORE the
-  in-memory store registers it, so a ``kill -9`` at any instant loses at
-  most the batch whose commit was never acknowledged.
+  new Datasource value is BUILT first (which fully validates the batch —
+  a rejected batch is never journaled), then the batch is journaled (WAL
+  append + fsync = commit point), then the store registers it, so a
+  ``kill -9`` at any instant loses at most the batch whose commit was
+  never acknowledged — and a rejected batch can never poison replay of
+  the committed ones behind it.
 - **Checkpoints**: fold a datasource's current in-memory state into a
   published snapshot (persist/snapshot.py) and truncate the WAL records
   the snapshot now covers. Explicit (``CHECKPOINT`` SQL /
@@ -138,26 +141,72 @@ class PersistManager:
     def stream_ingest(self, name: str, df: pd.DataFrame,
                       kwargs: dict):
         from spark_druid_olap_tpu.segment.append import (
-            apply_stream_ingest, wal_kwargs_to_dict)
+            append_dataframe, wal_kwargs_to_dict)
+        from spark_druid_olap_tpu.segment.ingest import ingest_dataframe
         with self.lock:
             store = self.ctx.store
             existing = store._datasources.get(name)
             if existing is not None and len(df) == 0:
                 return existing     # no-op: nothing to journal or apply
-            if existing is not None \
-                    and SNAP.current_version(self._ds_root(name)) is None:
+            if existing is None:
+                # new incarnation of this name: any on-disk state belongs
+                # to a previous one (dropped / cleared without PURGE) and
+                # recovery must never merge the two, so fence the old
+                # snapshot + WAL aside before journaling the create
+                self._fence_stale_state(name)
+            elif SNAP.current_version(self._ds_root(name)) is None:
                 # first append to a datasource that was batch-ingested in
                 # memory only: a WAL replay needs a base to append onto,
                 # so publish one synchronously before journaling
                 self.checkpoint(name)
             kind = "create" if existing is None else "append"
+            # Build the new Datasource value BEFORE journaling: the WAL
+            # append is the commit point, and a batch the build rejects
+            # (unknown column, missing time column, bad dtype) must never
+            # be journaled — a journaled reject would deterministically
+            # fail again on every replay, shadowing later committed
+            # batches behind it.
+            if existing is None:
+                new_ds = ingest_dataframe(name, df, **kwargs)
+            else:
+                new_ds = append_dataframe(
+                    existing, df,
+                    target_rows=int(kwargs.get("target_rows")
+                                    or (1 << 20)))
             header = {"seq": self._next_seq(name), "datasource": name,
                       "kind": kind,
                       "kwargs": wal_kwargs_to_dict(kwargs)}
             body = WAL.encode_batch(df)
             self._wal_for(name).append(header, body)   # <-- commit point
             self.counters["wal_appends"] += 1
-            return apply_stream_ingest(self.ctx, name, df, kwargs)
+            store.register(new_ds)
+            return new_ds
+
+    def _fence_stale_state(self, name: str) -> None:
+        """Move a previous incarnation's on-disk snapshot/WAL aside
+        (under a dotted name recovery ignores — kept, not deleted, so an
+        operator can still inspect it). Without the fence, a re-created
+        datasource's 'create' record lands in the OLD journal with a seq
+        past the stale snapshot's watermark, and recovery appends the
+        new data onto the dropped incarnation's rows."""
+        p = self._ds_root(name)
+        if not os.path.isdir(p):
+            return
+        w = self._wals.pop(name, None)
+        if w is not None:
+            w.close()
+        self._wal_seq.pop(name, None)
+        base = os.path.join(
+            self.root,
+            f".dropped-{int(time.time())}-{os.path.basename(p)}")
+        dst, i = base, 0
+        while os.path.exists(dst):
+            i += 1
+            dst = f"{base}.{i}"
+        try:
+            os.replace(p, dst)
+        except OSError:
+            shutil.rmtree(p, ignore_errors=True)
 
     # -- checkpoint -----------------------------------------------------------
     def checkpoint(self, name: str) -> dict:
@@ -186,8 +235,11 @@ class PersistManager:
                        byte_budget: Optional[int] = None) -> List[dict]:
         """Checkpoint every (or every dirty) complete datasource; with a
         byte budget, snapshot in ascending size order until the pass
-        would exceed it (the rest stay dirty for the next pass)."""
-        out = []
+        would exceed it (the rest stay dirty for the next pass). The
+        manager lock is held only to size the candidates and then
+        per-datasource inside :meth:`checkpoint` — a background pass
+        over many datasources never stalls streaming ingest for the
+        whole sweep."""
         with self.lock:
             store = self.ctx.store
             names = [n for n in store.names()
@@ -201,16 +253,19 @@ class PersistManager:
                 if ds.is_partial:
                     continue        # multi-host partials never checkpoint
                 sized.append((_ds_bytes(ds), n))
-            sized.sort()
-            spent = 0
-            for nbytes, n in sized:
-                if byte_budget and out and spent + nbytes > byte_budget:
-                    break           # always make progress on >= 1 ds
-                try:
-                    out.append(self.checkpoint(n))
-                    spent += nbytes
-                except Exception:   # noqa: BLE001 — one bad ds can't
-                    self.counters["errors"] += 1   # starve the rest
+        sized.sort()
+        out = []
+        spent = 0
+        for nbytes, n in sized:
+            if byte_budget and out and spent + nbytes > byte_budget:
+                break               # always make progress on >= 1 ds
+            try:
+                out.append(self.checkpoint(n))
+                spent += nbytes
+            except KeyError:
+                continue            # dropped between the listing and now
+            except Exception:       # noqa: BLE001 — one bad ds can't
+                self.counters["errors"] += 1   # starve the rest
         return out
 
     # -- catalog (stars / rollups / lookups / warmup) -------------------------
@@ -312,6 +367,13 @@ class PersistManager:
                 manifest = None
         if manifest is not None:
             self.ctx.store.restore(ds, int(manifest["ingest_version"]))
+        else:
+            # WAL-only path: replay rebuilds from the journaled 'create'
+            # record. An in-session RESTORE can reach here with the live
+            # object still registered — drop it (directly, no store
+            # events: the on-disk state must survive), or the create
+            # batch would append on top of it, duplicating every row.
+            self.ctx.store._datasources.pop(name, None)
         covered = int(manifest["wal_seq"]) if manifest is not None else 0
         replayed = 0
         wal = self._wal_for(name)
@@ -319,6 +381,9 @@ class PersistManager:
             seq = int(header.get("seq", 0))
             if seq <= covered:
                 continue
+            # advance the seq watermark even past a failing record so a
+            # later live append can never reuse its sequence number
+            self._wal_seq[name] = max(self._wal_seq.get(name, 0), seq)
             try:
                 df = WAL.decode_batch(body)
                 kwargs = wal_kwargs_from_dict(header.get("kwargs") or {})
@@ -327,9 +392,9 @@ class PersistManager:
                 self.counters["errors"] += 1
                 report["errors"].append(
                     {"datasource": name, "seq": seq, "reason": str(e)})
-                break
+                continue            # one bad record must not shadow the
+                                    # committed batches behind it
             replayed += 1
-            self._wal_seq[name] = max(self._wal_seq.get(name, 0), seq)
         self.counters["wal_replayed"] += replayed
         if manifest is None and replayed == 0:
             return None
@@ -423,7 +488,9 @@ class PersistManager:
             removed = 0
             if name is not None:
                 p = self._ds_root(name)
-                self._wals.pop(name, None)
+                w = self._wals.pop(name, None)
+                if w is not None:
+                    w.close()
                 self._wal_seq.pop(name, None)
                 self._dirty.discard(name)
                 if os.path.isdir(p):
@@ -433,10 +500,21 @@ class PersistManager:
             for n, p in self._ds_dirs().items():
                 shutil.rmtree(p, ignore_errors=True)
                 removed += 1
+            # fenced previous incarnations (.dropped-*) go too: PURGE
+            # means "nothing of this root survives a restart"
+            try:
+                for n in os.listdir(self.root):
+                    if n.startswith(".dropped-"):
+                        shutil.rmtree(os.path.join(self.root, n),
+                                      ignore_errors=True)
+            except OSError:
+                pass
             try:
                 os.remove(os.path.join(self.root, CATALOG_FILE))
             except OSError:
                 pass
+            for w in self._wals.values():
+                w.close()
             self._wals.clear()
             self._wal_seq.clear()
             self._dirty.clear()

@@ -3,7 +3,9 @@
 Layout under ``<root>/<datasource-dir>/``::
 
     CURRENT                   # JSON pointer {"version": N}, atomic replace
-    v<NNNNNNNNNN>/            # one published snapshot (N = ingest version)
+    v<NNNNNNNNNN>/            # one published snapshot (N = monotone
+                              #   publish number; the ingest version it
+                              #   captures lives in the manifest)
       manifest.json           # schema, segment map, versions, checksums
       time_days.bin ...       # per-column raw little-endian blobs
       dim_NNNN_dict.json      # sorted global dictionaries (NNNN = dim index)
@@ -13,8 +15,12 @@ Layout under ``<root>/<datasource-dir>/``::
 Publish protocol (≈ Druid's segment push to deep storage + metadata
 commit): write every blob into a hidden temp dir, fsync each file, then
 ``os.replace`` the temp dir to its version name and atomically rewrite
-CURRENT. A crash at any point leaves either the old CURRENT (temp dirs
-are garbage-collected on the next publish) or the new one — never a
+CURRENT. The version name is a monotone per-datasource publish number
+(max existing + 1), so a publish NEVER replaces an existing directory —
+even a re-checkpoint of the same ingest version lands in a fresh dir,
+and there is no instant at which CURRENT's directory is missing. A crash
+at any point leaves either the old CURRENT (temp dirs are
+garbage-collected on the next publish) or the new one — never a
 half-published snapshot.
 
 Every blob carries a CRC32 in the manifest; recovery verifies them
@@ -106,20 +112,27 @@ def current_version(ds_root: str) -> Optional[int]:
 def write_snapshot(ds_root: str, ds, ingest_version: int,
                    wal_seq: int, keep: int = 2) -> dict:
     """Publish one snapshot of a COMPLETE datasource; returns the
-    manifest. Atomic: temp dir -> rename -> CURRENT pointer swap."""
+    manifest. Atomic: temp dir -> rename -> CURRENT pointer swap. The
+    on-disk version is allocated (max existing + 1), never reused: an
+    in-place replace of an existing version dir would open a crash
+    window with no directory behind CURRENT after the covering WAL
+    records were already truncated."""
     ds.require_complete("checkpoint")
     os.makedirs(ds_root, exist_ok=True)
     # collect temp dirs a crashed previous publish left behind
     for n in os.listdir(ds_root):
         if n.startswith(".tmp-"):
             shutil.rmtree(os.path.join(ds_root, n), ignore_errors=True)
-    tmp = os.path.join(ds_root, f".tmp-{os.getpid()}-{ingest_version}")
+    versions = list_versions(ds_root)
+    publish_version = (versions[-1] + 1) if versions else 1
+    tmp = os.path.join(ds_root, f".tmp-{os.getpid()}-{publish_version}")
     os.makedirs(tmp, exist_ok=True)
 
     files: Dict[str, dict] = {}
     manifest = {
         "format": FORMAT_VERSION,
         "datasource": ds.name,
+        "snapshot_version": int(publish_version),
         "ingest_version": int(ingest_version),
         "wal_seq": int(wal_seq),
         "num_rows": int(ds.num_rows),
@@ -168,19 +181,11 @@ def write_snapshot(ds_root: str, ds, ingest_version: int,
         f.flush()
         os.fsync(f.fileno())
 
-    final = os.path.join(ds_root, version_dirname(ingest_version))
-    if os.path.exists(final):
-        # re-publish of the same ingest version (e.g. WAL folded in):
-        # replace via a two-step swap; the old dir goes to a temp name
-        old = final + f".old-{os.getpid()}"
-        os.replace(final, old)
-        os.replace(tmp, final)
-        shutil.rmtree(old, ignore_errors=True)
-    else:
-        os.replace(tmp, final)
+    final = os.path.join(ds_root, version_dirname(publish_version))
+    os.replace(tmp, final)
     _fsync_dir(ds_root)
-    _write_current(ds_root, int(ingest_version))
-    prune(ds_root, keep=keep, current=int(ingest_version))
+    _write_current(ds_root, int(publish_version))
+    prune(ds_root, keep=keep, current=int(publish_version))
     return manifest
 
 

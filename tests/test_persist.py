@@ -246,6 +246,121 @@ def test_checkpoint_restore_sql_and_purge(tmp_path):
     ctx.close()
 
 
+def test_rejected_batch_never_poisons_wal(tmp_path):
+    """A batch the build rejects (unknown column) must not reach the
+    journal: batches committed AFTER the reject must survive recovery
+    instead of being shadowed by a deterministically-failing record."""
+    ctx = _ctx(tmp_path)
+    ctx.stream_ingest("events", _events(100), **INGEST)
+    bad = _events(10, seed=7)
+    bad["surprise"] = 1
+    with pytest.raises(ValueError, match="surprise"):
+        ctx.stream_ingest("events", bad, **INGEST)
+    ctx.stream_ingest("events", _events(50, seed=8), **INGEST)  # ACKed
+    want = ctx.sql(Q).to_pandas()
+    assert int(want["n"].sum()) == 150
+    ctx.close()
+
+    ctx2 = _ctx(tmp_path)
+    got = ctx2.sql(Q).to_pandas()
+    assert int(got["n"].sum()) == 150
+    assert_frames_equal(got, want)
+    assert ctx2.persist.recovery_report["errors"] == []
+    ctx2.close()
+
+
+def test_replay_skips_poisoned_record(tmp_path):
+    """Defense-in-depth: even if a bad record somehow lands in the
+    journal, replay skips it (reporting the error) and still applies
+    the committed batches behind it."""
+    ctx = _ctx(tmp_path)
+    ctx.stream_ingest("events", _events(80), **INGEST)
+    ctx.stream_ingest("events", _events(20, seed=6), **INGEST)
+    wal_path = os.path.join(ctx.persist._ds_root("events"), "wal.log")
+    ctx.close()
+    w = WAL.WriteAheadLog(wal_path)
+    bad = _events(10, seed=7)
+    bad["surprise"] = 1
+    w.append({"seq": 3, "datasource": "events", "kind": "append",
+              "kwargs": {}}, WAL.encode_batch(bad))
+    w.append({"seq": 4, "datasource": "events", "kind": "append",
+              "kwargs": {}}, WAL.encode_batch(_events(15, seed=8)))
+    w.close()
+
+    ctx2 = _ctx(tmp_path)
+    got = ctx2.sql(Q).to_pandas()
+    assert int(got["n"].sum()) == 80 + 20 + 15   # seq 3 skipped, 4 kept
+    rep = ctx2.persist.recovery_report
+    assert any(e.get("seq") == 3 for e in rep["errors"])
+    ctx2.close()
+
+
+def test_restore_wal_only_does_not_duplicate(tmp_path):
+    """In-session RESTORE of a never-checkpointed, stream-created
+    datasource rebuilds from the WAL's create record — it must not
+    append that record onto the still-live in-memory object."""
+    ctx = _ctx(tmp_path)
+    ctx.stream_ingest("events", _events(60), **INGEST)
+    want = ctx.sql(Q).to_pandas()
+    assert int(want["n"].sum()) == 60
+    ctx.sql("restore events")
+    got = ctx.sql(Q).to_pandas()
+    assert int(got["n"].sum()) == 60
+    assert_frames_equal(got, want)
+    # the restored datasource keeps working as an append target
+    ctx.stream_ingest("events", _events(10, seed=13), **INGEST)
+    assert int(ctx.sql(Q).to_pandas()["n"].sum()) == 70
+    ctx.close()
+
+
+def test_recreate_after_clear_fences_old_state(tmp_path):
+    """Stream-creating a name whose previous incarnation was dropped
+    WITHOUT purge must fence the old snapshot/WAL aside: recovery
+    serves the new incarnation only, never a merge of the two."""
+    ctx = _ctx(tmp_path)
+    ctx.stream_ingest("events", _events(100), **INGEST)
+    ctx.checkpoint("events")
+    ctx.sql("clear metadata events")       # drop, deep storage kept
+    ctx.stream_ingest("events", _events(30, seed=9), **INGEST)
+    want = ctx.sql(Q).to_pandas()
+    assert int(want["n"].sum()) == 30
+    ctx.close()
+
+    ctx2 = _ctx(tmp_path)
+    got = ctx2.sql(Q).to_pandas()
+    assert int(got["n"].sum()) == 30       # new incarnation only
+    assert_frames_equal(got, want)
+    # the fenced incarnation is kept aside for the operator...
+    fenced = [n for n in os.listdir(tmp_path)
+              if n.startswith(".dropped-")]
+    assert len(fenced) == 1
+    # ...and a full PURGE sweeps it too
+    ctx2.sql("clear metadata purge")
+    assert [n for n in os.listdir(tmp_path)
+            if n.startswith(".dropped-")] == []
+    ctx2.close()
+
+
+def test_republish_never_replaces_version_dir(tmp_path):
+    """Re-checkpointing allocates a fresh publish number — never an
+    in-place swap of the directory CURRENT points at (a crash between
+    the two replaces of a swap would leave CURRENT dangling after the
+    covering WAL records were truncated)."""
+    ctx = _ctx(tmp_path, **{"sdot.persist.keep.snapshots": 4})
+    ctx.stream_ingest("events", _events(40), **INGEST)
+    ctx.checkpoint("events")
+    root = ctx.persist._ds_root("events")
+    v1 = SNAP.current_version(root)
+    ctx.checkpoint("events")               # same ingest version again
+    v2 = SNAP.current_version(root)
+    assert v2 == v1 + 1
+    assert SNAP.list_versions(root) == [v1, v2]
+    # both publishes capture the same ingest version in the manifest
+    assert (SNAP.load_manifest(root, v2)["ingest_version"]
+            == SNAP.load_manifest(root, v1)["ingest_version"])
+    ctx.close()
+
+
 def test_persist_disabled_statements_error(tmp_path):
     ctx = sdot.Context()
     ctx.ingest_dataframe("events", _events(20), **INGEST)
