@@ -564,6 +564,7 @@ def main():
     except ValueError:
         profile_n = 4
     ndisp = {}
+    zero_dispatch = []
     cold_total_s = 0.0
     n_engine = 0
     host_queries = []
@@ -652,6 +653,16 @@ def main():
         if nd is not None:
             ndisp[name] = int(nd)
             dd = f", {nd}+{nt}rt"   # program dispatches + host->dev transfers
+            if mode == "engine" and int(nd) == 0:
+                # an engine-mode query that reports zero device dispatches
+                # measured a cache hit, not an execution (TPC-H q20
+                # regression: the ungated subquery cache served its
+                # decorrelated inners on warm reps) — flag loudly so the
+                # accounting can't silently regress again
+                zero_dispatch.append(name)
+                log(f"{name}: WARNING engine-mode query reported ZERO "
+                    f"device dispatches — a cache is serving the "
+                    f"measured rep")
         cm = meas_stats.get("compact_m")
         if cm:
             dd += f", lm={cm}"      # late-materialization budget engaged
@@ -712,6 +723,8 @@ def main():
         # the dispatch floor, so this is wall time's dominant term made
         # auditable (and the target of dispatch-reduction work)
         out["n_dispatch"] = ndisp
+    if zero_dispatch:
+        out["zero_dispatch_engine"] = zero_dispatch
     if gbps:
         try:
             peak = float(os.environ.get("SDOT_BENCH_HBM_PEAK_GBPS", "819"))

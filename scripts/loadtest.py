@@ -567,6 +567,113 @@ def run_wlm(args):
     sys.exit(0 if ok else 1)
 
 
+def run_sharedscan(args):
+    """Shared-scan comparison: K client threads replay a fixed BI
+    dashboard mix over one TPC-H star (in process, caches off so every
+    rep executes), with query coalescing off then on. Reports qps and
+    p50/p99 per leg, the coalescing rate, and device-dispatch totals;
+    every reply is checked against the sequential reference answers and
+    any mismatch exit-codes 1 (answers must be identical whether or not
+    the query shared a scan)."""
+    sys.path.insert(0, ".")
+    import bench
+    sf = args.tpch if args.tpch is not None else 1.0
+    ctx, n_rows = bench.setup(sf)
+    ctx.config.set("sdot.wlm.batch.window.ms", float(args.window))
+    queries = args.sql or TPCH_DASHBOARD
+
+    # sequential reference (coalescing off): warm/compile, then answers
+    ctx.config.set("sdot.sharedscan.enabled", False)
+    answers = {}
+    for q in queries:
+        ctx.sql(q)                         # compile/warm rep
+        answers[q] = ctx.sql(q).to_pandas()
+
+    legs, mismatched = {}, []
+    for leg, enabled in (("sharedscan_off", False), ("sharedscan_on", True)):
+        ctx.config.set("sdot.sharedscan.enabled", enabled)
+        coal0 = dict(ctx.engine.sharedscan.stats())
+        lat, errors, dispatches = [], [0], [0]
+        lock = threading.Lock()
+        stop = time.monotonic() + args.duration
+
+        def worker(tid):
+            # dispatch_counts is thread-local and monotone: the diff is
+            # exactly this client's device round trips for the leg
+            d0 = ctx.engine.dispatch_counts[0]
+            i = tid                        # deterministic round-robin
+            my_lat, my_bad = [], []
+            while time.monotonic() < stop:
+                sql = queries[i % len(queries)]
+                i += 1
+                t0 = time.perf_counter()
+                try:
+                    df = ctx.sql(sql).to_pandas()
+                except Exception:   # noqa: BLE001
+                    with lock:
+                        errors[0] += 1
+                    continue
+                my_lat.append((time.perf_counter() - t0) * 1000)
+                if not _frames_close(df, answers[sql]):
+                    my_bad.append(sql)
+            dd = ctx.engine.dispatch_counts[0] - d0
+            with lock:
+                lat.extend(my_lat)
+                dispatches[0] += dd
+                mismatched.extend(f"[{leg}] {s[:70]}" for s in set(my_bad))
+
+        threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+                   for t in range(args.threads)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.monotonic() - t0
+        coal1 = dict(ctx.engine.sharedscan.stats())
+        served = len(lat)
+        a = np.array(lat) if lat else np.array([0.0])
+        coalesced = coal1["queries_coalesced"] - coal0["queries_coalesced"]
+        legs[leg] = {
+            "n": served, "errors": errors[0],
+            "qps": round(served / max(elapsed, 1e-9), 1),
+            "p50_ms": round(float(np.percentile(a, 50)), 1),
+            "p99_ms": round(float(np.percentile(a, 99)), 1),
+            "dispatches": dispatches[0],
+            "queries_coalesced": coalesced,
+            "coalesce_rate": round(coalesced / max(served, 1), 4),
+            "groups": coal1["groups_coalesced"] - coal0["groups_coalesced"],
+            "binds_saved_bytes": (coal1["binds_saved_bytes"]
+                                  - coal0["binds_saved_bytes"]),
+            "dispatches_saved": (coal1["dispatches_saved"]
+                                 - coal0["dispatches_saved"])}
+        print(f"  [{leg}] qps={legs[leg]['qps']:7.1f} "
+              f"p50={legs[leg]['p50_ms']:7.1f}ms "
+              f"p99={legs[leg]['p99_ms']:7.1f}ms n={served:5d} "
+              f"dispatches={dispatches[0]} "
+              f"coalesce_rate={legs[leg]['coalesce_rate']:.1%}")
+
+    on, off = legs["sharedscan_on"], legs["sharedscan_off"]
+    qps_x = on["qps"] / max(off["qps"], 1e-9)
+    disp_per_q_off = off["dispatches"] / max(off["n"], 1)
+    disp_per_q_on = on["dispatches"] / max(on["n"], 1)
+    disp_x = disp_per_q_off / max(disp_per_q_on, 1e-9)
+    print(f"  qps speedup {qps_x:.2f}x; dispatches/query "
+          f"{disp_per_q_off:.2f} -> {disp_per_q_on:.2f} ({disp_x:.2f}x "
+          f"fewer)" + (f"; RESULT MISMATCH on {sorted(set(mismatched))}"
+                       if mismatched else ""))
+    out = {"mode": "sharedscan", "sf": sf, "rows": n_rows,
+           "threads": args.threads, "duration_s": args.duration,
+           "window_ms": float(args.window), "legs": legs,
+           "qps_speedup": round(qps_x, 2),
+           "dispatch_reduction": round(disp_x, 2),
+           "result_mismatches": sorted(set(mismatched))}
+    print(json.dumps(out))
+    ok = not mismatched and on["n"] > 0 and off["n"] > 0 \
+        and on["queries_coalesced"] > 0
+    sys.exit(0 if ok else 1)
+
+
 def main():
     import os
     if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
@@ -606,6 +713,17 @@ def main():
                     "context's deep-storage recovery + first query "
                     "against the live context's first query "
                     "(differential: answers must match)")
+    ap.add_argument("--sharedscan", action="store_true",
+                    help="in-process shared-scan comparison: K client "
+                    "threads replay the TPC-H dashboard mix (scale from "
+                    "--tpch, default SF1) with query coalescing off then "
+                    "on; reports qps/p50/p99, coalescing rate, and device "
+                    "dispatches per leg; every reply is differentially "
+                    "checked against sequential answers (mismatch -> "
+                    "exit 1)")
+    ap.add_argument("--window", type=float, default=8.0, metavar="MS",
+                    help="sdot.wlm.batch.window.ms for --sharedscan "
+                    "(micro-batch hold window; default 8ms)")
     ap.add_argument("--wlm", action="store_true",
                     help="in-process overload comparison: interactive + "
                     "heavy query mix at 4x the interactive lane's "
@@ -616,6 +734,8 @@ def main():
 
     if args.coldstart:
         return run_coldstart(args)
+    if args.sharedscan:
+        return run_sharedscan(args)
     if args.wlm:
         return run_wlm(args)
     if args.rollup:

@@ -63,6 +63,17 @@ class InflightRegistry:
                 row["tenant"] = tenant
                 row["queued_ms"] = queued_ms
 
+    def annotate(self, tok, **fields) -> None:
+        """Attach extra columns to a live row (e.g. the shared-scan
+        coalesced-group id); snapshot() copies rows, so annotations flow
+        into ``sys_queries`` without schema changes here."""
+        if tok is None:
+            return
+        with self._lock:
+            row = self._rows.get(tok)
+            if row is not None:
+                row.update(fields)
+
     def done(self, tok: int) -> None:
         with self._lock:
             self._rows.pop(tok, None)

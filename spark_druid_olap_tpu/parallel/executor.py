@@ -734,6 +734,14 @@ class QueryEngine:
         from spark_druid_olap_tpu.wlm.admit import WorkloadManager
         self.wlm = WorkloadManager(self.config)
         self.inflight = InflightRegistry()
+        # shared-scan tier (parallel/sharedscan.py): concurrent eligible
+        # queries on one datasource coalesce into a single fused program
+        # with a shared column-union bind; gated by
+        # sdot.sharedscan.enabled (off by default)
+        from spark_druid_olap_tpu.parallel.sharedscan import (
+            SharedScanCoalescer)
+        self.sharedscan = SharedScanCoalescer(self)
+        self.wlm.sharedscan = self.sharedscan
 
     @property
     def last_stats(self) -> Dict[str, object]:
@@ -857,6 +865,10 @@ class QueryEngine:
         ticket = None
         tok = self.inflight.begin(qid, getattr(q, "datasource", None),
                                   type(q).__name__)
+        # visible to the shared-scan coalescer (joined on this thread):
+        # the group leader annotates every constituent's sys_queries row
+        # with the coalesced-group id
+        self._tls.inflight_tok = tok
         try:
             if self.wlm.enabled:
                 # admission BEFORE any planning/cache/dispatch work: a
@@ -886,6 +898,7 @@ class QueryEngine:
                 self.inflight.running(tok)
             return self._execute_admitted(q, t0)
         finally:
+            self._tls.inflight_tok = None
             if ticket is not None:
                 self.wlm.release(ticket)
             self.inflight.done(tok)
@@ -920,7 +933,13 @@ class QueryEngine:
                 self.last_stats["backend_lost"] = True
                 raise EngineFallback(
                     "backend_lost (device unreachable; host tier serving)")
-            r = self._execute_inner(q, t0)
+            if self.sharedscan.should_try(q):
+                # coalesce with concurrent eligible queries on the same
+                # datasource; sits UNDER the cache layer so each
+                # constituent still populates its own canonical key
+                r = self.sharedscan.run(q, t0)
+            else:
+                r = self._execute_inner(q, t0)
             if use_cache:
                 cache.put(q, ds_version, r)
                 self.last_stats["cache"] = "miss"

@@ -1,0 +1,604 @@
+"""Shared-scan multi-query execution: coalesce concurrent eligible queries
+over one datasource into ONE fused device program.
+
+The BI-dashboard storm the reference system was built for is K small
+concurrent star-schema queries over the *same* columns; executed solo,
+they pay K× scan bandwidth and K× dispatch overhead (each tunneled
+round-trip costs the dispatch floor). Classic shared-scan / fused-
+operator results (Flare, arxiv 1703.08219; Theseus, arxiv 2508.05029)
+say the win is multiplicative with concurrency, so this tier converts
+concurrency into a throughput multiplier instead of a queue:
+
+- The first eligible query on a datasource becomes the *leader* of an
+  open group and holds for ``sdot.wlm.batch.window.ms`` (group-commit
+  semantics; held time counts against the query's own timeout).
+- Companions arriving inside the window join as *followers* and park.
+- At close, the leader plans every constituent, binds the COLUMN UNION
+  of the group once per segment wave (through the engine's shared
+  device-array cache), runs one fused program evaluating every
+  constituent's filter mask + aggregation lanes against the shared
+  in-HBM bind, and demultiplexes per-query results.
+- Every constituent that cannot ride the fused program (hashed-tier
+  cardinality, sketch-over-unsupported, empty pruning, host residual)
+  falls back to its own solo execution on its own thread — coalescing
+  is an optimization, never a semantics change.
+
+Cache interaction: the coalescer runs *under* the result-cache layer
+(QueryEngine._execute_admitted), so each constituent still populates /
+serves the semantic cache under its own canonical key.
+
+Fused-program shape: one ``ScanContext`` over the union bind; per-lane
+``base = row_valid & filter & interval`` masks feed per-lane
+``dense_groupby`` calls; outputs pack through the engine's existing
+two-buffer packers per lane. ``row_valid`` travels IN the bound arrays
+(ops/scan.py), so the compiled program is segment-selection independent
+and keys the compile cache on the sorted tuple of constituent plan
+signatures — a warm dashboard mix reuses one executable.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_druid_olap_tpu.ir import spec as S
+from spark_druid_olap_tpu.ops import filters as F
+from spark_druid_olap_tpu.ops import groupby as G
+from spark_druid_olap_tpu.ops import hll as HLL
+from spark_druid_olap_tpu.ops import theta as TH
+from spark_druid_olap_tpu.ops import time_ops as T
+from spark_druid_olap_tpu.ops.scan import ScanContext, array_dtype, array_names
+from spark_druid_olap_tpu.parallel import cost as C
+from spark_druid_olap_tpu.result import QueryResult
+from spark_druid_olap_tpu.utils.config import (
+    GROUPBY_DENSE_MAX_KEYS,
+    GROUPBY_MATMUL_MAX_KEYS,
+    HLL_LOG2M,
+    SHAREDSCAN_ENABLED,
+    SHAREDSCAN_MAX_QUERIES,
+    TZ_ID,
+    WLM_BATCH_WINDOW_MS,
+)
+
+# a member's outcome slot: None = pending, _FALLBACK = run solo on the
+# member's own thread, an exception instance = raise it there, anything
+# else = the demultiplexed QueryResult
+_FALLBACK = object()
+
+# how often a parked follower re-checks its own cancel flag / deadline
+# while waiting for the leader to deliver
+_WAIT_POLL_S = 0.02
+
+
+class _Member:
+    __slots__ = ("q", "t0", "leader", "event", "outcome", "stats", "tok")
+
+    def __init__(self, q, t0, leader: bool, tok=None):
+        self.q = q
+        self.t0 = t0
+        self.leader = leader
+        self.event = threading.Event()
+        self.outcome = None
+        self.stats = None
+        self.tok = tok
+
+
+class _Group:
+    __slots__ = ("gid", "ds_name", "members", "state", "close_ev")
+
+    def __init__(self, gid: int, ds_name: str):
+        self.gid = gid
+        self.ds_name = ds_name
+        self.members: List[_Member] = []
+        self.state = "open"          # open -> closing -> closed
+        self.close_ev = threading.Event()
+
+
+class _LanePlan:
+    """One fused-program lane: the planned form of one distinct
+    constituent spec (members sharing a plan signature share a lane)."""
+
+    __slots__ = ("q", "sig", "dims", "aggs", "post", "having", "limit",
+                 "gran", "seg", "dim_plans", "agg_plans", "n_keys",
+                 "routes", "needed", "time_in_play", "names")
+
+    def __init__(self, q, sig, dims, aggs, post, having, limit, gran, seg):
+        self.q = q
+        self.sig = sig
+        self.dims = dims
+        self.aggs = aggs
+        self.post = post
+        self.having = having
+        self.limit = limit
+        self.gran = gran
+        self.seg = seg
+
+
+class SharedScanCoalescer:
+    """One per QueryEngine. ``run`` replaces ``_execute_inner`` for
+    eligible queries; everything ineligible (or racing a closed group)
+    degrades to the solo path."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._lock = threading.Lock()
+        self._groups: Dict[str, _Group] = {}
+        self._next_gid = 0
+        # monotone global counters (GET /metadata/wlm, loadtest)
+        self.groups_coalesced = 0     # groups that ran >= 2 fused lanes
+        self.solo_groups = 0          # window expired with one live member
+        self.queries_coalesced = 0    # constituents served by fused runs
+        self.fallbacks = 0            # members bounced to solo execution
+        self.binds_saved_bytes = 0
+        self.dispatches_saved = 0
+        self.wlm_handoffs = 0         # queued waiters bypassed into groups
+
+    # -- eligibility -----------------------------------------------------------
+    def enabled(self) -> bool:
+        return bool(self.engine.config.get(SHAREDSCAN_ENABLED))
+
+    def should_try(self, q) -> bool:
+        """Cheap pre-gate: spec shapes the fused tier can demultiplex.
+        Select (pagination state) and Search never coalesce; neither does
+        anything when the backend is lost (the host tier is serving)."""
+        if not self.enabled():
+            return False
+        if self.engine._backend_lost_at is not None:
+            return False
+        return isinstance(q, (S.GroupByQuerySpec, S.TimeseriesQuerySpec,
+                              S.TopNQuerySpec))
+
+    def open_group_hint(self, datasource) -> bool:
+        """True when an open group on ``datasource`` still has room — the
+        WLM poll loop uses this to hand a queued compatible query to the
+        coalescer instead of draining it serially."""
+        if not self.enabled() or datasource is None:
+            return False
+        maxq = int(self.engine.config.get(SHAREDSCAN_MAX_QUERIES))
+        with self._lock:
+            g = self._groups.get(datasource)
+            return g is not None and g.state == "open" \
+                and len(g.members) < maxq
+
+    # -- group membership ------------------------------------------------------
+    def run(self, q, t0: float) -> QueryResult:
+        """Join (or lead) the open group for q's datasource; return the
+        demultiplexed result, or fall back to solo execution."""
+        eng = self.engine
+        window_s = max(0.0,
+                       float(eng.config.get(WLM_BATCH_WINDOW_MS)) / 1000.0)
+        maxq = max(1, int(eng.config.get(SHAREDSCAN_MAX_QUERIES)))
+        tok = getattr(eng._tls, "inflight_tok", None)
+        with self._lock:
+            g = self._groups.get(q.datasource)
+            if g is not None and g.state == "open" and len(g.members) < maxq:
+                m = _Member(q, t0, leader=False, tok=tok)
+                g.members.append(m)
+                if len(g.members) >= maxq:
+                    g.state = "closing"
+                    g.close_ev.set()
+            else:
+                self._next_gid += 1
+                g = _Group(self._next_gid, q.datasource)
+                m = _Member(q, t0, leader=True, tok=tok)
+                g.members.append(m)
+                self._groups[q.datasource] = g
+
+        if m.leader:
+            self._hold_window(g, m, window_s)
+            with self._lock:
+                g.state = "closed"
+                if self._groups.get(q.datasource) is g:
+                    del self._groups[q.datasource]
+                members = list(g.members)
+            self._close_group(g, members)
+        else:
+            while not m.event.wait(_WAIT_POLL_S):
+                # honors the follower's OWN cancel/timeout while parked;
+                # a late delivery into an abandoned slot is harmless
+                eng._stage_check(q, t0)
+
+        out = m.outcome
+        if out is _FALLBACK:
+            return eng._execute_inner(q, t0)
+        if isinstance(out, BaseException):
+            raise out
+        if m.stats:
+            eng.last_stats.update(m.stats)
+        eng.last_stats["total_ms"] = (_time.perf_counter() - t0) * 1000
+        return out
+
+    def _hold_window(self, g: _Group, m: _Member, window_s: float) -> None:
+        """Leader parks for the micro-batch window (early close when the
+        group fills, or when the leader's own cancel/deadline fires —
+        held time counts against timeout_millis)."""
+        deadline = _time.perf_counter() + window_s
+        while not g.close_ev.is_set():
+            rem = deadline - _time.perf_counter()
+            if rem <= 0:
+                break
+            g.close_ev.wait(min(rem, 0.005))
+            try:
+                self.engine._stage_check(m.q, m.t0)
+            except BaseException:
+                break   # close now; _close_group re-checks and drops us
+
+    def _close_group(self, g: _Group, members: List[_Member]) -> None:
+        """Runs on the leader's thread. Every member gets an outcome and
+        (followers) a set event, no matter what — a fused-path crash
+        degrades the whole group to solo execution, never a hang."""
+        eng = self.engine
+        live = []
+        for m in members:
+            try:
+                eng._stage_check(m.q, m.t0)
+                live.append(m)
+            except BaseException as e:  # noqa: BLE001 — delivered as outcome
+                m.outcome = e           # cancelled/timed out while held:
+                #                         drops out before execution
+        fused_tried = len(live) >= 2
+        try:
+            if fused_tried:
+                self._run_fused(g, live)
+            else:
+                with self._lock:
+                    self.solo_groups += 1
+        except BaseException:  # noqa: BLE001 — degrade, don't strand
+            pass
+        finally:
+            n_fallback = 0
+            for m in members:
+                if m.outcome is None:
+                    m.outcome = _FALLBACK
+                    if fused_tried:
+                        n_fallback += 1
+                if not m.leader:
+                    m.event.set()
+            if n_fallback:
+                with self._lock:
+                    self.fallbacks += n_fallback
+
+    # -- fused planning + execution -------------------------------------------
+    def _run_fused(self, g: _Group, live: List[_Member]) -> None:
+        """Plan every live member against the union segment selection,
+        build/fetch ONE fused program keyed on the sorted tuple of lane
+        signatures, bind the column union once per wave, dispatch, and
+        demultiplex. Members that cannot ride stay at _FALLBACK."""
+        from spark_druid_olap_tpu.parallel import executor as X
+        eng = self.engine
+        ds_name = live[0].q.datasource
+        try:
+            ds = eng.store.get(ds_name)
+        except Exception:  # noqa: BLE001 — solo path reports the real error
+            return
+        if getattr(ds, "is_partial", False) or ds.num_rows == 0:
+            return
+
+        shaped = []
+        for m in live:
+            lp = self._shape_member(eng, ds, m.q)
+            if lp is not None:
+                shaped.append((m, lp))
+        if len(shaped) < 2:
+            return
+
+        seg_u = np.unique(np.concatenate([lp.seg for _, lp in shaped]))
+        mins, maxs = ds.segment_time_bounds()
+        min_day = int(mins[seg_u].min() // T.MILLIS_PER_DAY)
+        max_day = int(maxs[seg_u].max() // T.MILLIS_PER_DAY)
+
+        planned = []
+        for m, lp in shaped:
+            if self._plan_lane(eng, ds, lp, min_day, max_day):
+                planned.append((m, lp))
+        if len(planned) < 2:
+            return
+
+        # dedup identical specs into shared lanes, sorted by signature so
+        # the compile-cache key is order-independent across arrivals
+        by_sig: Dict[str, _LanePlan] = {}
+        for _, lp in planned:
+            by_sig.setdefault(lp.sig, lp)
+        sigs = tuple(sorted(by_sig))
+        lanes = [by_sig[s] for s in sigs]
+        lane_idx = {s: i for i, s in enumerate(sigs)}
+
+        union_cols = sorted(set().union(*[lp.needed for lp in lanes]))
+        union_time = any(lp.time_in_play for lp in lanes)
+        union_names = array_names(ds, union_cols, union_time)
+        seg_bytes = C.bytes_per_segment(ds, union_names)
+        spw, n_waves = C.plan_waves(
+            len(seg_u), 1, seg_bytes, C.wave_budget_bytes(eng.config),
+            eng.config, max(lp.n_keys for lp in lanes),
+            sum(len(lp.agg_plans) for lp in lanes))
+        s_pad = spw if n_waves > 1 else X._pad_segments(len(seg_u), 1)
+
+        sig = ("aggmulti", ds.name, id(ds), s_pad, ds.padded_rows,
+               min_day, max_day, tuple(union_names),
+               eng.config.get(TZ_ID), jax.default_backend(),
+               bool(jax.config.jax_enable_x64), sigs)
+        prog_fn, unpacks = eng._cached_program(
+            sig, lambda: self._build_fused_program(
+                ds, lanes, min_day, max_day))
+
+        per_lane_finals = self._dispatch(ds, union_names, seg_u, s_pad,
+                                         spw, n_waves, prog_fn, unpacks,
+                                         lanes, live[0])
+        results = [self._decode_lane(eng, ds, lp, fin)
+                   for lp, fin in zip(lanes, per_lane_finals)]
+
+        solo_bytes = sum(
+            C.bytes_per_segment(ds, lp.names) * len(lp.seg)
+            for _, lp in planned)
+        saved_bytes = max(0, solo_bytes - int(seg_bytes) * len(seg_u))
+        saved_disp = (len(planned) - 1) * n_waves
+        with self._lock:
+            self.groups_coalesced += 1
+            self.queries_coalesced += len(planned)
+            self.binds_saved_bytes += saved_bytes
+            self.dispatches_saved += saved_disp
+
+        for m, lp in planned:
+            li = lane_idx[lp.sig]
+            fin = per_lane_finals[li]
+            m.stats = {
+                "datasource": ds.name, "segments": int(len(lp.seg)),
+                "sharded": False, "rows_scanned": int(ds.num_rows),
+                "groups": int(np.count_nonzero(fin["__rows__"] > 0)),
+                "waves": int(n_waves), "segments_per_wave": int(spw),
+                "bytes_scanned": int(seg_bytes) * int(len(seg_u)),
+                "sharedscan": {
+                    "group": g.gid, "queries": len(planned),
+                    "lanes": len(lanes),
+                    "role": "leader" if m.leader else "follower",
+                    "binds_saved_bytes": saved_bytes,
+                    "dispatches_saved": saved_disp}}
+            m.outcome = results[li]
+            eng.inflight.annotate(m.tok, sharedscan_group=g.gid)
+
+    @staticmethod
+    def _shape_member(eng, ds, q) -> Optional[_LanePlan]:
+        """Map the spec to the engine's (dims, aggs, post, having, limit,
+        gran) shape (mirrors _execute_inner) + prune segments. None =
+        this member runs solo (e.g. empty pruning takes the engine's own
+        empty/identity-row path, which never touches the device)."""
+        from spark_druid_olap_tpu.parallel.executor import _cache_repr
+        try:
+            if isinstance(q, S.GroupByQuerySpec):
+                dims, having, limit = list(q.dimensions), q.having, q.limit
+            elif isinstance(q, S.TimeseriesQuerySpec):
+                dims, having, limit = [], None, None
+            elif isinstance(q, S.TopNQuerySpec):
+                dims, having = [q.dimension], None
+                limit = S.LimitSpec(
+                    (S.OrderByColumn(q.metric, ascending=False),),
+                    q.threshold)
+            else:
+                return None
+            seg = ds.prune_segments(q.intervals, q.filter)
+            if len(seg) == 0:
+                return None
+            return _LanePlan(q, _cache_repr(q), dims, q.aggregations,
+                             q.post_aggregations, having, limit,
+                             q.granularity, seg)
+        except Exception:  # noqa: BLE001 — solo path reports the real error
+            return None
+
+    @staticmethod
+    def _plan_lane(eng, ds, lp: _LanePlan, min_day: int,
+                   max_day: int) -> bool:
+        """Detailed planning against the GROUP's min/max day (every lane
+        must share one ScanContext day basis). False = member falls back
+        (hashed-tier cardinality, unsupported aggregation, wide ints on a
+        32-bit backend — everything the solo path handles specially)."""
+        from spark_druid_olap_tpu.parallel import executor as X
+        from spark_druid_olap_tpu.utils import config as CF
+        try:
+            gran_kind = lp.gran.kind if lp.gran else "all"
+            tz = eng.config.get(TZ_ID)
+            dim_plans = [X.plan_dimension(d, ds, min_day, max_day, tz)
+                         for d in lp.dims]
+            if gran_kind != "all":
+                dim_plans = [X.plan_granularity_dim(
+                    lp.gran, ds, min_day, max_day, tz)] + dim_plans
+            agg_plans = [X.plan_aggregation(a, ds) for a in lp.aggs]
+            n_keys = 1
+            for p in dim_plans:
+                n_keys *= p.card
+            if n_keys > eng.config.get(GROUPBY_DENSE_MAX_KEYS):
+                return False    # hashed tier: solo handles it
+            min_k = int(eng.config.get(CF.GROUPBY_SORTED_MIN_KEYS))
+            if min_k > 0 and n_keys >= min_k \
+                    and not any(p.kind in ("hll", "theta")
+                                for p in agg_plans) \
+                    and eng._sorted_run_wanted():
+                return False    # medium-K reroute territory: keep parity
+            needed = set()
+            for p in dim_plans:
+                needed |= set(p.source_cols)
+            for p in agg_plans:
+                needed |= set(p.source_cols)
+            needed |= F.columns_of_filter(lp.q.filter)
+            time_in_play = ds.time is not None and (
+                lp.q.intervals is not None or gran_kind != "all"
+                or ds.time.name in needed)
+            if time_in_play:
+                needed.add(ds.time.name)
+            names = array_names(ds, sorted(needed), time_in_play)
+            if not G._x64():
+                for k in names:
+                    if array_dtype(ds, k) == np.int64:
+                        return False   # wide ints on a 32-bit backend
+            lp.dim_plans = dim_plans
+            lp.agg_plans = agg_plans
+            lp.n_keys = n_keys
+            lp.routes = eng._plan_routes(agg_plans, n_keys, ds)
+            lp.needed = needed
+            lp.time_in_play = time_in_play
+            lp.names = names
+            return True
+        except Exception:  # noqa: BLE001 — solo path reports the real error
+            return False
+
+    def _build_fused_program(self, ds, lanes: List[_LanePlan],
+                             min_day: int, max_day: int):
+        """(jit_fn, [per-lane unpack]). One ScanContext over the union
+        bind; each lane is the engine's dense core (mask -> fused keys ->
+        dense_groupby -> sketch registers) packed through its own
+        two-buffer packers, so per-lane decode reuses the solo path
+        byte-for-byte."""
+        eng = self.engine
+        matmul_max = eng.config.get(GROUPBY_MATMUL_MAX_KEYS)
+        log2m = eng.config.get(HLL_LOG2M)
+        tz = eng.config.get(TZ_ID)
+        packers = [eng._agg_meta_packers(lp.agg_plans, lp.routes,
+                                         lp.n_keys, with_idx=False)
+                   for lp in lanes]
+
+        def fused(arrays):
+            ctx = ScanContext(ds, arrays, min_day, max_day, tz=tz)
+            rv = ctx.row_valid()
+            outs = []
+            for lp, (pack, _) in zip(lanes, packers):
+                base = rv
+                fm = F.lower_filter(lp.q.filter, ctx)
+                if fm is not None:
+                    base = base & fm
+                im = F.interval_mask(lp.q.intervals, ctx)
+                if im is not None:
+                    base = base & im
+                if lp.dim_plans:
+                    codes = [p.build(ctx) for p in lp.dim_plans]
+                    key, _ = G.fuse_keys(codes,
+                                         [p.card for p in lp.dim_plans])
+                else:
+                    key = jnp.zeros_like(base, dtype=jnp.int32)
+                inputs = []
+                for p in lp.agg_plans:
+                    if p.kind in ("hll", "theta"):
+                        continue
+                    inputs.append(G.AggInput(p.spec.name, p.kind,
+                                             p.build_values(ctx),
+                                             p.build_mask(ctx),
+                                             is_int=p.is_int,
+                                             maxabs=p.maxabs))
+                inputs.append(G.AggInput("__rows__", "count", is_int=True,
+                                         maxabs=1.0))
+                out = G.dense_groupby(key, base, lp.n_keys, inputs,
+                                      lp.routes, matmul_max)
+                for p in lp.agg_plans:
+                    if p.kind not in ("hll", "theta"):
+                        continue
+                    vals = p.build_values(ctx)
+                    am = p.build_mask(ctx)
+                    m = base if am is None else (base & am)
+                    if p.kind == "hll":
+                        out[p.spec.name] = HLL.hll_registers(
+                            key, m, vals, lp.n_keys, log2m)
+                    else:
+                        out[p.spec.name] = TH.theta_registers(
+                            key, m, vals, lp.n_keys)
+                outs.append(pack(out))
+            return tuple(outs)
+
+        return jax.jit(fused), [u for _, u in packers]
+
+    def _dispatch(self, ds, union_names, seg_u, s_pad, spw, n_waves,
+                  prog_fn, unpacks, lanes: List[_LanePlan], leader):
+        """One shared bind + ONE program dispatch per wave (double-
+        buffered like _run_waves); per-lane unpack -> finals -> cross-
+        wave merge. All device ticks land on the leader's thread."""
+        from spark_druid_olap_tpu.parallel import executor as X
+        eng = self.engine
+        sketch = [[p for p in lp.agg_plans if p.kind in ("hll", "theta")]
+                  for lp in lanes]
+        if n_waves == 1:
+            dev = eng._bind_arrays(ds, union_names, seg_u, s_pad, False)
+            eng._stage_check(leader.q, leader.t0)
+            eng._tick()
+            bufs = prog_fn(dev)
+            return [X._finals_from_out(unpacks[i](bufs[i]), lp.routes,
+                                       lp.n_keys, sketch[i])
+                    for i, lp in enumerate(lanes)]
+        wave_segs = [seg_u[i: i + spw] for i in range(0, len(seg_u), spw)]
+        finals: List[Optional[dict]] = [None] * len(lanes)
+        cur = eng._bind_wave(ds, union_names, wave_segs[0], spw, None,
+                             False)
+        for i in range(len(wave_segs)):
+            eng._stage_check(leader.q, leader.t0)
+            eng._tick()
+            bufs = prog_fn(cur)            # async dispatch
+            nxt = eng._bind_wave(ds, union_names, wave_segs[i + 1], spw,
+                                 None, False) \
+                if i + 1 < len(wave_segs) else None
+            for li, lp in enumerate(lanes):
+                f = X._finals_from_out(unpacks[li](bufs[li]), lp.routes,
+                                       lp.n_keys, sketch[li])
+                finals[li] = f if finals[li] is None \
+                    else X._merge_wave_finals(finals[li], f, lp.routes,
+                                              sketch[li])
+            cur = nxt
+        return finals
+
+    @staticmethod
+    def _decode_lane(eng, ds, lp: _LanePlan, finals) -> QueryResult:
+        """Host demultiplex of one lane: the solo dense decode (group
+        selection, dictionary decode, identity row, epilogue) minus the
+        device-topk/having specializations the fused tier never plans."""
+        from spark_druid_olap_tpu.parallel import executor as X
+        rows = finals["__rows__"]
+        sel = np.nonzero(rows > 0)[0]
+        gran_kind = lp.gran.kind if lp.gran else "all"
+        global_empty = (not lp.dim_plans and gran_kind == "all"
+                        and len(sel) == 0)
+        if global_empty:
+            sel = np.zeros(1, dtype=np.int64)
+        data: Dict[str, np.ndarray] = {}
+        columns: List[str] = []
+        if lp.dim_plans:
+            code_lists = G.unfuse_key(sel, [p.card for p in lp.dim_plans])
+            for p, codes in zip(lp.dim_plans, code_lists):
+                data[p.output_name] = p.decode(codes)
+                columns.append(p.output_name)
+        for p in lp.agg_plans:
+            name = p.spec.name
+            if p.kind in ("hll", "theta"):
+                regs = finals[name]
+                est = (HLL.estimate(regs) if p.kind == "hll"
+                       else TH.estimate(regs))[sel]
+                data[name] = np.round(est).astype(np.int64)
+                columns.append(name)
+                continue
+            data[name] = X._decode_agg_value(ds, p, lp.routes[name],
+                                             finals[name][sel])
+            columns.append(name)
+        if global_empty:
+            data.update(X._identity_row(
+                {p.spec.name: p.kind for p in lp.agg_plans
+                 if p.kind in ("sum", "min", "max")}))
+        data = eng._agg_epilogue(data, columns, lp.post, lp.having,
+                                 lp.limit)
+        return QueryResult(columns, data)
+
+    def note_handoff(self) -> None:
+        """Called by the WLM poll loop when a queued waiter bypasses its
+        lane to ride an open group's dispatch."""
+        with self._lock:
+            self.wlm_handoffs += 1
+
+    # -- observability ---------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {"enabled": self.enabled(),
+                    "groups_coalesced": self.groups_coalesced,
+                    "solo_groups": self.solo_groups,
+                    "queries_coalesced": self.queries_coalesced,
+                    "fallbacks": self.fallbacks,
+                    "binds_saved_bytes": self.binds_saved_bytes,
+                    "dispatches_saved": self.dispatches_saved,
+                    "wlm_handoffs": self.wlm_handoffs}

@@ -268,6 +268,15 @@ def explain_text(ctx, stmt: A.SelectStmt, sql: str) -> str:
     if pq.distinct_phase2:
         lines.append(f"  phase2: exact count-distinct over "
                      f"{pq.distinct_phase2.group_cols}")
+    from spark_druid_olap_tpu.utils.config import (SHAREDSCAN_ENABLED,
+                                                   WLM_BATCH_WINDOW_MS)
+    if ctx.config.get(SHAREDSCAN_ENABLED):
+        from spark_druid_olap_tpu.cache.keys import cacheable
+        n_elig = sum(1 for q in pq.specs if cacheable(q))
+        lines.append(
+            f"sharedscan: ON — {n_elig}/{len(pq.specs)} spec(s) eligible "
+            f"to coalesce with concurrent queries on the same datasource "
+            f"(hold window {ctx.config.get(WLM_BATCH_WINDOW_MS)}ms)")
     return "\n".join(lines)
 
 

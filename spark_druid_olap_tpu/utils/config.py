@@ -347,6 +347,28 @@ WLM_BATCH_COST = _entry(
     "channel as free-form keys: 'sdot.wlm.quota.<tenant>' = "
     "'concurrent=N,budget=F,refill=F' ('default' is the template for "
     "tenants without an explicit entry).", float)
+# --- shared-scan multi-query execution (parallel/sharedscan.py) ---------------
+SHAREDSCAN_ENABLED = _entry(
+    "sdot.sharedscan.enabled", False,
+    "Coalesce concurrent eligible queries (engine-mode GroupBy / "
+    "Timeseries / TopN) over the same datasource into ONE fused device "
+    "program: each segment wave's column union binds once and every "
+    "constituent's filter + aggregation lanes evaluate against the "
+    "shared in-HBM bind, then results demultiplex per query (each still "
+    "populating the result cache under its own canonical key). Off by "
+    "default: solo workloads pay the hold window for nothing.")
+WLM_BATCH_WINDOW_MS = _entry(
+    "sdot.wlm.batch.window.ms", 8.0,
+    "Micro-batch hold window for the shared-scan tier: the first "
+    "eligible query on a datasource holds this long for companions "
+    "before dispatching (group-commit semantics). Held time counts "
+    "against the query's own timeout_millis. The window closes early "
+    "when sdot.sharedscan.max.queries constituents have joined.", float)
+SHAREDSCAN_MAX_QUERIES = _entry(
+    "sdot.sharedscan.max.queries", 8,
+    "Constituent cap per coalesced group: the hold window closes early "
+    "at this size, bounding fused-program width (compile cost and "
+    "output-buffer size grow with every extra query lane).")
 # --- durable segment persistence (persist/) -----------------------------------
 PERSIST_PATH = _entry(
     "sdot.persist.path", "",

@@ -51,6 +51,10 @@ class Ticket:
     timeout_millis: Optional[int]   # effective (context or lane default)
     _lane_obj: Lane = dataclasses.field(repr=False, default=None)
     _started: float = 0.0
+    # admitted via the shared-scan handoff: the query rides a coalesced
+    # group's dispatch instead of a lane slot, so release() must not hand
+    # back a slot it never took
+    coalesced: bool = False
 
     def stats(self) -> dict:
         d = {"lane": self.lane, "queued_ms": round(self.queued_ms, 2),
@@ -59,6 +63,8 @@ class Ticket:
             d["tenant"] = self.tenant
         if self.demoted:
             d["demoted"] = True
+        if self.coalesced:
+            d["coalesced_handoff"] = True
         return d
 
 
@@ -78,6 +84,9 @@ class WorkloadManager:
         # global counters
         self.admitted_total = 0
         self.shed_total = 0
+        # set by the owning QueryEngine; lets queued waiters hand off to
+        # an open shared-scan group instead of draining serially
+        self.sharedscan = None
 
     # -- configuration ---------------------------------------------------------
     @property
@@ -224,6 +233,31 @@ class WorkloadManager:
                 if waiter.event.wait(_POLL_S):
                     break
                 now = time.perf_counter()
+                coal = self.sharedscan
+                if coal is not None and coal.should_try(q) \
+                        and coal.open_group_hint(
+                            getattr(q, "datasource", None)):
+                    # shared-scan handoff: a compatible group is holding
+                    # its micro-batch window — ride its fused dispatch
+                    # instead of waiting for a serial slot. The query
+                    # leaves the queue WITHOUT taking a slot (the group
+                    # leader owns the lane occupancy for the dispatch).
+                    with self._lock:
+                        if not waiter.granted:
+                            lane.remove(waiter)
+                            lane.admitted += 1
+                            lane.coalesced_handoff += 1
+                            self.admitted_total += 1
+                            queued_ms = (now - enq) * 1000.0
+                            lane.queued_ms_total += queued_ms
+                            coal.note_handoff()
+                            return Ticket(lane_name, tenant, priority,
+                                          queued_ms, est, demoted,
+                                          timeout_ms, lane,
+                                          time.perf_counter(),
+                                          coalesced=True)
+                        # a grant raced the handoff: keep the slot
+                        break
                 if cancel_event is not None and cancel_event.is_set():
                     self._unhook(lane, waiter, tenant, "cancel")
                     from spark_druid_olap_tpu.parallel.executor import (
@@ -276,20 +310,25 @@ class WorkloadManager:
     def release(self, ticket: Ticket) -> None:
         run_ms = (time.perf_counter() - ticket._started) * 1000.0
         with self._lock:
-            ticket._lane_obj.release(run_ms)
+            if not ticket.coalesced:
+                # coalesced handoffs never took a lane slot
+                ticket._lane_obj.release(run_ms)
             self.quotas.release(ticket.tenant)
 
     # -- observability ---------------------------------------------------------
     def stats(self) -> dict:
         with self._lock:
             self._refresh_locked()
-            return {"enabled": self.enabled,
-                    "admitted": self.admitted_total,
-                    "shed": self.shed_total,
-                    "default_lane": self._default_lane,
-                    "lanes": [ln.snapshot()
-                              for _, ln in sorted(self._lanes.items())],
-                    "tenants": self.quotas.snapshot()}
+            out = {"enabled": self.enabled,
+                   "admitted": self.admitted_total,
+                   "shed": self.shed_total,
+                   "default_lane": self._default_lane,
+                   "lanes": [ln.snapshot()
+                             for _, ln in sorted(self._lanes.items())],
+                   "tenants": self.quotas.snapshot()}
+        if self.sharedscan is not None:
+            out["sharedscan"] = self.sharedscan.stats()
+        return out
 
     def lanes_view(self):
         """``sys_lanes`` — one row per configured lane."""

@@ -108,6 +108,8 @@ class Lane:
         self.shed = 0                # queue-depth rejections
         self.timed_out = 0           # wait-budget rejections
         self.cancelled_queued = 0    # cancels honored while still queued
+        self.coalesced_handoff = 0   # waiters bypassed into a shared-scan
+        #                              group (counted in `admitted` too)
         self.queued_ms_total = 0.0
         self.run_ms_ewma = 0.0       # released-query runtime (retry hints)
 
@@ -168,6 +170,7 @@ class Lane:
                 "demoted_in": self.demoted_in, "shed": self.shed,
                 "timed_out": self.timed_out,
                 "cancelled_queued": self.cancelled_queued,
+                "coalesced_handoff": self.coalesced_handoff,
                 "max_active_seen": self.max_active_seen,
                 "queued_ms_total": round(self.queued_ms_total, 2),
                 "run_ms_ewma": round(self.run_ms_ewma, 2)}

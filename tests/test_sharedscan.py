@@ -1,0 +1,399 @@
+"""Shared-scan multi-query execution (parallel/sharedscan.py).
+
+Differential tests: a batch of concurrent eligible queries coalesced into
+one fused device dispatch must return bit-identical answers to the same
+queries run sequentially with coalescing disabled — across mixed filters,
+granularities, query types (GroupBy / Timeseries / TopN), datasources
+(TPC-H + SSB stars), fallback shapes, and mid-batch cancellation. Plus
+the deterministic perf smoke: the fused batch must report fewer device
+dispatches and positive bind savings (counted via ``dispatch_counts`` and
+coalescer stats, never wall time).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.ir import spec as S
+from spark_druid_olap_tpu.parallel.executor import QueryCancelled, QueryEngine
+from spark_druid_olap_tpu.segment.ingest import ingest_dataframe
+from spark_druid_olap_tpu.segment.store import SegmentStore
+from spark_druid_olap_tpu.utils.config import Config
+from spark_druid_olap_tpu.tools import ssb, tpch
+
+from conftest import assert_frames_equal, make_sales_df
+
+
+# -- harness ------------------------------------------------------------------
+
+# Wide hold window so every thread of a batch reliably joins the same
+# group even under CI scheduling jitter; the waiters poll their own
+# cancel/timeout checks every 20ms, so a wide window stays responsive.
+WINDOW_MS = 500.0
+
+
+def _engine(store, **overrides):
+    cfg = {"sdot.sharedscan.enabled": True,
+           "sdot.wlm.batch.window.ms": WINDOW_MS,
+           "sdot.wlm.enabled": False}
+    cfg.update(overrides)
+    return QueryEngine(store, config=Config(cfg))
+
+
+def _ref_engine(store, **overrides):
+    cfg = {"sdot.sharedscan.enabled": False, "sdot.wlm.enabled": False}
+    cfg.update(overrides)
+    return QueryEngine(store, config=Config(cfg))
+
+
+def _run_concurrent(eng, specs, collect_stats=False):
+    """Fire all specs at once (barrier start) and return per-query results
+    (frames), errors, and optionally the per-thread last_stats snapshots."""
+    n = len(specs)
+    res, errs, stats = [None] * n, [None] * n, [None] * n
+    bar = threading.Barrier(n)
+
+    def worker(i):
+        bar.wait()
+        try:
+            res[i] = eng.execute(specs[i]).to_pandas()
+            if collect_stats:
+                stats[i] = dict(eng.last_stats)
+        except Exception as e:          # noqa: BLE001 - surfaced via errs
+            errs[i] = e
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return res, errs, stats
+
+
+def _diff(eng, eng_ref, specs, min_coalesced=2):
+    """Differential: concurrent coalesced answers == sequential answers."""
+    before = eng.sharedscan.stats()["queries_coalesced"]
+    ref = [eng_ref.execute(q).to_pandas() for q in specs]
+    res, errs, _ = _run_concurrent(eng, specs)
+    assert not any(errs), [e for e in errs if e]
+    for got, want in zip(res, ref):
+        assert_frames_equal(got, want)
+    gained = eng.sharedscan.stats()["queries_coalesced"] - before
+    assert gained >= min_coalesced, (
+        f"expected >= {min_coalesced} coalesced constituents, got {gained}: "
+        f"{eng.sharedscan.stats()}")
+
+
+# -- sales-store batches ------------------------------------------------------
+
+AGGS = (S.AggregationSpec("doublesum", "revenue", field="price"),
+        S.AggregationSpec("longsum", "units", field="qty"),
+        S.AggregationSpec("count", "n"))
+
+
+def _sales_batch():
+    """Mixed shapes over one datasource: plain GroupBy, filtered GroupBy,
+    monthly Timeseries, interval-restricted Timeseries, TopN."""
+    return [
+        S.GroupByQuerySpec("sales", (S.DimensionSpec("region", "region"),),
+                           AGGS),
+        S.GroupByQuerySpec("sales", (S.DimensionSpec("flag", "flag"),),
+                           AGGS, filter=S.SelectorFilter("status", "O")),
+        S.TimeseriesQuerySpec("sales", AGGS,
+                              granularity=S.Granularity("month")),
+        S.TimeseriesQuerySpec(
+            "sales", AGGS,
+            intervals=((int(pd.Timestamp("2015-03-01").value // 10**6),
+                        int(pd.Timestamp("2016-02-01").value // 10**6)),)),
+        S.TopNQuerySpec("sales", S.DimensionSpec("product", "product"),
+                        "revenue", 7, AGGS),
+    ]
+
+
+def test_sales_mixed_batch_matches_sequential(store):
+    eng = _engine(store)
+    _diff(eng, _ref_engine(store), _sales_batch(), min_coalesced=4)
+
+
+def test_repeat_batches_reuse_compile_cache(store):
+    """Second identical batch must coalesce again (and hit the fused
+    program cache rather than recompiling per batch)."""
+    eng = _engine(store)
+    specs = _sales_batch()[:3]
+    ref = [_ref_engine(store).execute(q).to_pandas() for q in specs]
+    for _ in range(2):
+        res, errs, _ = _run_concurrent(eng, specs)
+        assert not any(errs), [e for e in errs if e]
+        for got, want in zip(res, ref):
+            assert_frames_equal(got, want)
+    st = eng.sharedscan.stats()
+    assert st["groups_coalesced"] >= 2
+    n_fused = sum(1 for sig in eng._programs if sig and sig[0] == "aggmulti")
+    assert n_fused == 1, "identical batches must share one fused program"
+
+
+# -- TPC-H / SSB differential batches ----------------------------------------
+
+@pytest.fixture(scope="module")
+def tpch_ctx():
+    ctx = sdot.Context({"sdot.sharedscan.enabled": True,
+                        "sdot.wlm.batch.window.ms": WINDOW_MS})
+    tpch.setup_context(ctx, sf=0.002, target_rows=4096, flat_only=True)
+    return ctx
+
+
+@pytest.fixture(scope="module")
+def ssb_ctx():
+    ctx = sdot.Context({"sdot.sharedscan.enabled": True,
+                        "sdot.wlm.batch.window.ms": WINDOW_MS})
+    ssb.setup_context(ctx, sf=0.003, target_rows=4096, flat_only=True)
+    return ctx
+
+
+def test_tpch_mixed_batch_matches_sequential(tpch_ctx):
+    aggs = (S.AggregationSpec("doublesum", "revenue",
+                              field="l_extendedprice"),
+            S.AggregationSpec("longsum", "qty", field="l_quantity"),
+            S.AggregationSpec("count", "n"))
+    specs = [
+        S.GroupByQuerySpec("tpch_flat",
+                           (S.DimensionSpec("l_returnflag", "l_returnflag"),
+                            S.DimensionSpec("l_linestatus", "l_linestatus")),
+                           aggs),
+        S.GroupByQuerySpec("tpch_flat",
+                           (S.DimensionSpec("c_mktsegment", "seg"),),
+                           aggs, filter=S.SelectorFilter("l_returnflag", "R")),
+        S.TimeseriesQuerySpec("tpch_flat", aggs,
+                              granularity=S.Granularity("year")),
+        S.TopNQuerySpec("tpch_flat", S.DimensionSpec("p_brand", "p_brand"),
+                        "revenue", 5, aggs),
+    ]
+    eng = tpch_ctx.engine
+    _diff(eng, _ref_engine(eng.store), specs, min_coalesced=3)
+
+
+def test_ssb_mixed_batch_matches_sequential(ssb_ctx):
+    aggs = (S.AggregationSpec("longsum", "revenue", field="lo_revenue"),
+            S.AggregationSpec("longsum", "qty", field="lo_quantity"),
+            S.AggregationSpec("count", "n"))
+    specs = [
+        S.GroupByQuerySpec("ssb_flat",
+                           (S.DimensionSpec("c_region", "c_region"),), aggs),
+        S.GroupByQuerySpec("ssb_flat",
+                           (S.DimensionSpec("p_category", "p_category"),),
+                           aggs, filter=S.SelectorFilter("s_region",
+                                                         "AMERICA")),
+        S.TimeseriesQuerySpec("ssb_flat", aggs,
+                              granularity=S.Granularity("year")),
+    ]
+    eng = ssb_ctx.engine
+    _diff(eng, _ref_engine(eng.store), specs, min_coalesced=2)
+
+
+# -- cache-key isolation ------------------------------------------------------
+
+def test_constituents_populate_cache_under_own_keys(store):
+    """Each coalesced constituent must land in the result cache under its
+    own canonical key: a later solo re-run of every member is a hit and
+    returns the identical frame."""
+    eng = _engine(store, **{"sdot.cache.enabled": True})
+    specs = _sales_batch()[:4]
+    res, errs, stats = _run_concurrent(eng, specs, collect_stats=True)
+    assert not any(errs), [e for e in errs if e]
+    assert all(s.get("cache") == "miss" for s in stats)
+    assert eng.sharedscan.stats()["queries_coalesced"] >= 3
+    for q, fused_frame in zip(specs, res):
+        again = eng.execute(q).to_pandas()       # solo, same thread
+        assert eng.last_stats.get("cache") == "hit", (q, eng.last_stats)
+        assert_frames_equal(again, fused_frame)
+
+
+# -- ineligible shapes fall back, correctly ----------------------------------
+
+def test_select_paging_never_coalesces(store):
+    """Select (raw-row paging) is not an engine aggregation shape — it must
+    run solo even when fired inside an eligible batch."""
+    eng = _engine(store)
+    sel = S.SelectQuerySpec("sales", ("region", "qty"),
+                            filter=S.SelectorFilter("status", "F"),
+                            page_size=100)
+    assert not eng.sharedscan.should_try(sel)
+    specs = [_sales_batch()[0], _sales_batch()[2], sel]
+    before = eng.sharedscan.stats()["queries_coalesced"]
+    ref = [_ref_engine(store).execute(q).to_pandas() for q in specs]
+    res, errs, _ = _run_concurrent(eng, specs)
+    assert not any(errs), [e for e in errs if e]
+    for got, want in zip(res, ref):
+        assert_frames_equal(got, want)
+    # only the two aggregate queries may have fused
+    assert eng.sharedscan.stats()["queries_coalesced"] - before <= 2
+
+
+def test_different_datasources_form_different_groups(sales_df):
+    st = SegmentStore()
+    st.register(ingest_dataframe("sales", sales_df, time_column="ts",
+                                 target_rows=4096))
+    st.register(ingest_dataframe("sales_eu", make_sales_df(n=8000, seed=11),
+                                 time_column="ts", target_rows=4096))
+    eng = _engine(st)
+    gb = lambda ds: S.GroupByQuerySpec(  # noqa: E731
+        ds, (S.DimensionSpec("region", "region"),), AGGS)
+    ts = lambda ds: S.TimeseriesQuerySpec(  # noqa: E731
+        ds, AGGS, granularity=S.Granularity("month"))
+    specs = [gb("sales"), ts("sales"), gb("sales_eu"), ts("sales_eu")]
+    ref = [_ref_engine(st).execute(q).to_pandas() for q in specs]
+    res, errs, stats = _run_concurrent(eng, specs, collect_stats=True)
+    assert not any(errs), [e for e in errs if e]
+    for got, want in zip(res, ref):
+        assert_frames_equal(got, want)
+    groups = {}
+    for q, s in zip(specs, stats):
+        ss = s.get("sharedscan")
+        if ss:
+            groups.setdefault(q.datasource, set()).add(ss["group"])
+    for ds_name, gids in groups.items():
+        assert len(gids) == 1, (ds_name, gids)
+    if "sales" in groups and "sales_eu" in groups:
+        assert groups["sales"].isdisjoint(groups["sales_eu"]), (
+            "a coalesced group crossed datasources")
+
+
+def test_host_tier_residual_falls_back_solo(store):
+    """A member whose lane cannot run on the dense device tier (key
+    cardinality above the dense cap -> hashed/host tier) must fall back to
+    its own solo execution while the rest of the batch still fuses."""
+    eng = _engine(store, **{"sdot.engine.groupby.dense.max.keys": 8})
+    specs = [
+        # flag (3 values) and status (2 values): under the cap, fusable
+        S.GroupByQuerySpec("sales", (S.DimensionSpec("flag", "flag"),),
+                           AGGS),
+        S.GroupByQuerySpec("sales", (S.DimensionSpec("status", "status"),),
+                           AGGS),
+        # product (50 values): over the cap -> hashed tier, solo fallback
+        S.GroupByQuerySpec("sales", (S.DimensionSpec("product", "product"),),
+                           AGGS),
+    ]
+    ref = [_ref_engine(store).execute(q).to_pandas() for q in specs]
+    res, errs, _ = _run_concurrent(eng, specs)
+    assert not any(errs), [e for e in errs if e]
+    for got, want in zip(res, ref):
+        assert_frames_equal(got, want)
+    st = eng.sharedscan.stats()
+    assert st["queries_coalesced"] >= 2
+    assert st["fallbacks"] >= 1, st
+
+
+# -- cancellation -------------------------------------------------------------
+
+def test_cancel_one_of_the_batch(store):
+    """Cancelling one constituent during the hold window drops only that
+    member (QueryCancelled); the survivors' fused answers are unchanged."""
+    eng = _engine(store, **{"sdot.wlm.batch.window.ms": 800.0})
+    victim = S.GroupByQuerySpec(
+        "sales", (S.DimensionSpec("product", "product"),), AGGS,
+        context=S.QueryContext(query_id="sharedscan-victim"))
+    survivors = [_sales_batch()[0], _sales_batch()[2]]
+    specs = survivors + [victim]
+    ref = [_ref_engine(store).execute(q).to_pandas() for q in survivors]
+
+    n = len(specs)
+    res, errs = [None] * n, [None] * n
+    bar = threading.Barrier(n + 1)      # +1: the cancelling main thread
+
+    def worker(i):
+        bar.wait()
+        try:
+            res[i] = eng.execute(specs[i]).to_pandas()
+        except Exception as e:          # noqa: BLE001
+            errs[i] = e
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    bar.wait()
+    time.sleep(0.2)                     # well inside the 800ms hold window
+    assert eng.cancel("sharedscan-victim")
+    for t in threads:
+        t.join()
+
+    assert isinstance(errs[n - 1], QueryCancelled), errs[n - 1]
+    for i, want in enumerate(ref):
+        assert errs[i] is None, errs[i]
+        assert_frames_equal(res[i], want)
+
+
+# -- WLM handoff --------------------------------------------------------------
+
+def test_wlm_queue_hands_off_into_open_group(store):
+    """Queries queued behind a full lane are handed to an open coalesced
+    group by the admission poll loop instead of waiting for a slot."""
+    eng = _engine(store, **{
+        "sdot.wlm.enabled": True,
+        "sdot.wlm.lanes": "interactive:slots=1,queue=16",
+        "sdot.wlm.default.lane": "interactive",
+        "sdot.wlm.batch.cost.threshold": 0})
+    specs = _sales_batch()[:4]
+    ref = [_ref_engine(store).execute(q).to_pandas() for q in specs]
+    res, errs, _ = _run_concurrent(eng, specs)
+    assert not any(errs), [e for e in errs if e]
+    for got, want in zip(res, ref):
+        assert_frames_equal(got, want)
+    st = eng.wlm.stats()
+    assert st["sharedscan"]["queries_coalesced"] >= 2
+    assert st["sharedscan"]["wlm_handoffs"] >= 1, st
+    lane = next(l for l in st["lanes"] if l["lane"] == "interactive")
+    assert lane["coalesced_handoff"] >= 1, lane
+
+
+# -- deterministic perf smoke (CI gate) ---------------------------------------
+
+def test_coalesced_batch_saves_dispatches_and_binds(store):
+    """The CI perf gate: a 4-query coalesced batch must cost fewer device
+    dispatches than sequential execution and must report positive bind
+    savings. Counted via the engine's monotone ``dispatch_counts`` and the
+    coalescer's stats — never wall time, so this is jitter-free."""
+    specs = _sales_batch()[:4]
+
+    eng_off = _ref_engine(store)
+    d0 = eng_off.dispatch_counts[0]
+    for q in specs:
+        eng_off.execute(q)
+    seq_dispatches = eng_off.dispatch_counts[0] - d0
+    assert seq_dispatches >= len(specs)
+
+    eng_on = _engine(store)
+    per_thread = [0] * len(specs)
+    errs = [None] * len(specs)
+    bar = threading.Barrier(len(specs))
+
+    def worker(i):
+        bar.wait()
+        base = eng_on.dispatch_counts[0]     # thread-local counter
+        try:
+            eng_on.execute(specs[i])
+            per_thread[i] = eng_on.dispatch_counts[0] - base
+        except Exception as e:              # noqa: BLE001
+            errs[i] = e
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(specs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not any(errs), [e for e in errs if e]
+
+    coal_dispatches = sum(per_thread)
+    st = eng_on.sharedscan.stats()
+    assert st["queries_coalesced"] == len(specs), st
+    # one fused dispatch replaced four solo dispatches
+    assert coal_dispatches < seq_dispatches, (coal_dispatches,
+                                              seq_dispatches)
+    assert seq_dispatches - coal_dispatches >= len(specs) - 1
+    assert st["dispatches_saved"] >= len(specs) - 1, st
+    # the union bind is strictly smaller than four per-query binds
+    assert st["binds_saved_bytes"] > 0, st
