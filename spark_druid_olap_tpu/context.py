@@ -54,8 +54,13 @@ class Context:
         from spark_druid_olap_tpu.metadata.catalog import Catalog
         self.catalog = Catalog(self.store)
         from spark_druid_olap_tpu.metadata.history import QueryHistory
-        from spark_druid_olap_tpu.utils.config import QUERY_HISTORY_SIZE
-        self.history = QueryHistory(self.config.get(QUERY_HISTORY_SIZE))
+        from spark_druid_olap_tpu.utils.config import (QUERY_HISTORY,
+                                                       QUERY_HISTORY_SIZE)
+        # disabled history keeps the registry but records nothing
+        # (maxlen=0 deque): every record() call stays a cheap no-op
+        self.history = QueryHistory(
+            self.config.get(QUERY_HISTORY_SIZE)
+            if self.config.get(QUERY_HISTORY) else 0)
         # named lookup tables for the SQL LOOKUP(col, 'name') function
         # (≈ Druid registered lookups backing the lookup extraction fn)
         self.lookups: Dict[str, Dict[str, Optional[str]]] = {}

@@ -1,0 +1,49 @@
+"""The declared merge-closure of every aggregate the engine registers.
+
+Adding an aggregation kind touches four places that must stay mutually
+consistent or waves / multi-host shards / rollups / shared-scan quietly
+break: the executor's kind table (``parallel/executor.py:_AGG_KIND``),
+the cross-chip merge (``ops/groupby.py:merge_partials``), the rollup
+re-aggregation table (``mv/match.py``), and the shared-scan demux
+(``parallel/sharedscan.py``). This module is the single declaration the
+``mergeclosure`` sdlint pass cross-checks against all four — register
+the new kind HERE first and the linter will point at every site that
+still needs teaching.
+
+Fields per druid-level kind:
+
+- ``route``  — the internal lowered kind (``ops/groupby.py`` Route
+  vocabulary: count/sum/min/max) or the sketch name for sketches.
+- ``dtype``  — accumulator dtype name as ``numpy`` spells it.
+- ``reagg``  — the kind literal ``mv/match.py`` re-aggregates stored
+  partials with (losslessly merge-closed), or None when rollup must
+  reject it (sketch registers are not closed over stored partials).
+- ``sketch`` — "hll"/"theta" for register-valued aggregates that need
+  their own shared-scan demux + wave-merge handling, else None.
+
+Kept import-free and ``ast.literal_eval``-parseable on purpose: sdlint
+reads this file without importing it (and so without jax installed).
+"""
+
+AGG_CLOSURE = {
+    "count":       {"route": "count", "dtype": "int64",
+                    "reagg": "count", "sketch": None},
+    "longsum":     {"route": "sum", "dtype": "int64",
+                    "reagg": "longsum", "sketch": None},
+    "doublesum":   {"route": "sum", "dtype": "float64",
+                    "reagg": "doublesum", "sketch": None},
+    "longmin":     {"route": "min", "dtype": "int64",
+                    "reagg": "longmin", "sketch": None},
+    "longmax":     {"route": "max", "dtype": "int64",
+                    "reagg": "longmax", "sketch": None},
+    "doublemin":   {"route": "min", "dtype": "float64",
+                    "reagg": "doublemin", "sketch": None},
+    "doublemax":   {"route": "max", "dtype": "float64",
+                    "reagg": "doublemax", "sketch": None},
+    "cardinality": {"route": "hll", "dtype": "int64",
+                    "reagg": None, "sketch": "hll"},
+    "thetasketch": {"route": "theta", "dtype": "int64",
+                    "reagg": None, "sketch": "theta"},
+    "anyvalue":    {"route": "max", "dtype": "float64",
+                    "reagg": "anyvalue", "sketch": None},
+}

@@ -136,7 +136,9 @@ def _tpu_backend() -> bool:
         return False
 
 
-def _interpret() -> bool:
+def _interpret() -> bool:  # sdlint: disable=purity (trace-time mode
+    # flag: freezing the env read into the compiled program is the point
+    # — interpret-vs-Mosaic must be decided once per compilation)
     if os.environ.get("SDOT_PALLAS", "") == "interpret":
         return True
     return not _tpu_backend()

@@ -3216,10 +3216,14 @@ class QueryEngine:
         return out
 
     def clear_caches(self):
-        self._programs.clear()
-        self._compact_overflowed.clear()
-        self._device_arrays.clear()
-        self._device_bytes = 0
+        # under the compile lock: the backend-lost recovery thread calls
+        # this concurrently with query threads populating the same dicts
+        # in _cached_program/_device_tables (sdlint locks/unguarded-write)
+        with self._compile_lock:
+            self._programs.clear()
+            self._compact_overflowed.clear()
+            self._device_arrays.clear()
+            self._device_bytes = 0
         self.result_cache.clear()
 
 

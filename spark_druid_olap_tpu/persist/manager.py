@@ -78,6 +78,10 @@ class PersistManager:
         self.ctx = ctx
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
+        # LOCK ORDER: checkpoint paths read the session query history
+        # (QueryHistory._lock) while this lock is held — the global
+        # order is PersistManager.lock BEFORE QueryHistory._lock
+        # (docs/LINT.md); history code must never call into persist.
         self.lock = threading.RLock()
         cfg = ctx.config
         self.wal_fsync = bool(cfg.get(PERSIST_WAL_FSYNC))
@@ -265,7 +269,8 @@ class PersistManager:
             except KeyError:
                 continue            # dropped between the listing and now
             except Exception:       # noqa: BLE001 — one bad ds can't
-                self.counters["errors"] += 1   # starve the rest
+                with self.lock:     # starve the rest; counter increments
+                    self.counters["errors"] += 1   # are read-modify-write
         return out
 
     # -- catalog (stars / rollups / lookups / warmup) -------------------------
@@ -536,7 +541,8 @@ class PersistManager:
                     only_dirty=True,
                     byte_budget=self.pass_budget or None)
             except Exception:  # noqa: BLE001 — the loop must survive
-                self.counters["errors"] += 1
+                with self.lock:
+                    self.counters["errors"] += 1
 
     def stop(self) -> None:
         self._stop.set()

@@ -1,0 +1,36 @@
+"""sdlint: domain-aware static analysis for the engine's own invariants.
+
+Four AST-based passes over the package (no imports, no execution — pure
+``ast`` analysis, so fixtures with seeded violations never need their
+dependencies installed):
+
+- ``locks`` — interprocedural lock-acquisition graph over
+  ``threading.Lock/RLock/Condition`` attributes: potential deadlock
+  cycles, plus attributes mutated from thread entrypoints without the
+  guarding lock that protects them elsewhere.
+- ``purity`` — functions reachable from ``jax.jit`` / ``pallas_call``
+  sites must not call host-only APIs (time, random, locks, I/O,
+  concretization) or branch on traced values.
+- ``contracts`` — every ``sdot.*`` config key read anywhere must be
+  declared with a default in ``utils/config.py`` and vice versa; every
+  emitted ``stats[...]`` key must be documented in ``docs/STATS.md``.
+- ``mergeclosure`` — every aggregate registered in the engine must be
+  declared in ``ops/agg_registry.py`` and consistently handled by
+  ``ops/groupby.py``, the rollup derivation table (``mv/match.py``) and
+  the shared-scan demux, so a new agg can never silently break
+  wave/shard/rollup/coalesce composition.
+
+Run as ``python -m spark_druid_olap_tpu.tools.sdlint``; CI runs the
+same passes via ``tests/test_lint.py``. Known-and-justified findings
+live in ``tools/sdlint/baseline.json``; line-level escapes use
+``# sdlint: disable=<pass>``. See docs/LINT.md.
+"""
+
+from spark_druid_olap_tpu.tools.sdlint.core import (  # noqa: F401
+    Baseline,
+    Finding,
+    Project,
+    run_passes,
+)
+
+PASSES = ("locks", "purity", "contracts", "mergeclosure")

@@ -1,0 +1,74 @@
+"""``python -m spark_druid_olap_tpu.tools.sdlint`` — CI entrypoint.
+
+Exit codes: 0 = clean (every finding baselined), 1 = unbaselined
+findings, 2 = invalid baseline (entry without a justification).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from spark_druid_olap_tpu.tools.sdlint import PASSES
+from spark_druid_olap_tpu.tools.sdlint.core import (Baseline, Project,
+                                                    report_human,
+                                                    report_json, run_passes)
+
+
+def default_root() -> str:
+    # .../spark_druid_olap_tpu/tools/sdlint/__main__.py -> the package dir
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="sdlint",
+        description="domain-aware static analysis for spark_druid_olap_tpu")
+    ap.add_argument("--root", default=None,
+                    help="package directory to scan (default: the "
+                         "installed spark_druid_olap_tpu package)")
+    ap.add_argument("--package", default="spark_druid_olap_tpu",
+                    help="dotted package name the root maps to")
+    ap.add_argument("--passes", default=",".join(PASSES),
+                    help=f"comma-separated subset of {','.join(PASSES)}")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: <root>/tools/sdlint/"
+                         "baseline.json; 'none' disables)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root or default_root())
+    passes = [p.strip() for p in args.passes.split(",") if p.strip()]
+    bad = [p for p in passes if p not in PASSES]
+    if bad:
+        ap.error(f"unknown pass(es): {', '.join(bad)}")
+
+    if args.baseline == "none":
+        baseline = Baseline()
+    else:
+        bpath = args.baseline or os.path.join(root, "tools", "sdlint",
+                                              "baseline.json")
+        baseline = Baseline.load(bpath)
+    missing = baseline.missing_justifications()
+    if missing:
+        for e in missing:
+            print(f"sdlint: baseline entry missing justification: "
+                  f"{e.get('pass')}/{e.get('rule')} {e.get('symbol')}",
+                  file=sys.stderr)
+        return 2
+
+    project = Project(root, package=args.package)
+    findings = run_passes(project, passes)
+    if args.json:
+        print(report_json(findings, baseline))
+        new = sum(1 for f in findings if not baseline.matches(f))
+    else:
+        new = report_human(findings, baseline)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,220 @@
+"""sdlint core: project model, findings, suppression, baseline, reporters.
+
+Everything is pure ``ast`` — no module under analysis is ever imported,
+so the linter runs identically with or without jax/pandas installed and
+fixture modules with seeded violations stay import-free.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# `# sdlint: disable=locks` or `# sdlint: disable=locks,purity` or
+# `# sdlint: disable=all` — applies to that line, or to a whole function
+# when placed on its `def` line.
+_SUPPRESS_RE = re.compile(r"#\s*sdlint:\s*disable=([a-z,]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    pass_name: str     # locks | purity | contracts | mergeclosure
+    rule: str          # stable rule slug within the pass
+    path: str          # path relative to the scanned root
+    line: int
+    symbol: str        # stable anchor (qualified function, key, ...)
+    message: str
+
+    def key(self) -> Tuple[str, str, str, str]:
+        """Baseline identity: line numbers churn, symbols don't."""
+        return (self.pass_name, self.rule, self.path, self.symbol)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.pass_name}/{self.rule}] "
+                f"{self.symbol}: {self.message}")
+
+
+class Module:
+    """One parsed source file: AST + per-line suppressions + def spans."""
+
+    def __init__(self, root: str, relpath: str, source: str):
+        self.relpath = relpath
+        self.name = relpath[:-3].replace(os.sep, ".")
+        if self.name.endswith(".__init__"):
+            self.name = self.name[: -len(".__init__")]
+        self.source = source
+        self.tree = ast.parse(source, filename=os.path.join(root, relpath))
+        self.suppress: Dict[int, set] = {}
+        for i, ln in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(ln)
+            if m:
+                self.suppress[i] = set(m.group(1).split(","))
+        # innermost-enclosing-def lookup for def-line suppressions
+        self._def_spans: List[Tuple[int, int, int]] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                end = getattr(node, "end_lineno", node.lineno)
+                self._def_spans.append((node.lineno, end, node.lineno))
+        self._def_spans.sort()
+
+    def suppressed(self, pass_name: str, line: int) -> bool:
+        for at in (line, self._enclosing_def_line(line)):
+            if at is None:
+                continue
+            s = self.suppress.get(at)
+            if s and (pass_name in s or "all" in s):
+                return True
+        return False
+
+    def _enclosing_def_line(self, line: int) -> Optional[int]:
+        best = None
+        for start, end, defline in self._def_spans:
+            if start <= line <= end:
+                best = defline      # spans sorted by start: innermost last
+        return best
+
+
+class Project:
+    """The scanned tree. ``root`` is the package directory itself (e.g.
+    ``.../spark_druid_olap_tpu``) or any directory of fixture modules;
+    ``package`` is the dotted import name that prefix maps onto ``root``
+    (used to resolve intra-package imports)."""
+
+    def __init__(self, root: str, package: str = "spark_druid_olap_tpu",
+                 skip: Sequence[str] = ("tools/sdlint",)):
+        self.root = os.path.abspath(root)
+        self.package = package
+        self.modules: Dict[str, Module] = {}
+        skip = tuple(s.replace("/", os.sep) for s in skip)
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = [d for d in sorted(dirnames)
+                           if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), self.root)
+                if any(rel == s or rel.startswith(s + os.sep)
+                       for s in skip):
+                    continue
+                with open(os.path.join(dirpath, fn),
+                          encoding="utf-8") as f:
+                    src = f.read()
+                try:
+                    mod = Module(self.root, rel, src)
+                except SyntaxError:
+                    continue        # not this linter's business
+                self.modules[mod.name] = mod
+
+    def module_for_import(self, dotted: str) -> Optional[Module]:
+        """Resolve an absolute import like ``<package>.ops.groupby`` (or a
+        bare ``ops.groupby`` in fixture trees) to a scanned module."""
+        if dotted.startswith(self.package + "."):
+            dotted = dotted[len(self.package) + 1:]
+        elif dotted == self.package:
+            dotted = ""
+        return self.modules.get(dotted)
+
+    def by_suffix(self, suffix: str) -> Optional[Module]:
+        """Find the one module whose relpath ends with ``suffix`` (anchor
+        files like ``parallel/executor.py``); None when absent (fixture
+        trees carry only the anchors their seeded violation needs)."""
+        suffix = suffix.replace("/", os.sep)
+        hits = [m for m in self.modules.values()
+                if m.relpath == suffix
+                or m.relpath.endswith(os.sep + suffix)]
+        return hits[0] if len(hits) == 1 else None
+
+
+class Baseline:
+    """Checked-in known findings. Every entry must carry a one-line
+    ``justification``; matching is on Finding.key() (no line numbers, so
+    unrelated edits don't churn the file)."""
+
+    def __init__(self, entries: Iterable[dict] = ()):
+        self.entries = list(entries)
+        self._keys = {}
+        for e in self.entries:
+            k = (e.get("pass"), e.get("rule"), e.get("path"),
+                 e.get("symbol"))
+            self._keys[k] = e
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        return cls(doc.get("findings", []))
+
+    def matches(self, f: Finding) -> bool:
+        return f.key() in self._keys
+
+    def unmatched(self, findings: Sequence[Finding]) -> List[dict]:
+        """Baseline entries no current finding hits — stale, should be
+        deleted (surfaced by the CLI as a warning, not a failure)."""
+        seen = {f.key() for f in findings}
+        return [e for k, e in self._keys.items() if k not in seen]
+
+    def missing_justifications(self) -> List[dict]:
+        return [e for e in self.entries
+                if not str(e.get("justification", "")).strip()]
+
+
+def run_passes(project: Project,
+               passes: Sequence[str] = ("locks", "purity", "contracts",
+                                        "mergeclosure")) -> List[Finding]:
+    """Run the named passes; returns suppression-filtered findings."""
+    from spark_druid_olap_tpu.tools.sdlint import (contracts, locks,
+                                                   mergeclosure, purity)
+    impl = {"locks": locks.run, "purity": purity.run,
+            "contracts": contracts.run, "mergeclosure": mergeclosure.run}
+    out: List[Finding] = []
+    for name in passes:
+        for f in impl[name](project):
+            mod = project.modules.get(
+                f.path[:-3].replace(os.sep, ".")) if f.path.endswith(".py") \
+                else None
+            if mod is None:
+                for m in project.modules.values():
+                    if m.relpath == f.path:
+                        mod = m
+                        break
+            if mod is not None and mod.suppressed(f.pass_name, f.line):
+                continue
+            out.append(f)
+    out.sort(key=lambda f: (f.pass_name, f.path, f.line, f.rule, f.symbol))
+    return out
+
+
+# -- reporters ----------------------------------------------------------------
+
+def report_human(findings: Sequence[Finding], baseline: Baseline,
+                 write=print) -> int:
+    """Human report; returns the count of NON-baselined findings."""
+    new = [f for f in findings if not baseline.matches(f)]
+    known = [f for f in findings if baseline.matches(f)]
+    for f in new:
+        write(f.render())
+    if known:
+        write(f"sdlint: {len(known)} baselined finding(s) suppressed "
+              f"(tools/sdlint/baseline.json)")
+    stale = baseline.unmatched(findings)
+    if stale:
+        write(f"sdlint: warning: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} no longer fire(s): "
+              + ", ".join(sorted(str(e.get("symbol")) for e in stale)))
+    write(f"sdlint: {len(new)} finding(s), {len(known)} baselined")
+    return len(new)
+
+
+def report_json(findings: Sequence[Finding], baseline: Baseline) -> str:
+    doc = {"findings": [dataclasses.asdict(f) | {
+        "baselined": baseline.matches(f)} for f in findings],
+        "new": sum(1 for f in findings if not baseline.matches(f)),
+        "baselined": sum(1 for f in findings if baseline.matches(f)),
+        "stale_baseline": baseline.unmatched(findings)}
+    return json.dumps(doc, indent=2)

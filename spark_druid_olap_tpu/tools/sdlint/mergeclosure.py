@@ -1,0 +1,169 @@
+"""Merge-closure pass.
+
+Cross-checks ``ops/agg_registry.py:AGG_CLOSURE`` (the declared closure)
+against the four sites that must each handle every aggregate:
+
+- ``unregistered-agg`` — a kind in ``parallel/executor.py:_AGG_KIND``
+  missing from ``AGG_CLOSURE``.
+- ``stale-registry``   — an ``AGG_CLOSURE`` kind the executor no longer
+  registers.
+- ``route-mismatch``   — registry route/dtype disagrees with the
+  executor's ``_AGG_KIND`` tuple.
+- ``unmergeable-agg``  — a non-sketch route kind
+  ``ops/groupby.py:merge_partials`` has no branch for (``sum``/``count``
+  ride the ``psum`` default; ``min``/``max`` must appear literally).
+- ``rollup-gap``       — a declared ``reagg`` kind ``mv/match.py`` never
+  mentions (neither in ``_REAGG_KINDS`` nor as a special-case literal).
+- ``demux-gap``        — a sketch kind ``parallel/sharedscan.py`` never
+  special-cases in its fused program / demux.
+
+Anchors are found by path suffix, so fixture trees carrying only the
+anchors their seeded violation needs still exercise the pass; a missing
+anchor skips its checks rather than failing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from spark_druid_olap_tpu.tools.sdlint.core import Finding, Module, Project
+
+_REGISTRY_SUFFIX = "ops/agg_registry.py"
+_EXECUTOR_SUFFIX = "parallel/executor.py"
+_GROUPBY_SUFFIX = "ops/groupby.py"
+_MATCH_SUFFIX = "mv/match.py"
+_SHAREDSCAN_SUFFIX = "parallel/sharedscan.py"
+# psum is merge_partials' fallthrough: additive routes need no literal
+_PSUM_ROUTES = {"sum", "count"}
+
+
+def _registry(mod: Module) -> Optional[Dict[str, dict]]:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "AGG_CLOSURE":
+            try:
+                v = ast.literal_eval(node.value)
+            except ValueError:
+                return None
+            return v if isinstance(v, dict) else None
+    return None
+
+
+def _agg_kind_literal(mod: Module) -> Dict[str, tuple]:
+    """executor's ``_AGG_KIND`` dict literal -> {kind: (route, dtype)};
+    dtype read off the ``np.<dtype>`` attribute name."""
+    out: Dict[str, tuple] = {}
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "_AGG_KIND"
+                and isinstance(node.value, ast.Dict)):
+            continue
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                continue
+            route = dtype = None
+            if isinstance(v, ast.Tuple) and len(v.elts) == 2:
+                if isinstance(v.elts[0], ast.Constant):
+                    route = v.elts[0].value
+                if isinstance(v.elts[1], ast.Attribute):
+                    dtype = v.elts[1].attr
+            out[k.value] = (route, dtype, node.lineno)
+    return out
+
+
+def _function_literals(mod: Module, name: str) -> Set[str]:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return {n.value for n in ast.walk(node)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)}
+    return set()
+
+
+def _module_literals(mod: Module) -> Set[str]:
+    return {n.value for n in ast.walk(mod.tree)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)}
+
+
+def run(project: Project) -> List[Finding]:
+    reg_mod = project.by_suffix(_REGISTRY_SUFFIX)
+    if reg_mod is None:
+        return []
+    registry = _registry(reg_mod)
+    if registry is None:
+        return []
+    out: List[Finding] = []
+
+    exec_mod = project.by_suffix(_EXECUTOR_SUFFIX)
+    if exec_mod is not None:
+        agg_kind = _agg_kind_literal(exec_mod)
+        for kind, (route, dtype, line) in sorted(agg_kind.items()):
+            if kind not in registry:
+                out.append(Finding(
+                    "mergeclosure", "unregistered-agg", exec_mod.relpath,
+                    line, kind,
+                    f"aggregate kind {kind!r} is registered in "
+                    f"executor._AGG_KIND but missing from "
+                    f"ops/agg_registry.py:AGG_CLOSURE — declare its "
+                    f"merge closure there first"))
+            else:
+                ent = registry[kind]
+                if route != ent.get("route") or dtype != ent.get("dtype"):
+                    out.append(Finding(
+                        "mergeclosure", "route-mismatch",
+                        exec_mod.relpath, line, kind,
+                        f"executor._AGG_KIND maps {kind!r} to "
+                        f"({route!r}, {dtype}) but AGG_CLOSURE declares "
+                        f"({ent.get('route')!r}, {ent.get('dtype')})"))
+        for kind in sorted(set(registry) - set(agg_kind)):
+            out.append(Finding(
+                "mergeclosure", "stale-registry", reg_mod.relpath, 1,
+                kind,
+                f"AGG_CLOSURE declares {kind!r} but executor._AGG_KIND "
+                f"no longer registers it"))
+
+    gb_mod = project.by_suffix(_GROUPBY_SUFFIX)
+    if gb_mod is not None:
+        handled = _function_literals(gb_mod, "merge_partials")
+        for kind, ent in sorted(registry.items()):
+            route = ent.get("route")
+            if ent.get("sketch") or route in _PSUM_ROUTES:
+                continue
+            if route not in handled:
+                out.append(Finding(
+                    "mergeclosure", "unmergeable-agg", gb_mod.relpath, 1,
+                    kind,
+                    f"aggregate {kind!r} routes as {route!r} but "
+                    f"ops/groupby.py:merge_partials has no branch for "
+                    f"{route!r}: cross-chip merge would psum it"))
+
+    match_mod = project.by_suffix(_MATCH_SUFFIX)
+    if match_mod is not None:
+        mentioned = _module_literals(match_mod)
+        for kind, ent in sorted(registry.items()):
+            reagg = ent.get("reagg")
+            if reagg is not None and reagg not in mentioned:
+                out.append(Finding(
+                    "mergeclosure", "rollup-gap", match_mod.relpath, 1,
+                    kind,
+                    f"aggregate {kind!r} declares reagg kind {reagg!r} "
+                    f"but mv/match.py never handles it: rollup rewrites "
+                    f"would silently reject (or mis-merge) it"))
+
+    ss_mod = project.by_suffix(_SHAREDSCAN_SUFFIX)
+    if ss_mod is not None:
+        mentioned = _module_literals(ss_mod)
+        for kind, ent in sorted(registry.items()):
+            sketch = ent.get("sketch")
+            if sketch is not None and sketch not in mentioned:
+                out.append(Finding(
+                    "mergeclosure", "demux-gap", ss_mod.relpath, 1, kind,
+                    f"sketch aggregate {kind!r} ({sketch}) has no "
+                    f"special-case in the shared-scan fused program / "
+                    f"demux: coalesced execution would decode its "
+                    f"registers as plain columns"))
+    return out

@@ -1,0 +1,371 @@
+"""Tracer-purity pass.
+
+Functions reachable from a ``jax.jit`` / ``jax.shard_map`` /
+``pl.pallas_call`` site are *traced*: they run once at trace time with
+abstract values, and anything host-visible they do (clock reads, RNG,
+locks, I/O) silently bakes into — or falls out of — the compiled
+program. Three rules:
+
+- **host-call** — a traced function calls a host-only API
+  (``time.*``, ``random.*``, ``np.random.*``, ``threading.*``,
+  ``logging.*``, ``os.*``, ``open``/``print``/``input``, sockets,
+  subprocess) or takes a lock.
+- **traced-branch** — ``if``/``while`` on a value derived from
+  ``jnp.*`` / ``jax.lax.*`` results (a tracer): raises
+  ``TracerBoolConversionError`` at best, shape-specializes at worst.
+  Branches on static python values (shapes, config, plan parameters)
+  are fine and not flagged — taint starts at jax expressions only,
+  never at function parameters.
+- **concretize** — ``float()/int()/bool()/np.asarray()/np.array()`` or
+  ``.item()/.tolist()`` on a tainted value forces a device sync inside
+  the trace.
+
+Root discovery understands the repo's wrapper idiom: a function that
+passes one of its own parameters into a jit-like call (e.g.
+``QueryEngine._shard_wrap``) marks the corresponding argument at every
+call site as a traced root, so nested ``def core(...)`` programs are
+followed even though ``jax.jit`` is two frames away.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from spark_druid_olap_tpu.tools.sdlint.astutil import (FuncId, Index,
+                                                       dotted_name)
+from spark_druid_olap_tpu.tools.sdlint.core import Finding, Project
+
+# dotted-name heads/prefixes that mean "this call is jit-like: its
+# function-valued argument gets traced"
+_JIT_LIKE = {"jax.jit", "jit", "jax.shard_map", "shard_map",
+             "pl.pallas_call", "pallas_call", "jax.vmap", "vmap",
+             "jax.pmap", "checkify.checkify"}
+
+_HOST_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.",
+                  "threading.", "logging.", "os.", "socket.",
+                  "subprocess.", "requests.", "shutil.", "pathlib.")
+_HOST_CALLS = {"open", "print", "input", "time", "sleep"}
+_CONCRETIZE_FUNCS = {"float", "int", "bool", "np.asarray", "np.array",
+                     "numpy.asarray", "numpy.array", "np.frombuffer"}
+_CONCRETIZE_METHODS = {"item", "tolist", "block_until_ready"}
+# attribute reads that stay static even on a tracer: array metadata plus
+# the engine's own plan/route metadata vocabulary (AggInput.is_int,
+# Route.kind/tag, AggregationSpec.name, ... — python values computed at
+# plan time, carried on objects that also hold traced arrays)
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "is_float", "is_int",
+                 "kind", "card", "merged", "tag", "maxabs", "spec",
+                 "name", "n_lanes"}
+# array-producing namespaces; deliberately NOT bare "jax." — calls like
+# jax.default_backend()/jax.devices() return host values
+_TAINT_PREFIXES = ("jnp.", "lax.", "jax.lax.", "jax.numpy.", "jax.nn.",
+                   "jsp.")
+
+
+def _expr_tainted(expr: ast.expr, tainted: Set[str]) -> bool:
+    """May ``expr`` evaluate to a tracer? Attribute reads in
+    ``_STATIC_ATTRS`` cut taint (metadata, not arrays); ``x is None``
+    comparisons are static control flow even on tracers; comprehension
+    variables inherit taint from their iterable."""
+    if isinstance(expr, ast.Name):
+        return expr.id in tainted
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in _STATIC_ATTRS:
+            return False
+        return _expr_tainted(expr.value, tainted)
+    if isinstance(expr, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+            return False
+        return any(_expr_tainted(e, tainted)
+                   for e in [expr.left] + expr.comparators)
+    if isinstance(expr, ast.Call):
+        name = dotted_name(expr.func)
+        if name in ("isinstance", "issubclass", "len", "type", "hasattr",
+                    "callable", "id", "repr", "str"):
+            return False            # static predicates even on tracers
+        if name and (name.startswith(_TAINT_PREFIXES)
+                     or name.split(".")[0] == "jnp"):
+            return True
+        parts = ([] if name else [expr.func]) + list(expr.args) \
+            + [kw.value for kw in expr.keywords]
+        if name and isinstance(expr.func, ast.Attribute):
+            parts.append(expr.func.value)   # x.sum() on a tracer
+        return any(_expr_tainted(e, tainted) for e in parts)
+    if isinstance(expr, (ast.GeneratorExp, ast.ListComp, ast.SetComp,
+                         ast.DictComp)):
+        inner = set(tainted)
+        for gen in expr.generators:
+            if _expr_tainted(gen.iter, tainted):
+                for n in ast.walk(gen.target):
+                    if isinstance(n, ast.Name):
+                        inner.add(n.id)
+        elts = [expr.key, expr.value] if isinstance(expr, ast.DictComp) \
+            else [expr.elt]
+        return any(_expr_tainted(e, inner) for e in elts)
+    if isinstance(expr, (ast.Lambda, ast.FunctionDef)):
+        return False
+    return any(_expr_tainted(e, tainted)
+               for e in ast.iter_child_nodes(expr)
+               if isinstance(e, ast.expr))
+
+
+def _is_jit_like(idx: Index, mi, name: str) -> bool:
+    if name in _JIT_LIKE:
+        return True
+    # imported-alias forms: `from jax.experimental import pallas as pl`
+    # already covered by the `pl.pallas_call` spelling; anything ending
+    # in `.pallas_call` or `.shard_map` or `.jit` counts
+    return name.split(".")[-1] in {"jit", "shard_map", "pallas_call",
+                                   "vmap", "pmap"} and "." in name
+
+
+class _Purity:
+    def __init__(self, project: Project):
+        self.project = project
+        self.index = Index(project)
+        # param positions (by name) of each function that get traced
+        self.wrapper_params: Dict[FuncId, Set[str]] = {}
+        self._find_wrapper_params()
+        self.roots: Dict[FuncId, Tuple[str, int]] = {}   # fid -> site
+        self._find_roots()
+        self.reachable = self._reach()
+
+    # -- roots -----------------------------------------------------------------
+    def _find_wrapper_params(self) -> None:
+        for fid, fn in self.index.functions.items():
+            params = {a.arg for a in fn.args.args}
+            traced: Set[str] = set()
+            aliases: Dict[str, str] = {}    # local alias -> param
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id in params:
+                    aliases[node.targets[0].id] = node.value.id
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name is None or not _is_jit_like(self.index, None, name):
+                    continue
+                for a in node.args[:1]:
+                    if isinstance(a, ast.Name):
+                        p = a.id if a.id in params else aliases.get(a.id)
+                        if p:
+                            traced.add(p)
+            if traced:
+                self.wrapper_params[fid] = traced
+
+    def _add_root_expr(self, mi, ci, expr: ast.expr, local,
+                       enclosing_qual: str, site: Tuple[str, int]) -> None:
+        idx = self.index
+        if isinstance(expr, ast.Lambda):
+            # the lambda body is one expression: follow the calls it makes
+            for node in ast.walk(expr.body):
+                if isinstance(node, ast.Call):
+                    for callee in idx.resolve_call(
+                            mi, ci, node, local,
+                            enclosing_qual=enclosing_qual):
+                        self.roots.setdefault(callee, site)
+            return
+        ref = idx.resolve_func_ref(mi, ci, expr, local,
+                                   enclosing_qual=enclosing_qual)
+        if ref is not None:
+            self.roots.setdefault(ref, site)
+            return
+        # one level of unwrapping: `smfn = jax.shard_map(fn, ...)` then
+        # `jax.jit(smfn)` — handled because shard_map itself is jit-like,
+        # nothing to do here.
+
+    def _find_roots(self) -> None:
+        idx = self.index
+        for fid, fn in self.index.functions.items():
+            mi = idx.modules[fid[0]]
+            ci = idx.func_class[fid]
+            local = idx.local_types(mi, ci, fn)
+            site = (mi.mod.relpath, fn.lineno)
+            # decorators: @jax.jit / @jit / @partial(jax.jit, ...)
+            for dec in fn.decorator_list:
+                name = dotted_name(dec if not isinstance(dec, ast.Call)
+                                   else dec.func)
+                if name and _is_jit_like(idx, mi, name):
+                    self.roots.setdefault(fid, site)
+                elif name in ("partial", "functools.partial") \
+                        and isinstance(dec, ast.Call) and dec.args:
+                    inner = dotted_name(dec.args[0])
+                    if inner and _is_jit_like(idx, mi, inner):
+                        self.roots.setdefault(fid, site)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name is not None and _is_jit_like(idx, mi, name):
+                    for a in node.args[:1]:
+                        self._add_root_expr(mi, ci, a, local, fid[1],
+                                            (mi.mod.relpath, node.lineno))
+                    continue
+                # wrapper call sites: self._shard_wrap(core, ...)
+                for callee in idx.resolve_call(mi, ci, node, local,
+                                               enclosing_qual=fid[1],
+                                               unique_fallback=True):
+                    traced = self.wrapper_params.get(callee)
+                    if not traced:
+                        continue
+                    cfn = idx.functions[callee]
+                    pnames = [a.arg for a in cfn.args.args]
+                    if pnames and pnames[0] == "self":
+                        pnames = pnames[1:]
+                    for i, a in enumerate(node.args):
+                        if i < len(pnames) and pnames[i] in traced:
+                            self._add_root_expr(
+                                mi, ci, a, local, fid[1],
+                                (mi.mod.relpath, node.lineno))
+                    for kw in node.keywords:
+                        if kw.arg in traced:
+                            self._add_root_expr(
+                                mi, ci, kw.value, local, fid[1],
+                                (mi.mod.relpath, node.lineno))
+
+    def _reach(self) -> Set[FuncId]:
+        idx = self.index
+        seen = set(self.roots)
+        stack = list(self.roots)
+        while stack:
+            fid = stack.pop()
+            fn = idx.functions.get(fid)
+            if fn is None:
+                continue
+            mi = idx.modules[fid[0]]
+            ci = idx.func_class[fid]
+            local = idx.local_types(mi, ci, fn)
+            for node in self._own_nodes(fn):
+                if isinstance(node, ast.Call):
+                    for callee in idx.resolve_call(mi, ci, node, local,
+                                                   enclosing_qual=fid[1]):
+                        if callee not in seen:
+                            seen.add(callee)
+                            stack.append(callee)
+        return seen
+
+    @staticmethod
+    def _own_nodes(fn: ast.FunctionDef):
+        """Walk a function's body without descending into nested defs or
+        lambdas (they are traced only if themselves reachable)."""
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- per-function violation scan -------------------------------------------
+    def _taints(self, fn: ast.FunctionDef) -> Set[str]:
+        """Names bound (anywhere in the function) to jax expressions —
+        a fixpoint over-approximation of 'is a tracer'."""
+        tainted: Set[str] = set()
+
+        def target_names(t: ast.expr):
+            """Names BOUND by an assignment target — the base of a
+            subscript/attribute, not names appearing in its slice."""
+            if isinstance(t, ast.Name):
+                yield t.id
+            elif isinstance(t, (ast.Subscript, ast.Attribute,
+                                ast.Starred)):
+                base = t.value if not isinstance(t, ast.Starred) else t.value
+                yield from target_names(base)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    yield from target_names(e)
+
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    if _expr_tainted(node.value, tainted):
+                        for t in node.targets:
+                            for nid in target_names(t):
+                                if nid not in tainted:
+                                    tainted.add(nid)
+                                    changed = True
+                elif isinstance(node, ast.AugAssign):
+                    if _expr_tainted(node.value, tainted) \
+                            and isinstance(node.target, ast.Name) \
+                            and node.target.id not in tainted:
+                        tainted.add(node.target.id)
+                        changed = True
+        return tainted
+
+    def scan(self, fid: FuncId) -> List[Finding]:
+        idx = self.index
+        fn = idx.functions.get(fid)
+        if fn is None:
+            return []
+        mi = idx.modules[fid[0]]
+        ci = idx.func_class[fid]
+        local = idx.local_types(mi, ci, fn)
+        path = mi.mod.relpath
+        tainted = self._taints(fn)
+        out: List[Finding] = []
+
+        def is_tainted(expr: ast.expr) -> bool:
+            return _expr_tainted(expr, tainted)
+
+        for node in self._own_nodes(fn):
+            if isinstance(node, (ast.If, ast.While)) \
+                    and is_tainted(node.test):
+                out.append(Finding(
+                    "purity", "traced-branch", path, node.lineno,
+                    f"{fid[1]}:{'while' if isinstance(node, ast.While) else 'if'}",
+                    f"{fid[1]} is traced under jit but branches on a "
+                    f"value derived from jax ops; use jnp.where/"
+                    f"lax.cond or hoist the decision to trace time"))
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lk = idx.resolve_lock(mi, ci, item.context_expr, local)
+                    if lk is not None:
+                        out.append(Finding(
+                            "purity", "host-call", path, node.lineno,
+                            f"{fid[1]}:lock", f"{fid[1]} is traced under "
+                            f"jit but acquires lock {lk[0]}; the acquire "
+                            f"runs once at trace time, not per call"))
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is not None:
+                if any(name.startswith(p) for p in _HOST_PREFIXES) \
+                        or name in _HOST_CALLS:
+                    out.append(Finding(
+                        "purity", "host-call", path, node.lineno,
+                        f"{fid[1]}:{name}",
+                        f"{fid[1]} is traced under jit but calls "
+                        f"host-only API {name}(); its value freezes at "
+                        f"trace time"))
+                    continue
+                if name in _CONCRETIZE_FUNCS and node.args \
+                        and is_tainted(node.args[0]):
+                    out.append(Finding(
+                        "purity", "concretize", path, node.lineno,
+                        f"{fid[1]}:{name}",
+                        f"{fid[1]} concretizes a traced value via "
+                        f"{name}(); this fails (or syncs) under jit"))
+                    continue
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _CONCRETIZE_METHODS \
+                    and is_tainted(node.func.value):
+                out.append(Finding(
+                    "purity", "concretize", path, node.lineno,
+                    f"{fid[1]}:.{node.func.attr}",
+                    f"{fid[1]} calls .{node.func.attr}() on a traced "
+                    f"value; this forces a device sync inside the trace"))
+        return out
+
+
+def run(project: Project) -> List[Finding]:
+    p = _Purity(project)
+    out: List[Finding] = []
+    for fid in sorted(p.reachable):
+        out.extend(p.scan(fid))
+    return out

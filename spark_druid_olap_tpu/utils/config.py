@@ -424,6 +424,19 @@ HOST_GATHER_PAGE_BYTES = _entry(
     "instead of one unbounded allgather.")
 
 
+# Families of runtime-shaped keys (tenant / datasource suffixes) that
+# cannot be declared one-by-one with _entry(). This tuple IS the declared
+# contract for them: the sdlint contracts pass accepts any read of a key
+# under these prefixes, and anything else must be an _entry. Add a prefix
+# here (with a pointer to the consuming module) before introducing a new
+# dynamic family.
+DYNAMIC_KEY_PREFIXES = (
+    "sdot.wlm.quota.",          # per-tenant quota grammar (wlm/quota.py)
+    "sdot.datasource.option.",  # per-session datasource option overrides
+                                # (Config.datasource_option_overrides)
+)
+
+
 class Config:
     """A mutable key-value session config over the registered entries.
 

@@ -242,6 +242,12 @@ class WorkloadManager:
                     # instead of waiting for a serial slot. The query
                     # leaves the queue WITHOUT taking a slot (the group
                     # leader owns the lane occupancy for the dispatch).
+                    # LOCK ORDER: note_handoff() takes the coalescer's
+                    # group lock while self._lock is held — the global
+                    # order is WorkloadManager._lock BEFORE
+                    # SharedScanCoalescer._lock (docs/LINT.md); the
+                    # coalescer must never call back into admission
+                    # under its lock.
                     with self._lock:
                         if not waiter.granted:
                             lane.remove(waiter)

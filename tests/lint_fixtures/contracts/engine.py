@@ -1,0 +1,9 @@
+"""Fixture consumer reading a config key the registry never declared."""
+
+
+class Engine:
+    def __init__(self, config):
+        self.config = config
+
+    def run(self):
+        return self.config.get("sdot.fixture.mystery")

@@ -1,0 +1,10 @@
+"""Fixture config registry: declares one key nothing reads
+(unread-key); the fixture engine reads a second key never declared here
+(undeclared-key)."""
+
+
+def _entry(key, default, doc=""):
+    return key
+
+
+FIXTURE_DECLARED = _entry("sdot.fixture.declared", 1, "never read")

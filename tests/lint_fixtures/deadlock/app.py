@@ -1,0 +1,35 @@
+"""Seeded locks-pass violations: an AB/BA deadlock cycle plus an
+unguarded write from a thread entrypoint. Never imported — analyzed as
+ast only."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+        self.balance = 0
+        self.pending = 0
+
+    def credit(self, n):
+        with self.lock_a:            # A then B
+            with self.lock_b:
+                self.balance += n
+
+    def debit(self, n):
+        with self.lock_b:            # B then A: cycle with credit()
+            with self.lock_a:
+                self.balance -= n
+
+    def note(self, n):
+        with self.lock_a:
+            self.pending += n        # guarded here ...
+
+    def spawn(self):
+        t = threading.Thread(target=self._bg_loop)
+        t.start()
+
+    def _bg_loop(self):
+        self.pending = 0             # ... but raced from the bg thread
+        self.credit(1)

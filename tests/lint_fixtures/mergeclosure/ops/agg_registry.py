@@ -1,0 +1,10 @@
+"""Fixture registry: 'median' routes as a non-psum kind merge_partials
+has no branch for (unmergeable-agg); the fixture executor also registers
+'mode' which is absent here (unregistered-agg)."""
+
+AGG_CLOSURE = {
+    "longsum": {"route": "sum", "dtype": "int64", "reagg": "longsum",
+                "sketch": None},
+    "median": {"route": "median", "dtype": "float64", "reagg": None,
+               "sketch": None},
+}

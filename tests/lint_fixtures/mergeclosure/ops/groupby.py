@@ -1,0 +1,11 @@
+"""Fixture merge: only min/max get literal branches; everything else
+rides the psum default — so the registry's 'median' route is
+unmergeable."""
+
+
+def merge_partials(route, partials):
+    if route == "min":
+        return min(partials)
+    if route == "max":
+        return max(partials)
+    return sum(partials)    # psum default: additive routes only

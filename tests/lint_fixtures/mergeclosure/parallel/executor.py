@@ -1,0 +1,10 @@
+"""Fixture executor: registers 'mode' which AGG_CLOSURE never declares
+(unregistered-agg)."""
+
+import numpy as np
+
+_AGG_KIND = {
+    "longsum": ("sum", np.int64),
+    "median": ("median", np.float64),
+    "mode": ("mode", np.int64),
+}

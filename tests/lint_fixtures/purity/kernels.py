@@ -1,0 +1,17 @@
+"""Seeded purity-pass violations: a jitted function that branches on a
+traced value and touches host-only APIs. Never imported — analyzed as
+ast only (jax need not be installed)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_kernel(x):
+    total = jnp.sum(x)
+    if total > 0:                    # traced-branch: data-dependent if
+        time.sleep(0.01)             # host-call under trace
+    print("total", total)            # host-call under trace
+    return total * 2

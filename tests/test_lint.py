@@ -1,0 +1,162 @@
+"""sdlint CI gate + self-tests (tools/sdlint).
+
+Three layers:
+
+1. **The gate** — run every pass over the real package and fail the
+   suite on any finding the checked-in baseline doesn't cover (and on
+   baseline rot: entries without a justification, entries nothing hits).
+   This is what makes the linter CI-enforced rather than advisory.
+2. **Seeded fixtures** — each pass must FIRE on its violation tree under
+   tests/lint_fixtures/ (a checker that never trips proves nothing).
+3. **Concurrency/closure regressions** — pin the real lock graph
+   (cross-subsystem edges, no cycles, known thread entrypoints) and the
+   aggregate merge closure against the live runtime tables, so drift
+   shows up as a named assertion, not a lint finding alone.
+
+Everything except the runtime-closure test is pure ast — no engine
+import, no jax dispatch.
+"""
+
+import os
+import subprocess
+import sys
+
+import spark_druid_olap_tpu
+from spark_druid_olap_tpu.tools.sdlint.core import (Baseline, Project,
+                                                    run_passes)
+from spark_druid_olap_tpu.tools.sdlint.locks import LockAnalysis
+
+PKG_ROOT = os.path.dirname(os.path.abspath(spark_druid_olap_tpu.__file__))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+BASELINE = os.path.join(PKG_ROOT, "tools", "sdlint", "baseline.json")
+
+
+def _fixture(name, passes):
+    p = Project(os.path.join(FIXTURES, name), package="fixture")
+    return run_passes(p, passes)
+
+
+# -- 1. the CI gate -----------------------------------------------------------
+
+def test_package_has_no_unbaselined_findings():
+    findings = run_passes(Project(PKG_ROOT))
+    baseline = Baseline.load(BASELINE)
+    fresh = [f for f in findings if not baseline.matches(f)]
+    assert not fresh, \
+        "sdlint findings not covered by tools/sdlint/baseline.json " \
+        "(fix them, or baseline WITH a justification):\n" \
+        + "\n".join(f.render() for f in fresh)
+
+
+def test_baseline_entries_are_justified_and_live():
+    findings = run_passes(Project(PKG_ROOT))
+    baseline = Baseline.load(BASELINE)
+    unjust = baseline.missing_justifications()
+    assert not unjust, f"baseline entries missing justification: {unjust}"
+    stale = baseline.unmatched(findings)
+    assert not stale, \
+        f"stale baseline entries (nothing emits them any more — " \
+        f"delete them): {stale}"
+
+
+def test_cli_exit_codes():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    clean = subprocess.run(
+        [sys.executable, "-m", "spark_druid_olap_tpu.tools.sdlint"],
+        capture_output=True, text=True, env=env)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    dirty = subprocess.run(
+        [sys.executable, "-m", "spark_druid_olap_tpu.tools.sdlint",
+         "--root", os.path.join(FIXTURES, "deadlock"),
+         "--package", "fixture", "--baseline", "none"],
+        capture_output=True, text=True, env=env)
+    assert dirty.returncode == 1, dirty.stdout + dirty.stderr
+    assert "deadlock-cycle" in dirty.stdout
+
+
+# -- 2. each pass fires on its seeded fixture ---------------------------------
+
+def test_locks_pass_fires_on_deadlock_fixture():
+    rules = {(f.rule, f.path) for f in _fixture("deadlock", ("locks",))}
+    assert ("deadlock-cycle", "app.py") in rules
+    assert ("unguarded-write", "app.py") in rules
+
+
+def test_purity_pass_fires_on_impure_jit_fixture():
+    found = _fixture("purity", ("purity",))
+    rules = {f.rule for f in found}
+    assert "traced-branch" in rules
+    assert "host-call" in rules
+    # the host calls are attributed to the jitted function itself
+    assert any(f.symbol.startswith("bad_kernel") for f in found)
+
+
+def test_contracts_pass_fires_on_undeclared_key_fixture():
+    by_rule = {f.rule: f for f in _fixture("contracts", ("contracts",))}
+    assert by_rule["undeclared-key"].symbol == "sdot.fixture.mystery"
+    assert by_rule["unread-key"].symbol == "sdot.fixture.declared"
+
+
+def test_mergeclosure_pass_fires_on_unmergeable_agg_fixture():
+    found = _fixture("mergeclosure", ("mergeclosure",))
+    by_rule = {f.rule: f for f in found}
+    assert by_rule["unmergeable-agg"].symbol == "median"
+    assert by_rule["unregistered-agg"].symbol == "mode"
+    assert "stale-registry" not in by_rule, found
+
+
+def test_suppression_comment_silences_a_finding(tmp_path):
+    # same violation as the contracts fixture, but disabled on the line
+    (tmp_path / "engine.py").write_text(
+        "class E:\n"
+        "    def run(self, config):\n"
+        "        return config.get('sdot.nope')"
+        "  # sdlint: disable=contracts known probe key\n")
+    found = run_passes(Project(str(tmp_path), package="fixture"),
+                       ("contracts",))
+    assert not found, [f.render() for f in found]
+
+
+# -- 3. concurrency / closure regressions over the real package ---------------
+
+def _edge_present(edges, held_suffix, acq_suffix):
+    return any(h.endswith(held_suffix) and a.endswith(acq_suffix)
+               for (h, a) in edges)
+
+
+def test_real_lock_graph_shape():
+    """Pin the package's lock graph: the known cross-subsystem orderings
+    must stay modeled (proof the analysis sees through the layers), and
+    the graph must stay acyclic. The documented global lock order is
+    WLM lane lock -> shared-scan group lock, and
+    persist manager lock -> history lock; never the reverse."""
+    la = LockAnalysis(Project(PKG_ROOT))
+    assert len(la.lock_kinds) >= 10, sorted(la.lock_kinds)
+    edges = set(la.edges)
+    assert _edge_present(edges, "WorkloadManager._lock",
+                         "SharedScanCoalescer._lock"), sorted(edges)
+    assert _edge_present(edges, "PersistManager.lock",
+                         "QueryHistory._lock"), sorted(edges)
+    assert la.cycles == [], la.cycles
+    ep_names = {fid[1].split(".")[-1] for fid in la.entrypoints}
+    # coalescer/WLM/checkpointer bg loops, HTTP + Flight servers,
+    # backend-loss probe: the threads the race pass guards against
+    assert "_bg_loop" in ep_names, sorted(ep_names)
+    assert "do_GET" in ep_names, sorted(ep_names)
+    assert "do_get" in ep_names, sorted(ep_names)
+    assert len(la.entrypoints) >= 6, sorted(la.entrypoints)
+
+
+def test_agg_closure_matches_runtime_tables():
+    """ops/agg_registry.py:AGG_CLOSURE is the declared merge closure;
+    the executor's live _AGG_KIND table must agree exactly (the static
+    pass checks the literal; this checks the imported runtime value,
+    catching non-literal edits the ast reader can't see)."""
+    from spark_druid_olap_tpu.ops.agg_registry import AGG_CLOSURE
+    from spark_druid_olap_tpu.parallel.executor import _AGG_KIND
+    assert set(AGG_CLOSURE) == set(_AGG_KIND)
+    for kind, (route, np_dtype) in _AGG_KIND.items():
+        ent = AGG_CLOSURE[kind]
+        assert ent["route"] == route, kind
+        assert ent["dtype"] == np_dtype.__name__, kind
