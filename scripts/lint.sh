@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# One-command sdlint entrypoint: all seven passes (locks, purity,
+# contracts, mergeclosure, keys, leaks, ordering) over the package,
+# gated by tools/sdlint/baseline.json. Args pass straight through:
+#
+#   scripts/lint.sh                      # full run, human output
+#   scripts/lint.sh --changed-only       # only git-dirty files (pre-commit)
+#   scripts/lint.sh --timing             # per-pass wall time
+#   scripts/lint.sh --format json        # machine output (schema v2)
+#
+# Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m spark_druid_olap_tpu.tools.sdlint "$@"

@@ -115,6 +115,19 @@ def normalize_aggs(
     return tuple(sorted(normed, key=lambda a: a.name))
 
 
+#: Spec fields DELIBERATELY stripped from the canonical key even though
+#: runtime code reads them (sdlint keys/K2 checks this list). Every entry
+#: needs a result-neutrality argument:
+#: - context: carries query_id / timeout / lane / tenant / priority —
+#:   pure execution metadata. The planner and executor read it only for
+#:   cancellation, deadlines, and admission routing; no field of
+#:   QueryContext ever reaches an aggregation, filter, or output column,
+#:   so two queries differing only in context MUST alias to one entry
+#:   (that aliasing is the whole point of the result cache under
+#:   per-request ids).
+KEY_EXEMPT_FIELDS = ("context",)
+
+
 def normalize_spec(q):
     """Canonical form of a cacheable spec: context stripped, filter/aggs/
     intervals normalized. The returned spec is only used for its repr."""

@@ -862,46 +862,51 @@ class QueryEngine:
             # concurrent statements) stay cancellable until the LAST
             # holder releases
             self.register_query(qid)
-        ticket = None
-        tok = self.inflight.begin(qid, getattr(q, "datasource", None),
-                                  type(q).__name__)
-        # visible to the shared-scan coalescer (joined on this thread):
-        # the group leader annotates every constituent's sys_queries row
-        # with the coalesced-group id
-        self._tls.inflight_tok = tok
         try:
-            if self.wlm.enabled:
-                # admission BEFORE any planning/cache/dispatch work: a
-                # shed query must cost nothing, and queue wait counts
-                # against the deadline (t0 is already ticking). Specs of
-                # one statement admit sequentially (never hold-and-wait),
-                # so nested plans cannot deadlock on lane slots.
-                cancel_ev = self._cancel_flags.get(qid) \
-                    if qid is not None else None
-                ticket = self.wlm.admit(self, q, t0, cancel_ev)
-                if ticket.timeout_millis is not None \
-                        and getattr(q.context, "timeout_millis",
-                                    None) is None:
-                    # lane default timeout rides the spec so every
-                    # downstream _stage_check honors it (context is
-                    # stripped from cache keys and compile signatures,
-                    # so the replace is cache-neutral)
-                    import dataclasses as _dc
-                    q = _dc.replace(q, context=_dc.replace(
-                        q.context or S.QueryContext(),
-                        timeout_millis=ticket.timeout_millis))
-                self.last_stats["wlm"] = ticket.stats()
-                self.inflight.running(tok, lane=ticket.lane,
-                                      tenant=ticket.tenant,
-                                      queued_ms=ticket.queued_ms)
-            else:
-                self.inflight.running(tok)
-            return self._execute_admitted(q, t0)
+            tok = self.inflight.begin(qid, getattr(q, "datasource", None),
+                                      type(q).__name__)
+            try:
+                # visible to the shared-scan coalescer (joined on this
+                # thread): the group leader annotates every constituent's
+                # sys_queries row with the coalesced-group id
+                self._tls.inflight_tok = tok
+                ticket = None
+                try:
+                    if self.wlm.enabled:
+                        # admission BEFORE any planning/cache/dispatch
+                        # work: a shed query must cost nothing, and queue
+                        # wait counts against the deadline (t0 is already
+                        # ticking). Specs of one statement admit
+                        # sequentially (never hold-and-wait), so nested
+                        # plans cannot deadlock on lane slots.
+                        cancel_ev = self._cancel_flags.get(qid) \
+                            if qid is not None else None
+                        ticket = self.wlm.admit(self, q, t0, cancel_ev)
+                        if ticket.timeout_millis is not None \
+                                and getattr(q.context, "timeout_millis",
+                                            None) is None:
+                            # lane default timeout rides the spec so every
+                            # downstream _stage_check honors it (context
+                            # is stripped from cache keys and compile
+                            # signatures, so the replace is cache-neutral)
+                            import dataclasses as _dc
+                            q = _dc.replace(q, context=_dc.replace(
+                                q.context or S.QueryContext(),
+                                timeout_millis=ticket.timeout_millis))
+                        self.last_stats["wlm"] = ticket.stats()
+                        self.inflight.running(tok, lane=ticket.lane,
+                                              tenant=ticket.tenant,
+                                              queued_ms=ticket.queued_ms)
+                    else:
+                        self.inflight.running(tok)
+                    return self._execute_admitted(q, t0)
+                finally:
+                    self._tls.inflight_tok = None
+                    if ticket is not None:
+                        self.wlm.release(ticket)
+            finally:
+                self.inflight.done(tok)
         finally:
-            self._tls.inflight_tok = None
-            if ticket is not None:
-                self.wlm.release(ticket)
-            self.inflight.done(tok)
             if qid is not None:
                 self.release_query(qid)
 
@@ -1134,7 +1139,9 @@ class QueryEngine:
         top_idx = None
         base_sig = (ds.name, id(ds), _cache_repr(q), s_pad, ds.padded_rows,
                     min_day, max_day, sharded, n_dev, tuple(names),
-                    self.config.get(TZ_ID), jax.default_backend(),
+                    self.config.get(TZ_ID),
+                    self.config.get(GROUPBY_MATMUL_MAX_KEYS),
+                    self.config.get(HLL_LOG2M), jax.default_backend(),
                     bool(jax.config.jax_enable_x64))
         if having_dev:
             # two dispatches: finals stay device-resident, only the mask
@@ -1632,6 +1639,8 @@ class QueryEngine:
                    ds.padded_rows, min_day, max_day, sharded, n_dev, T,
                    tuple(names), topk, compact, lm, sorted_run,
                    self.config.get(TZ_ID),
+                   self.config.get(GROUPBY_MATMUL_MAX_KEYS),
+                   self.config.get(HLL_LOG2M),
                    jax.default_backend(), bool(jax.config.jax_enable_x64))
 
             def build(lm=lm):

@@ -320,7 +320,9 @@ class SharedScanCoalescer:
 
         sig = ("aggmulti", ds.name, id(ds), s_pad, ds.padded_rows,
                min_day, max_day, tuple(union_names),
-               eng.config.get(TZ_ID), jax.default_backend(),
+               eng.config.get(TZ_ID),
+               eng.config.get(GROUPBY_MATMUL_MAX_KEYS),
+               eng.config.get(HLL_LOG2M), jax.default_backend(),
                bool(jax.config.jax_enable_x64), sigs)
         prog_fn, unpacks = eng._cached_program(
             sig, lambda: self._build_fused_program(

@@ -209,6 +209,10 @@ class PersistManager:
             dst = f"{base}.{i}"
         try:
             os.replace(p, dst)
+            # the fence must survive a crash: if the rename is lost,
+            # recovery resurrects the dropped incarnation and the new
+            # create lands in its journal
+            SNAP.fsync_dir(self.root)
         except OSError:
             shutil.rmtree(p, ignore_errors=True)
 
@@ -309,6 +313,7 @@ class PersistManager:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self.root, CATALOG_FILE))
+        SNAP.fsync_dir(self.root)
 
     def _read_catalog(self) -> dict:
         try:
@@ -338,10 +343,21 @@ class PersistManager:
                 except (OSError, ValueError, KeyError):
                     name = None
             if name is None:
-                for h, _ in WAL.WriteAheadLog(
-                        os.path.join(p, "wal.log")).replay():
-                    name = h.get("datasource")
-                    break
+                w = WAL.WriteAheadLog(os.path.join(p, "wal.log"))
+                it = None
+                try:
+                    it = w.replay()
+                    for h, _ in it:
+                        name = h.get("datasource")
+                        break
+                finally:
+                    # the break leaves the generator suspended inside
+                    # its `with open(...)` — close it, or the read
+                    # handle lives until GC (a real fd on every recovery
+                    # scan, not just lint hygiene)
+                    if it is not None:
+                        it.close()
+                    w.close()
             if name is not None:
                 out[name] = p
         return out

@@ -52,7 +52,10 @@ def sanitize(name: str) -> str:
     return "_" + out if out.startswith(".") else out
 
 
-def _fsync_dir(path: str) -> None:
+def fsync_dir(path: str) -> None:
+    """Flush a directory inode: a rename-publish is only durable once
+    the directory entry itself is synced (best-effort — some filesystems
+    refuse O_RDONLY fsync on directories)."""
     try:
         fd = os.open(path, os.O_RDONLY)
         try:
@@ -61,6 +64,9 @@ def _fsync_dir(path: str) -> None:
             os.close(fd)
     except OSError:
         pass
+
+
+_fsync_dir = fsync_dir    # established internal spelling
 
 
 def _write_blob(dirpath: str, rel: str, data: bytes,
@@ -127,7 +133,19 @@ def write_snapshot(ds_root: str, ds, ingest_version: int,
     publish_version = (versions[-1] + 1) if versions else 1
     tmp = os.path.join(ds_root, f".tmp-{os.getpid()}-{publish_version}")
     os.makedirs(tmp, exist_ok=True)
+    try:
+        return _fill_and_publish(ds_root, ds, ingest_version, wal_seq,
+                                 keep, publish_version, tmp)
+    except BaseException:
+        # a failed publish must not strand the temp dir until the next
+        # write_snapshot's sweep — a crash-restart loop would otherwise
+        # accumulate one orphan per attempt
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
 
+
+def _fill_and_publish(ds_root: str, ds, ingest_version: int, wal_seq: int,
+                      keep: int, publish_version: int, tmp: str) -> dict:
     files: Dict[str, dict] = {}
     manifest = {
         "format": FORMAT_VERSION,
@@ -326,4 +344,8 @@ def quarantine_version(ds_root: str, version: int) -> Optional[str]:
         dst = os.path.join(
             qdir, f"{int(time.time())}-{version_dirname(version)}.{i}")
     os.replace(src, dst)
+    # the corrupt dir must STAY moved after a crash, or recovery retries
+    # the same poisoned version forever
+    _fsync_dir(ds_root)
+    _fsync_dir(qdir)
     return dst

@@ -31,6 +31,18 @@ _MAGIC = b"SDWL"
 _FRAME = struct.Struct("<4sIQI")
 
 
+def _fsync_dir(path: str) -> None:
+    # local copy of snapshot.fsync_dir — this module stays import-free
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
+
+
 def encode_batch(df) -> bytes:
     """pandas DataFrame -> Arrow IPC stream bytes (schema included)."""
     import pyarrow as pa
@@ -143,6 +155,11 @@ class WriteAheadLog:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
+        # the rewritten journal replaces records a snapshot already owns;
+        # if the rename itself is lost on crash, replay re-applies them —
+        # harmless for idempotent restores but the dir entry must still
+        # be durable before the caller drops the covering snapshot refs
+        _fsync_dir(os.path.dirname(self.path) or ".")
 
     def last_seq(self) -> Optional[int]:
         last = None

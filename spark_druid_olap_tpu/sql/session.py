@@ -115,12 +115,13 @@ def run_sql(ctx, sql: str, query_id: Optional[str] = None,
         # statement's life is honored; current id rides thread-local state
         # down to every spec this statement executes (incl. subqueries)
         from spark_druid_olap_tpu.planner.host_exec import ctx_tls
-        ctx.engine.register_query(query_id)
-        ctx_tls(ctx).query_id = query_id
-        try:
+        tls = ctx_tls(ctx)       # resolve BEFORE acquiring the refcount:
+        ctx.engine.register_query(query_id)   # nothing between acquire
+        try:                                  # and try may raise
+            tls.query_id = query_id
             return _run_sql_inner(ctx, sql)
         finally:
-            ctx_tls(ctx).query_id = None
+            tls.query_id = None
             ctx.engine.release_query(query_id)
     return _run_sql_inner(ctx, sql)
 

@@ -1,6 +1,6 @@
 """sdlint: domain-aware static analysis for the engine's own invariants.
 
-Four AST-based passes over the package (no imports, no execution — pure
+Seven AST-based passes over the package (no imports, no execution — pure
 ``ast`` analysis, so fixtures with seeded violations never need their
 dependencies installed):
 
@@ -19,6 +19,19 @@ dependencies installed):
   ``ops/groupby.py``, the rollup derivation table (``mv/match.py``) and
   the shared-scan demux, so a new agg can never silently break
   wave/shard/rollup/coalesce composition.
+- ``keys`` — canonical cache keys (cache/keys.py, compile signatures,
+  ``Config.fingerprint``) must cover exactly the result-affecting
+  state: result-affecting fields/config missing from a key is cache
+  poisoning, key terms nothing reads is needless churn.
+- ``leaks`` — acquired resources (lane slots, quota tokens, tickets,
+  inflight entries, cancel-flag refcounts, WAL handles, snapshot temp
+  dirs) must be released on ALL paths of the exception-edge CFG
+  (``cfg.py``) — ``finally``/context-manager discipline, machine
+  checked.
+- ``ordering`` — happens-before on persist paths: fsync before
+  ``os.replace`` publish, directory fsync after it, WAL commit append
+  before ``store.register``, ``truncate_through`` only after a
+  completed checkpoint.
 
 Run as ``python -m spark_druid_olap_tpu.tools.sdlint``; CI runs the
 same passes via ``tests/test_lint.py``. Known-and-justified findings
@@ -33,4 +46,5 @@ from spark_druid_olap_tpu.tools.sdlint.core import (  # noqa: F401
     run_passes,
 )
 
-PASSES = ("locks", "purity", "contracts", "mergeclosure")
+PASSES = ("locks", "purity", "contracts", "mergeclosure", "keys",
+          "leaks", "ordering")

@@ -157,12 +157,16 @@ class Index:
                 kind = _threading_factory(stmt.value)
                 if kind is not None:
                     mi.module_locks[stmt.targets[0].id] = kind
-            elif isinstance(stmt, (ast.If, ast.Try)):
-                # guarded/optional definitions (e.g. `if pa is not None:`)
+            elif isinstance(stmt, (ast.If, ast.Try, ast.While, ast.For,
+                                   ast.AsyncFor, ast.With, ast.AsyncWith)):
+                # defs under guards/loops/with (e.g. `if pa is not None:`
+                # fallbacks, a build closure inside a retry loop) are
+                # still defs of the enclosing scope
                 self._index_body(mi, stmt.body, prefix, ci, top)
                 for h in getattr(stmt, "handlers", ()):
                     self._index_body(mi, h.body, prefix, ci, top)
-                self._index_body(mi, stmt.orelse, prefix, ci, top)
+                self._index_body(mi, getattr(stmt, "orelse", ()), prefix,
+                                 ci, top)
                 self._index_body(mi, getattr(stmt, "finalbody", ()),
                                  prefix, ci, top)
 
@@ -448,3 +452,39 @@ def dotted_name(expr: ast.expr) -> Optional[str]:
         parts.append(expr.id)
         return ".".join(reversed(parts))
     return None
+
+
+def call_chain(expr: ast.expr) -> List[str]:
+    """Best-effort segment chain of a call target, descending through
+    intermediate calls and subscripts: ``self._wal_for(name).append``
+    -> ``['self', '_wal_for', 'append']``; empty when nothing named."""
+    parts: List[str] = []
+    while True:
+        if isinstance(expr, ast.Attribute):
+            parts.append(expr.attr)
+            expr = expr.value
+        elif isinstance(expr, ast.Call):
+            expr = expr.func
+        elif isinstance(expr, ast.Subscript):
+            expr = expr.value
+        elif isinstance(expr, ast.Name):
+            parts.append(expr.id)
+            break
+        else:
+            break
+    return list(reversed(parts))
+
+
+def walk_shallow(node: ast.AST):
+    """``ast.walk`` that does not descend into nested function / class /
+    lambda bodies — the statements of *this* frame only. (A call inside
+    a nested ``def`` runs when the closure runs, not here.)"""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)

@@ -16,8 +16,11 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 # `# sdlint: disable=locks` or `# sdlint: disable=locks,purity` or
 # `# sdlint: disable=all` — applies to that line, or to a whole function
-# when placed on its `def` line.
+# when placed anywhere on its def header (decorators and multi-line
+# signatures included). `# sdlint: disable-file=<pass>` within the
+# first 10 lines silences a pass for the whole module.
 _SUPPRESS_RE = re.compile(r"#\s*sdlint:\s*disable=([a-z,]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*sdlint:\s*disable-file=([a-z,]+)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,32 +52,47 @@ class Module:
         self.source = source
         self.tree = ast.parse(source, filename=os.path.join(root, relpath))
         self.suppress: Dict[int, set] = {}
+        self.suppress_file: set = set()
         for i, ln in enumerate(source.splitlines(), start=1):
             m = _SUPPRESS_RE.search(ln)
             if m:
                 self.suppress[i] = set(m.group(1).split(","))
-        # innermost-enclosing-def lookup for def-line suppressions
-        self._def_spans: List[Tuple[int, int, int]] = []
+            if i <= 10:
+                m = _SUPPRESS_FILE_RE.search(ln)
+                if m:
+                    self.suppress_file |= set(m.group(1).split(","))
+        # innermost-enclosing-def lookup for def-header suppressions.
+        # The span starts at the FIRST DECORATOR, and the whole header
+        # (def line through the closing paren of a multi-line signature)
+        # counts as "the def line" for suppression comments.
+        self._def_spans: List[Tuple[int, int, Tuple[int, ...]]] = []
         for node in ast.walk(self.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 end = getattr(node, "end_lineno", node.lineno)
-                self._def_spans.append((node.lineno, end, node.lineno))
+                start = min([node.lineno]
+                            + [d.lineno for d in node.decorator_list])
+                # header ends where the first body statement starts
+                hdr_end = node.body[0].lineno - 1 if node.body \
+                    else node.lineno
+                header = tuple(range(start, max(hdr_end,
+                                                node.lineno) + 1))
+                self._def_spans.append((start, end, header))
         self._def_spans.sort()
 
     def suppressed(self, pass_name: str, line: int) -> bool:
-        for at in (line, self._enclosing_def_line(line)):
-            if at is None:
-                continue
+        if pass_name in self.suppress_file or "all" in self.suppress_file:
+            return True
+        for at in (line,) + self._enclosing_def_header(line):
             s = self.suppress.get(at)
             if s and (pass_name in s or "all" in s):
                 return True
         return False
 
-    def _enclosing_def_line(self, line: int) -> Optional[int]:
-        best = None
-        for start, end, defline in self._def_spans:
+    def _enclosing_def_header(self, line: int) -> Tuple[int, ...]:
+        best: Tuple[int, ...] = ()
+        for start, end, header in self._def_spans:
             if start <= line <= end:
-                best = defline      # spans sorted by start: innermost last
+                best = header       # spans sorted by start: innermost last
         return best
 
 
@@ -85,10 +103,15 @@ class Project:
     (used to resolve intra-package imports)."""
 
     def __init__(self, root: str, package: str = "spark_druid_olap_tpu",
-                 skip: Sequence[str] = ("tools/sdlint",)):
+                 skip: Sequence[str] = ("tools/sdlint",),
+                 only: Optional[Sequence[str]] = None):
         self.root = os.path.abspath(root)
         self.package = package
         self.modules: Dict[str, Module] = {}
+        self._index = None          # shared astutil.Index, built once
+        self._cfgs: Dict[object, object] = {}   # fn node -> cfg.CFG
+        only_rel = None if only is None else {
+            o.replace("/", os.sep) for o in only}
         skip = tuple(s.replace("/", os.sep) for s in skip)
         for dirpath, dirnames, filenames in os.walk(self.root):
             dirnames[:] = [d for d in sorted(dirnames)
@@ -99,6 +122,8 @@ class Project:
                 rel = os.path.relpath(os.path.join(dirpath, fn), self.root)
                 if any(rel == s or rel.startswith(s + os.sep)
                        for s in skip):
+                    continue
+                if only_rel is not None and rel not in only_rel:
                     continue
                 with open(os.path.join(dirpath, fn),
                           encoding="utf-8") as f:
@@ -117,6 +142,22 @@ class Project:
         elif dotted == self.package:
             dotted = ""
         return self.modules.get(dotted)
+
+    def index(self):
+        """The one shared :class:`astutil.Index` — every pass resolves
+        through the same parse (v1 re-built it per pass)."""
+        if self._index is None:
+            from spark_druid_olap_tpu.tools.sdlint.astutil import Index
+            self._index = Index(self)
+        return self._index
+
+    def cfg(self, fn):
+        """Memoized per-function CFG (leaks + ordering share them)."""
+        c = self._cfgs.get(fn)
+        if c is None:
+            from spark_druid_olap_tpu.tools.sdlint import cfg as _cfg
+            c = self._cfgs[fn] = _cfg.build(fn)
+        return c
 
     def by_suffix(self, suffix: str) -> Optional[Module]:
         """Find the one module whose relpath ends with ``suffix`` (anchor
@@ -166,15 +207,30 @@ class Baseline:
 
 def run_passes(project: Project,
                passes: Sequence[str] = ("locks", "purity", "contracts",
-                                        "mergeclosure")) -> List[Finding]:
-    """Run the named passes; returns suppression-filtered findings."""
-    from spark_druid_olap_tpu.tools.sdlint import (contracts, locks,
-                                                   mergeclosure, purity)
+                                        "mergeclosure", "keys", "leaks",
+                                        "ordering"),
+               timing: Optional[Dict[str, float]] = None) -> List[Finding]:
+    """Run the named passes; returns suppression-filtered findings.
+    With ``timing`` a dict, per-pass wall seconds are written into it
+    (plus ``"index"`` for the shared parse/index build)."""
+    import time as _time
+    from spark_druid_olap_tpu.tools.sdlint import (contracts, keys, leaks,
+                                                   locks, mergeclosure,
+                                                   ordering, purity)
     impl = {"locks": locks.run, "purity": purity.run,
-            "contracts": contracts.run, "mergeclosure": mergeclosure.run}
+            "contracts": contracts.run, "mergeclosure": mergeclosure.run,
+            "keys": keys.run, "leaks": leaks.run, "ordering": ordering.run}
+    if timing is not None:
+        t0 = _time.perf_counter()
+        project.index()
+        timing["index"] = _time.perf_counter() - t0
     out: List[Finding] = []
     for name in passes:
-        for f in impl[name](project):
+        t0 = _time.perf_counter()
+        found = impl[name](project)
+        if timing is not None:
+            timing[name] = _time.perf_counter() - t0
+        for f in found:
             mod = project.modules.get(
                 f.path[:-3].replace(os.sep, ".")) if f.path.endswith(".py") \
                 else None
@@ -211,10 +267,24 @@ def report_human(findings: Sequence[Finding], baseline: Baseline,
     return len(new)
 
 
+#: bump ONLY on a breaking change to the JSON document shape — CI diffs
+#: and downstream tooling key on this (golden-tested in tests/test_lint.py)
+JSON_SCHEMA_VERSION = 2
+
+
 def report_json(findings: Sequence[Finding], baseline: Baseline) -> str:
-    doc = {"findings": [dataclasses.asdict(f) | {
-        "baselined": baseline.matches(f)} for f in findings],
-        "new": sum(1 for f in findings if not baseline.matches(f)),
-        "baselined": sum(1 for f in findings if baseline.matches(f)),
-        "stale_baseline": baseline.unmatched(findings)}
-    return json.dumps(doc, indent=2)
+    """Stable machine output: findings sorted by (pass, path, rule,
+    symbol, line), keys sorted, schema versioned."""
+    ordered = sorted(findings, key=lambda f: (f.pass_name, f.path, f.rule,
+                                              f.symbol, f.line))
+    doc = {"schema_version": JSON_SCHEMA_VERSION,
+           "findings": [dict(sorted((dataclasses.asdict(f) | {
+               "baselined": baseline.matches(f)}).items()))
+               for f in ordered],
+           "new": sum(1 for f in findings if not baseline.matches(f)),
+           "baselined": sum(1 for f in findings if baseline.matches(f)),
+           "stale_baseline": sorted(
+               baseline.unmatched(findings),
+               key=lambda e: (str(e.get("pass")), str(e.get("rule")),
+                              str(e.get("symbol"))))}
+    return json.dumps(doc, indent=2, sort_keys=True)

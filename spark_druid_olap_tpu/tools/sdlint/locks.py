@@ -76,7 +76,7 @@ class LockAnalysis:
 
     def __init__(self, project: Project):
         self.project = project
-        self.index = Index(project)
+        self.index = project.index()   # shared: parsed/typed once for all passes
         self.lock_kinds: Dict[str, str] = {}
         self.summaries: Dict[FuncId, _Summary] = {}
         for mi in self.index.modules.values():

@@ -122,7 +122,7 @@ def _is_jit_like(idx: Index, mi, name: str) -> bool:
 class _Purity:
     def __init__(self, project: Project):
         self.project = project
-        self.index = Index(project)
+        self.index = project.index()   # shared: parsed/typed once for all passes
         # param positions (by name) of each function that get traced
         self.wrapper_params: Dict[FuncId, Set[str]] = {}
         self._find_wrapper_params()

@@ -24,6 +24,10 @@ class ConfigEntry:
     default: Any
     doc: str
     parse: Callable[[str], Any] = lambda s: s
+    #: semantic keys change query RESULTS or compiled programs and belong
+    #: in cache fingerprints; operational keys (quotas, cadence, history
+    #: sizing) must NOT churn every cache on tuning (sdlint keys/K4)
+    semantic: bool = True
 
 
 def _parse_bool(s: str) -> bool:
@@ -33,7 +37,8 @@ def _parse_bool(s: str) -> bool:
 _REGISTRY: Dict[str, ConfigEntry] = {}
 
 
-def _entry(key: str, default: Any, doc: str, parse=None) -> ConfigEntry:
+def _entry(key: str, default: Any, doc: str, parse=None,
+           semantic: bool = True) -> ConfigEntry:
     if parse is None:
         if isinstance(default, bool):
             parse = _parse_bool
@@ -43,7 +48,7 @@ def _entry(key: str, default: Any, doc: str, parse=None) -> ConfigEntry:
             parse = float
         else:
             parse = lambda s: s
-    e = ConfigEntry(key, default, doc, parse)
+    e = ConfigEntry(key, default, doc, parse, semantic)
     _REGISTRY[key] = e
     return e
 
@@ -323,7 +328,7 @@ WLM_ENABLED = _entry(
     "classified into a named lane with bounded concurrency and queue "
     "depth; overload sheds with a retryable rejection (HTTP 429 + "
     "Retry-After) instead of melting every in-flight query (≈ Druid "
-    "query laning / QueryScheduler).")
+    "query laning / QueryScheduler).", semantic=False)
 WLM_LANES = _entry(
     "sdot.wlm.lanes",
     "interactive:slots=8,queue=64;reporting:slots=4,queue=32;"
@@ -333,11 +338,12 @@ WLM_LANES = _entry(
     "bounded wait-queue depth past which admissions shed, wait_ms = max "
     "queue-wait budget (0 = only the query's own timeout bounds it), "
     "timeout_ms = default QueryContext timeout applied when the client "
-    "set none, priority = default admission priority (higher first).")
+    "set none, priority = default admission priority (higher first).",
+    semantic=False)
 WLM_DEFAULT_LANE = _entry(
     "sdot.wlm.default.lane", "interactive",
     "Lane for queries with no explicit context.lane (before cost-based "
-    "demotion is considered).")
+    "demotion is considered).", semantic=False)
 WLM_BATCH_COST = _entry(
     "sdot.wlm.batch.cost.threshold", 0.5,
     "Estimated single-chip cost units (parallel/cost.estimate) at or "
@@ -346,7 +352,7 @@ WLM_BATCH_COST = _entry(
     "cost-based demotion. Per-tenant quotas ride the same config "
     "channel as free-form keys: 'sdot.wlm.quota.<tenant>' = "
     "'concurrent=N,budget=F,refill=F' ('default' is the template for "
-    "tenants without an explicit entry).", float)
+    "tenants without an explicit entry).", float, semantic=False)
 # --- shared-scan multi-query execution (parallel/sharedscan.py) ---------------
 SHAREDSCAN_ENABLED = _entry(
     "sdot.sharedscan.enabled", False,
@@ -391,30 +397,31 @@ PERSIST_WAL_FSYNC = _entry(
     "fsync the write-ahead journal before a stream_ingest batch is "
     "considered committed. Off trades the kill -9 durability guarantee "
     "for append throughput (an OS crash can lose the un-synced tail; "
-    "replay still stops cleanly at the first torn record).")
+    "replay still stops cleanly at the first torn record).",
+    semantic=False)
 PERSIST_CHECKPOINT_SECONDS = _entry(
     "sdot.persist.checkpoint.interval.seconds", 0.0,
     "Cadence of the background checkpointer folding dirty datasources "
     "(new/re-ingested, or WAL tail past the byte budget) into fresh "
     "snapshots. 0 disables the thread; CHECKPOINT statements and "
-    "Context.checkpoint() still work.", float)
+    "Context.checkpoint() still work.", float, semantic=False)
 PERSIST_CHECKPOINT_MAX_BYTES = _entry(
     "sdot.persist.checkpoint.max.bytes", 0,
     "Byte budget for ONE background checkpoint pass: dirty datasources "
     "snapshot in ascending size order until the pass would exceed it; "
     "the rest stay dirty for the next tick (bounds the I/O burst a "
-    "cadence tick can issue). 0 = unbounded.", int)
+    "cadence tick can issue). 0 = unbounded.", int, semantic=False)
 PERSIST_KEEP_SNAPSHOTS = _entry(
     "sdot.persist.keep.snapshots", 2,
     "Published snapshot versions retained per datasource; older versions "
     "are pruned after each successful publish. Must be >= 1 (the current "
-    "version is never pruned).")
+    "version is never pruned).", semantic=False)
 PERSIST_VERIFY_CHECKSUMS = _entry(
     "sdot.persist.verify.checksums", True,
     "Verify per-file CRC32 checksums against the manifest during "
     "recovery. A mismatch quarantines that snapshot version and recovery "
     "falls back to the previous one (or the WAL alone) — the engine "
-    "always starts.")
+    "always starts.", semantic=False)
 # --- host-tier safety valve ---------------------------------------------------
 HOST_GATHER_PAGE_BYTES = _entry(
     "sdot.host.gather.page.bytes", 32 << 20,
@@ -460,10 +467,24 @@ class Config:
         self._values[key] = value
 
     def fingerprint(self) -> tuple:
-        """Hashable snapshot of every override — result caches key on it
-        so a session config change (timezone, HLL precision, ...) can
-        never serve results computed under the old settings."""
-        return tuple(sorted((k, repr(v)) for k, v in self._values.items()))
+        """Hashable snapshot of the SEMANTIC overrides — result/plan
+        caches key on it so a session config change (timezone, HLL
+        precision, ...) can never serve results computed under the old
+        settings. Keys declared ``semantic=False`` (admission quotas,
+        lane layouts, history sizing) are excluded: they shape scheduling
+        and observability, never results, and folding them in would
+        invalidate every cache on each operational tuning step. Unknown
+        keys are kept — forward compatibility must fail toward
+        correctness, not cache retention."""
+        out = []
+        for k, v in self._values.items():
+            e = _REGISTRY.get(k)
+            if e is not None and not e.semantic:
+                continue
+            if k.startswith("sdot.wlm.quota."):
+                continue    # dynamic family, admission-only
+            out.append((k, repr(v)))
+        return tuple(sorted(out))
 
     def get(self, entry_or_key) -> Any:
         if isinstance(entry_or_key, ConfigEntry):

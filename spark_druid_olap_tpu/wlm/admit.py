@@ -223,12 +223,13 @@ class WorkloadManager:
                 self.quotas.release(tenant)
                 raise
         # --- queued: wait outside the lock ---------------------------------
-        enq = time.perf_counter()
-        wait_deadline = enq + cfg.max_wait_ms / 1000.0 \
-            if cfg.max_wait_ms > 0 else None
-        query_deadline = t0 + timeout_ms / 1000.0 \
-            if timeout_ms is not None else None
+        unhooked = False    # quota + queue entry handed off or released
         try:
+            enq = time.perf_counter()
+            wait_deadline = enq + cfg.max_wait_ms / 1000.0 \
+                if cfg.max_wait_ms > 0 else None
+            query_deadline = t0 + timeout_ms / 1000.0 \
+                if timeout_ms is not None else None
             while True:
                 if waiter.event.wait(_POLL_S):
                     break
@@ -250,13 +251,18 @@ class WorkloadManager:
                     # under its lock.
                     with self._lock:
                         if not waiter.granted:
+                            # note_handoff BEFORE remove: if the
+                            # coalescer refuses (raises), the waiter is
+                            # still queued and the error path below
+                            # unhooks it cleanly
+                            coal.note_handoff()
                             lane.remove(waiter)
+                            unhooked = True   # ticket owns quota now
                             lane.admitted += 1
                             lane.coalesced_handoff += 1
                             self.admitted_total += 1
                             queued_ms = (now - enq) * 1000.0
                             lane.queued_ms_total += queued_ms
-                            coal.note_handoff()
                             return Ticket(lane_name, tenant, priority,
                                           queued_ms, est, demoted,
                                           timeout_ms, lane,
@@ -266,6 +272,7 @@ class WorkloadManager:
                         break
                 if cancel_event is not None and cancel_event.is_set():
                     self._unhook(lane, waiter, tenant, "cancel")
+                    unhooked = True
                     from spark_druid_olap_tpu.parallel.executor import (
                         QueryCancelled)
                     qid = getattr(ctxq, "query_id", None)
@@ -274,28 +281,36 @@ class WorkloadManager:
                         f"{lane_name!r}")
                 if wait_deadline is not None and now >= wait_deadline:
                     self._unhook(lane, waiter, tenant, "wait")
+                    unhooked = True
                     raise LaneFullError(
                         f"lane {lane_name!r} queue-wait budget "
                         f"({cfg.max_wait_ms:.0f}ms) exceeded",
                         retry_after_s=lane.retry_after_s())
                 if query_deadline is not None and now >= query_deadline:
                     self._unhook(lane, waiter, tenant, "deadline")
+                    unhooked = True
                     from spark_druid_olap_tpu.parallel.executor import (
                         QueryTimeout)
                     raise QueryTimeout(
                         f"query exceeded {timeout_ms}ms "
                         f"(queued in lane {lane_name!r})")
+            queued_ms = (time.perf_counter() - enq) * 1000.0
+            with self._lock:
+                lane.admitted += 1
+                if demoted:
+                    lane.demoted_in += 1
+                self.admitted_total += 1
+                lane.queued_ms_total += queued_ms
+            return Ticket(lane_name, tenant, priority, queued_ms, est,
+                          demoted, timeout_ms, lane, time.perf_counter())
         except BaseException:
+            # anything that escapes the wait (KeyboardInterrupt landing
+            # in event.wait, a raising stats hook, ...) must give back
+            # the queue entry — or the granted slot, if a grant raced —
+            # and the tenant quota, or the lane wedges permanently
+            if not unhooked:
+                self._unhook(lane, waiter, tenant, "error")
             raise
-        queued_ms = (time.perf_counter() - enq) * 1000.0
-        with self._lock:
-            lane.admitted += 1
-            if demoted:
-                lane.demoted_in += 1
-            self.admitted_total += 1
-            lane.queued_ms_total += queued_ms
-        return Ticket(lane_name, tenant, priority, queued_ms, est, demoted,
-                      timeout_ms, lane, time.perf_counter())
 
     def _unhook(self, lane: Lane, waiter, tenant: Optional[str],
                 why: str) -> None:

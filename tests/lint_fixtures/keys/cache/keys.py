@@ -1,0 +1,25 @@
+"""Fixture canonical-key module. Seeded: normalize_spec replaces
+``granularity`` with a constant (dropping it from the key) while the
+planner reads it — key-missing-field."""
+
+import dataclasses
+
+from ir import spec as S
+
+CACHEABLE_TYPES = (S.GroupByQuerySpec,)
+
+
+def normalize_filter(f):
+    return f
+
+
+def normalize_spec(q):
+    kw = dict(
+        granularity="all",
+        filter=normalize_filter(q.filter),
+    )
+    return dataclasses.replace(q, **kw)
+
+
+def canonical_key(q, config_fp):
+    return (type(q).__name__, config_fp, repr(normalize_spec(q)))

@@ -1,0 +1,31 @@
+"""Fixture compile-cache engine. Seeded: both _cached_program sites
+(lambda build and loop-nested local-def build) read HLL_LOG2M during
+program build while the signature only folds TZ_ID —
+compile-sig-missing-config."""
+
+from utils.config import HLL_LOG2M, TZ_ID
+
+
+class Engine:
+    def __init__(self, config):
+        self.config = config
+        self._programs = {}
+
+    def _cached_program(self, sig, build):
+        prog = self._programs.get(sig)
+        if prog is None:
+            prog = self._programs[sig] = build()
+        return prog
+
+    def _build_prog(self, q):
+        return ("prog", q.datasource, self.config.get(HLL_LOG2M))
+
+    def run(self, q):
+        sig = ("agg", q.datasource, self.config.get(TZ_ID))
+        prog = self._cached_program(sig, lambda: self._build_prog(q))
+        while True:
+            def build():
+                return self._build_prog(q)
+
+            prog2 = self._cached_program(sig, build)
+            return prog, prog2

@@ -1,0 +1,5 @@
+class GroupByQuerySpec:
+    datasource: str
+    granularity: str
+    filter: object
+    legacy_hint: str     # seeded: keyed but never read anywhere

@@ -1,0 +1,7 @@
+from utils.config import TZ_ID
+
+
+def build_plan(q, config):
+    # reads granularity (stripped from the key) and a semantic=False
+    # config key — both result-defining reads
+    return (q.datasource, q.granularity, config.get(TZ_ID))

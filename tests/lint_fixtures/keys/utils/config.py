@@ -1,0 +1,25 @@
+"""Fixture config registry. Seeded: TZ_ID is declared semantic=False
+but planner code reads it (fingerprint-missing-key); WLM_POLL_MS is
+default-semantic but only wlm/ reads it (fingerprint-churn-key); and
+Config.fingerprint folds the raw map (fingerprint-unfiltered)."""
+
+
+def _entry(key, default, doc, parse=None, semantic=True):
+    return key
+
+
+TZ_ID = _entry("sdot.fixture.timezone", "UTC", "bucketing timezone",
+               semantic=False)
+HLL_LOG2M = _entry("sdot.fixture.hll.log2m", 11, "sketch precision")
+WLM_POLL_MS = _entry("sdot.fixture.wlm.poll.ms", 5, "queue poll cadence")
+
+
+class Config:
+    def __init__(self):
+        self._values = {}
+
+    def get(self, key):
+        return self._values.get(key)
+
+    def fingerprint(self):
+        return tuple(sorted(self._values.items()))

@@ -1,0 +1,5 @@
+from utils.config import WLM_POLL_MS
+
+
+def poll_interval(config):
+    return config.get(WLM_POLL_MS) / 1000.0

@@ -1,0 +1,24 @@
+"""Fixture admission path. Seeded: the tenant quota token and the lane
+wait-queue entry both escape on the exception path out of the wait loop
+(the deadline check raises) — unreleased-quota / unreleased-lane-waiter."""
+
+
+class Admission:
+    def __init__(self, lane, quotas):
+        self.lane = lane
+        self.quotas = quotas
+
+    def check_deadline(self, tenant):
+        raise TimeoutError(f"tenant {tenant} queue-wait exceeded")
+
+    def admit_quota(self, tenant):
+        self.quotas.acquire(tenant, 1)
+        self.check_deadline(tenant)
+        self.quotas.release(tenant)
+
+    def admit_slot(self, tenant, priority):
+        waiter = self.lane.enqueue(priority)
+        while not waiter.event.wait(0.005):
+            self.check_deadline(tenant)
+        self.lane.remove(waiter)
+        return waiter

@@ -1,0 +1,28 @@
+"""Fixture persist path. Seeded: the manifest rename publishes bytes
+that were never fsynced (rename-before-fsync), and a datasource is
+registered before its WAL commit record lands
+(register-before-wal-commit)."""
+
+import json
+import os
+
+
+def publish_manifest(root, doc):
+    tmp = os.path.join(root, "manifest.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.write("\n")
+    os.replace(tmp, os.path.join(root, "manifest.json"))
+
+
+def compact(wal, seq):
+    # seeded: the journal is truncated with no write_snapshot/checkpoint
+    # on the path — truncate-without-checkpoint
+    wal.truncate_through(seq)
+
+
+def ingest(store, wal, name, rows):
+    ds = store.build(name, rows)
+    store.register(ds)
+    wal.append({"seq": 1, "datasource": name}, rows)
+    return ds
