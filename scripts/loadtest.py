@@ -579,7 +579,8 @@ def run_sharedscan(args):
     import bench
     sf = args.tpch if args.tpch is not None else 1.0
     ctx, n_rows = bench.setup(sf)
-    ctx.config.set("sdot.wlm.batch.window.ms", float(args.window))
+    window_ms = float(args.window if args.window is not None else 8.0)
+    ctx.config.set("sdot.wlm.batch.window.ms", window_ms)
     queries = args.sql or TPCH_DASHBOARD
 
     # sequential reference (coalescing off): warm/compile, then answers
@@ -664,7 +665,7 @@ def run_sharedscan(args):
                        if mismatched else ""))
     out = {"mode": "sharedscan", "sf": sf, "rows": n_rows,
            "threads": args.threads, "duration_s": args.duration,
-           "window_ms": float(args.window), "legs": legs,
+           "window_ms": window_ms, "legs": legs,
            "qps_speedup": round(qps_x, 2),
            "dispatch_reduction": round(disp_x, 2),
            "result_mismatches": sorted(set(mismatched))}
@@ -672,6 +673,250 @@ def run_sharedscan(args):
     ok = not mismatched and on["n"] > 0 and off["n"] > 0 \
         and on["queries_coalesced"] > 0
     sys.exit(0 if ok else 1)
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_ready(port, timeout=240.0, proc=None):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(
+                f"historical on :{port} exited rc={proc.returncode} "
+                "before becoming ready")
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/readyz", timeout=2) as r:
+                if r.status == 200:
+                    return
+        except Exception:   # noqa: BLE001 — booting
+            pass
+        time.sleep(0.25)
+    raise RuntimeError(f"historical on :{port} not ready in {timeout}s")
+
+
+def run_cluster(args):
+    """Multi-process distributed-serving benchmark (cluster/): build +
+    checkpoint a synthetic store, spawn N historical subprocesses over
+    it (`python -m spark_druid_olap_tpu.cluster historical`), attach an
+    in-process broker, and hammer the same query mix through the broker
+    vs a single-process engine (result/plan caches off everywhere —
+    every rep executes). Reports scatter fan-out, merge latency,
+    per-node shared-scan coalesce rates, and the qps ratio; then a
+    kill -9 failover leg: one historical dies mid-storm and every answer
+    must still match the single-engine reference (zero mismatches)."""
+    import os
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    sys.path.insert(0, ".")
+    import spark_druid_olap_tpu as sdot
+
+    n_nodes = args.cluster
+    # micro-batch hold window for the historicals: subqueries for one
+    # shard arrive tens of ms apart under a storm, so the in-process
+    # default (8 ms) closes nearly every group solo. 25 ms is enough for
+    # the queued-waiter handoff to fill groups once lanes serialize.
+    window_ms = args.window if args.window is not None else 25.0
+    root = tempfile.mkdtemp(prefix="sdot-cluster-bench-")
+    caches_off = {"sdot.cache.enabled": False,
+                  "sdot.plan.cache.enabled": False}
+    procs, broker, single = [], None, None
+    try:
+        seed = sdot.Context({"sdot.persist.path": root})
+        # enough rows that scan work dominates per-RPC overhead — the
+        # regime the tier is for; small segments so every node gets real
+        # shards to own
+        df = _synthetic_sales(1_200_000)
+        seed.ingest_dataframe("sales", df, time_column="ts",
+                              target_rows=16384)
+        seed.checkpoint()
+        seed.close()
+
+        ports = [_free_port() for _ in range(n_nodes)]
+        nodes = ",".join(f"127.0.0.1:{p}" for p in ports)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        for i in range(n_nodes):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "spark_druid_olap_tpu.cluster",
+                 "historical", "--persist", root, "--nodes", nodes,
+                 "--node-id", str(i),
+                 "--set", "sdot.cache.enabled=false",
+                 "--set", "sdot.plan.cache.enabled=false",
+                 # the tier's designed configuration: each historical
+                 # coalesces its own slice of the storm (concurrent
+                 # subqueries on one node fuse into one scan), which is
+                 # what lets N nodes multiply qps instead of merely
+                 # splitting rows. Single-slot lanes serialize execution
+                 # so every subquery that arrives while a fused dispatch
+                 # runs queues — and the WLM handoff rides it into the
+                 # NEXT group's micro-batch window instead of scanning
+                 # solo.
+                 "--set", "sdot.sharedscan.enabled=true",
+                 "--set", "sdot.sharedscan.max.queries=64",
+                 "--set", f"sdot.wlm.batch.window.ms={window_ms}",
+                 "--set", "sdot.wlm.lanes=interactive:slots=1,queue=256;"
+                          "reporting:slots=1,queue=64;"
+                          "batch:slots=1,queue=32"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
+        print(f"[cluster] waiting for {n_nodes} historicals "
+              f"(persist recovery + shard load) ...")
+        t0 = time.monotonic()
+        for p, proc in zip(ports, procs):
+            _wait_ready(p, proc=proc)
+        print(f"[cluster] ready in {time.monotonic() - t0:.1f}s "
+              f"on ports {ports}")
+
+        broker = sdot.Context({
+            "sdot.persist.path": root, "sdot.cluster.nodes": nodes,
+            "sdot.cluster.role": "broker",
+            "sdot.cluster.probe.interval.seconds": 0.25,
+            "sdot.cluster.retry.backoff.start.seconds": 0.01,
+            # don't bottleneck the storm at the broker: every in-flight
+            # query needs a scatter worker per shard, and the broker's
+            # own admission must pass the full user count through so the
+            # historicals see the real concurrency to coalesce
+            "sdot.cluster.scatter.threads": args.threads * n_nodes,
+            "sdot.wlm.lanes": (
+                f"interactive:slots={max(args.threads, 8)},queue=512;"
+                "reporting:slots=8,queue=64;batch:slots=4,queue=32"),
+            **caches_off})
+        single = sdot.Context({"sdot.persist.path": root, **caches_off})
+
+        queries = args.sql or DEFAULT_QUERIES
+        answers = {}
+        for q in queries:                  # warm/compile both engines
+            single.sql(q)
+            answers[q] = single.sql(q).to_pandas()
+            broker.sql(q)
+            if not _frames_close(broker.sql(q).to_pandas(), answers[q]):
+                print(f"[cluster] WARMUP MISMATCH: {q}")
+                sys.exit(1)
+
+        # concurrent warmup: each distinct combination of fused lanes is
+        # its own compiled program on the historicals (identical specs
+        # dedup into one lane, so the combo space is the subsets of the
+        # query mix). A sequential pass never forms groups — storm the
+        # broker untimed so the common combos are compiled before the
+        # measured leg, matching the single engine whose programs the
+        # gate above already compiled.
+        print("[cluster] concurrent warmup (fused-group compile) ...")
+        run(lambda: (lambda sql: broker.sql(sql)), queries,
+            args.threads, 20.0)
+
+        legs = {}
+        print(f"\n=== single-process leg ({args.threads} threads x "
+              f"{args.duration:.0f}s) ===")
+        legs["single"] = _summarize(run(
+            lambda: (lambda sql: single.sql(sql)), queries,
+            args.threads, args.duration))
+        c0 = dict(broker.cluster.counters)
+        print(f"\n=== cluster leg ({n_nodes} historicals, {args.threads} "
+              f"threads x {args.duration:.0f}s) ===")
+        legs["cluster"] = _summarize(run(
+            lambda: (lambda sql: broker.sql(sql)), queries,
+            args.threads, args.duration))
+        c1 = dict(broker.cluster.counters)
+        dq = max(c1["queries"] - c0["queries"], 1)
+        fanout = (c1["scatters"] - c0["scatters"]) / dq
+        merge_ms = (c1["merge_ms"] - c0["merge_ms"]) / dq
+        coalesce = {}
+        for i, p in enumerate(ports):
+            try:
+                ss = get_json(f"http://127.0.0.1:{p}", "/metadata/sharedscan")
+                served = max(ss.get("queries_coalesced", 0)
+                             + ss.get("solo_groups", 0), 1)
+                coalesce[str(i)] = round(
+                    ss.get("queries_coalesced", 0) / served, 4)
+            except Exception:   # noqa: BLE001 — introspection only
+                coalesce[str(i)] = None
+        speedup = legs["cluster"]["qps"] / max(legs["single"]["qps"], 1e-9)
+        print(f"  scatter fan-out {fanout:.2f} shards/query, broker merge "
+              f"{merge_ms:.2f}ms/query, per-node coalesce {coalesce}")
+        print(f"  qps {legs['single']['qps']} -> {legs['cluster']['qps']} "
+              f"({speedup:.2f}x)")
+
+        # -- kill -9 failover leg ------------------------------------------
+        print(f"\n=== failover leg: kill -9 node {n_nodes - 1} "
+              f"mid-storm ===")
+        mism, errs, post_kill = [], [0], []
+        lock = threading.Lock()
+        stop_at = time.monotonic() + max(6.0, args.duration / 3)
+        t_kill = [None]
+
+        def storm(tid):
+            i = tid
+            while time.monotonic() < stop_at:
+                sql = queries[i % len(queries)]
+                i += 1
+                t0 = time.perf_counter()
+                try:
+                    got = broker.sql(sql).to_pandas()
+                except Exception:   # noqa: BLE001 — counted + asserted
+                    with lock:
+                        errs[0] += 1
+                    continue
+                dt = (time.perf_counter() - t0) * 1000
+                with lock:
+                    if t_kill[0] is not None:
+                        post_kill.append(dt)
+                    if not _frames_close(got, answers[sql]):
+                        mism.append(sql)
+
+        workers = [threading.Thread(target=storm, args=(t,), daemon=True)
+                   for t in range(args.threads)]
+        for t in workers:
+            t.start()
+        time.sleep(1.0)
+        victim = procs[-1]
+        t_kill[0] = time.monotonic()
+        victim.send_signal(signal.SIGKILL)
+        for t in workers:
+            t.join()
+        # detection latency: kill -> broker marking the node down
+        st = broker.cluster.stats()
+        down_s = st["nodes"][n_nodes - 1].get("down_seconds")
+        detect_ms = None if down_s is None else round(
+            (time.monotonic() - t_kill[0] - down_s) * 1000, 1)
+        pk = np.array(post_kill) if post_kill else np.array([0.0])
+        print(f"  {len(post_kill)} queries answered after the kill; "
+              f"mismatches={len(mism)} errors={errs[0]} "
+              f"detect={detect_ms}ms post-kill "
+              f"p99={np.percentile(pk, 99):.1f}ms")
+
+        out = {"mode": "cluster", "nodes": n_nodes, "rows": len(df),
+               "threads": args.threads, "duration_s": args.duration,
+               "legs": legs, "qps_speedup": round(speedup, 2),
+               "scatter_fanout": round(fanout, 2),
+               "merge_ms_per_query": round(merge_ms, 3),
+               "per_node_coalesce_rate": coalesce,
+               "failover": {
+                   "answered_after_kill": len(post_kill),
+                   "mismatches": len(mism), "errors": errs[0],
+                   "detect_ms": detect_ms,
+                   "post_kill_p99_ms": round(float(
+                       np.percentile(pk, 99)), 1)}}
+        print("\n" + json.dumps(out))
+        ok = (not mism and legs["cluster"]["n"] > 0
+              and len(post_kill) > 0 and speedup >= 2.0)
+        sys.exit(0 if ok else 1)
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for ctx in (broker, single):
+            if ctx is not None:
+                ctx.close()
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def main():
@@ -683,7 +928,11 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     ap = argparse.ArgumentParser()
     ap.add_argument("--url", default="http://127.0.0.1:8082")
-    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--threads", type=int, default=None,
+                    help="concurrent client threads (default 8; "
+                    "--cluster defaults to 32 — a dashboard storm needs "
+                    "more users than distinct queries for per-node "
+                    "dedup to bite)")
     ap.add_argument("--duration", type=float, default=30.0)
     ap.add_argument("--sql", action="append", default=None,
                     help="query to run (repeatable); default: built-in mix")
@@ -721,9 +970,19 @@ def main():
                     "dispatches per leg; every reply is differentially "
                     "checked against sequential answers (mismatch -> "
                     "exit 1)")
-    ap.add_argument("--window", type=float, default=8.0, metavar="MS",
-                    help="sdot.wlm.batch.window.ms for --sharedscan "
-                    "(micro-batch hold window; default 8ms)")
+    ap.add_argument("--window", type=float, default=None, metavar="MS",
+                    help="sdot.wlm.batch.window.ms (micro-batch hold "
+                    "window) for --sharedscan (default 8ms) and for the "
+                    "historicals in --cluster (default 25ms)")
+    ap.add_argument("--cluster", type=int, default=0, metavar="N",
+                    help="multi-process distributed-serving benchmark: "
+                    "checkpoint a synthetic store, spawn N historical "
+                    "subprocesses over it, scatter the query mix through "
+                    "an in-process broker vs a single-process engine "
+                    "(caches off), then kill -9 one node mid-storm; "
+                    "reports fan-out, merge latency, per-node coalesce "
+                    "rates, failover detection, and the qps ratio "
+                    "(exit 0 needs zero mismatches and >= 2x qps)")
     ap.add_argument("--wlm", action="store_true",
                     help="in-process overload comparison: interactive + "
                     "heavy query mix at 4x the interactive lane's "
@@ -731,7 +990,11 @@ def main():
                     "reports per-class p50/p99 and shed rate (caches "
                     "off, fixed seed)")
     args = ap.parse_args()
+    if args.threads is None:
+        args.threads = 32 if args.cluster else 8
 
+    if args.cluster:
+        return run_cluster(args)
     if args.coldstart:
         return run_coldstart(args)
     if args.sharedscan:

@@ -1,0 +1,64 @@
+#!/usr/bin/env bash
+# Start a local sdot serving cluster: N historical processes + one broker
+# over a shared deep-storage root (≈ Druid's historical tier + broker,
+# minus the coordinator — the shard plan is computed from the persist
+# manifests by every member independently; see docs/DISTRIBUTED.md).
+#
+#   scripts/start-sdot-cluster.sh <persist-root> [n-historicals] \
+#       [broker-port] [base-port]
+#
+# Historicals listen on base-port, base-port+1, ...; the broker fronts
+# them on broker-port with the ordinary SQL HTTP surface. Ctrl-C tears
+# the whole tree down. Logs land next to the persist root as
+# historical-<i>.log.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ROOT="${1:?usage: start-sdot-cluster.sh <persist-root> [n] [broker-port] [base-port]}"
+N="${2:-2}"
+BROKER_PORT="${3:-8082}"
+BASE_PORT="${4:-9101}"
+
+NODES=""
+for ((i = 0; i < N; i++)); do
+    NODES="${NODES:+$NODES,}127.0.0.1:$((BASE_PORT + i))"
+done
+
+PIDS=()
+cleanup() {
+    for pid in "${PIDS[@]}"; do
+        kill "$pid" 2>/dev/null || true
+    done
+}
+trap cleanup EXIT INT TERM
+
+# SDOT_HISTORICAL_ARGS: extra args for every historical, e.g. the storm
+# serving config from docs/DISTRIBUTED.md ("--set sdot.sharedscan.enabled=true ...")
+for ((i = 0; i < N; i++)); do
+    # shellcheck disable=SC2086 — word splitting is the point
+    python -m spark_druid_olap_tpu.cluster historical \
+        --persist "$ROOT" --nodes "$NODES" --node-id "$i" \
+        ${SDOT_HISTORICAL_ARGS:-} \
+        >"$ROOT/historical-$i.log" 2>&1 &
+    PIDS+=("$!")
+done
+
+# readyz gate: every historical must finish recovery + shard load before
+# the broker starts taking traffic
+for ((i = 0; i < N; i++)); do
+    port=$((BASE_PORT + i))
+    for ((t = 0; t < 480; t++)); do
+        if curl -fsS "http://127.0.0.1:$port/readyz" >/dev/null 2>&1; then
+            echo "historical $i ready on :$port"
+            break
+        fi
+        if ! kill -0 "${PIDS[$i]}" 2>/dev/null; then
+            echo "historical $i died during boot; see $ROOT/historical-$i.log" >&2
+            exit 1
+        fi
+        sleep 0.5
+    done
+done
+
+exec python -m spark_druid_olap_tpu.cluster broker \
+    --persist "$ROOT" --nodes "$NODES" --port "$BROKER_PORT"

@@ -1,0 +1,84 @@
+"""Cluster entrypoint.
+
+Historical (one per node id in the list)::
+
+    python -m spark_druid_olap_tpu.cluster historical \
+        --persist /data/sdot --nodes h0:9101,h1:9102 --node-id 0
+
+Broker (fronts the cluster on the ordinary SQL HTTP surface)::
+
+    python -m spark_druid_olap_tpu.cluster broker \
+        --persist /data/sdot --nodes h0:9101,h1:9102 --port 8082
+
+Every member must see the same --persist root and the same --nodes
+list: the shard plan is recomputed identically from those two inputs.
+``scripts/start-sdot-cluster.sh`` wraps the N+1 process spawn.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def _common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--persist", required=True,
+                   help="deep storage root (sdot.persist.path); the "
+                        "coordination substrate")
+    p.add_argument("--nodes", required=True,
+                   help="comma-separated host:port historical list; "
+                        "index order = node id")
+    p.add_argument("--replication", type=int, default=2,
+                   help="shard copies across historicals (default 2)")
+    p.add_argument("--shards", type=int, default=0,
+                   help="shards per datasource (0 = one per node)")
+    p.add_argument("--set", action="append", default=[], metavar="K=V",
+                   help="extra sdot.* config overrides (repeatable)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m spark_druid_olap_tpu.cluster")
+    sub = ap.add_subparsers(dest="role", required=True)
+    h = sub.add_parser("historical", help="serve assigned shards")
+    _common(h)
+    h.add_argument("--node-id", type=int, required=True,
+                   help="this node's index into --nodes")
+    b = sub.add_parser("broker", help="scatter/merge front over the nodes")
+    _common(b)
+    b.add_argument("--host", default="0.0.0.0")
+    b.add_argument("--port", type=int, default=8082)
+    args = ap.parse_args(argv)
+
+    overrides = {
+        "sdot.persist.path": args.persist,
+        "sdot.cluster.nodes": args.nodes,
+        "sdot.cluster.replication": args.replication,
+        "sdot.cluster.shards": args.shards,
+    }
+    for kv in args.set:
+        k, _, v = kv.partition("=")
+        overrides[k] = v
+
+    if args.role == "historical":
+        from spark_druid_olap_tpu.cluster.historical import HistoricalNode
+        node = HistoricalNode(overrides, node_id=args.node_id)
+        host, port = node.addresses[node.node_id]
+        print(f"sdot historical {node.node_id} booting on "
+              f"http://{host}:{port} (readyz flips 200 when shards load)",
+              flush=True)
+        node.start(background=False)
+        return 0
+
+    overrides["sdot.cluster.role"] = "broker"
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.server.http import SqlServer
+    ctx = sdot.Context(overrides)
+    n_ds = len(ctx.cluster.plan.datasources) if ctx.cluster else 0
+    print(f"sdot broker on http://{args.host}:{args.port} — "
+          f"{len(ctx.cluster.nodes)} nodes, {n_ds} planned datasources",
+          flush=True)
+    SqlServer(ctx, args.host, args.port).start(background=False)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

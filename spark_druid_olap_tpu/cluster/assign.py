@@ -1,0 +1,137 @@
+"""Deterministic segment-shard -> historical assignment.
+
+≈ ``DruidMetadataCache.assignHistoricalServers`` with deep storage as the
+coordination substrate: the plan is a pure function of (published
+manifests, node list, replication factor), so the broker and every
+historical compute the IDENTICAL plan independently — no coordinator
+process, no gossip. A topology change (node list edit) is a restart, the
+way Druid treats a historical tier resize as a coordinator rebalance.
+
+Sharding reuses the multi-host cut algorithm
+(``parallel/multihost.py:assign_segments_to_hosts``): contiguous
+time-blocks of segments balanced by row count. Contiguity keeps each
+shard one time range, so the broker's interval pruning could skip whole
+nodes the way Druid's time-chunk assignment does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Dict, Optional, Tuple
+
+from spark_druid_olap_tpu.parallel.multihost import assign_segments_to_hosts
+from spark_druid_olap_tpu.persist import snapshot as SNAP
+
+
+def shard_name(datasource: str, index: int, n_shards: int) -> str:
+    """Store name a historical registers shard ``index`` under. The
+    full-name prefix keeps result-cache keys and WLM attribution legible
+    per node; '::' cannot appear in SQL identifiers, so shard stores are
+    unreachable from user queries."""
+    return f"{datasource}::shard{index}of{n_shards}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Shard:
+    index: int
+    segment_indexes: Tuple[int, ...]   # indexes into the manifest's segment list
+    rows: int
+    owners: Tuple[int, ...]            # node ids, primary first
+
+
+@dataclasses.dataclass(frozen=True)
+class DatasourcePlan:
+    name: str
+    snapshot_version: int
+    ingest_version: int
+    num_rows: int
+    num_segments: int
+    shards: Tuple[Shard, ...]
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.shards)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterPlan:
+    n_nodes: int
+    replication: int
+    datasources: Dict[str, DatasourcePlan]
+
+    def shards_of(self, node_id: int) -> Dict[str, Tuple[Shard, ...]]:
+        """datasource -> shards this node owns (primary or replica)."""
+        out = {}
+        for name, dp in self.datasources.items():
+            owned = tuple(sh for sh in dp.shards if node_id in sh.owners)
+            if owned:
+                out[name] = owned
+        return out
+
+
+def _plan_datasource(manifest: dict, n_nodes: int, replication: int,
+                     n_shards: int) -> DatasourcePlan:
+    name = manifest["datasource"]
+    segs = manifest["segments"]            # [[id, start, end, min_ms, max_ms]]
+    rows = [int(e[2]) - int(e[1]) for e in segs]
+    want = n_shards if n_shards > 0 else n_nodes
+    k = max(1, min(want, len(segs)))
+    cut = assign_segments_to_hosts(rows, k)
+    # primary rotation by datasource-name CRC spreads different
+    # datasources' shard-0 primaries across nodes (Python's str hash is
+    # process-salted; CRC32 is stable everywhere)
+    base = zlib.crc32(name.encode("utf-8"))
+    r = min(max(1, replication), n_nodes)
+    shards = []
+    for i in range(k):
+        members = tuple(int(j) for j in range(len(cut)) if int(cut[j]) == i)
+        primary = (base + i) % n_nodes
+        owners = tuple((primary + c) % n_nodes for c in range(r))
+        shards.append(Shard(index=i, segment_indexes=members,
+                            rows=sum(rows[j] for j in members),
+                            owners=owners))
+    return DatasourcePlan(
+        name=name,
+        snapshot_version=int(manifest["snapshot_version"]),
+        ingest_version=int(manifest["ingest_version"]),
+        num_rows=int(manifest["num_rows"]),
+        num_segments=len(segs),
+        shards=tuple(shards))
+
+
+def plan_cluster(persist_root: str, n_nodes: int, replication: int,
+                 n_shards: int = 0,
+                 manifests: Optional[Dict[str, dict]] = None) -> ClusterPlan:
+    """Compute the full cluster plan from deep storage.
+
+    ``manifests`` injects a pre-scanned catalog (tests, or a broker that
+    already holds one); otherwise the root is scanned fresh. Determinism
+    contract: identical (manifests, n_nodes, replication, n_shards) ->
+    identical plan, on any process, in any order of discovery."""
+    if n_nodes < 1:
+        raise ValueError("cluster plan needs at least one node")
+    if manifests is None:
+        manifests = SNAP.datasource_manifests(persist_root)
+    dss = {}
+    for name in sorted(manifests):
+        dss[name] = _plan_datasource(manifests[name], n_nodes,
+                                     replication, n_shards)
+    return ClusterPlan(n_nodes=n_nodes,
+                       replication=min(max(1, replication), n_nodes),
+                       datasources=dss)
+
+
+def parse_nodes(spec: str) -> Tuple[Tuple[str, int], ...]:
+    """'host:port,host:port' -> ((host, port), ...); index = node id."""
+    out = []
+    for part in (spec or "").replace(";", ",").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"bad cluster node address {part!r} "
+                             "(want host:port)")
+        out.append((host, int(port)))
+    return tuple(out)

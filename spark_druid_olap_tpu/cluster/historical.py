@@ -1,0 +1,214 @@
+"""Historical node: boot from deep storage, serve owned shards.
+
+Boot sequence (order matters for the health contract):
+
+1. the HTTP server starts FIRST — ``/healthz`` answers immediately,
+   ``/readyz`` answers 503 until boot completes, so orchestrators and
+   the broker's prober can watch recovery progress;
+2. a full ``Context`` is created over the shared persist root —
+   ``PersistManager.recover()`` rebuilds every datasource from
+   snapshots + WAL tails exactly as a single-process engine would;
+3. the node computes the SAME shard plan as the broker (pure function
+   of deep storage + the node list), slices each owned shard out of the
+   recovered datasource with ``segment/store.py:slice_segments``,
+   registers it under its shard name at the manifest's ingest version,
+   and drops the full datasource — memory is bounded by owned rows;
+4. ``ready`` flips True; ``/readyz`` goes 200 and the broker routes
+   primary traffic here.
+
+The subquery RPC wraps the ordinary ``QueryEngine.execute``: WLM lane
+admission, the per-node result cache, and shared-scan coalescing all
+apply to subqueries, so each historical absorbs its own slice of a
+dashboard storm. ``partial_sketches`` makes sketch aggregates return
+raw registers for the broker's exact register merge.
+
+A datasource whose recovered state runs PAST the planned manifest (WAL
+tail appended after the last checkpoint) is kept whole and unsliced:
+the broker's matching ingest-version check already serves it locally,
+and slicing would silently drop the WAL rows here.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+from urllib.parse import urlparse
+
+from spark_druid_olap_tpu.cluster import wire as WIRE
+from spark_druid_olap_tpu.cluster.assign import (
+    parse_nodes, plan_cluster, shard_name)
+from spark_druid_olap_tpu.server.http import SqlServer
+from spark_druid_olap_tpu.utils.config import (
+    CLUSTER_NODE_ID,
+    CLUSTER_NODES,
+    CLUSTER_REPLICATION,
+    CLUSTER_ROLE,
+    CLUSTER_SHARDS,
+    PERSIST_PATH,
+)
+
+
+class HistoricalServer(SqlServer):
+    """SqlServer + the cluster subquery RPC. Everything else — /sql,
+    /metadata/*, /healthz — is inherited, so a historical is also a
+    directly-queryable engine over its shards (handy for debugging a
+    single node's slice)."""
+
+    def __init__(self, node: "HistoricalNode", host: str, port: int):
+        super().__init__(None, host, port)   # ctx attaches after boot
+        self.node = node
+        self.ready_check = lambda: node.ready
+
+    def _handle_post(self, h):
+        if urlparse(h.path).path == "/cluster/subquery":
+            n = int(h.headers.get("Content-Length", "0"))
+            raw = h.rfile.read(n) if n else b"{}"
+            code, body, ctype = self.node.handle_subquery(raw)
+            h._send(code, body, ctype)
+            return
+        super()._handle_post(h)
+
+
+class HistoricalNode:
+    """One serving process. ``overrides`` is the shared cluster config
+    (persist path, node list, replication, shard count) — identical on
+    every member, which is what makes the independently-computed plans
+    identical."""
+
+    def __init__(self, overrides: Optional[dict] = None,
+                 node_id: Optional[int] = None):
+        from spark_druid_olap_tpu.utils.config import Config
+        self.overrides = dict(overrides or {})
+        self.overrides[CLUSTER_ROLE.key] = "historical"
+        cfg = Config(self.overrides)
+        self.addresses = parse_nodes(str(cfg.get(CLUSTER_NODES)))
+        if not self.addresses:
+            raise ValueError("HistoricalNode needs sdot.cluster.nodes")
+        if node_id is None:
+            node_id = int(cfg.get(CLUSTER_NODE_ID))
+        self.node_id = int(node_id)
+        self.overrides[CLUSTER_NODE_ID.key] = self.node_id
+        if not 0 <= self.node_id < len(self.addresses):
+            raise ValueError(
+                f"node id {self.node_id} outside the node list "
+                f"(n={len(self.addresses)})")
+        self.ready = False
+        self.ctx = None
+        self.plan = None
+        self.shards_loaded = 0
+        self.server: Optional[HistoricalServer] = None
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self, background: bool = True) -> "HistoricalNode":
+        host, port = self.addresses[self.node_id]
+        self.server = HistoricalServer(self, host, port)
+        self.server.start(background=True)
+        self.boot()
+        if not background:
+            try:
+                threading.Event().wait()
+            except KeyboardInterrupt:
+                pass
+            finally:
+                self.stop()
+        return self
+
+    def boot(self) -> None:
+        import spark_druid_olap_tpu as sdot
+        self.ctx = sdot.Context(self.overrides)
+        self.server.ctx = self.ctx
+        # sketch aggregates ship raw registers to the broker (both the
+        # solo and the fused shared-scan decode honor this flag)
+        self.ctx.engine.partial_sketches = True
+        cfg = self.ctx.config
+        self.plan = plan_cluster(
+            cfg.get(PERSIST_PATH), len(self.addresses),
+            int(cfg.get(CLUSTER_REPLICATION)),
+            int(cfg.get(CLUSTER_SHARDS)))
+        self._load_shards()
+        self.ready = True
+
+    def stop(self) -> None:
+        self.ready = False
+        if self.server is not None:
+            self.server.stop()
+        if self.ctx is not None:
+            self.ctx.close()
+
+    def _load_shards(self) -> None:
+        from spark_druid_olap_tpu.segment.store import slice_segments
+        store = self.ctx.store
+        owned_by_ds = self.plan.shards_of(self.node_id)
+        for name in store.names():
+            dp = self.plan.datasources.get(name)
+            if dp is None:
+                # WAL-only datasource (no published manifest): not in
+                # the plan, broker serves it locally — keep it whole
+                continue
+            if store.datasource_version(name) != dp.ingest_version \
+                    or store.get(name).num_segments != dp.num_segments:
+                # recovery replayed WAL past the planned snapshot;
+                # slicing by manifest segment indexes would drop those
+                # rows. Keep whole — the broker's version check routes
+                # this datasource locally until the next checkpoint.
+                continue
+            full = store.get(name)
+            for sh in owned_by_ds.get(name, ()):
+                shard = slice_segments(
+                    full, sh.segment_indexes,
+                    name=shard_name(name, sh.index, dp.n_shards))
+                store.restore(shard, ingest_version=dp.ingest_version)
+                self.shards_loaded += 1
+            # serve ONLY owned shards: per-node memory is bounded by
+            # assigned rows, the point of the tier
+            store.drop(name)
+
+    # -- RPC ------------------------------------------------------------------
+    def handle_subquery(self, raw: bytes):
+        """-> (http status, payload, content type). 200 carries a wire-
+        encoded partial result; everything else is a JSON error whose
+        ``error`` kind the broker uses to pick retry-on-replica vs
+        fall-back-to-local."""
+        if not self.ready:
+            return 503, WIRE.encode_error(
+                "NotReady", "recovery / shard load in progress"), \
+                "application/json"
+        from spark_druid_olap_tpu.ir.serde import query_from_dict
+        from spark_druid_olap_tpu.parallel.executor import (
+            EngineFallback, QueryCancelled, QueryTimeout)
+        from spark_druid_olap_tpu.wlm.lanes import AdmissionRejected
+        try:
+            q = query_from_dict(json.loads(raw.decode("utf-8")))
+        except (ValueError, KeyError, TypeError) as e:
+            return 400, WIRE.encode_error("BadQuery", str(e)), \
+                "application/json"
+        engine = self.ctx.engine
+        try:
+            r = engine.execute(q)
+        except KeyError as e:
+            # unknown shard store: stale plan or mid-rejoin — the
+            # broker marks this node down and asks a replica
+            return 404, WIRE.encode_error("UnknownDatasource", str(e)), \
+                "application/json"
+        except AdmissionRejected as e:
+            return 429, WIRE.encode_error(
+                "AdmissionRejected", str(e),
+                retryAfterSeconds=float(getattr(e, "retry_after_s", 1.0))), \
+                "application/json"
+        except EngineFallback as e:
+            # this node cannot answer the shape (e.g. sketch over the
+            # hashed tier); no replica can either — broker runs it
+            # locally through its own session-level host tier
+            return 422, WIRE.encode_error("EngineFallback", str(e)), \
+                "application/json"
+        except (QueryCancelled, QueryTimeout) as e:
+            return 504, WIRE.encode_error(type(e).__name__, str(e)), \
+                "application/json"
+        ls = engine.last_stats
+        stats = {"node": self.node_id,
+                 "cache": ls.get("cache"),
+                 "sharedscan": ls.get("sharedscan"),
+                 "total_ms": ls.get("total_ms")}
+        return 200, WIRE.encode_result(r.columns, r.data, stats), \
+            "application/octet-stream"

@@ -1,0 +1,210 @@
+"""Broker-side merge of per-shard partial aggregates.
+
+Exactness contract (the failover acceptance bar is byte-identical
+answers vs single-engine execution):
+
+- integer sums accumulate as Python ints (arbitrary precision), so a
+  sum that overflows int64 across shards still matches the engine's
+  wide-int object columns;
+- float sums accumulate in float64 skipping NaN identity cells
+  (all-NaN group -> NaN, matching ``_identity_row``);
+- min/max are NaN/None-aware with the same null-wins-never rule;
+- sketch aggregates merge RAW registers (HLL: elementwise max, theta:
+  elementwise min — both associative and commutative) and the estimate
+  is finalized ONCE here, so the distributed estimate equals the
+  single-engine estimate exactly, not approximately.
+
+The mergeable-kind set derives from ``ops/agg_registry.AGG_CLOSURE``
+(the declared merge closure): anything routed sum/min/max/count or
+sketch-valued is distributable. ``anyvalue`` is excluded on purpose —
+its "pick any" contract is only deterministic within one engine's scan
+order, and the broker must never change an answer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from spark_druid_olap_tpu.ops import hll as HLL
+from spark_druid_olap_tpu.ops import theta as TH
+from spark_druid_olap_tpu.ops.agg_registry import AGG_CLOSURE
+
+# druid-level agg kind -> merge op
+MERGE_OP: Dict[str, str] = {}
+for _k, _spec in AGG_CLOSURE.items():
+    if _spec["sketch"] is not None:
+        MERGE_OP[_k] = _spec["sketch"]                  # hll | theta
+    elif _k != "anyvalue" and _spec["route"] in ("count", "sum"):
+        MERGE_OP[_k] = "sum"
+    elif _k != "anyvalue" and _spec["route"] in ("min", "max"):
+        MERGE_OP[_k] = _spec["route"]
+
+MERGEABLE_KINDS = frozenset(MERGE_OP)
+
+
+def _is_null(v) -> bool:
+    if v is None:
+        return True
+    if isinstance(v, (float, np.floating)):
+        return bool(np.isnan(v))
+    return False
+
+
+def _sort_token(v):
+    # None sorts first; within a column all non-null cells share a type
+    return (0, 0) if v is None else (1, v)
+
+
+def _key_cell(v):
+    """Group-key cell normalized for dict identity: NaN / NaT become
+    None (NaN != NaN would split one null group per shard). np.array
+    re-materializes None as NaN/NaT under the saved key dtype."""
+    if isinstance(v, (float, np.floating)) and np.isnan(v):
+        return None
+    if isinstance(v, np.datetime64) and np.isnat(v):
+        return None
+    return v
+
+
+class _Acc:
+    """One group's accumulators, one slot per aggregate column."""
+
+    __slots__ = ("slots",)
+
+    def __init__(self, n: int):
+        self.slots = [None] * n
+
+
+def merge_partials(parts: Sequence[Dict[str, np.ndarray]],
+                   key_cols: Sequence[str],
+                   aggs: Sequence[Tuple[str, str]],
+                   ) -> Tuple[List[str], Dict[str, np.ndarray], int]:
+    """Merge shard partials into one canonical result.
+
+    ``parts``: per-shard column dicts (every part carries all key and
+    agg columns). ``aggs``: (output name, druid kind) in output order.
+    Returns (columns, data, n_rows) with rows canonically sorted by the
+    key tuple (None first) — the epilogue's own ORDER BY re-sorts when
+    the query asks for one, and the canonical order makes unordered
+    results deterministic regardless of shard arrival order."""
+    ops = [(name, MERGE_OP[kind]) for name, kind in aggs]
+    groups: Dict[tuple, _Acc] = {}
+    float_domain = {name: False for name, _ in ops}
+    key_dtypes: Dict[str, np.dtype] = {}
+
+    for data in parts:
+        if not data:
+            continue
+        n = len(data[key_cols[0]]) if key_cols else (
+            len(data[ops[0][0]]) if ops else 0)
+        kcols = []
+        for k in key_cols:
+            arr = data[k]
+            if k not in key_dtypes and arr.dtype != object:
+                key_dtypes[k] = arr.dtype
+            kcols.append(arr)
+        acols = []
+        for name, op in ops:
+            arr = data[name]
+            if op == "sum" and arr.dtype != object \
+                    and arr.dtype.kind == "f":
+                float_domain[name] = True
+            acols.append(arr)
+        for i in range(n):
+            key = tuple(_key_cell(c[i]) for c in kcols)
+            acc = groups.get(key)
+            if acc is None:
+                acc = groups[key] = _Acc(len(ops))
+            slots = acc.slots
+            for j, (_, op) in enumerate(ops):
+                v = acols[j][i]
+                if op in ("hll", "theta"):
+                    # v is a 1-D register row — EXCEPT when the shard's
+                    # segments all pruned away and its engine emitted
+                    # the scalar identity 0 (_identity_row): that cell
+                    # carries no registers and merges as a no-op
+                    if not isinstance(v, np.ndarray) or v.ndim != 1:
+                        continue
+                    # copy on first sight so the in-place merge never
+                    # writes a buffer another group row shares
+                    if slots[j] is None:
+                        slots[j] = np.array(v, copy=True)
+                    elif op == "hll":
+                        np.maximum(slots[j], v, out=slots[j])
+                    else:
+                        np.minimum(slots[j], v, out=slots[j])
+                    continue
+                if _is_null(v):
+                    continue
+                if isinstance(v, np.generic):
+                    v = v.item()
+                cur = slots[j]
+                if cur is None:
+                    slots[j] = v
+                elif op == "sum":
+                    slots[j] = cur + v
+                elif op == "min":
+                    slots[j] = v if v < cur else cur
+                else:
+                    slots[j] = v if v > cur else cur
+
+    keys = sorted(groups, key=lambda t: tuple(_sort_token(v) for v in t))
+    n_out = len(keys)
+    columns = list(key_cols) + [name for name, _ in ops]
+    data_out: Dict[str, np.ndarray] = {}
+    for ki, k in enumerate(key_cols):
+        vals = [key[ki] for key in keys]
+        dt = key_dtypes.get(k)
+        if dt is not None:
+            data_out[k] = np.array(vals, dtype=dt)
+        else:
+            arr = np.empty(n_out, dtype=object)
+            for i, v in enumerate(vals):
+                arr[i] = v
+            data_out[k] = arr
+    for j, (name, op) in enumerate(ops):
+        cells = [groups[key].slots[j] for key in keys]
+        if op in ("hll", "theta"):
+            m = next((len(c) for c in cells if c is not None), 0)
+            if n_out == 0 or m == 0:
+                # no shard contributed registers: every group estimates 0
+                data_out[name] = np.zeros(n_out, dtype=np.int64)
+                continue
+            # a group no shard had registers for uses the empty-register
+            # identity (hll: all-zero registers, theta: all-one lane
+            # minima) — both estimate to exactly 0
+            fill = np.zeros(m, dtype=np.int64) if op == "hll" \
+                else np.ones(m, dtype=np.float64)
+            regs = np.stack([fill if c is None else c for c in cells])
+            est = HLL.estimate(regs) if op == "hll" else TH.estimate(regs)
+            data_out[name] = np.round(est).astype(np.int64)
+            continue
+        data_out[name] = _finalize_scalar(cells, float_domain[name])
+    return columns, data_out, n_out
+
+
+def _finalize_scalar(cells: List, force_float: bool) -> np.ndarray:
+    """Column from merged scalar accumulators, matching engine dtypes:
+    float64 (NaN nulls) for float-domain columns, int64 when every int
+    fits, else object (wide ints / None nulls, the epilogue's
+    object-column comparators handle these)."""
+    if force_float or any(isinstance(v, float) for v in cells):
+        return np.array([np.nan if v is None else float(v)
+                         for v in cells], dtype=np.float64)
+    if all(v is not None for v in cells):
+        if all(-(2 ** 63) <= v < 2 ** 63 for v in cells):
+            return np.array(cells, dtype=np.int64)
+        arr = np.empty(len(cells), dtype=object)
+        for i, v in enumerate(cells):
+            arr[i] = v
+        return arr
+    if not any(v is not None for v in cells):
+        # every group null (e.g. min over no non-null rows): engine
+        # emits float64 NaN for numeric nulls
+        return np.full(len(cells), np.nan, dtype=np.float64)
+    arr = np.empty(len(cells), dtype=object)
+    for i, v in enumerate(cells):
+        arr[i] = v
+    return arr

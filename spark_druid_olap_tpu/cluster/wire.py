@@ -1,0 +1,101 @@
+"""Pickle-free wire format for subquery results.
+
+Layout: ``b"SDW1" + uint32le(header_len) + header_json + buffers``.
+Numeric / datetime columns travel as raw little-endian buffers described
+by ``dtype.str`` + shape in the header (2-D shapes carry partial sketch
+register blocks); object columns (decoded strings, wide ints, None
+nulls) travel as JSON lists — Python ints survive JSON with arbitrary
+precision, which is what keeps exact int128-ish sums exact across the
+wire. No pickle anywhere: a historical's RPC port must not be a
+remote-code-execution port.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+MAGIC = b"SDW1"
+_LEN = struct.Struct("<I")
+
+
+def _jsonable_cell(v: Any):
+    if v is None:
+        return None
+    if isinstance(v, np.generic):
+        v = v.item()
+    if isinstance(v, float) and not math.isfinite(v):
+        # JSON has no NaN/Inf literal worth trusting cross-parser; a
+        # non-finite float in an object column is a null cell
+        return None
+    return v
+
+
+def encode_result(columns: List[str], data: Dict[str, np.ndarray],
+                  stats: Optional[dict] = None) -> bytes:
+    n = int(len(data[columns[0]])) if columns else 0
+    header: Dict[str, Any] = {"n": n, "stats": stats or {}, "cols": []}
+    bufs: List[bytes] = []
+    for name in columns:
+        arr = np.asarray(data[name])
+        if arr.dtype == object:
+            header["cols"].append({
+                "name": name, "kind": "obj",
+                "values": [_jsonable_cell(v) for v in arr.tolist()]})
+        else:
+            arr = np.ascontiguousarray(arr)
+            raw = arr.tobytes()
+            header["cols"].append({
+                "name": name, "kind": "bin", "dtype": arr.dtype.str,
+                "shape": list(arr.shape), "nbytes": len(raw)})
+            bufs.append(raw)
+    hb = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    return b"".join([MAGIC, _LEN.pack(len(hb)), hb] + bufs)
+
+
+def decode_result(payload: bytes) -> Tuple[List[str], Dict[str, np.ndarray],
+                                           dict]:
+    """-> (columns, data, stats). Raises ValueError on a malformed frame."""
+    if payload[:4] != MAGIC:
+        raise ValueError("bad wire magic")
+    (hlen,) = _LEN.unpack_from(payload, 4)
+    off = 8 + hlen
+    header = json.loads(payload[8:off].decode("utf-8"))
+    columns: List[str] = []
+    data: Dict[str, np.ndarray] = {}
+    for col in header["cols"]:
+        name = col["name"]
+        columns.append(name)
+        if col["kind"] == "obj":
+            vals = col["values"]
+            arr = np.empty(len(vals), dtype=object)
+            for i, v in enumerate(vals):
+                arr[i] = v
+            data[name] = arr
+        else:
+            nb = int(col["nbytes"])
+            arr = np.frombuffer(payload[off:off + nb],
+                                dtype=np.dtype(col["dtype"]))
+            data[name] = arr.reshape(col["shape"]).copy()
+            off += nb
+    return columns, data, header.get("stats", {})
+
+
+def encode_error(kind: str, message: str, **extra) -> bytes:
+    return json.dumps({"error": kind, "message": message, **extra},
+                      separators=(",", ":")).encode("utf-8")
+
+
+def decode_error(payload: bytes) -> dict:
+    try:
+        d = json.loads(payload.decode("utf-8", "replace"))
+        if isinstance(d, dict) and "error" in d:
+            return d
+    except ValueError:
+        pass
+    return {"error": "Unknown", "message": payload[:200].decode(
+        "utf-8", "replace")}

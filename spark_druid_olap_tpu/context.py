@@ -90,6 +90,18 @@ class Context:
             if self.config.get(PERSIST_RECOVER):
                 self.persist.recover()
             self.persist.start_background()
+        # distributed serving tier (cluster/): a broker attaches the
+        # scatter/merge client to its engine; historicals are built by
+        # cluster/historical.py (they set sdot.cluster.role=historical
+        # and never attach a client — no recursive scatter)
+        self.cluster = None
+        from spark_druid_olap_tpu.utils.config import (
+            CLUSTER_NODES, CLUSTER_ROLE)
+        if self.config.get(CLUSTER_NODES) \
+                and self.config.get(CLUSTER_ROLE) == "broker":
+            from spark_druid_olap_tpu.cluster.broker import ClusterClient
+            self.cluster = ClusterClient(self)
+            self.engine.cluster = self.cluster
 
     def reshard(self, devices=None) -> None:
         """Rebuild the engine's device mesh over the currently-live (or
@@ -170,10 +182,15 @@ class Context:
         return self.persist.checkpoint_all()
 
     def close(self) -> None:
-        """Stop background machinery (the persist checkpointer). Safe to
-        call more than once; the context remains usable for queries."""
+        """Stop background machinery (the persist checkpointer, the
+        cluster client's prober + scatter pool). Safe to call more than
+        once; the context remains usable for queries."""
         if self.persist is not None:
             self.persist.stop()
+        if self.cluster is not None:
+            self.cluster.close()
+            self.cluster = None
+            self.engine.cluster = None
 
     def register_star_schema(self, star_schema) -> None:
         self.catalog.register_star_schema(star_schema)

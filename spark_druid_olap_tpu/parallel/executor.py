@@ -742,6 +742,15 @@ class QueryEngine:
             SharedScanCoalescer)
         self.sharedscan = SharedScanCoalescer(self)
         self.wlm.sharedscan = self.sharedscan
+        # distributed serving tier (cluster/): on a broker this is the
+        # scatter/merge client (cluster/broker.py:ClusterClient) wired
+        # in by Context; None on single-process engines and historicals
+        self.cluster = None
+        # historical-node mode (cluster/historical.py): sketch
+        # aggregates emit RAW register blocks instead of finalized
+        # estimates, so the broker can merge registers across shards
+        # and finalize the estimate exactly once
+        self.partial_sketches = False
 
     @property
     def last_stats(self) -> Dict[str, object]:
@@ -933,6 +942,20 @@ class QueryEngine:
                     self.last_stats["total_ms"] = \
                         (_time.perf_counter() - t0) * 1000
                     return served
+            if self.cluster is not None and self.cluster.should_distribute(q):
+                # broker path: scatter per-shard subqueries to the
+                # historicals and merge partials. Sits UNDER the cache
+                # (hits never leave this process) and ABOVE the
+                # backend-loss gate (the scatter needs no local device).
+                # None = the client declined mid-flight (serde gap, node
+                # EngineFallback, replicas exhausted with local fallback
+                # enabled) — fall through to ordinary local execution.
+                r = self.cluster.execute(q, t0)
+                if r is not None:
+                    if use_cache:
+                        cache.put(q, ds_version, r)
+                        self.last_stats["cache"] = "miss"
+                    return r
             if self._backend_lost_at is not None \
                     and not self._try_reattach():
                 self.last_stats["backend_lost"] = True
@@ -1288,6 +1311,16 @@ class QueryEngine:
             name = p.spec.name
             if p.kind in ("hll", "theta"):
                 regs = finals[name]
+                if self.partial_sketches:
+                    # cluster historical mode: ship the raw [G, m]
+                    # register block; the broker merges registers
+                    # across shards (max/min) and finalizes the
+                    # estimate once (cluster/merge.py) — that is what
+                    # makes the distributed estimate EQUAL the
+                    # single-engine one, not merely close
+                    data[name] = np.asarray(regs)[sel]
+                    columns.append(name)
+                    continue
                 est = (HLL.estimate(regs) if p.kind == "hll"
                        else TH.estimate(regs))[sel]
                 data[name] = np.round(est).astype(np.int64)

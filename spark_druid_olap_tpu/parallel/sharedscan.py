@@ -571,6 +571,13 @@ class SharedScanCoalescer:
             name = p.spec.name
             if p.kind in ("hll", "theta"):
                 regs = finals[name]
+                if eng.partial_sketches:
+                    # cluster historical: ship the raw [G, m] register
+                    # block exactly like the solo decode — the broker
+                    # merges registers across shards and finalizes once
+                    data[name] = np.asarray(regs)[sel]
+                    columns.append(name)
+                    continue
                 est = (HLL.estimate(regs) if p.kind == "hll"
                        else TH.estimate(regs))[sel]
                 data[name] = np.round(est).astype(np.int64)

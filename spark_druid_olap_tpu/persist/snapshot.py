@@ -235,6 +235,36 @@ def load_manifest(ds_root: str, version: int) -> dict:
         return json.load(f)
 
 
+def datasource_manifests(root: str) -> Dict[str, dict]:
+    """Deep-storage catalog scan: datasource name -> current published
+    manifest. The cluster shard plan (cluster/assign.py) is a pure
+    function of this scan, which is what makes deep storage the
+    coordination substrate: every process pointed at the same root
+    derives the same plan with no coordinator service. Datasources with
+    WAL-only state (never checkpointed) have no manifest and are
+    invisible here — the broker serves those locally."""
+    out: Dict[str, dict] = {}
+    try:
+        entries = sorted(os.listdir(root))
+    except OSError:
+        return out
+    for n in entries:
+        p = os.path.join(root, n)
+        if not os.path.isdir(p) or n.startswith("."):
+            continue
+        cur = current_version(p)
+        if cur is None:
+            continue
+        try:
+            m = load_manifest(p, cur)
+        except (OSError, ValueError, KeyError):
+            continue
+        name = m.get("datasource")
+        if name is not None:
+            out[name] = m
+    return out
+
+
 class SnapshotCorrupt(Exception):
     """A snapshot file failed checksum / structural verification."""
 

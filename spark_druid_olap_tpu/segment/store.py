@@ -561,6 +561,55 @@ def restrict_to_host(ds: Datasource, host_assignment,
                       host_assignment=assignment, host_id=int(host_id))
 
 
+def slice_segments(ds: Datasource, segment_indexes,
+                   name: Optional[str] = None) -> Datasource:
+    """COMPLETE datasource holding only the given segments' rows,
+    renumbered to contiguous row ranges (ascending source order).
+
+    Unlike ``restrict_to_host`` the result is a normal complete store:
+    a cluster historical registers one slice per assigned shard
+    (cluster/historical.py) and every engine path — device tiers, host
+    fallback, shared-scan — serves it as an ordinary datasource. Dim
+    dictionaries are shared with the source; codes keep referencing the
+    full dictionary, so decode stays exact on every node. Metric bounds
+    are NOT inherited: a shard's local min/max is correct for its own
+    rows and recomputes lazily."""
+    import dataclasses as _dc
+
+    ds.require_complete("segment slicing")
+    ids = sorted(int(i) for i in segment_indexes)
+    ranges = [(ds.segments[i].start_row, ds.segments[i].end_row)
+              for i in ids]
+
+    def _slice(arr):
+        if arr is None:
+            return None
+        if not ranges:
+            return arr[:0]
+        return np.concatenate([arr[s:e] for s, e in ranges])
+
+    dims = {}
+    for k, d in ds.dims.items():
+        dims[k] = _dc.replace(d, codes=_slice(d.codes),
+                              validity=_slice(d.validity))
+    mets = {}
+    for k, m in ds.metrics.items():
+        mets[k] = _dc.replace(m, values=_slice(m.values),
+                              validity=_slice(m.validity))
+    time = None
+    if ds.time is not None:
+        time = _dc.replace(ds.time, days=_slice(ds.time.days),
+                           ms_in_day=_slice(ds.time.ms_in_day))
+    segs, row = [], 0
+    for i in ids:
+        s = ds.segments[i]
+        n = s.end_row - s.start_row
+        segs.append(Segment(s.id, row, row + n, s.min_millis, s.max_millis))
+        row += n
+    return Datasource(name=name or ds.name, time=time, dims=dims,
+                      metrics=mets, segments=segs, spatial=dict(ds.spatial))
+
+
 # Byte bound on a partial datasource's gathered-column cache (tuples of
 # host arrays rebuilt from the cross-host exchange on miss). Keeps the
 # host tier's residual-gather working set from growing without bound as

@@ -91,6 +91,12 @@ class SqlServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._handler_threads: set = set()
+        # readiness predicate for GET /readyz: None = ready once the
+        # server accepts (plain single-process serving). A cluster
+        # historical points this at its boot flag (recovery complete +
+        # assigned shards loaded). MUST be lock-free and engine-free:
+        # health answers may not queue behind long queries.
+        self.ready_check = None
         # queries run CONCURRENTLY (one thread per request, like the
         # reference thriftserver's pooled sessions, DruidClient.scala:46-74);
         # the engine serializes only compile-cache population internally,
@@ -130,6 +136,19 @@ class SqlServer:
                 self._send(code, body)
 
             def do_GET(self):
+                # liveness/readiness FIRST, touching no context, engine
+                # or lock: a long query can hold every other handler
+                # thread (and the engine's compile lock), and the
+                # broker's health prober must never be judged by query
+                # latency — only by whether this process accepts and
+                # answers
+                path = self.path.split("?", 1)[0]
+                if path in ("/healthz", "/readyz"):
+                    try:
+                        server._handle_health(self, path)
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+                    return
                 try:
                     server._handle_get(self)
                 except BrokenPipeError:
@@ -147,7 +166,14 @@ class SqlServer:
                     traceback.print_exc()
                     self._error(500, e)
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        class _Httpd(ThreadingHTTPServer):
+            # under a dashboard storm every handler thread can sit
+            # inside the engine; a deeper accept backlog keeps health
+            # probes and new clients out of connection-refused while
+            # the accept loop catches up
+            request_queue_size = 128
+
+        self._httpd = _Httpd((self.host, self.port), Handler)
         # handler threads must not pin the process (tests start/stop many
         # servers; a hung client connection would otherwise block exit),
         # and server_close() must not join them unboundedly either —
@@ -184,6 +210,22 @@ class SqlServer:
             self._thread = None
 
     # -- handlers -------------------------------------------------------------
+    def _handle_health(self, h, path: str):
+        """GET /healthz (liveness) and /readyz (readiness). Reads one
+        attribute and calls one user predicate — no context, engine, or
+        lock access, so it answers even while long queries hold every
+        other handler thread."""
+        if path == "/healthz":
+            h._send(200, b'{"status": "alive"}')
+            return
+        chk = self.ready_check
+        try:
+            ok = True if chk is None else bool(chk())
+        except Exception:  # noqa: BLE001 — a broken predicate is "not ready"
+            ok = False
+        h._send(200 if ok else 503,
+                b'{"ready": true}' if ok else b'{"ready": false}')
+
     def _handle_get(self, h):
         url = urlparse(h.path)
         qs = parse_qs(url.query)
@@ -215,6 +257,21 @@ class SqlServer:
                 # quota state — ≈ Druid's query-scheduler lane metrics
                 h._send(200, json.dumps(
                     self.ctx.engine.wlm.stats()).encode())
+                return
+            if kind == "cluster":
+                # distributed serving tier: shard plan, node health,
+                # scatter/merge counters (broker), or role stub
+                cl = getattr(self.ctx, "cluster", None)
+                if cl is None:
+                    h._send(200, b'{"enabled": false}')
+                    return
+                h._send(200, json.dumps(cl.stats()).encode())
+                return
+            if kind == "sharedscan":
+                # shared-scan coalescer counters; the cluster loadtest
+                # polls this per historical for per-node coalesce rate
+                h._send(200, json.dumps(
+                    self.ctx.engine.sharedscan.stats()).encode())
                 return
             if kind == "persist":
                 # deep-storage state: per-ds snapshot versions, WAL

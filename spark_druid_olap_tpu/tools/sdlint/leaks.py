@@ -71,6 +71,15 @@ REGISTRY: Tuple[Resource, ...] = (
     Resource("device-pin", (("pin_array",), ("device_pin",)),
              (("unpin_array",), ("device_unpin",))),
     Resource("wal-handle", (), (("close",),), ctor="WriteAheadLog"),
+    # cluster RPC: every HTTPConnection the broker opens (subquery
+    # scatter, readyz probes) must close on all paths — leaked sockets
+    # exhaust the historical's accept queue under dashboard storms
+    Resource("rpc-conn", (), (("close",),), ctor="HTTPConnection"),
+    # scatter pool: a locally-constructed executor dropped without
+    # shutdown leaks its worker threads (self.x storage transfers
+    # ownership to close())
+    Resource("scatter-pool", (), (("shutdown",),),
+             ctor="ThreadPoolExecutor"),
     Resource("tmpdir", (("os", "makedirs"),),
              (("os", "replace"), ("rmtree",)), tmp_named=True),
 )
@@ -186,6 +195,11 @@ def _check_function(project: Project, mod, qual: str,
         for site_n, call, var in sites:
             payload = g.nodes[site_n]
             escapes = False
+            if res.ctor is not None and isinstance(
+                    payload, (ast.With, ast.AsyncWith)):
+                # `with Ctor(...):` — __exit__ releases on every path,
+                # including the exception edges this pass walks
+                escapes = True
             if res.ctor is not None:
                 # ownership transfer: stored into an attribute/container
                 # at the acquire itself, or the bound name is later

@@ -429,6 +429,72 @@ HOST_GATHER_PAGE_BYTES = _entry(
     "Datasource.complete() reassembles a partial store's column on the "
     "host tier; larger columns exchange in multiple bounded pages "
     "instead of one unbounded allgather.")
+# --- distributed serving tier (cluster/) --------------------------------------
+CLUSTER_NODES = _entry(
+    "sdot.cluster.nodes", "",
+    "Comma-separated host:port list of historical nodes, index order = "
+    "node id. Empty disables the cluster tier (single-process engine). "
+    "Every process of one cluster — broker and historicals — must be "
+    "given the identical list: the deterministic shard assignment "
+    "(cluster/assign.py) is a pure function of this list plus the deep "
+    "storage manifests.", semantic=False)
+CLUSTER_ROLE = _entry(
+    "sdot.cluster.role", "",
+    "Role of THIS process in the cluster: 'broker' attaches the "
+    "scatter/merge client to the engine; 'historical' is set by the "
+    "cluster entrypoint on serving nodes; empty = not clustered.",
+    semantic=False)
+CLUSTER_NODE_ID = _entry(
+    "sdot.cluster.node.id", 0,
+    "This historical's index into sdot.cluster.nodes (which address it "
+    "serves on and which shards it owns).", int, semantic=False)
+CLUSTER_REPLICATION = _entry(
+    "sdot.cluster.replication", 2,
+    "Copies of each segment shard across historicals (clamped to the "
+    "node count). The broker retries a failed shard on each replica "
+    "before declaring the shard unreachable.", int, semantic=False)
+CLUSTER_SHARDS = _entry(
+    "sdot.cluster.shards", 0,
+    "Segment shards per datasource the broker scatters over; 0 = one "
+    "per node. Semantic: the shard composition fixes the partial-merge "
+    "grouping (float accumulation order), so cached results are keyed "
+    "on it.", int)
+CLUSTER_RPC_TIMEOUT_SECONDS = _entry(
+    "sdot.cluster.rpc.timeout.seconds", 30.0,
+    "Socket timeout for one broker->historical subquery RPC. A timeout "
+    "marks the node down and fails the attempt over to a replica.",
+    float, semantic=False)
+CLUSTER_RETRY_TRIES = _entry(
+    "sdot.cluster.retry.tries", 3,
+    "Full passes over a shard's replica set before the broker gives up "
+    "on remote execution (then: local fallback if enabled, else fail). "
+    "Between passes it sleeps with decorrelated-jitter backoff "
+    "(utils/retry.py).", int, semantic=False)
+CLUSTER_RETRY_BACKOFF_START_SECONDS = _entry(
+    "sdot.cluster.retry.backoff.start.seconds", 0.05,
+    "Base delay of the decorrelated-jitter backoff between replica-set "
+    "passes.", float, semantic=False)
+CLUSTER_RETRY_BACKOFF_CAP_SECONDS = _entry(
+    "sdot.cluster.retry.backoff.cap.seconds", 2.0,
+    "Delay ceiling of the decorrelated-jitter backoff between "
+    "replica-set passes.", float, semantic=False)
+CLUSTER_PROBE_INTERVAL_SECONDS = _entry(
+    "sdot.cluster.probe.interval.seconds", 1.0,
+    "Cadence of the broker's background health prober (GET /readyz on "
+    "every node). A failing probe marks the node down — its shards "
+    "route to replicas — and a passing one marks it back up. "
+    "0 disables probing (nodes are still marked down reactively on "
+    "RPC failure).", float, semantic=False)
+CLUSTER_SCATTER_THREADS = _entry(
+    "sdot.cluster.scatter.threads", 16,
+    "Worker threads in the broker's scatter pool (concurrent subquery "
+    "RPCs across all in-flight queries).", int, semantic=False)
+CLUSTER_LOCAL_FALLBACK = _entry(
+    "sdot.cluster.local.fallback", True,
+    "When every replica of some shard is unreachable, execute the whole "
+    "query on the broker's own engine (it holds a full recovered copy) "
+    "instead of failing. Answers are identical; only placement changes.",
+    semantic=False)
 
 
 # Families of runtime-shaped keys (tenant / datasource suffixes) that

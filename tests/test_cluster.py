@@ -1,0 +1,546 @@
+"""Distributed serving tier (cluster/): broker + replicated historicals.
+
+The acceptance bar is differential, like test_persist.py: a broker
+scattering over in-process historicals must answer byte-identically (ints
+/ dims / sketches) or within float tolerance (sum re-association) to a
+single-process engine over the same deep storage. On top of that:
+
+- assignment determinism + replication invariants (pure-function plan);
+- replica failover: a node dies mid-storm and every answer still matches
+  (zero mismatches is the contract, not "most");
+- stale-node rejoin: a restarted historical is probed back up and
+  resumes serving without operator action;
+- liveness: ``/healthz`` answers before boot completes, ``/readyz``
+  flips 503 -> 200 exactly when shards are loaded.
+
+True kill -9 / multi-process coverage lives in ``scripts/loadtest.py
+--cluster N`` (subprocess; not tier-1).
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.cluster import merge as MG
+from spark_druid_olap_tpu.cluster import wire as WIRE
+from spark_druid_olap_tpu.cluster.assign import (
+    parse_nodes, plan_cluster, shard_name)
+from spark_druid_olap_tpu.cluster.historical import (
+    HistoricalNode, HistoricalServer)
+from spark_druid_olap_tpu.ir import spec as S
+from spark_druid_olap_tpu.tools import ssb, tpch
+
+from conftest import assert_frames_equal, make_sales_df
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(port: int, path: str, timeout=5.0):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class Env:
+    def __init__(self, root, nodes_csv, hist, broker, single):
+        self.root = root
+        self.nodes_csv = nodes_csv
+        self.hist = hist
+        self.broker = broker
+        self.single = single
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("cluster-deep-storage"))
+    # seed deep storage: TPC-H flat + SSB flat + a synthetic fact, all
+    # with small segments so every datasource splits into real shards
+    seed = sdot.Context({"sdot.persist.path": root})
+    tpch_tables = tpch.generate(sf=0.002)
+    seed.ingest_dataframe("tpch_flat", tpch.flatten(tpch_tables),
+                          time_column="l_shipdate", target_rows=2048)
+    ssb_tables = ssb.generate(sf=0.003)
+    seed.ingest_dataframe("ssb_flat", ssb.flatten(ssb_tables),
+                          time_column="lo_orderdate", target_rows=2048)
+    seed.ingest_dataframe("sales", make_sales_df(), time_column="ts",
+                          target_rows=2048)
+    seed.checkpoint()
+    seed.close()
+
+    ports = [_free_port(), _free_port()]
+    nodes_csv = ",".join(f"127.0.0.1:{p}" for p in ports)
+    common = {"sdot.persist.path": root, "sdot.cluster.nodes": nodes_csv}
+    hist = [HistoricalNode(dict(common), node_id=i).start()
+            for i in range(2)]
+    broker = sdot.Context({
+        **common, "sdot.cluster.role": "broker",
+        # fast probe so the rejoin test converges quickly
+        "sdot.cluster.probe.interval.seconds": 0.2,
+        "sdot.cluster.retry.backoff.start.seconds": 0.01})
+    single = sdot.Context({"sdot.persist.path": root})
+    for ctx in (broker, single):
+        ctx.register_star_schema(tpch.star_schema("tpch_flat"))
+        ctx.register_star_schema(ssb.star_schema("ssb_flat"))
+    e = Env(root, nodes_csv, hist, broker, single)
+    yield e
+    for h in e.hist:
+        h.stop()
+    broker.close()
+    single.close()
+
+
+def _diff_sql(env, query, expect_mode="scatter"):
+    got = env.broker.sql(query).to_pandas()
+    st = env.broker.engine.last_stats.get("cluster") or {}
+    want = env.single.sql(query).to_pandas()
+    if not got.equals(want):
+        assert_frames_equal(got, want, rtol=1e-9, atol=1e-9)
+    if expect_mode is not None:
+        assert st.get("mode") == expect_mode, st
+    return got
+
+
+# -- assignment determinism + replication invariants --------------------------
+
+def test_plan_is_deterministic(env):
+    p1 = plan_cluster(env.root, 2, 2)
+    p2 = plan_cluster(env.root, 2, 2)
+    assert p1 == p2
+    # independently-computed node plans equal the broker's
+    assert env.broker.cluster.plan == env.hist[0].plan == env.hist[1].plan
+
+
+def test_replication_and_partition_invariants(env):
+    for n_nodes in (1, 2, 3, 5):
+        for repl in (1, 2, 3):
+            plan = plan_cluster(env.root, n_nodes, repl)
+            assert plan.replication == min(max(1, repl), n_nodes)
+            for dp in plan.datasources.values():
+                seen = []
+                for sh in dp.shards:
+                    # every shard has exactly min(R, N) DISTINCT owners
+                    assert len(sh.owners) == len(set(sh.owners)) \
+                        == min(repl, n_nodes)
+                    assert all(0 <= o < n_nodes for o in sh.owners)
+                    assert sh.rows > 0
+                    seen.extend(sh.segment_indexes)
+                # shards partition the manifest's segments exactly once,
+                # in contiguous time order
+                assert sorted(seen) == list(range(dp.num_segments))
+                assert sum(sh.rows for sh in dp.shards) == dp.num_rows
+
+
+def test_shard_names_unreachable_from_sql(env):
+    name = shard_name("sales", 0, 2)
+    assert "::" in name
+    with pytest.raises(Exception):
+        env.broker.sql(f'select count(*) from "{name}"')
+
+
+def test_parse_nodes():
+    assert parse_nodes("a:1, b:2;c:3") == (("a", 1), ("b", 2), ("c", 3))
+    with pytest.raises(ValueError):
+        parse_nodes("nope")
+
+
+def test_historicals_hold_only_owned_shards(env):
+    for h in env.hist:
+        names = h.ctx.store.names()
+        assert names, "historical serves nothing"
+        assert all("::shard" in n for n in names)
+        owned = h.plan.shards_of(h.node_id)
+        want = {shard_name(ds, sh.index, h.plan.datasources[ds].n_shards)
+                for ds, shards in owned.items() for sh in shards}
+        assert set(names) == want
+
+
+# -- differential: TPC-H + SSB + spec-level shapes ----------------------------
+
+TPCH_QUERIES = ["basic_agg", "q1", "q6", "q12", "q14"]
+
+
+@pytest.mark.parametrize("name", TPCH_QUERIES)
+def test_tpch_differential(env, name):
+    _diff_sql(env, tpch.QUERIES[name], expect_mode=None)
+
+
+SSB_QUERIES = ["q1.1", "q2.1", "q3.1", "q4.1"]
+
+
+@pytest.mark.parametrize("name", SSB_QUERIES)
+def test_ssb_differential(env, name):
+    _diff_sql(env, ssb.QUERIES[name], expect_mode=None)
+
+
+def test_groupby_scatters_and_matches(env):
+    _diff_sql(env, "select region, sum(qty) as q, count(*) as c, "
+                   "min(price) as mn, max(price) as mx from sales "
+                   "group by region order by region")
+
+
+def test_topn_order_limit(env):
+    _diff_sql(env, "select product, sum(price) as rev from sales "
+                   "group by product order by rev desc limit 7")
+
+
+def test_having_and_post_aggregation(env):
+    _diff_sql(env, "select region, sum(price) as rev, "
+                   "sum(price)/sum(qty) as unit from sales "
+                   "group by region having sum(qty) > 10 order by region")
+
+
+def test_global_rollup(env):
+    _diff_sql(env, "select count(*) as c, sum(qty) as q from sales")
+
+
+def test_sketch_register_merge_is_exact(env):
+    # APPROX_COUNT_DISTINCT must be EXACTLY the single-engine estimate:
+    # historicals ship raw registers, the broker merges and finalizes
+    # once — same registers, same estimate, not merely "close"
+    q = ("select region, approx_count_distinct(product) as dp "
+         "from sales group by region order by region")
+    got = env.broker.sql(q).to_pandas()
+    want = env.single.sql(q).to_pandas()
+    assert got.equals(want)
+
+
+def test_granular_timeseries_spec(env):
+    q = S.TimeseriesQuerySpec(
+        datasource="sales",
+        aggregations=(S.AggregationSpec("longsum", "q", field="qty"),
+                      S.AggregationSpec("count", "c")),
+        granularity=S.Granularity("month"))
+    got = env.broker.execute(q).to_pandas()
+    st = env.broker.engine.last_stats.get("cluster") or {}
+    assert st.get("mode") == "scatter", st
+    want = env.single.execute(q).to_pandas()
+    if not got.equals(want):
+        assert_frames_equal(got, want, rtol=1e-9, atol=1e-9)
+
+
+def test_topn_spec_threshold(env):
+    q = S.TopNQuerySpec(
+        datasource="sales",
+        dimension=S.DimensionSpec("product", "product"),
+        metric="q", threshold=5,
+        aggregations=(S.AggregationSpec("longsum", "q", field="qty"),))
+    got = env.broker.execute(q).to_pandas()
+    assert (env.broker.engine.last_stats.get("cluster") or {}) \
+        .get("mode") == "scatter"
+    want = env.single.execute(q).to_pandas()
+    assert got.equals(want)
+    assert len(got) == 5
+
+
+# -- eligibility: what must NOT distribute ------------------------------------
+
+def test_unmergeable_agg_runs_locally(env):
+    q = S.GroupByQuerySpec(
+        datasource="sales",
+        dimensions=(S.DimensionSpec("region", "region"),),
+        aggregations=(S.AggregationSpec("anyvalue", "p", field="price"),))
+    got = env.broker.execute(q).to_pandas()
+    # eligibility declines BEFORE scatter: no cluster stat at all
+    st = env.broker.engine.last_stats.get("cluster") or {}
+    assert st.get("mode") != "scatter", st
+    assert len(got) == 4
+
+
+def test_post_boot_ingest_served_locally(env):
+    # read-your-writes: a datasource ingested AFTER the plan was computed
+    # is invisible to the cluster and must be answered by the broker
+    env.broker.ingest_dataframe(
+        "fresh", pd.DataFrame({"k": ["a", "b", "a"], "v": [1, 2, 3]}))
+    got = env.broker.sql(
+        "select k, sum(v) as s from fresh group by k order by k"
+    ).to_pandas()
+    st = env.broker.engine.last_stats.get("cluster")
+    assert st is None or st.get("mode") != "scatter"
+    assert list(got["s"]) == [4, 2]
+
+
+# -- liveness + introspection -------------------------------------------------
+
+def test_healthz_and_readyz_lifecycle(env):
+    # a server started BEFORE boot: alive immediately, not ready
+    port = _free_port()
+    node = HistoricalNode(
+        {"sdot.persist.path": env.root,
+         "sdot.cluster.nodes": f"127.0.0.1:{port}"}, node_id=0)
+    node.server = HistoricalServer(node, "127.0.0.1", port)
+    node.server.start(background=True)
+    try:
+        code, body = _get(port, "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "alive"
+        code, body = _get(port, "/readyz")
+        assert code == 503 and json.loads(body)["ready"] is False
+        node.boot()
+        code, body = _get(port, "/readyz")
+        assert code == 200 and json.loads(body)["ready"] is True
+    finally:
+        node.stop()
+
+
+def test_cluster_metadata_route(env):
+    from spark_druid_olap_tpu.server.http import SqlServer
+    srv = SqlServer(env.broker, "127.0.0.1", _free_port())
+    srv.start(background=True)
+    try:
+        code, body = _get(srv.port, "/metadata/cluster")
+        assert code == 200
+        st = json.loads(body)
+        assert st["enabled"] and len(st["nodes"]) == 2
+        assert "sales" in st["datasources"]
+        code, body = _get(srv.port, "/metadata/cluster")
+        assert code == 200
+    finally:
+        srv.stop()
+
+
+def test_broker_stats_shape(env):
+    st = env.broker.cluster.stats()
+    assert st["replication"] == 2
+    for dp in st["datasources"].values():
+        assert set(dp) == {"shards", "segments", "rows", "ingest_version",
+                           "owners"}
+    assert st["counters"]["queries"] >= 1
+
+
+# -- wire + merge units -------------------------------------------------------
+
+def test_wire_roundtrip():
+    data = {
+        "i": np.array([1, 2, 3], dtype=np.int64),
+        "f": np.array([1.5, np.nan, -2.0]),
+        "t": np.array(["2024-01-01", "2024-06-01", "NaT"],
+                      dtype="datetime64[ms]"),
+        "s": np.array(["a", None, "c"], dtype=object),
+        "wide": np.array([2**70, -5, None], dtype=object),
+        "regs": np.arange(12, dtype=np.int64).reshape(3, 4),
+    }
+    payload = WIRE.encode_result(list(data), data, stats={"node": 1})
+    cols, out, stats = WIRE.decode_result(payload)
+    assert cols == list(data) and stats == {"node": 1}
+    np.testing.assert_array_equal(out["i"], data["i"])
+    np.testing.assert_array_equal(out["f"], data["f"])
+    np.testing.assert_array_equal(out["t"], data["t"])
+    assert list(out["s"]) == ["a", None, "c"]
+    assert list(out["wide"]) == [2**70, -5, None]
+    np.testing.assert_array_equal(out["regs"], data["regs"])
+
+    err = WIRE.encode_error("AdmissionRejected", "lane full",
+                            retryAfterSeconds=0.5)
+    info = WIRE.decode_error(err)
+    assert info["error"] == "AdmissionRejected"
+    assert info["retryAfterSeconds"] == 0.5
+
+
+def test_merge_partials_sums_exact():
+    a = {"k": np.array(["x", "y"], dtype=object),
+         "c": np.array([2, 3], dtype=np.int64),
+         "s": np.array([10, 2**62], dtype=np.int64)}
+    b = {"k": np.array(["y", "z"], dtype=object),
+         "c": np.array([5, 7], dtype=np.int64),
+         "s": np.array([3 * 2**61, -1], dtype=np.int64)}
+    cols, data, n = MG.merge_partials(
+        [a, b], ["k"], [("c", "count"), ("s", "longsum")])
+    assert n == 3 and cols == ["k", "c", "s"]
+    assert list(data["k"]) == ["x", "y", "z"]
+    assert list(data["c"]) == [2, 8, 7]
+    # 2**62 + 3*2**61 overflows int64: must widen, not wrap
+    assert list(data["s"]) == [10, 2**62 + 3 * 2**61, -1]
+    assert data["s"].dtype == object
+
+
+def test_merge_partials_hll_registers():
+    regs_a = np.array([[3, 0, 1, 0]], dtype=np.int64)
+    regs_b = np.array([[1, 2, 0, 0]], dtype=np.int64)
+    from spark_druid_olap_tpu.ops import hll
+    cols, data, n = MG.merge_partials(
+        [{"k": np.array(["g"], dtype=object), "d": regs_a},
+         {"k": np.array(["g"], dtype=object), "d": regs_b}],
+        ["k"], [("d", "cardinality")])
+    assert n == 1
+    want = np.round(hll.estimate(
+        np.maximum(regs_a, regs_b).astype(np.int32))).astype(np.int64)
+    np.testing.assert_array_equal(data["d"], want)
+
+
+def test_merge_null_keys_collapse():
+    a = {"k": np.array([np.nan, 1.0]), "v": np.array([1, 2], dtype=np.int64)}
+    b = {"k": np.array([np.nan]), "v": np.array([10], dtype=np.int64)}
+    cols, data, n = MG.merge_partials([a, b], ["k"], [("v", "longsum")])
+    # NaN keys from different shards are ONE group (nulls-first order)
+    assert n == 2
+    assert list(data["v"]) == [11, 2]
+
+
+# -- per-node shared-scan coalescing ------------------------------------------
+
+def test_per_node_coalescing_storm_is_exact(env):
+    """The tier's designed serving config: historicals with shared-scan
+    on and single-slot lanes, so concurrent subqueries per node fuse
+    into one scan (queued waiters hand off into the open group). A
+    concurrent storm — sketch aggregates included, which ride the fused
+    path as raw registers — must still match the single engine exactly,
+    and the nodes must actually coalesce."""
+    ports = [_free_port(), _free_port()]
+    nodes_csv = ",".join(f"127.0.0.1:{p}" for p in ports)
+    coalescing = {
+        "sdot.persist.path": env.root,
+        "sdot.cluster.nodes": nodes_csv,
+        "sdot.sharedscan.enabled": True,
+        "sdot.wlm.batch.window.ms": 25.0,
+        "sdot.wlm.lanes": ("interactive:slots=1,queue=256;"
+                           "reporting:slots=1,queue=64;"
+                           "batch:slots=1,queue=32"),
+    }
+    hist = [HistoricalNode(dict(coalescing), node_id=i).start()
+            for i in range(2)]
+    broker = sdot.Context({
+        "sdot.persist.path": env.root, "sdot.cluster.nodes": nodes_csv,
+        "sdot.cluster.role": "broker",
+        "sdot.cluster.retry.backoff.start.seconds": 0.01})
+    try:
+        queries = [
+            "select region, sum(price) as rev from sales "
+            "group by region order by region",
+            "select product, sum(qty) as q from sales "
+            "group by product order by q desc limit 5",
+            "select approx_count_distinct(product) as np from sales",
+            "select status, count(*) as c from sales group by status "
+            "order by status",
+        ]
+        want = [env.single.sql(q).to_pandas() for q in queries]
+        mismatches, errors = [], []
+
+        def storm(worker):
+            for i in range(8):
+                k = (worker + i) % len(queries)
+                try:
+                    got = broker.sql(queries[k]).to_pandas()
+                except Exception as e:  # noqa: BLE001 — asserted below
+                    errors.append(e)
+                    return
+                if not got.equals(want[k]):
+                    try:
+                        assert_frames_equal(got, want[k],
+                                            rtol=1e-9, atol=1e-9)
+                    except AssertionError as e:
+                        mismatches.append((queries[k], str(e)))
+
+        threads = [threading.Thread(target=storm, args=(w,))
+                   for w in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+        assert not errors, errors[:1]
+        assert mismatches == [], mismatches[:2]
+        coalesced = sum(
+            h.ctx.engine.sharedscan.stats()["queries_coalesced"]
+            for h in hist)
+        assert coalesced >= 2, [
+            h.ctx.engine.sharedscan.stats() for h in hist]
+    finally:
+        for h in hist:
+            h.stop()
+        broker.close()
+
+
+# -- failover + rejoin (mutating: keep these last) ----------------------------
+
+def test_failover_mid_storm_zero_mismatches(env):
+    queries = [
+        "select region, sum(qty) as q, count(*) as c from sales "
+        "group by region order by region",
+        "select product, sum(price) as rev from sales "
+        "group by product order by rev desc limit 5",
+        "select status, count(*) as c from sales group by status "
+        "order by status",
+    ]
+    want = [env.single.sql(q).to_pandas() for q in queries]
+    mismatches, errors = [], []
+
+    def storm(worker):
+        for i in range(12):
+            q = queries[(worker + i) % len(queries)]
+            try:
+                got = env.broker.sql(q).to_pandas()
+            except Exception as e:  # noqa: BLE001 — collected + asserted
+                errors.append(e)
+                return
+            ref = want[(worker + i) % len(queries)]
+            if not got.equals(ref):
+                try:
+                    assert_frames_equal(got, ref, rtol=1e-9, atol=1e-9)
+                except AssertionError as e:
+                    mismatches.append((q, str(e)))
+
+    before = env.broker.cluster.counters["failovers"]
+    threads = [threading.Thread(target=storm, args=(w,)) for w in range(4)]
+    for t in threads:
+        t.start()
+    # kill node 1 while the storm is in flight
+    time.sleep(0.05)
+    env.hist[1].ready = False
+    env.hist[1].server.stop(join_timeout_s=0.2)
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors[:1]
+    assert mismatches == [], mismatches[:2]
+    # the broker noticed: reactive failover and/or the prober marked it
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        st = env.broker.cluster.stats()
+        if st["nodes"][1]["state"] == "down":
+            break
+        time.sleep(0.1)
+    assert st["nodes"][1]["state"] == "down"
+    assert env.broker.cluster.counters["failovers"] >= before
+
+
+def test_dead_replica_still_answers_exactly(env):
+    # node 1 is down from the previous test: every shard it owned must
+    # be served by its replica on node 0, with identical answers
+    _diff_sql(env, "select region, sum(price) as rev from sales "
+                   "group by region order by region")
+
+
+def test_stale_node_rejoin(env):
+    # restart node 1 on the same port; the prober must mark it up and
+    # scatter must resume using it — no operator action, no broker restart
+    host, port = env.hist[1].addresses[1]
+    node = HistoricalNode(
+        {"sdot.persist.path": env.root,
+         "sdot.cluster.nodes": env.nodes_csv}, node_id=1)
+    node.start()
+    env.hist[1] = node
+    deadline = time.time() + 15
+    state = None
+    while time.time() < deadline:
+        state = env.broker.cluster.stats()["nodes"][1]["state"]
+        if state == "up":
+            break
+        time.sleep(0.1)
+    assert state == "up"
+    got = _diff_sql(env, "select flag, sum(qty) as q from sales "
+                         "group by flag order by flag")
+    assert len(got) == 3
