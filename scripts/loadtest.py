@@ -358,6 +358,94 @@ def run_rollup(args):
     sys.exit(0 if (hits > 0 and not mismatches) else 1)
 
 
+def run_coldtier(args):
+    """Cold-tier comparison (tier/): build + checkpoint a synthetic
+    store, capture unbudgeted (eager-recovery) answers, then reopen with
+    ``sdot.tier.enabled`` under ``--budget`` bytes and replay the mix —
+    first pass cold (every chunk faults from the memory-mapped blobs),
+    then N hot reps. Reports cold vs hot p50/p99, hot-set hit rate,
+    bytes faulted, and the prefetch overlap ratio; any differential
+    mismatch against the unbudgeted answers exits 1."""
+    sys.path.insert(0, ".")
+    import shutil
+    import tempfile
+    import spark_druid_olap_tpu as sdot
+    root = tempfile.mkdtemp(prefix="sdot-coldtier-")
+    try:
+        seed = sdot.Context({"sdot.persist.path": root})
+        seed.ingest_dataframe("sales", _synthetic_sales(),
+                              time_column="ts", target_rows=8192)
+        col_bytes = sum(
+            c["size"] for c in
+            seed.store.get("sales").metadata()["columns"].values())
+        seed.checkpoint()
+        seed.close()
+        queries = args.sql or DEFAULT_QUERIES
+        common = {"sdot.persist.path": root,
+                  "sdot.cache.enabled": False,
+                  "sdot.plan.cache.enabled": False}
+        eager = sdot.Context(dict(common))
+        answers = {sql: eager.sql(sql).to_pandas() for sql in queries}
+        eager.close()
+
+        budget = int(args.budget)
+        print(f"[coldtier] store {col_bytes:,} column bytes, "
+              f"budget {budget:,} bytes "
+              f"({col_bytes / max(budget, 1):.1f}x over)")
+        # cap per-wave I/O well under the budget so scans split into
+        # waves and the load-behind-compute overlap is measurable
+        ctx = sdot.Context({**common, "sdot.tier.enabled": True,
+                            "sdot.tier.budget.bytes": budget,
+                            "sdot.tier.wave.io.bytes":
+                                max(64 * 1024, budget // 8)})
+        iters = 5
+        mismatches, cold, hot = [], [], []
+        for sql in queries:
+            t0 = time.perf_counter()
+            df = ctx.sql(sql).to_pandas()
+            cold.append((time.perf_counter() - t0) * 1000)
+            if not _frames_close(answers[sql], df):
+                mismatches.append(sql)
+        for _ in range(iters):
+            for sql in queries:
+                t0 = time.perf_counter()
+                df = ctx.sql(sql).to_pandas()
+                hot.append((time.perf_counter() - t0) * 1000)
+                if not _frames_close(answers[sql], df):
+                    mismatches.append(sql)
+        st = ctx.persist.tier.stats_snapshot()
+        ctx.close()
+        hit_rate = st["hits"] / max(st["hits"] + st["faults"], 1)
+        c, h = np.array(cold), np.array(hot)
+        print(f"  cold p50={np.percentile(c, 50):7.1f}ms "
+              f"p99={np.percentile(c, 99):7.1f}ms n={len(c)}")
+        print(f"  hot  p50={np.percentile(h, 50):7.1f}ms "
+              f"p99={np.percentile(h, 99):7.1f}ms n={len(h)}")
+        print(f"  hit rate {hit_rate:.1%}, "
+              f"faulted {st['bytes_faulted']:,}B, "
+              f"evicted {st['bytes_evicted']:,}B, "
+              f"peak-resident<= {st['budget_bytes']:,}B+pins, "
+              f"prefetch overlap {st['prefetch_overlap_ratio']:.1%}"
+              + (f"; RESULT MISMATCH on {mismatches}"
+                 if mismatches else ""))
+        out = {"mode": "coldtier", "queries": len(queries),
+               "iters": iters, "budget_bytes": budget,
+               "column_bytes": int(col_bytes),
+               "cold_p50_ms": round(float(np.percentile(c, 50)), 2),
+               "cold_p99_ms": round(float(np.percentile(c, 99)), 2),
+               "hot_p50_ms": round(float(np.percentile(h, 50)), 2),
+               "hot_p99_ms": round(float(np.percentile(h, 99)), 2),
+               "hit_rate": round(float(hit_rate), 4),
+               "bytes_faulted": st["bytes_faulted"],
+               "bytes_evicted": st["bytes_evicted"],
+               "prefetch_overlap_ratio": st["prefetch_overlap_ratio"],
+               "result_mismatches": mismatches}
+        print(json.dumps(out))
+        sys.exit(1 if mismatches else 0)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_coldstart(args):
     """Warm vs cold startup-to-first-result (persist/): build + checkpoint
     a synthetic store, then compare the first-query latency of the live
@@ -956,6 +1044,16 @@ def main():
                     "synthetic dataset: N timed reps per query with the "
                     "planner rewrite off, then on (caches disabled); "
                     "reports rewrite hit rate and p50/p99 side by side")
+    ap.add_argument("--coldtier", action="store_true",
+                    help="in-process cold-tier comparison: checkpoint a "
+                    "synthetic store, capture unbudgeted answers, then "
+                    "replay the mix through a tiered recovery under "
+                    "--budget bytes (cold pass + hot reps); reports "
+                    "cold/hot p50/p99, hit rate, bytes faulted, and "
+                    "prefetch overlap (differential mismatch -> exit 1)")
+    ap.add_argument("--budget", type=int, default=1 << 20, metavar="BYTES",
+                    help="hot-set byte budget for --coldtier "
+                    "(default 1 MiB — far under the synthetic store)")
     ap.add_argument("--coldstart", action="store_true",
                     help="warm vs cold startup-to-first-result: build + "
                     "checkpoint a synthetic store, then time a fresh "
@@ -997,6 +1095,8 @@ def main():
         return run_cluster(args)
     if args.coldstart:
         return run_coldstart(args)
+    if args.coldtier:
+        return run_coldtier(args)
     if args.sharedscan:
         return run_sharedscan(args)
     if args.wlm:

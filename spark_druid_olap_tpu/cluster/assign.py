@@ -38,6 +38,10 @@ class Shard:
     segment_indexes: Tuple[int, ...]   # indexes into the manifest's segment list
     rows: int
     owners: Tuple[int, ...]            # node ids, primary first
+    # time envelope over member segments ([min_ms, max_ms] inclusive);
+    # empty shard keeps the (0, -1) sentinel, which no interval overlaps
+    min_ms: int = 0
+    max_ms: int = -1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,7 +94,11 @@ def _plan_datasource(manifest: dict, n_nodes: int, replication: int,
         owners = tuple((primary + c) % n_nodes for c in range(r))
         shards.append(Shard(index=i, segment_indexes=members,
                             rows=sum(rows[j] for j in members),
-                            owners=owners))
+                            owners=owners,
+                            min_ms=min((int(segs[j][3]) for j in members),
+                                       default=0),
+                            max_ms=max((int(segs[j][4]) for j in members),
+                                       default=-1)))
     return DatasourcePlan(
         name=name,
         snapshot_version=int(manifest["snapshot_version"]),

@@ -103,8 +103,8 @@ class ClusterClient:
         self._down: Dict[int, float] = {}       # node id -> down-since
         self.counters = {"queries": 0, "scatters": 0, "subqueries": 0,
                          "retries": 0, "failovers": 0, "local_fallbacks": 0,
-                         "merge_ms": 0.0, "probe_marks_down": 0,
-                         "probe_marks_up": 0}
+                         "shards_pruned": 0, "merge_ms": 0.0,
+                         "probe_marks_down": 0, "probe_marks_up": 0}
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, int(self.config.get(CLUSTER_SCATTER_THREADS))),
             thread_name_prefix="sdot-scatter")
@@ -199,8 +199,26 @@ class ClusterClient:
         tm = getattr(q.context, "timeout_millis", None)
         if tm:
             deadline = t0 + float(tm) / 1000.0
+        # interval pruning: shards are contiguous time blocks, so a shard
+        # whose [min_ms, max_ms] envelope cannot overlap any query
+        # interval need not be scattered to at all (≈ Druid's time-chunk
+        # pruning on the broker)
+        shards = dp.shards
+        pruned = 0
+        if getattr(q, "intervals", None):
+            keep = tuple(
+                sh for sh in shards
+                if any(sh.max_ms >= lo and sh.min_ms < hi
+                       for lo, hi in q.intervals))
+            pruned = len(shards) - len(keep)
+            shards = keep
+        self.counters["shards_pruned"] += pruned
+        if not shards:
+            # every shard outside the interval: the empty answer is
+            # cheaper (and shape-exact) on the broker's local engine
+            return self._local("all shards pruned by query interval")
         futs = []
-        for sh in dp.shards:
+        for sh in shards:
             name = shard_name(q.datasource, sh.index, dp.n_shards)
             futs.append(self._pool.submit(
                 self._run_shard, body, name, sh.owners, deadline))
@@ -236,7 +254,8 @@ class ClusterClient:
             r = QueryResult(names, data)
         self.engine.last_stats["cluster"] = {
             "mode": "scatter", "shards": len(futs),
-            "nodes": sorted(nodes_used), "merge_ms": round(merge_ms, 3)}
+            "shards_pruned": pruned, "nodes": sorted(nodes_used),
+            "merge_ms": round(merge_ms, 3)}
         self.engine.last_stats["datasource"] = q.datasource
         self.engine.last_stats["total_ms"] = \
             (_time.perf_counter() - t0) * 1000

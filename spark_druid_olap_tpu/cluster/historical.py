@@ -154,10 +154,18 @@ class HistoricalNode:
                 # this datasource locally until the next checkpoint.
                 continue
             full = store.get(name)
+            tiered = getattr(full, "tier", None) is not None
+            if tiered:
+                from spark_druid_olap_tpu.tier.loader import slice_tiered
             for sh in owned_by_ds.get(name, ()):
-                shard = slice_segments(
-                    full, sh.segment_indexes,
-                    name=shard_name(name, sh.index, dp.n_shards))
+                sname = shard_name(name, sh.index, dp.n_shards)
+                # tiered recovery: shards stay loadable handles, so the
+                # node's hot set covers ONLY its owned segments' bytes
+                # and boots without faulting the whole datasource
+                shard = slice_tiered(full, sh.segment_indexes,
+                                     name=sname) if tiered \
+                    else slice_segments(full, sh.segment_indexes,
+                                        name=sname)
                 store.restore(shard, ingest_version=dp.ingest_version)
                 self.shards_loaded += 1
             # serve ONLY owned shards: per-node memory is bounded by

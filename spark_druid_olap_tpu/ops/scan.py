@@ -125,7 +125,11 @@ def array_names(ds: Datasource, columns, need_time_ms: bool):
     """The array keys a scan program over ``columns`` binds."""
     names = list(columns)
     for name in columns:
-        if ds.stacked_null_validity(name) is not None:
+        # metadata-only nulls check: building the stacked validity here
+        # (the old spelling) would fault whole columns on a tiered store
+        # just to PLAN the array list
+        col = ds.dims.get(name) or ds.metrics.get(name)
+        if col is not None and col.has_nulls():
             names.append(NULL_VALID_PREFIX + name)
     if need_time_ms and ds.time is not None:
         names.append(TIME_MS_KEY)
@@ -138,13 +142,13 @@ def array_dtype(ds: Datasource, key: str):
     if key == ROW_VALID_KEY or key.startswith(NULL_VALID_PREFIX):
         return np.bool_
     if key == TIME_MS_KEY:
-        return ds.time.ms_in_day.dtype
+        return ds.time.ms_dtype()
     if key in ds.dims:
-        return ds.dims[key].codes.dtype
+        return ds.dims[key].data_dtype()
     if key in ds.metrics:
-        return ds.metrics[key].values.dtype
+        return ds.metrics[key].data_dtype()
     if ds.time is not None and key == ds.time.name:
-        return ds.time.days.dtype
+        return ds.time.data_dtype()
     return np.int32
 
 
@@ -170,6 +174,14 @@ def build_array(ds: Datasource, key: str,
     stable across prunings (compile-cache friendliness) and divisible by the
     mesh size.
     """
+    tb = getattr(ds, "_tier_build", None)
+    if tb is not None:
+        # tiered store: fault only the requested segments' chunks into
+        # the stacked layout (tier/handles.py). None means the key is
+        # metadata-only (row validity) — fall through to the base path.
+        out = tb(key, segment_indices, pad_segments_to)
+        if out is not None:
+            return out
     if ds.is_partial:
         # global segment ids -> local block (only this host's segments may
         # be requested; the multi-host layout guarantees that). The

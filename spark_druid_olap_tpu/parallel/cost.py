@@ -247,13 +247,13 @@ def array_itemsize(ds, key: str) -> int:
     if key == ROW_VALID_KEY or key.startswith(NULL_VALID_PREFIX):
         return 1
     if key == TIME_MS_KEY:
-        return int(ds.time.ms_in_day.dtype.itemsize)
+        return int(ds.time.ms_dtype().itemsize)
     if key in ds.dims:
-        return int(ds.dims[key].codes.dtype.itemsize)
+        return int(ds.dims[key].data_dtype().itemsize)
     if key in ds.metrics:
-        return int(ds.metrics[key].values.dtype.itemsize)
+        return int(ds.metrics[key].data_dtype().itemsize)
     if ds.time is not None and key == ds.time.name:
-        return int(ds.time.days.dtype.itemsize)
+        return int(ds.time.data_dtype().itemsize)
     return 4
 
 
@@ -279,14 +279,29 @@ def wave_budget_bytes(conf) -> Optional[int]:
     return None
 
 
+def tier_io_budget(ds, conf) -> Optional[int]:
+    """Per-wave host-I/O byte cap for a tiered (cold) datasource, or
+    None on an in-memory store. A cold scan in one giant wave serializes
+    the entire fault traffic ahead of the first dispatch; capping wave
+    bytes at ``sdot.tier.wave.io.bytes`` forces enough waves that the
+    prefetcher can hide wave i+1's loads behind wave i's compute."""
+    if getattr(ds, "tier", None) is None:
+        return None
+    from spark_druid_olap_tpu.utils.config import TIER_WAVE_IO_BYTES
+    b = int(conf.get(TIER_WAVE_IO_BYTES))
+    return b if b > 0 else None
+
+
 def plan_waves(n_segments: int, n_dev: int, seg_bytes: int,
                budget: Optional[int], conf, output_groups: int,
-               n_aggs: int) -> tuple:
+               n_aggs: int, io_budget: Optional[int] = None) -> tuple:
     """Min-cost search over segments-per-wave (≈ the reference's
     ``druidQueryMethod`` searching 1..histSegsPerQueryLimit,
     DruidQueryCostModel.scala:343-414). Each wave costs a dispatch plus a
     host-side merge of the wave's [K] partials; each wave's scan arrays for
-    one device must fit ``budget`` bytes.
+    one device must fit ``budget`` bytes. ``io_budget`` additionally caps
+    one WAVE's total host bytes (all devices) — the cold-tier I/O term
+    (``tier_io_budget``) that keeps load-behind-compute overlap full.
 
     Returns (segments_per_wave, n_waves); segments_per_wave is a multiple of
     n_dev.
@@ -304,6 +319,9 @@ def plan_waves(n_segments: int, n_dev: int, seg_bytes: int,
     if budget is not None and seg_bytes > 0:
         per_dev = int(budget // seg_bytes)
         cap = min(cap, max(1, per_dev) * n_dev)
+    if io_budget is not None and seg_bytes > 0:
+        per_wave = max(1, int(io_budget // seg_bytes))
+        cap = min(cap, -(-per_wave // n_dev) * n_dev)
     return cap, -(-n_segments // cap)
 
 

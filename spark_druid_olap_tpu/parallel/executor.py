@@ -871,7 +871,17 @@ class QueryEngine:
             # concurrent statements) stay cancellable until the LAST
             # holder releases
             self.register_query(qid)
+        tier = pin_tok = None
         try:
+            # tiered cold storage: pin every hot chunk this query faults
+            # for its whole lifetime — eviction under budget pressure
+            # must never pull a column out from under an in-flight scan.
+            # acquire/release is a checked pair (sdlint leaks registry,
+            # "tier-pin").
+            tier_ds = self.store._datasources.get(
+                getattr(q, "datasource", None))
+            tier = getattr(tier_ds, "tier", None)
+            pin_tok = tier.acquire_pins() if tier is not None else None
             tok = self.inflight.begin(qid, getattr(q, "datasource", None),
                                       type(q).__name__)
             try:
@@ -916,8 +926,13 @@ class QueryEngine:
             finally:
                 self.inflight.done(tok)
         finally:
-            if qid is not None:
-                self.release_query(qid)
+            try:
+                if pin_tok is not None:
+                    tier.release_pins(pin_tok)
+                    self.last_stats["tier"] = tier.stats_snapshot()
+            finally:
+                if qid is not None:
+                    self.release_query(qid)
 
     def _execute_admitted(self, q: S.QuerySpec, t0: float) -> QueryResult:
         try:
@@ -1141,7 +1156,8 @@ class QueryEngine:
         spw, n_waves = C.plan_waves(
             len(seg_idx), n_dev, seg_bytes,
             C.wave_budget_bytes(self.config), self.config, n_keys,
-            len(agg_plans))
+            len(agg_plans),
+            io_budget=C.tier_io_budget(ds, self.config))
         s_pad = spw if n_waves > 1 else _pad_segments(len(seg_idx), n_dev)
         n_seg_sel = len(seg_idx)
         multihost = sharded and MH.is_multihost()
@@ -1603,7 +1619,8 @@ class QueryEngine:
         spw, n_waves = C.plan_waves(
             len(seg_idx), n_dev, seg_bytes,
             C.wave_budget_bytes(self.config), self.config,
-            min(rows_sel, T), len(agg_plans))
+            min(rows_sel, T), len(agg_plans),
+            io_budget=C.tier_io_budget(ds, self.config))
         s_pad = spw if n_waves > 1 else _pad_segments(len(seg_idx), n_dev)
         n_seg_sel = len(seg_idx)
         multihost = sharded and MH.is_multihost()
@@ -1695,6 +1712,9 @@ class QueryEngine:
                 return self._bind_wave(ds, names, wave_segs[i], s_pad,
                                        sharding, multihost)
 
+            # cold tier: start loading wave 1's chunks while wave 0
+            # binds and computes (load-behind-compute)
+            self._tier_prefetch(ds, names, wave_segs, 1)
             cur = self._bind_arrays(ds, names, seg_idx, s_pad, sharded) \
                 if n_waves == 1 else bind(0)
             for i in range(len(wave_segs)):
@@ -1708,6 +1728,9 @@ class QueryEngine:
                     if _STAGE_TIMING:
                         jax.block_until_ready(table)
                         self._stamp("device_ms", _td)
+                    # wave i+2's cold chunks load behind wave i's compute
+                    # and wave i+1's (synchronous) bind
+                    self._tier_prefetch(ds, names, wave_segs, i + 2)
                     nxt = bind(i + 1) if i + 1 < len(wave_segs) else None
                     stats = np.asarray(
                         table.pop("__stats__")).reshape(-1, 2)
@@ -1762,6 +1785,7 @@ class QueryEngine:
                         jax.block_until_ready(buf)
                         self._stamp("device_ms", _td)
                     # double buffer: next wave's transfer overlaps compute
+                    self._tier_prefetch(ds, names, wave_segs, i + 2)
                     nxt = bind(i + 1) if i + 1 < len(wave_segs) else None
                     _tf = _time.perf_counter()
                     raw = unpack(buf)
@@ -2295,12 +2319,17 @@ class QueryEngine:
             return self._bind_wave(ds, names, w, spw, sharding, multihost)
 
         finals = None
+        # cold tier: wave 1's chunks load while wave 0 binds + computes
+        self._tier_prefetch(ds, names, wave_segs, 1)
         cur = bind(wave_segs[0])
         for i in range(len(wave_segs)):
             if t0 is not None:
                 self._stage_check(q, t0)   # per-wave boundary
             self._tick()
             bufs = prog_fn(cur)            # async dispatch
+            # wave i+2's cold chunks load behind wave i's compute and
+            # wave i+1's (synchronous) bind
+            self._tier_prefetch(ds, names, wave_segs, i + 2)
             nxt = bind(wave_segs[i + 1]) if i + 1 < len(wave_segs) else None
             out = unpack(bufs)             # blocks on the device round-trip
             over = out.pop("__over__", None)
@@ -3256,6 +3285,14 @@ class QueryEngine:
                         self._device_bytes += nbytes
             out[k] = dev
         return out
+
+    def _tier_prefetch(self, ds, names, wave_segs, i):
+        """Enqueue wave ``i``'s cold-tier chunks on the prefetcher so
+        they load behind the current wave's device compute. No-op on
+        in-memory datasources or past the last wave."""
+        pf = getattr(ds, "tier_prefetch", None)
+        if pf is not None and i < len(wave_segs):
+            pf(names, wave_segs[i])
 
     def clear_caches(self):
         # under the compile lock: the backend-lost recovery thread calls

@@ -315,7 +315,8 @@ class SharedScanCoalescer:
         spw, n_waves = C.plan_waves(
             len(seg_u), 1, seg_bytes, C.wave_budget_bytes(eng.config),
             eng.config, max(lp.n_keys for lp in lanes),
-            sum(len(lp.agg_plans) for lp in lanes))
+            sum(len(lp.agg_plans) for lp in lanes),
+            io_budget=C.tier_io_budget(ds, eng.config))
         s_pad = spw if n_waves > 1 else X._pad_segments(len(seg_u), 1)
 
         sig = ("aggmulti", ds.name, id(ds), s_pad, ds.padded_rows,
@@ -529,12 +530,15 @@ class SharedScanCoalescer:
                     for i, lp in enumerate(lanes)]
         wave_segs = [seg_u[i: i + spw] for i in range(0, len(seg_u), spw)]
         finals: List[Optional[dict]] = [None] * len(lanes)
+        # cold tier: wave 1's chunks load while wave 0 binds + computes
+        eng._tier_prefetch(ds, union_names, wave_segs, 1)
         cur = eng._bind_wave(ds, union_names, wave_segs[0], spw, None,
                              False)
         for i in range(len(wave_segs)):
             eng._stage_check(leader.q, leader.t0)
             eng._tick()
             bufs = prog_fn(cur)            # async dispatch
+            eng._tier_prefetch(ds, union_names, wave_segs, i + 2)
             nxt = eng._bind_wave(ds, union_names, wave_segs[i + 1], spw,
                                  None, False) \
                 if i + 1 < len(wave_segs) else None

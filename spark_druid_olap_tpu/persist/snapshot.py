@@ -184,8 +184,16 @@ def _fill_and_publish(ds_root: str, ds, ingest_version: int, wal_seq: int,
     for i, (name, m) in enumerate(ds.metrics.items()):
         vals_f = f"met_{i:04d}_values.bin"
         _array_blob(tmp, vals_f, m.values, files)
+        # global (min, max) over valid rows: the cost model's
+        # selectivity input. Publishing it keeps a TIERED recovery from
+        # faulting a whole column just to plan (tier/loader.py injects
+        # these as the column's bounds cache). Additive — format
+        # version unchanged; old manifests simply lack the field.
+        mn, mx = m.min, m.max
         entry = {"name": name, "kind": m.kind.value, "values": vals_f,
-                 "validity": None}
+                 "validity": None,
+                 "min": None if mn is None else float(mn),
+                 "max": None if mx is None else float(mx)}
         if m.validity is not None:
             vf = f"met_{i:04d}_valid.bin"
             _array_blob(tmp, vf, m.validity, files)

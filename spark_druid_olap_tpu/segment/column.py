@@ -87,6 +87,24 @@ class DimColumn:
     def decode(self, codes: np.ndarray) -> np.ndarray:
         return self.dictionary[np.asarray(codes, dtype=np.int64)]
 
+    # Metadata accessors: planning / sizing paths MUST use these instead
+    # of touching ``codes`` / ``validity`` directly — on a tiered column
+    # (tier/handles.py) the arrays are fault-on-access properties, and a
+    # dtype or nbytes peek through the array would fault the whole
+    # column into the hot set.
+    def data_dtype(self) -> np.dtype:
+        return self.codes.dtype
+
+    def has_nulls(self) -> bool:
+        return self.validity is not None
+
+    def data_nbytes(self) -> int:
+        return int(self.codes.nbytes)
+
+    def footprint_nbytes(self) -> int:
+        v = int(self.validity.nbytes) if self.validity is not None else 0
+        return int(self.codes.nbytes) + v
+
 
 @dataclasses.dataclass
 class MetricColumn:
@@ -118,6 +136,20 @@ class MetricColumn:
     def max(self):
         return self._bounds()[1]
 
+    # metadata accessors (see DimColumn.data_dtype)
+    def data_dtype(self) -> np.dtype:
+        return self.values.dtype
+
+    def has_nulls(self) -> bool:
+        return self.validity is not None
+
+    def data_nbytes(self) -> int:
+        return int(self.values.nbytes)
+
+    def footprint_nbytes(self) -> int:
+        v = int(self.validity.nbytes) if self.validity is not None else 0
+        return int(self.values.nbytes) + v
+
 
 MILLIS_PER_DAY = 86_400_000
 
@@ -148,6 +180,22 @@ class TimeColumn:
             return 0
         i = int(np.lexsort((self.ms_in_day, self.days))[-1])
         return int(self.days[i]) * MILLIS_PER_DAY + int(self.ms_in_day[i])
+
+    # metadata accessors (see DimColumn.data_dtype)
+    def data_dtype(self) -> np.dtype:
+        return self.days.dtype
+
+    def ms_dtype(self) -> np.dtype:
+        return self.ms_in_day.dtype
+
+    def has_nulls(self) -> bool:
+        return False
+
+    def data_nbytes(self) -> int:
+        return int(self.days.nbytes)
+
+    def footprint_nbytes(self) -> int:
+        return int(self.days.nbytes) + int(self.ms_in_day.nbytes)
 
 
 def encode_time_millis(millis: np.ndarray):

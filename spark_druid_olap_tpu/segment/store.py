@@ -345,17 +345,20 @@ class Datasource:
         """Druid segmentMetadata-equivalent summary (reference:
         ``MetadataResponse`` fields)."""
         cols = {}
+        # metadata accessors, not raw arrays: on a tiered store a
+        # .nbytes / validity peek through the array property would fault
+        # every column into the hot set just to answer /metadata
         for d in self.dims.values():
             cols[d.name] = {"type": "STRING", "cardinality": d.cardinality,
-                            "size": int(d.codes.nbytes),
-                            "hasNulls": d.validity is not None}
+                            "size": d.data_nbytes(),
+                            "hasNulls": d.has_nulls()}
         for m in self.metrics.values():
             cols[m.name] = {"type": "LONG" if m.kind == ColumnKind.LONG else "DOUBLE",
-                            "cardinality": None, "size": int(m.values.nbytes),
-                            "hasNulls": m.validity is not None}
+                            "cardinality": None, "size": m.data_nbytes(),
+                            "hasNulls": m.has_nulls()}
         if self.time is not None:
             cols[self.time.name] = {"type": "TIME", "cardinality": None,
-                                    "size": int(self.time.days.nbytes * 2),
+                                    "size": self.time.footprint_nbytes(),
                                     "hasNulls": False}
         return {"datasource": self.name, "numRows": self.num_rows,
                 "numSegments": self.num_segments, "interval": self.interval(),
@@ -424,7 +427,10 @@ class Datasource:
         """[S, R] bool column-null validity, or None when the column has no
         nulls (padding rows read as invalid)."""
         col = self.dims.get(name) or self.metrics.get(name)
-        if col is None or col.validity is None:
+        # has_nulls() is metadata: checking ``col.validity is None`` on a
+        # tiered column would fault the whole validity array just to
+        # learn the column has no nulls
+        if col is None or not col.has_nulls():
             return None
         key = f"__nulls__{name}"
         if key not in self._stacked_cache:

@@ -1,0 +1,2 @@
+"""Out-of-core tiered storage: persist/ snapshots as a first-class cold
+tier behind a byte-budgeted hot set (see docs/TIERING.md)."""

@@ -70,6 +70,10 @@ REGISTRY: Tuple[Resource, ...] = (
              (("release_query",),)),
     Resource("device-pin", (("pin_array",), ("device_pin",)),
              (("unpin_array",), ("device_unpin",))),
+    # cold-tier column pins: an unreleased token keeps every chunk a
+    # query faulted resident forever, silently growing the hot set past
+    # its byte budget (tier/store.py pin protocol)
+    Resource("tier-pin", (("acquire_pins",),), (("release_pins",),)),
     Resource("wal-handle", (), (("close",),), ctor="WriteAheadLog"),
     # cluster RPC: every HTTPConnection the broker opens (subquery
     # scatter, readyz probes) must close on all paths — leaked sockets

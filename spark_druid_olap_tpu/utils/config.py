@@ -495,6 +495,47 @@ CLUSTER_LOCAL_FALLBACK = _entry(
     "query on the broker's own engine (it holds a full recovered copy) "
     "instead of failing. Answers are identical; only placement changes.",
     semantic=False)
+# --- out-of-core tiered storage (tier/) ---------------------------------------
+TIER_ENABLED = _entry(
+    "sdot.tier.enabled", False,
+    "Recover datasources as TIERED stores: column bytes stay in the "
+    "persist/ snapshot (cold tier) and fault on demand into a "
+    "byte-budgeted hot set instead of loading eagerly at boot "
+    "(tier/loader.py; requires sdot.persist.path). Consulted ONCE at "
+    "recovery — flipping it mid-session changes nothing until the next "
+    "Context, so cached results within a session are unaffected; the "
+    "wave-composition effects of tiering key off the per-query "
+    "sdot.tier.wave.io.bytes (semantic) instead.", semantic=False)
+TIER_BUDGET_BYTES = _entry(
+    "sdot.tier.budget.bytes", 2 << 30,
+    "Byte budget of the hot set (per process — on a cluster historical "
+    "this bounds the node's owned-shard residency). Chunks over budget "
+    "evict by query-history popularity, oldest-touch first; chunks "
+    "pinned by in-flight queries never evict, so peak residency is "
+    "budget + in-flight bytes.", int, semantic=False)
+TIER_VERIFY_CHECKSUMS = _entry(
+    "sdot.tier.verify.checksums", True,
+    "Verify each cold blob's CRC32 against the manifest on the FIRST "
+    "fault that touches it (recovery itself only checks structure, "
+    "keeping boot O(manifest)). A mismatch quarantines the snapshot "
+    "version and re-recovers per PERSIST semantics.", semantic=False)
+TIER_PREFETCH_ENABLED = _entry(
+    "sdot.tier.prefetch.enabled", True,
+    "Run the cold-tier prefetcher threads: the wave loop enqueues wave "
+    "i+2's chunks while wave i computes on device, hiding cold loads "
+    "behind dispatch. Purely a latency optimization — demand faults "
+    "serve everything when disabled.", semantic=False)
+TIER_PREFETCH_THREADS = _entry(
+    "sdot.tier.prefetch.threads", 2,
+    "Prefetcher worker threads draining the cold-load queue.",
+    int, semantic=False)
+TIER_WAVE_IO_BYTES = _entry(
+    "sdot.tier.wave.io.bytes", 256 << 20,
+    "Per-wave host-I/O byte cap on a tiered scan (the wave planner's "
+    "I/O term, parallel/cost.py:tier_io_budget): forces enough waves "
+    "that prefetch can overlap loads with compute. 0 disables the "
+    "term. Semantic: changes the wave composition and with it float "
+    "accumulation order.", int)
 
 
 # Families of runtime-shaped keys (tenant / datasource suffixes) that

@@ -1,0 +1,272 @@
+"""Out-of-core tiered storage (tier/): budgeted hot set over cold blobs.
+
+The acceptance bar is differential, like test_persist.py: a context
+whose datasources recover as loadable handles under a byte budget far
+smaller than the column bytes must answer queries identically to an
+unbudgeted (eager) recovery of the same deep storage. On top of that:
+
+- eviction never touches chunks pinned by an in-flight query, and the
+  deferred eviction on pin release restores the budget invariant;
+- a CRC-corrupt cold blob discovered at fault time quarantines the
+  snapshot version and recovery falls back, exactly like an eager-load
+  corruption (PERSIST semantics);
+- the load-behind-compute prefetcher's overlap counters advance on a
+  multi-wave cold scan;
+- a cluster historical boots tiered shards without faulting the whole
+  datasource, so its hot set covers only owned segments.
+"""
+
+import os
+import zlib
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.persist import snapshot as SNAP
+from spark_druid_olap_tpu.tier.store import BlobRef, TieredColumnStore
+
+from conftest import assert_frames_equal, make_sales_df
+
+
+def _events(n=200, seed=3):
+    r = np.random.default_rng(seed)
+    start = np.datetime64("2024-01-01")
+    return pd.DataFrame({
+        "ts": (start + r.integers(0, 90, n).astype("timedelta64[D]")
+               ).astype("datetime64[ns]"),
+        "country": r.choice(["US", "DE", "FR", "JP"], n),
+        "clicks": r.integers(0, 100, n),
+        "price": np.round(r.uniform(0, 50, n), 2),
+    })
+
+
+INGEST = dict(time_column="ts", dimensions=["country"],
+              metrics=["clicks", "price"])
+
+Q = ("select country, sum(clicks) as c, count(*) as n from events "
+     "group by country order by country")
+
+
+def _ctx(root, **extra):
+    return sdot.Context({"sdot.persist.path": str(root), **extra})
+
+
+def _seed_sales(root):
+    seed = _ctx(root)
+    seed.ingest_dataframe("sales", make_sales_df(), time_column="ts",
+                          target_rows=2048)
+    q = ("select region, sum(qty) as q, sum(price) as p, count(*) as n "
+         "from sales group by region order by region")
+    want = seed.sql(q).to_pandas()
+    seed.checkpoint()
+    seed.close()
+    return q, want
+
+
+# -- (a) differential exactness under a tiny byte budget ----------------------
+
+def test_tiny_budget_differential(tmp_path):
+    q, want = _seed_sales(tmp_path)
+    ctx = _ctx(tmp_path, **{"sdot.tier.enabled": True,
+                            "sdot.tier.budget.bytes": 4096})
+    ds = ctx.store.get("sales")
+    assert getattr(ds, "tier", None) is not None
+    assert_frames_equal(ctx.sql(q).to_pandas(), want)
+    st = ctx.engine.last_stats["tier"]
+    # the working set exceeds the budget many times over: the query
+    # faulted cold bytes and the pin-release eviction restored the
+    # budget invariant (peak residency = budget + pinned is allowed
+    # only WHILE pinned)
+    assert st["bytes_faulted"] > st["budget_bytes"]
+    assert st["evictions"] > 0
+    assert st["hot_bytes"] <= st["budget_bytes"]
+    assert st["pinned_entries"] == 0
+    # a repeat query still answers exactly through re-faults
+    assert_frames_equal(ctx.sql(q).to_pandas(), want)
+    ctx.close()
+
+
+def test_unbudgeted_second_query_hits_hot_set(tmp_path):
+    q, want = _seed_sales(tmp_path)
+    ctx = _ctx(tmp_path, **{"sdot.tier.enabled": True})
+    assert_frames_equal(ctx.sql(q).to_pandas(), want)
+    ctx.engine.clear_caches()   # force a re-bind, not a result-cache hit
+    faults0 = ctx.persist.tier.counters["faults"]
+    assert_frames_equal(ctx.sql(q).to_pandas(), want)
+    st = ctx.engine.last_stats["tier"]
+    assert st["faults"] == faults0, "warm re-bind faulted cold chunks"
+    assert st["hits"] > 0
+    ctx.close()
+
+
+# -- (b) eviction honors pins -------------------------------------------------
+
+def _blob(tmp_path, name, n):
+    arr = (np.arange(n, dtype=np.int32) + len(name)).astype(np.int32)
+    p = str(tmp_path / name)
+    arr.tofile(p)
+    return arr, BlobRef(path=p, dtype="int32", start=0, count=n,
+                        crc=zlib.crc32(arr.tobytes()) & 0xFFFFFFFF,
+                        file_bytes=arr.nbytes)
+
+
+def test_eviction_honors_pins(tmp_path):
+    a, ra = _blob(tmp_path, "a.bin", 256)
+    b, rb = _blob(tmp_path, "b.bin", 256)
+    c, rc = _blob(tmp_path, "c.bin", 256)
+    tier = TieredColumnStore(budget_bytes=2 * ra.nbytes)
+    tok = tier.acquire_pins()
+    np.testing.assert_array_equal(tier.fault("ds", "a", ra), a)
+    np.testing.assert_array_equal(tier.fault("ds", "b", rb), b)
+    # third chunk overflows the budget, but every resident chunk is
+    # pinned by the open token: nothing may be evicted yet
+    np.testing.assert_array_equal(tier.fault("ds", "c", rc), c)
+    st = tier.stats_snapshot()
+    assert st["hot_bytes"] == 3 * ra.nbytes > st["budget_bytes"]
+    assert st["evictions"] == 0
+    assert st["pinned_entries"] == 3
+    # release runs the deferred eviction and restores the invariant
+    tier.release_pins(tok)
+    st = tier.stats_snapshot()
+    assert st["hot_bytes"] <= st["budget_bytes"]
+    assert st["evictions"] >= 1
+    assert st["pinned_entries"] == 0
+    tier.stop()
+
+
+def test_eviction_prefers_unpopular_columns(tmp_path):
+    a, ra = _blob(tmp_path, "a.bin", 256)
+    b, rb = _blob(tmp_path, "b.bin", 256)
+    scores = {("ds", "hotcol"): 9.0, ("ds", "coldcol"): 0.0}
+    tier = TieredColumnStore(
+        budget_bytes=ra.nbytes,   # room for exactly one chunk
+        popularity=lambda ds, col: scores[(ds, col)])
+    tier.fault("ds", "coldcol", ra)
+    tier.fault("ds", "hotcol", rb)
+    st = tier.stats_snapshot()
+    assert st["hot_entries"] == 1 and st["evictions"] == 1
+    # the popular column survived; the cold one re-faults
+    assert tier.counters["faults"] == 2
+    tier.fault("ds", "hotcol", rb)
+    assert tier.counters["hits"] == 1
+    tier.stop()
+
+
+# -- (c) CRC failure at fault time: quarantine + PERSIST fallback -------------
+
+def test_cold_crc_failure_quarantines_and_falls_back(tmp_path):
+    ctx = _ctx(tmp_path)
+    ctx.stream_ingest("events", _events(100), **INGEST)
+    want = ctx.sql(Q).to_pandas()
+    ctx.checkpoint("events")
+    ctx.stream_ingest("events", _events(10, seed=5), **INGEST)
+    ctx.checkpoint("events")
+    ds_root = ctx.persist._ds_root("events")
+    cur = SNAP.current_version(ds_root)
+    vdir = os.path.join(ds_root, SNAP.version_dirname(cur))
+    blob = next(p for p in sorted(os.listdir(vdir)) if p.endswith(".bin"))
+    with open(os.path.join(vdir, blob), "r+b") as f:
+        f.seek(0)
+        f.write(b"\xde\xad\xbe\xef")
+    ctx.close()
+
+    # tiered boot only checks structure (existence/sizes); the flipped
+    # bytes surface at the FIRST FAULT, not at recovery
+    ctx2 = _ctx(tmp_path, **{"sdot.tier.enabled": True})
+    assert not ctx2.persist.recovery_report["quarantined"]
+    with pytest.raises(SNAP.SnapshotCorrupt):
+        ctx2.sql(Q)
+    # the faulting query quarantined the version and re-ran recovery:
+    # the next query answers from the older intact snapshot
+    rep = ctx2.persist.recovery_report
+    assert len(rep["quarantined"]) == 1
+    assert rep["quarantined"][0]["version"] == cur
+    assert_frames_equal(ctx2.sql(Q).to_pandas(), want)
+    assert ctx2.persist.tier.counters["crc_failures"] == 1
+    snaps = ctx2.sql("select state from sys_snapshots").to_pandas()
+    assert any(s.startswith("quarantined:") for s in snaps["state"])
+    ctx2.close()
+
+
+# -- (d) prefetch overlap on a multi-wave cold scan ---------------------------
+
+def test_prefetch_overlap_counters_advance(tmp_path):
+    q, want = _seed_sales(tmp_path)
+    ctx = _ctx(tmp_path, **{"sdot.tier.enabled": True,
+                            # tiny per-wave I/O cap -> multi-wave scan
+                            "sdot.tier.wave.io.bytes": 64 * 1024})
+    assert_frames_equal(ctx.sql(q).to_pandas(), want)
+    st = ctx.engine.last_stats
+    assert st["waves"] > 1, "scan did not split into waves"
+    t = st["tier"]
+    # waves past the first were enqueued behind the running compute;
+    # the first query's compile leaves the prefetcher plenty of time,
+    # so demand binds find prefetched chunks hot
+    assert t["prefetch_submitted"] > 0
+    assert t["prefetch_loaded"] > 0
+    assert t["prefetch_hits"] > 0
+    assert t["prefetch_overlap_ratio"] > 0.0
+    ctx.close()
+
+
+def test_prefetch_disabled_still_exact(tmp_path):
+    q, want = _seed_sales(tmp_path)
+    ctx = _ctx(tmp_path, **{"sdot.tier.enabled": True,
+                            "sdot.tier.prefetch.enabled": False,
+                            "sdot.tier.wave.io.bytes": 64 * 1024})
+    assert_frames_equal(ctx.sql(q).to_pandas(), want)
+    t = ctx.engine.last_stats["tier"]
+    assert t["prefetch_loaded"] == 0 and t["faults"] > 0
+    ctx.close()
+
+
+# -- (e) historical boots tiered shards within budget -------------------------
+
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_historical_boots_owned_shards_within_budget(tmp_path):
+    from spark_druid_olap_tpu.cluster.historical import HistoricalNode
+    from spark_druid_olap_tpu.tier.handles import TieredDatasource
+    _seed_sales(tmp_path)
+    budget = 256 * 1024
+    nodes_csv = f"127.0.0.1:{_free_port()},127.0.0.1:{_free_port()}"
+    node = HistoricalNode({
+        "sdot.persist.path": str(tmp_path),
+        "sdot.cluster.nodes": nodes_csv,
+        "sdot.tier.enabled": True,
+        "sdot.tier.budget.bytes": budget,
+    }, node_id=0).start()
+    try:
+        names = node.ctx.store.names()
+        assert names and all("::shard" in n for n in names)
+        for n in names:
+            assert isinstance(node.ctx.store.get(n), TieredDatasource)
+        # boot sliced handles without faulting data: the hot set is
+        # empty until a query arrives, so a node whose owned shards
+        # exceed RAM still comes up
+        st = node.ctx.persist.tier.stats_snapshot()
+        assert st["budget_bytes"] == budget
+        assert st["hot_bytes"] == 0 and st["faults"] == 0
+        # one shard answers through the tier, faulting only its bytes
+        from spark_druid_olap_tpu.ir import spec as S
+        q = S.GroupByQuerySpec(
+            datasource=names[0],
+            dimensions=(S.DimensionSpec(dimension="region",
+                                        output_name="region"),),
+            aggregations=(S.AggregationSpec(kind="longsum", name="q",
+                                            field="qty"),))
+        r = node.ctx.engine.execute(q)
+        assert r.to_pandas()["q"].sum() > 0
+        st = node.ctx.persist.tier.stats_snapshot()
+        assert 0 < st["hot_bytes"] <= budget
+    finally:
+        node.stop()
