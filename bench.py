@@ -565,6 +565,16 @@ def main():
         profile_n = 4
     ndisp = {}
     zero_dispatch = []
+    fusion_fallback = []
+
+    def _fusion_stats():
+        # engine fusion-planner counters (0s until any engine query runs);
+        # host-mode suites (numerics) have no sharedscan tier
+        try:
+            return dict(ctx.engine.sharedscan.stats().get("fusion") or {})
+        except Exception:   # noqa: BLE001 — counters are advisory
+            return {}
+
     cold_total_s = 0.0
     n_engine = 0
     host_queries = []
@@ -577,6 +587,7 @@ def main():
         # queries run as written over the base tables; the planner's
         # star-join collapse routes fact+dim joins onto the flat index
         sql = queries[name]
+        fus0 = _fusion_stats()
         try:
             t0 = time.perf_counter()
             r = ctx.sql(sql)
@@ -624,6 +635,17 @@ def main():
         # appends its own history entry (ADVICE r4: reading entries()[-1]
         # after that rep would report the profiling run's counters)
         meas_stats = dict(ctx.history.entries()[-1].stats)
+        # fusion-plan regression guard (extends the zero_dispatch pattern):
+        # a plan_fallbacks advance during this query's reps means a fused
+        # group silently reverted to the unfused (per-lane re-eval)
+        # program — the single-pass win regressed without failing anything
+        fus1 = _fusion_stats()
+        if mode == "engine" and (int(fus1.get("plan_fallbacks", 0))
+                                 > int(fus0.get("plan_fallbacks", 0))):
+            fusion_fallback.append(name)
+            log(f"{name}: WARNING fusion planner fell back to the unfused "
+                f"program during this query's reps — fused dispatch is no "
+                f"longer single-pass")
         bs = meas_stats.get("bytes_scanned")
         gb = ""
         if mode == "engine" and bs:
@@ -725,6 +747,13 @@ def main():
         out["n_dispatch"] = ndisp
     if zero_dispatch:
         out["zero_dispatch_engine"] = zero_dispatch
+    fus_end = _fusion_stats()
+    if fus_end:
+        # deterministic CSE counters for the whole suite: how much
+        # predicate work and column streaming the fusion planner removed
+        out["fusion"] = fus_end
+    if fusion_fallback:
+        out["fusion_fallback_engine"] = fusion_fallback
     if gbps:
         try:
             peak = float(os.environ.get("SDOT_BENCH_HBM_PEAK_GBPS", "819"))

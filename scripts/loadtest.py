@@ -202,6 +202,15 @@ TPCH_DASHBOARD = [
     "select o_orderpriority, count(*) as c from orders "
     "where o_orderdate >= date '1993-07-01' "
     "and o_orderdate < date '1993-10-01' group by o_orderpriority",
+    # two widget variants sharing the global dashboard time window with
+    # the pricing-summary tile: a coalesced wave carries the
+    # `l_shipdate <= date '1998-09-02'` conjunct in >= 2 lanes, so the
+    # fusion planner provably lowers it once (predicate_evals_saved > 0)
+    "select l_linestatus, sum(l_extendedprice) as rev from lineitem "
+    "where l_shipdate <= date '1998-09-02' and l_discount > 0.04 "
+    "group by l_linestatus",
+    "select count(*) as big_orders from lineitem "
+    "where l_shipdate <= date '1998-09-02' and l_quantity >= 45",
 ]
 
 
@@ -679,8 +688,16 @@ def run_sharedscan(args):
         answers[q] = ctx.sql(q).to_pandas()
 
     legs, mismatched = {}, []
-    for leg, enabled in (("sharedscan_off", False), ("sharedscan_on", True)):
+    # three legs: coalescing off, coalesced but UNFUSED (fusion planner
+    # disabled — the pre-fusion per-lane-re-eval program), and fully
+    # fused. All three are differentially checked against the sequential
+    # reference, so "fused == pre-fusion fused == solo" is enforced
+    # byte-for-byte on every reply.
+    for leg, enabled, fused in (("sharedscan_off", False, True),
+                                ("sharedscan_on_nofusion", True, False),
+                                ("sharedscan_on", True, True)):
         ctx.config.set("sdot.sharedscan.enabled", enabled)
+        ctx.config.set("sdot.sharedscan.fusion.enabled", fused)
         coal0 = dict(ctx.engine.sharedscan.stats())
         lat, errors, dispatches = [], [0], [0]
         lock = threading.Lock()
@@ -736,21 +753,41 @@ def run_sharedscan(args):
                                   - coal0["binds_saved_bytes"]),
             "dispatches_saved": (coal1["dispatches_saved"]
                                  - coal0["dispatches_saved"])}
+        f0, f1 = coal0["fusion"], coal1["fusion"]
+        evals = f1["predicate_evals_total"] - f0["predicate_evals_total"] \
+            + f1["solo_evals_total"] - f0["solo_evals_total"]
+        saved = f1["predicate_evals_saved"] - f0["predicate_evals_saved"] \
+            + f1["solo_evals_saved"] - f0["solo_evals_saved"]
+        legs[leg]["fusion"] = {
+            "shared_predicates": (f1["shared_predicates"]
+                                  - f0["shared_predicates"]),
+            "predicate_evals_saved": (f1["predicate_evals_saved"]
+                                      - f0["predicate_evals_saved"]),
+            "column_streams_saved": (f1["column_streams_saved"]
+                                     - f0["column_streams_saved"]),
+            "plan_fallbacks": f1["plan_fallbacks"] - f0["plan_fallbacks"],
+            "cse_hit_rate": round(saved / evals, 4) if evals else 0.0}
         print(f"  [{leg}] qps={legs[leg]['qps']:7.1f} "
               f"p50={legs[leg]['p50_ms']:7.1f}ms "
               f"p99={legs[leg]['p99_ms']:7.1f}ms n={served:5d} "
               f"dispatches={dispatches[0]} "
-              f"coalesce_rate={legs[leg]['coalesce_rate']:.1%}")
+              f"coalesce_rate={legs[leg]['coalesce_rate']:.1%} "
+              f"cse_hit_rate={legs[leg]['fusion']['cse_hit_rate']:.1%} "
+              f"evals_saved={saved}")
 
     on, off = legs["sharedscan_on"], legs["sharedscan_off"]
+    fus = on["fusion"]
     qps_x = on["qps"] / max(off["qps"], 1e-9)
     disp_per_q_off = off["dispatches"] / max(off["n"], 1)
     disp_per_q_on = on["dispatches"] / max(on["n"], 1)
     disp_x = disp_per_q_off / max(disp_per_q_on, 1e-9)
     print(f"  qps speedup {qps_x:.2f}x; dispatches/query "
           f"{disp_per_q_off:.2f} -> {disp_per_q_on:.2f} ({disp_x:.2f}x "
-          f"fewer)" + (f"; RESULT MISMATCH on {sorted(set(mismatched))}"
-                       if mismatched else ""))
+          f"fewer); fusion: cse_hit_rate={fus['cse_hit_rate']:.1%} "
+          f"evals_saved={fus['predicate_evals_saved']} "
+          f"col_streams_saved={fus['column_streams_saved']}"
+          + (f"; RESULT MISMATCH on {sorted(set(mismatched))}"
+             if mismatched else ""))
     out = {"mode": "sharedscan", "sf": sf, "rows": n_rows,
            "threads": args.threads, "duration_s": args.duration,
            "window_ms": window_ms, "legs": legs,
@@ -758,8 +795,13 @@ def run_sharedscan(args):
            "dispatch_reduction": round(disp_x, 2),
            "result_mismatches": sorted(set(mismatched))}
     print(json.dumps(out))
+    # the fused leg must additionally have planned real cross-lane CSE:
+    # shared predicates lowered once and union columns streamed once
     ok = not mismatched and on["n"] > 0 and off["n"] > 0 \
-        and on["queries_coalesced"] > 0
+        and legs["sharedscan_on_nofusion"]["n"] > 0 \
+        and on["queries_coalesced"] > 0 \
+        and fus["predicate_evals_saved"] > 0 \
+        and fus["column_streams_saved"] > 0
     sys.exit(0 if ok else 1)
 
 

@@ -57,6 +57,7 @@ from spark_druid_olap_tpu.ops.scan import (
 )
 from spark_druid_olap_tpu.parallel import cost as C
 from spark_druid_olap_tpu.parallel.mesh import SEGMENT_AXIS, mesh_size
+from spark_druid_olap_tpu.planner import fusion as FU
 from spark_druid_olap_tpu.result import QueryResult
 from spark_druid_olap_tpu.segment.column import ColumnKind
 from spark_druid_olap_tpu.segment.store import (Datasource, Segment,
@@ -79,6 +80,7 @@ from spark_druid_olap_tpu.utils.config import (
     HAVING_DEVICE_MIN_KEYS,
     HLL_LOG2M,
     SELECT_DEVICE_MIN_ROWS,
+    SHAREDSCAN_FUSION_ENABLED,
     TOPN_DEVICE_MIN_KEYS,
 )
 
@@ -519,11 +521,15 @@ class AggPlan:
             return n.arr
         return None
 
-    def build_mask(self, ctx: ScanContext):
+    def build_mask(self, ctx: ScanContext, cse=None):
+        """``cse`` (planner.fusion.CSECache, bound to ``ctx``) memoizes
+        the filter lowering so aggregation filters repeated within a
+        query — or across fused shared-scan lanes — lower once."""
         a = self.spec
         masks = []
         if a.filter is not None:
-            m = F.lower_filter(a.filter, ctx)
+            m = cse.lower(a.filter) if cse is not None \
+                else F.lower_filter(a.filter, ctx)
             if m is not None:
                 masks.append(m)
         if a.field is not None:
@@ -1129,6 +1135,22 @@ class QueryEngine:
         self._stamp("plan_ms", _tp)
         cards = [p.card for p in all_dim_plans]
 
+        if bool(self.config.get(SHAREDSCAN_FUSION_ENABLED)):
+            # solo-path CSE accounting, at PLAN time so warm program-
+            # cache runs still tick the deterministic counters (the
+            # trace-time cache in _make_core/_hash_core does the actual
+            # sharing; this mirrors its hit count)
+            try:
+                tot, distinct = FU.analyze_query(
+                    filter_spec, intervals,
+                    [a.filter for a in aggregations])
+                if tot > distinct:
+                    self.sharedscan.note_solo_cse(tot - distinct, tot)
+                elif tot:
+                    self.sharedscan.note_solo_cse(0, tot)
+            except Exception:  # noqa: BLE001 — accounting never fails a query
+                pass
+
         route_hashed = n_keys > self.config.get(GROUPBY_DENSE_MAX_KEYS)
         if not route_hashed:
             # medium-K reroute (VERDICT r3 item 3): at K past the onehot
@@ -1181,7 +1203,8 @@ class QueryEngine:
                     self.config.get(TZ_ID),
                     self.config.get(GROUPBY_MATMUL_MAX_KEYS),
                     self.config.get(HLL_LOG2M), jax.default_backend(),
-                    bool(jax.config.jax_enable_x64))
+                    bool(jax.config.jax_enable_x64),
+                    bool(self.config.get(SHAREDSCAN_FUSION_ENABLED)))
         if having_dev:
             # two dispatches: finals stay device-resident, only the mask
             # count then the passing groups travel
@@ -1691,7 +1714,8 @@ class QueryEngine:
                    self.config.get(TZ_ID),
                    self.config.get(GROUPBY_MATMUL_MAX_KEYS),
                    self.config.get(HLL_LOG2M),
-                   jax.default_backend(), bool(jax.config.jax_enable_x64))
+                   jax.default_backend(), bool(jax.config.jax_enable_x64),
+                   bool(self.config.get(SHAREDSCAN_FUSION_ENABLED)))
 
             def build(lm=lm):
                 if compact or exch:
@@ -1906,12 +1930,16 @@ class QueryEngine:
         cards = [p.card for p in dim_plans]
         cheap_f, exp_f = (self._split_filter_staged(filter_spec)
                           if compact_m else (filter_spec, None))
+        fuse_cse = bool(self.config.get(SHAREDSCAN_FUSION_ENABLED))
 
         def core(arrays):
             ctx = ScanContext(ds, arrays, min_day, max_day,
                               tz=self.config.get(TZ_ID))
+            # same trace-time predicate CSE as the dense core
+            cse = FU.CSECache(ctx) if fuse_cse else None
             base = ctx.row_valid()
-            fm = F.lower_filter(cheap_f, ctx)
+            fm = cse.lower(cheap_f) if cse is not None \
+                else F.lower_filter(cheap_f, ctx)
             if fm is not None:
                 base = base & fm
             im = F.interval_mask(intervals, ctx)
@@ -1929,9 +1957,11 @@ class QueryEngine:
                     n_live - jnp.int32(compact_m), 0).astype(jnp.int32)
                 ctx = CompactScanContext(ds, arrays, min_day, max_day,
                                          self.config.get(TZ_ID), keep=keep)
+                cse = FU.CSECache(ctx) if fuse_cse else None
                 base = flat[keep]
                 if exp_f is not None:
-                    em = F.lower_filter(exp_f, ctx)
+                    em = cse.lower(exp_f) if cse is not None \
+                        else F.lower_filter(exp_f, ctx)
                     if em is not None:
                         base = base & em
             codes = [p.build(ctx) for p in dim_plans]
@@ -1942,7 +1972,7 @@ class QueryEngine:
             for p in agg_plans:
                 inputs.append(G.AggInput(p.spec.name, p.kind,
                                          p.build_values(ctx),
-                                         p.build_mask(ctx),
+                                         p.build_mask(ctx, cse=cse),
                                          is_int=p.is_int, maxabs=p.maxabs))
             if sorted_run:
                 # sorted-run tier: the slot sort rides the agg values as
@@ -2435,12 +2465,19 @@ class QueryEngine:
 
         cheap_f, exp_f = (self._split_filter_staged(filter_spec)
                           if compact_m else (filter_spec, None))
+        fuse_cse = bool(self.config.get(SHAREDSCAN_FUSION_ENABLED))
 
         def core(arrays):
             ctx = ScanContext(ds, arrays, min_day, max_day,
                               tz=self.config.get(TZ_ID))
+            # trace-time predicate CSE: one query's tree can repeat
+            # sub-predicates (OR-of-bounds over one column, a selector
+            # shared by every filtered aggregation) — memoized lowering
+            # emits each distinct sub-mask once, bit-identically
+            cse = FU.CSECache(ctx) if fuse_cse else None
             base = ctx.row_valid()
-            fm = F.lower_filter(cheap_f, ctx)
+            fm = cse.lower(cheap_f) if cse is not None \
+                else F.lower_filter(cheap_f, ctx)
             if fm is not None:
                 base = base & fm
             im = F.interval_mask(intervals, ctx)
@@ -2464,11 +2501,15 @@ class QueryEngine:
                     n_live - jnp.int32(compact_m), 0).astype(jnp.int32)
                 ctx = CompactScanContext(ds, arrays, min_day, max_day,
                                          self.config.get(TZ_ID), keep=keep)
+                # the compacted context changes every mask's shape: the
+                # full-width CSE entries must never leak past this point
+                cse = FU.CSECache(ctx) if fuse_cse else None
                 base = flat[keep]
                 if exp_f is not None:
                     # staged: gather-heavy conjuncts (membership sets,
                     # keyed lookups) evaluate on the survivors only
-                    em = F.lower_filter(exp_f, ctx)
+                    em = cse.lower(exp_f) if cse is not None \
+                        else F.lower_filter(exp_f, ctx)
                     if em is not None:
                         base = base & em
             if dim_plans:
@@ -2480,7 +2521,7 @@ class QueryEngine:
             for p in dense_plans:
                 inputs.append(G.AggInput(p.spec.name, p.kind,
                                          p.build_values(ctx),
-                                         p.build_mask(ctx),
+                                         p.build_mask(ctx, cse=cse),
                                          is_int=p.is_int, maxabs=p.maxabs))
             inputs.append(G.AggInput("__rows__", "count", is_int=True,
                                      maxabs=1.0))
@@ -2488,13 +2529,13 @@ class QueryEngine:
                                   matmul_max)
             for p in hll_plans:
                 vals = p.build_values(ctx)
-                am = p.build_mask(ctx)
+                am = p.build_mask(ctx, cse=cse)
                 m = base if am is None else (base & am)
                 out[p.spec.name] = HLL.hll_registers(
                     key, m, vals, n_keys, log2m)
             for p in theta_plans:
                 vals = p.build_values(ctx)
-                am = p.build_mask(ctx)
+                am = p.build_mask(ctx, cse=cse)
                 m = base if am is None else (base & am)
                 out[p.spec.name] = TH.theta_registers(key, m, vals, n_keys)
             if n_over is not None:

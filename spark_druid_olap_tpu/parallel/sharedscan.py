@@ -54,12 +54,15 @@ from spark_druid_olap_tpu.ops import theta as TH
 from spark_druid_olap_tpu.ops import time_ops as T
 from spark_druid_olap_tpu.ops.scan import ScanContext, array_dtype, array_names
 from spark_druid_olap_tpu.parallel import cost as C
+from spark_druid_olap_tpu.planner import fusion as FU
 from spark_druid_olap_tpu.result import QueryResult
 from spark_druid_olap_tpu.utils.config import (
     GROUPBY_DENSE_MAX_KEYS,
     GROUPBY_MATMUL_MAX_KEYS,
     HLL_LOG2M,
     SHAREDSCAN_ENABLED,
+    SHAREDSCAN_FUSION_ENABLED,
+    SHAREDSCAN_FUSION_MAX_NODES,
     SHAREDSCAN_MAX_QUERIES,
     TZ_ID,
     WLM_BATCH_WINDOW_MS,
@@ -137,6 +140,19 @@ class SharedScanCoalescer:
         self.binds_saved_bytes = 0
         self.dispatches_saved = 0
         self.wlm_handoffs = 0         # queued waiters bypassed into groups
+        # fusion planner (planner/fusion.py) — deterministic plan-time
+        # counters, ticked on EVERY fused run (warm program cache too)
+        self.fusion_groups = 0          # fused runs that planned CSE
+        self.fusion_fallbacks = 0       # planning errors -> unfused lowering
+        self.fusion_shared_predicates = 0
+        self.fusion_predicate_evals_saved = 0
+        self.fusion_predicate_evals_total = 0
+        self.fusion_column_streams_saved = 0
+        # solo-path CSE (parallel/executor.py threads the same cache
+        # through the dense/hashed cores; one query's tree can repeat
+        # sub-predicates, e.g. OR-of-bounds over one column)
+        self.fusion_solo_evals_saved = 0
+        self.fusion_solo_evals_total = 0
 
     # -- eligibility -----------------------------------------------------------
     def enabled(self) -> bool:
@@ -319,15 +335,40 @@ class SharedScanCoalescer:
             io_budget=C.tier_io_budget(ds, eng.config))
         s_pad = spw if n_waves > 1 else X._pad_segments(len(seg_u), 1)
 
+        # fusion planning is advisory: any error lowers the unfused way
+        # (routing tiers never change). Runs on EVERY fused execution —
+        # warm program-cache runs included — so the counters below are
+        # deterministic and CI-guardable without a chip.
+        fplan = None
+        if bool(eng.config.get(SHAREDSCAN_FUSION_ENABLED)):
+            try:
+                fplan = FU.plan_lanes(
+                    [(lp.q.filter, lp.q.intervals,
+                      tuple(a.filter for a in lp.aggs)) for lp in lanes],
+                    per_lane_cols=[len(lp.needed) for lp in lanes],
+                    union_cols=len(union_cols),
+                    max_nodes=int(
+                        eng.config.get(SHAREDSCAN_FUSION_MAX_NODES)))
+            except Exception:  # noqa: BLE001 — fall back to unfused
+                fplan = None
+                with self._lock:
+                    self.fusion_fallbacks += 1
+
         sig = ("aggmulti", ds.name, id(ds), s_pad, ds.padded_rows,
                min_day, max_day, tuple(union_names),
                eng.config.get(TZ_ID),
                eng.config.get(GROUPBY_MATMUL_MAX_KEYS),
                eng.config.get(HLL_LOG2M), jax.default_backend(),
-               bool(jax.config.jax_enable_x64), sigs)
+               bool(jax.config.jax_enable_x64), sigs,
+               # the fusion plan shapes the traced program: the token is
+               # a pure function of the sorted lane set (arrival-order
+               # independent), None when planning declined or failed
+               bool(eng.config.get(SHAREDSCAN_FUSION_ENABLED)),
+               int(eng.config.get(SHAREDSCAN_FUSION_MAX_NODES)),
+               fplan.token() if fplan is not None else None)
         prog_fn, unpacks = eng._cached_program(
             sig, lambda: self._build_fused_program(
-                ds, lanes, min_day, max_day))
+                ds, lanes, min_day, max_day, fplan))
 
         per_lane_finals = self._dispatch(ds, union_names, seg_u, s_pad,
                                          spw, n_waves, prog_fn, unpacks,
@@ -345,6 +386,14 @@ class SharedScanCoalescer:
             self.queries_coalesced += len(planned)
             self.binds_saved_bytes += saved_bytes
             self.dispatches_saved += saved_disp
+            if fplan is not None:
+                self.fusion_groups += 1
+                self.fusion_shared_predicates += fplan.shared_predicates
+                self.fusion_predicate_evals_saved += \
+                    fplan.predicate_evals_saved
+                self.fusion_predicate_evals_total += fplan.n_nodes
+                self.fusion_column_streams_saved += \
+                    fplan.column_streams_saved
 
         for m, lp in planned:
             li = lane_idx[lp.sig]
@@ -360,7 +409,9 @@ class SharedScanCoalescer:
                     "lanes": len(lanes),
                     "role": "leader" if m.leader else "follower",
                     "binds_saved_bytes": saved_bytes,
-                    "dispatches_saved": saved_disp}}
+                    "dispatches_saved": saved_disp,
+                    "fusion": (fplan.counters()
+                               if fplan is not None else None)}}
             m.outcome = results[li]
             eng.inflight.annotate(m.tok, sharedscan_group=g.gid)
 
@@ -449,12 +500,19 @@ class SharedScanCoalescer:
             return False
 
     def _build_fused_program(self, ds, lanes: List[_LanePlan],
-                             min_day: int, max_day: int):
+                             min_day: int, max_day: int, fplan=None):
         """(jit_fn, [per-lane unpack]). One ScanContext over the union
         bind; each lane is the engine's dense core (mask -> fused keys ->
         dense_groupby -> sketch registers) packed through its own
         two-buffer packers, so per-lane decode reuses the solo path
-        byte-for-byte."""
+        byte-for-byte.
+
+        With a fusion plan, the program is single-pass with predicate
+        CSE: cross-lane shared masks lower FIRST (each union column
+        streams through VMEM once while they compute), then every lane's
+        ``base = row_valid & shared & residual`` combine reuses them via
+        the trace-time CSE cache — bit-identical to the unfused trace
+        because masks only combine with exact bool ops."""
         eng = self.engine
         matmul_max = eng.config.get(GROUPBY_MATMUL_MAX_KEYS)
         log2m = eng.config.get(HLL_LOG2M)
@@ -466,13 +524,19 @@ class SharedScanCoalescer:
         def fused(arrays):
             ctx = ScanContext(ds, arrays, min_day, max_day, tz=tz)
             rv = ctx.row_valid()
+            cse = None
+            if fplan is not None:
+                cse = FU.CSECache(ctx)
+                cse.prelower(fplan)
             outs = []
             for lp, (pack, _) in zip(lanes, packers):
                 base = rv
-                fm = F.lower_filter(lp.q.filter, ctx)
+                fm = cse.lower(lp.q.filter) if cse is not None \
+                    else F.lower_filter(lp.q.filter, ctx)
                 if fm is not None:
                     base = base & fm
-                im = F.interval_mask(lp.q.intervals, ctx)
+                im = cse.interval(lp.q.intervals) if cse is not None \
+                    else F.interval_mask(lp.q.intervals, ctx)
                 if im is not None:
                     base = base & im
                 if lp.dim_plans:
@@ -487,7 +551,7 @@ class SharedScanCoalescer:
                         continue
                     inputs.append(G.AggInput(p.spec.name, p.kind,
                                              p.build_values(ctx),
-                                             p.build_mask(ctx),
+                                             p.build_mask(ctx, cse=cse),
                                              is_int=p.is_int,
                                              maxabs=p.maxabs))
                 inputs.append(G.AggInput("__rows__", "count", is_int=True,
@@ -498,7 +562,7 @@ class SharedScanCoalescer:
                     if p.kind not in ("hll", "theta"):
                         continue
                     vals = p.build_values(ctx)
-                    am = p.build_mask(ctx)
+                    am = p.build_mask(ctx, cse=cse)
                     m = base if am is None else (base & am)
                     if p.kind == "hll":
                         out[p.spec.name] = HLL.hll_registers(
@@ -604,9 +668,20 @@ class SharedScanCoalescer:
         with self._lock:
             self.wlm_handoffs += 1
 
+    def note_solo_cse(self, saved: int, total: int) -> None:
+        """Called by the solo executor path's plan-time CSE accounting
+        (one query's own tree repeating sub-predicates)."""
+        with self._lock:
+            self.fusion_solo_evals_saved += int(saved)
+            self.fusion_solo_evals_total += int(total)
+
     # -- observability ---------------------------------------------------------
     def stats(self) -> dict:
         with self._lock:
+            total = self.fusion_predicate_evals_total \
+                + self.fusion_solo_evals_total
+            saved = self.fusion_predicate_evals_saved \
+                + self.fusion_solo_evals_saved
             return {"enabled": self.enabled(),
                     "groups_coalesced": self.groups_coalesced,
                     "solo_groups": self.solo_groups,
@@ -614,4 +689,19 @@ class SharedScanCoalescer:
                     "fallbacks": self.fallbacks,
                     "binds_saved_bytes": self.binds_saved_bytes,
                     "dispatches_saved": self.dispatches_saved,
-                    "wlm_handoffs": self.wlm_handoffs}
+                    "wlm_handoffs": self.wlm_handoffs,
+                    "fusion": {
+                        "groups": self.fusion_groups,
+                        "plan_fallbacks": self.fusion_fallbacks,
+                        "shared_predicates":
+                            self.fusion_shared_predicates,
+                        "predicate_evals_saved":
+                            self.fusion_predicate_evals_saved,
+                        "predicate_evals_total":
+                            self.fusion_predicate_evals_total,
+                        "column_streams_saved":
+                            self.fusion_column_streams_saved,
+                        "solo_evals_saved": self.fusion_solo_evals_saved,
+                        "solo_evals_total": self.fusion_solo_evals_total,
+                        "cse_hit_rate": round(saved / total, 4)
+                        if total else 0.0}}

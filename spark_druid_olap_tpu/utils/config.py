@@ -375,6 +375,24 @@ SHAREDSCAN_MAX_QUERIES = _entry(
     "Constituent cap per coalesced group: the hold window closes early "
     "at this size, bounding fused-program width (compile cost and "
     "output-buffer size grow with every extra query lane).")
+SHAREDSCAN_FUSION_ENABLED = _entry(
+    "sdot.sharedscan.fusion.enabled", True,
+    "Cross-lane fusion planner (planner/fusion.py): canonicalize every "
+    "lane's filter tree into a shared sub-expression DAG, lower each "
+    "distinct sub-predicate ONCE per fused program (shared masks first, "
+    "then per-lane base = row_valid & shared & residual), and thread "
+    "the same CSE cache through the solo dense/hashed cores for "
+    "queries whose own tree repeats sub-predicates. Bit-identical "
+    "answers by construction (masks combine with exact bool ops); any "
+    "planning error falls back to unfused lowering. Folded into every "
+    "affected compile signature, so toggling recompiles rather than "
+    "reusing a mismatched program.")
+SHAREDSCAN_FUSION_MAX_NODES = _entry(
+    "sdot.sharedscan.fusion.max.nodes", 512,
+    "Planner cost guard: per-group cap on distinct predicate nodes the "
+    "fusion analysis will canonicalize. A group over the cap plans "
+    "unfused (the host-side DAG walk is O(nodes) per execution and "
+    "must stay negligible next to the dispatch floor). 0 = uncapped.")
 # --- durable segment persistence (persist/) -----------------------------------
 PERSIST_PATH = _entry(
     "sdot.persist.path", "",

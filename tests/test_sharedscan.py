@@ -397,3 +397,203 @@ def test_coalesced_batch_saves_dispatches_and_binds(store):
     assert st["dispatches_saved"] >= len(specs) - 1, st
     # the union bind is strictly smaller than four per-query binds
     assert st["binds_saved_bytes"] > 0, st
+
+
+# -- cross-lane fusion planner (predicate CSE) --------------------------------
+
+# the canned 4-lane dashboard storm: every lane carries the same global
+# selector conjunct (a dashboard's tenant/time filter), plus a private
+# residual — the planner must lower `status = 'O'` exactly once
+_SHARED = S.SelectorFilter("status", "O")
+
+
+def _storm_batch():
+    return [
+        S.GroupByQuerySpec("sales", (S.DimensionSpec("region", "region"),),
+                           AGGS, filter=_SHARED),
+        S.GroupByQuerySpec(
+            "sales", (S.DimensionSpec("flag", "flag"),), AGGS,
+            filter=S.LogicalFilter("and", (
+                _SHARED, S.SelectorFilter("region", "east")))),
+        S.TimeseriesQuerySpec(
+            "sales", AGGS, granularity=S.Granularity("month"),
+            filter=S.LogicalFilter("and", (
+                _SHARED,
+                S.BoundFilter("qty", lower=10, numeric=True)))),
+        S.TopNQuerySpec("sales", S.DimensionSpec("product", "product"),
+                        "revenue", 7, AGGS, filter=_SHARED),
+    ]
+
+
+def _fusion_delta(eng, fn):
+    """Run ``fn`` and return the delta of the engine's fusion counters."""
+    f0 = eng.sharedscan.stats()["fusion"]
+    fn()
+    f1 = eng.sharedscan.stats()["fusion"]
+    return {k: f1[k] - f0[k] for k in f1 if k not in ("cse_hit_rate",)}
+
+
+def test_fusion_identical_subfilters_across_lanes(store):
+    """Identical sub-filters across lanes must evaluate once: the storm
+    coalesces, answers match sequential exactly, and the planner reports
+    cross-lane sharing on deterministic counters."""
+    eng = _engine(store)
+    d = _fusion_delta(
+        eng, lambda: _diff(eng, _ref_engine(store), _storm_batch(),
+                           min_coalesced=3))
+    assert d["groups"] >= 1, d
+    assert d["plan_fallbacks"] == 0, d
+    assert d["shared_predicates"] > 0, d
+    assert d["predicate_evals_saved"] > 0, d
+
+
+def test_fusion_partially_overlapping_trees(store):
+    """Partially-overlapping AND trees (one shared conjunct, different
+    residuals, one lane with commuted operand order) unify on canonical
+    keys and stay bit-identical to sequential execution."""
+    eng = _engine(store)
+    shared = S.BoundFilter("qty", lower=5, upper=40, numeric=True)
+    east = S.SelectorFilter("region", "east")
+    specs = [
+        S.GroupByQuerySpec("sales", (S.DimensionSpec("region", "region"),),
+                           AGGS, filter=S.LogicalFilter("and", (shared,
+                                                                east))),
+        # commuted operand order: same canonical key as the lane above
+        S.GroupByQuerySpec("sales", (S.DimensionSpec("flag", "flag"),),
+                           AGGS, filter=S.LogicalFilter("and", (
+                               S.SelectorFilter("status", "F"), shared))),
+        S.TimeseriesQuerySpec("sales", AGGS,
+                              granularity=S.Granularity("month"),
+                              filter=shared),
+    ]
+    d = _fusion_delta(
+        eng, lambda: _diff(eng, _ref_engine(store), specs, min_coalesced=2))
+    assert d["shared_predicates"] > 0, d
+    assert d["predicate_evals_saved"] > 0, d
+
+
+def test_fusion_not_or_nesting(store):
+    """NOT/OR nesting: shared sub-predicates inside negations and
+    disjunctions still unify (OR operands sort canonically), and the
+    all-true short-circuit semantics survive CSE."""
+    eng = _engine(store)
+    ew = S.LogicalFilter("or", (S.SelectorFilter("region", "east"),
+                                S.SelectorFilter("region", "west")))
+    we = S.LogicalFilter("or", (S.SelectorFilter("region", "west"),
+                                S.SelectorFilter("region", "east")))
+    specs = [
+        S.GroupByQuerySpec("sales", (S.DimensionSpec("flag", "flag"),),
+                           AGGS, filter=S.LogicalFilter("not", (ew,))),
+        # commuted OR: canonically identical to `ew`
+        S.GroupByQuerySpec("sales", (S.DimensionSpec("status", "status"),),
+                           AGGS, filter=we),
+        S.TimeseriesQuerySpec(
+            "sales", AGGS, granularity=S.Granularity("month"),
+            filter=S.LogicalFilter("and", (
+                ew, S.LogicalFilter("not", (
+                    S.SelectorFilter("status", "F"),))))),
+    ]
+    d = _fusion_delta(
+        eng, lambda: _diff(eng, _ref_engine(store), specs, min_coalesced=2))
+    assert d["shared_predicates"] > 0, d
+    assert d["predicate_evals_saved"] > 0, d
+
+
+def test_fusion_dense_cap_fallback_parity(store):
+    """With fusion on, a lane over the dense key cap still falls back to
+    its own solo execution (routing tiers never change) while the
+    remaining lanes fuse WITH cross-lane CSE — all answers exact."""
+    eng = _engine(store, **{"sdot.engine.groupby.dense.max.keys": 8})
+    specs = [
+        S.GroupByQuerySpec("sales", (S.DimensionSpec("flag", "flag"),),
+                           AGGS, filter=_SHARED),
+        S.GroupByQuerySpec("sales", (S.DimensionSpec("status", "status"),),
+                           AGGS, filter=S.LogicalFilter("and", (
+                               _SHARED, S.BoundFilter("qty", lower=3,
+                                                      numeric=True)))),
+        # product (50 values) exceeds the cap -> hashed tier, solo
+        S.GroupByQuerySpec("sales", (S.DimensionSpec("product", "product"),),
+                           AGGS, filter=_SHARED),
+    ]
+    ref = [_ref_engine(store).execute(q).to_pandas() for q in specs]
+    f0 = eng.sharedscan.stats()["fusion"]
+    res, errs, _ = _run_concurrent(eng, specs)
+    assert not any(errs), [e for e in errs if e]
+    for got, want in zip(res, ref):
+        assert_frames_equal(got, want)
+    st = eng.sharedscan.stats()
+    assert st["fallbacks"] >= 1, st
+    assert st["fusion"]["shared_predicates"] - f0["shared_predicates"] > 0
+    assert st["fusion"]["plan_fallbacks"] == f0["plan_fallbacks"]
+
+
+def test_fusion_compile_cache_key_isolation(store):
+    """Two storms that differ ONLY in a shared sub-predicate must compile
+    two distinct fused programs (the fusion plan folds into the cache
+    key) and each must return its own correct answers."""
+    eng = _engine(store)
+
+    def storm(shared):
+        return [
+            S.GroupByQuerySpec("sales",
+                               (S.DimensionSpec("region", "region"),),
+                               AGGS, filter=shared),
+            S.TimeseriesQuerySpec(
+                "sales", AGGS, granularity=S.Granularity("month"),
+                filter=S.LogicalFilter("and", (
+                    shared, S.SelectorFilter("region", "west")))),
+        ]
+
+    specs_o = storm(S.SelectorFilter("status", "O"))
+    specs_f = storm(S.SelectorFilter("status", "F"))
+    _diff(eng, _ref_engine(store), specs_o, min_coalesced=2)
+    _diff(eng, _ref_engine(store), specs_f, min_coalesced=2)
+    n_fused = sum(1 for sig in eng._programs if sig and sig[0] == "aggmulti")
+    assert n_fused == 2, (
+        "storms differing only in a shared sub-predicate must not share "
+        f"a fused program (got {n_fused})")
+
+
+def test_fusion_off_matches_on(store):
+    """Kill switch differential: the same storm with the fusion planner
+    disabled (pre-fusion fused program) returns identical answers, and
+    the two configurations compile under distinct program keys."""
+    eng_on = _engine(store)
+    eng_off = _engine(store,
+                      **{"sdot.sharedscan.fusion.enabled": False})
+    specs = _storm_batch()
+    ref = [_ref_engine(store).execute(q).to_pandas() for q in specs]
+    for eng in (eng_on, eng_off):
+        res, errs, _ = _run_concurrent(eng, specs)
+        assert not any(errs), [e for e in errs if e]
+        for got, want in zip(res, ref):
+            assert_frames_equal(got, want)
+    d = eng_off.sharedscan.stats()["fusion"]
+    assert d["predicate_evals_saved"] == 0, d
+    assert d["column_streams_saved"] == 0, d
+
+
+def test_fusion_smoke_canned_storm(store):
+    """The CI deterministic-counter smoke (tier-1, CPU): the canned
+    4-lane storm must report column_streams_saved > 0 (each union column
+    streams once instead of once per lane) with exact-answer parity, and
+    every fused constituent must surface the per-group fusion counters
+    in its own stats."""
+    eng = _engine(store)
+    specs = _storm_batch()
+    ref = [_ref_engine(store).execute(q).to_pandas() for q in specs]
+    f0 = eng.sharedscan.stats()["fusion"]
+    res, errs, stats = _run_concurrent(eng, specs, collect_stats=True)
+    assert not any(errs), [e for e in errs if e]
+    for got, want in zip(res, ref):
+        assert_frames_equal(got, want)
+    f1 = eng.sharedscan.stats()["fusion"]
+    assert f1["column_streams_saved"] - f0["column_streams_saved"] > 0, f1
+    assert f1["predicate_evals_saved"] - f0["predicate_evals_saved"] > 0, f1
+    fused = [s["sharedscan"]["fusion"] for s in stats
+             if s.get("sharedscan")]
+    assert fused, "no constituent reported sharedscan stats"
+    for fc in fused:
+        assert fc is not None
+        assert fc["column_streams_saved"] > 0, fc
+        assert fc["shared_predicates"] > 0, fc
