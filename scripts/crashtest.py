@@ -175,6 +175,237 @@ def run_round(rnd, args, tmpdir):
             "wal_records": info.get("wal_records")}
 
 
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_ready(port, timeout=240.0, proc=None):
+    import urllib.request
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(f"historical exited rc={proc.returncode}")
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/readyz", timeout=2) as r:
+                if r.status == 200:
+                    return
+        except Exception:   # noqa: BLE001
+            pass
+        time.sleep(0.1)
+    raise RuntimeError(f"historical on :{port} never became ready")
+
+
+def _spawn_historical(root, nodes, node_id):
+    return subprocess.Popen(
+        [sys.executable, "-m", "spark_druid_olap_tpu.cluster",
+         "historical", "--persist", root, "--nodes", nodes,
+         "--node-id", str(node_id),
+         "--set", "sdot.cache.enabled=false",
+         "--set", "sdot.plan.cache.enabled=false"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+CLUSTER_QUERIES = [
+    "select region, sum(qty) as q, count(*) as n from sales "
+    "group by region order by region",
+    "select product, sum(price) as p, count(*) as n from sales "
+    "group by product order by product",
+    "select count(*) as n from sales where qty >= 500",
+]
+
+
+def _close(a, b) -> bool:
+    """Shard partials merge in a different order than a single-process
+    sum, so float aggregates may differ in the last ulps."""
+    import numpy as np
+    if list(a.columns) != list(b.columns) or len(a) != len(b):
+        return False
+    for c in a.columns:
+        av, bv = a[c].to_numpy(), b[c].to_numpy()
+        if av.dtype.kind in "if" and bv.dtype.kind in "if":
+            if not np.allclose(av.astype(float), bv.astype(float),
+                               rtol=1e-9, atol=1e-9, equal_nan=True):
+                return False
+        elif not (av == bv).all():
+            return False
+    return True
+
+
+def run_cluster_mode(args):
+    """kill -9 one historical mid-storm under a seeded FaultPlan.
+
+    A two-node cluster serves a checkpointed datasource while a broker
+    storms the query mix (slow replies + corrupt frames injected from
+    --seed) AND streams acked batches into a WAL-backed datasource with
+    seeded torn appends. One historical is SIGKILLed mid-storm; every
+    reply before, during, and after the kill must match the
+    single-process reference, the node must rejoin after a restart and
+    serve exact answers again, and recovery over the same persist root
+    must see every acknowledged commit and none of the torn ones."""
+    import tempfile
+    import threading
+    import spark_druid_olap_tpu as sdot
+
+    S = args.seed
+    tmp = tempfile.mkdtemp(prefix="sdot-crashtest-cluster-")
+    root = os.path.join(tmp, "store")
+    caches_off = {"sdot.cache.enabled": False,
+                  "sdot.plan.cache.enabled": False}
+    procs = {}
+    broker = single = None
+    plan = json.dumps({"seed": S, "rules": [
+        {"site": "rpc.request", "action": "delay", "arg": 0.01,
+         "p": 0.25},
+        {"site": "rpc.response", "action": "flip", "p": 0.05},
+        {"site": "wal.append", "action": "truncate", "arg": 9,
+         "p": 0.25, "scope": "torn"}]})
+    try:
+        print(f"[cluster] seed={S}: building deep storage ...")
+        single = sdot.Context({"sdot.persist.path": root, **caches_off})
+        single.ingest_dataframe("sales", make_batch(0, 120_000, seed=S),
+                                time_column="ts", target_rows=8192)
+        single.checkpoint()
+        want = {q: single.sql(q).to_pandas() for q in CLUSTER_QUERIES}
+
+        ports = [_free_port(), _free_port()]
+        nodes = ",".join(f"127.0.0.1:{p}" for p in ports)
+        for i in range(2):
+            procs[i] = _spawn_historical(root, nodes, i)
+        for i in range(2):
+            _wait_ready(ports[i], proc=procs[i])
+        print(f"[cluster] 2 historicals ready on {ports}")
+
+        broker = sdot.Context({
+            "sdot.persist.path": root, "sdot.cluster.nodes": nodes,
+            "sdot.cluster.role": "broker",
+            "sdot.cluster.probe.interval.seconds": 0.2,
+            "sdot.cluster.retry.backoff.start.seconds": 0.01,
+            "sdot.fault.plan": plan, **caches_off})
+        for q in CLUSTER_QUERIES:       # warm + baseline differential
+            got = broker.sql(q).to_pandas()
+            if not _close(got, want[q]):
+                print(f"[cluster] WARMUP MISMATCH: {q}")
+                sys.exit(1)
+
+        stop = threading.Event()
+        mism, errs, served = [], [0], [0]
+        lock = threading.Lock()
+
+        def storm(tid):
+            i = tid
+            while not stop.is_set():
+                q = CLUSTER_QUERIES[i % len(CLUSTER_QUERIES)]
+                i += 1
+                try:
+                    got = broker.sql(q).to_pandas()
+                except Exception as e:      # noqa: BLE001
+                    with lock:
+                        errs[0] += 1
+                    print(f"  [storm] ERROR {type(e).__name__}: {e}")
+                    continue
+                with lock:
+                    served[0] += 1
+                    if not _close(got, want[q]):
+                        mism.append(q)
+
+        threads = [threading.Thread(target=storm, args=(t,), daemon=True)
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+
+        # streaming commits ride through the whole storm: acked batches
+        # must survive recovery, seeded torn appends must never ack
+        acked, torn = [], []
+        inj = broker.engine.fault
+        tok = inj.begin_scope("torn")
+        try:
+            for i in range(args.batches):
+                if i == args.batches // 3:
+                    victim = 1
+                    print(f"[cluster] kill -9 historical {victim} "
+                          f"mid-storm")
+                    os.kill(procs[victim].pid, signal.SIGKILL)
+                    procs[victim].wait()
+                try:
+                    broker.stream_ingest(
+                        "events", make_batch(i, args.rows), **INGEST)
+                    acked.append(i)
+                except OSError:
+                    torn.append(i)
+                time.sleep(0.05)
+        finally:
+            inj.end_scope(tok)
+
+        print(f"[cluster] restarting historical 1 (rejoin) ...")
+        procs[1] = _spawn_historical(root, nodes, 1)
+        _wait_ready(ports[1], proc=procs[1])
+        time.sleep(0.6)             # a couple of prober ticks to re-mark
+        rejoined = {q: broker.sql(q).to_pandas() for q in CLUSTER_QUERIES}
+        stop.set()
+        for t in threads:
+            t.join()
+
+        c = dict(broker.cluster.counters)
+        rejoin_ok = all(_close(rejoined[q], want[q])
+                        for q in CLUSTER_QUERIES)
+        broker.close()
+        broker = None
+
+        # recovery differential: a fresh context over the same root must
+        # hold exactly the acked batches
+        rec = sdot.Context({"sdot.persist.path": root, **caches_off})
+        n_rows = int(rec.sql("select count(*) as n from events")
+                     .data["n"][0]) if acked else 0
+        ref = sdot.Context()
+        for i in acked:
+            ref.stream_ingest("events", make_batch(i, args.rows), **INGEST)
+        rec_mism = [q for q in (QUERIES if acked else [])
+                    if not rec.sql(q).to_pandas().equals(
+                        ref.sql(q).to_pandas())]
+        rec.close()
+        ref.close()
+
+        out = {"mode": "crashtest-cluster", "seed": S,
+               "storm_served": served[0], "storm_errors": errs[0],
+               "storm_mismatches": len(mism), "acked": len(acked),
+               "torn": len(torn), "recovered_rows": n_rows,
+               "rejoin_exact": rejoin_ok,
+               "failovers": c.get("failovers", 0),
+               "wire_corrupt": c.get("wire_corrupt", 0),
+               "recovery_mismatches": rec_mism}
+        print(json.dumps(out))
+        ok = (not mism and errs[0] == 0 and rejoin_ok and not rec_mism
+              and n_rows == len(acked) * args.rows
+              and torn and acked
+              and c.get("failovers", 0) >= 1)
+        if not ok:
+            print("CLUSTER CRASHTEST FAILED")
+            sys.exit(1)
+        print(f"OK: {served[0]} storm replies exact through a kill -9 + "
+              f"rejoin, {len(acked)} acked commits recovered, "
+              f"{len(torn)} torn appends never acked")
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        for c_ in (broker, single):
+            if c_ is not None:
+                try:
+                    c_.close()
+                except Exception:   # noqa: BLE001
+                    pass
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=3)
@@ -185,6 +416,15 @@ def main():
     ap.add_argument("--warmup-s", type=float, default=4.0,
                     help="minimum child lifetime before the kill (child "
                     "startup = imports + jax init)")
+    ap.add_argument("--cluster", action="store_true",
+                    help="kill -9 one historical subprocess mid-storm "
+                    "under a seeded FaultPlan (slow replies, corrupt "
+                    "frames, torn WAL appends): every broker reply must "
+                    "match the single-process reference through the kill "
+                    "and after the node rejoins, and recovery must hold "
+                    "exactly the acknowledged commits")
+    ap.add_argument("--seed", type=int, default=42,
+                    help="FaultPlan seed for --cluster")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--persist-root", help=argparse.SUPPRESS)
     ap.add_argument("--marker", help=argparse.SUPPRESS)
@@ -196,6 +436,10 @@ def main():
     import jax
     jax.config.update("jax_platforms", "cpu")
     sys.path.insert(0, ROOT)
+    if args.cluster:
+        if args.batches == 200:
+            args.batches = 60   # the cluster storm paces ingest at 50ms
+        return run_cluster_mode(args)
     import tempfile
     results = []
     with tempfile.TemporaryDirectory(prefix="sdot-crashtest-") as tmpdir:

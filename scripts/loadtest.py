@@ -832,6 +832,450 @@ def _wait_ready(port, timeout=240.0, proc=None):
     raise RuntimeError(f"historical on :{port} not ready in {timeout}s")
 
 
+CHAOS_QUERIES = [
+    "select region, sum(qty) as q, count(*) as c from sales "
+    "group by region order by region",
+    "select product, sum(price) as rev from sales "
+    "group by product order by rev desc limit 5",
+    "select region, flag, count(*) as c from sales "
+    "group by region, flag order by region, flag",
+    "select count(*) as c from sales where qty >= 25 and status = 'O'",
+]
+
+
+def run_chaos(args):
+    """Seeded chaos differential (fault/, docs/CHAOS.md): one FaultPlan
+    derived from --seed drives every leg over an in-process two-node
+    cluster — RPC connection drops, slow replies, corrupt wire frames,
+    historical 500s that trip and then close a circuit breaker, hedged
+    scatter, a replication-1 partial outage, torn WAL appends, a
+    cold-tier CRC flip, WLM shed/starvation, and a threaded mixed storm.
+
+    Every strict-mode reply is differentially checked against a
+    single-process reference (byte-exact up to float ulps); the degraded
+    leg must match the reference RESTRICTED to the surviving shards and
+    carry exact ``missing_shards``/coverage. The JSON report ends with a
+    replay digest computed only from seed-deterministic quantities
+    (count-rule fire totals, sequential p-rule draws, breaker
+    transitions, coverage annotations, the torn-batch set): two runs
+    with the same --seed must print the same digest."""
+    import hashlib
+    import os
+    import shutil
+    import tempfile
+    sys.path.insert(0, ".")
+    import pandas as pd
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.cluster.historical import HistoricalNode
+    from spark_druid_olap_tpu.persist import snapshot as SNAP
+    from spark_druid_olap_tpu.segment.store import slice_segments
+    from spark_druid_olap_tpu.wlm.lanes import AdmissionRejected
+
+    S = int(args.seed)
+    # scoped rules: a site only misbehaves while its leg holds the scope
+    # open, so the baseline/warmup traffic sees a healthy cluster.
+    # Cluster legs use count rules (exact totals even though scatter
+    # legs race); the WLM rules are evaluated once per query in call
+    # order, so their p draws replay exactly too.
+    plan = json.dumps({"seed": S, "rules": [
+        {"site": "rpc.connect", "match": "node:0", "action": "error",
+         "arg": "ConnectionRefusedError", "count": 3, "scope": "rpc_drop"},
+        {"site": "rpc.request", "action": "delay", "arg": 0.02,
+         "count": 4, "scope": "rpc_delay"},
+        {"site": "rpc.response", "action": "flip", "count": 3,
+         "scope": "rpc_corrupt"},
+        {"site": "rpc.request", "action": "delay", "arg": 0.4,
+         "count": 2, "scope": "hedge"},
+        {"site": "wlm.admit", "action": "error", "arg": "LaneFullError",
+         "p": 0.15, "scope": "wlm"},
+        {"site": "wlm.admit", "action": "delay", "arg": 0.005, "p": 0.3,
+         "scope": "wlm"},
+        {"site": "rpc.connect", "match": "node:0", "action": "error",
+         "arg": "ConnectionRefusedError", "p": 0.1, "scope": "storm"},
+        {"site": "rpc.request", "action": "delay", "arg": 0.005,
+         "p": 0.2, "scope": "storm"},
+        {"site": "rpc.response", "action": "flip", "p": 0.05,
+         "scope": "storm"},
+    ]})
+    degr_plan = json.dumps({"seed": S ^ 0x1D, "rules": [
+        {"site": "rpc.connect", "match": "node:1", "action": "error",
+         "arg": "ConnectionRefusedError", "scope": "degraded"}]})
+    hist_plan = json.dumps({"seed": S ^ 0xB5, "rules": [
+        {"site": "hist.handle", "action": "error", "scope": "hist500"}]})
+
+    caches_off = {"sdot.cache.enabled": False,
+                  "sdot.plan.cache.enabled": False}
+    root = tempfile.mkdtemp(prefix="sdot-chaos-")
+    hists, ctxs = [], []
+    legs, digest_src, failures = {}, [], []
+
+    def check(name, ok_bool, detail=""):
+        if not ok_bool:
+            failures.append(name)
+            print(f"  [FAIL] {name} {detail}")
+
+    def fired_delta(inj, before):
+        after = inj.stats()["by_site"] if inj else {}
+        return {k: v - before.get(k, 0) for k, v in after.items()
+                if v - before.get(k, 0)}
+
+    def leg_seq(name, broker, want, scopes=(), n_iters=12, allow=()):
+        """Sequential dashboard rounds with a per-reply differential."""
+        inj = broker.engine.fault
+        before = dict(inj.stats()["by_site"]) if inj else {}
+        toks = [inj.begin_scope(s) for s in scopes]
+        mism = errs = shed = 0
+        lats = []
+        try:
+            for i in range(n_iters):
+                q = CHAOS_QUERIES[i % len(CHAOS_QUERIES)]
+                t0 = time.perf_counter()
+                try:
+                    got = broker.sql(q).to_pandas()
+                except allow:
+                    shed += 1
+                    continue
+                except Exception as e:      # noqa: BLE001
+                    errs += 1
+                    print(f"  [{name}] ERROR {type(e).__name__}: {e}")
+                    continue
+                lats.append((time.perf_counter() - t0) * 1000)
+                if not _frames_close(got, want[q]):
+                    mism += 1
+                    print(f"  [{name}] MISMATCH: {q[:60]}")
+        finally:
+            for t in reversed(toks):
+                inj.end_scope(t)
+        fired = fired_delta(inj, before)
+        leg = {"n": n_iters, "mismatches": mism, "errors": errs,
+               "shed": shed, "fired": fired,
+               "p50_ms": round(float(np.percentile(lats, 50)), 1)
+               if lats else None}
+        legs[name] = leg
+        digest_src.append([name, sorted(fired.items()), mism, shed])
+        check(name, mism == 0 and errs == 0)
+        print(f"  [{name}] {json.dumps(leg)}")
+        return leg
+
+    try:
+        print(f"[chaos] seed={S}: building deep storage ...")
+        single = sdot.Context({"sdot.persist.path": root, **caches_off})
+        ctxs.append(single)
+        single.ingest_dataframe("sales", _synthetic_sales(150_000),
+                                time_column="ts", target_rows=8192)
+        single.checkpoint()
+
+        ports = [_free_port() for _ in range(4)]
+        nodes_r2 = ",".join(f"127.0.0.1:{p}" for p in ports[:2])
+        nodes_r1 = ",".join(f"127.0.0.1:{p}" for p in ports[2:])
+        shards = {"sdot.cluster.shards": 4}
+        # two rings over the same deep storage: replication 2 for the
+        # strict legs (every fault is survivable), replication 1 for the
+        # degraded leg (losing a node loses exactly its shards)
+        hists += [HistoricalNode(
+            {"sdot.persist.path": root, "sdot.cluster.nodes": nodes_r2,
+             "sdot.cluster.replication": 2, "sdot.fault.plan": hist_plan,
+             **shards, **caches_off}, node_id=i).start()
+            for i in range(2)]
+        hists += [HistoricalNode(
+            {"sdot.persist.path": root, "sdot.cluster.nodes": nodes_r1,
+             "sdot.cluster.replication": 1,
+             **shards, **caches_off}, node_id=i).start()
+            for i in range(2)]
+
+        def mk_broker(nodes, replication, plan_text, **over):
+            cfg = {
+                "sdot.persist.path": root, "sdot.cluster.nodes": nodes,
+                "sdot.cluster.role": "broker",
+                "sdot.cluster.replication": replication,
+                "sdot.cluster.probe.interval.seconds": 0,
+                "sdot.cluster.retry.backoff.start.seconds": 0.01,
+                "sdot.cluster.retry.backoff.cap.seconds": 0.05,
+                "sdot.cluster.scatter.threads": 16,
+                "sdot.fault.plan": plan_text, **shards, **caches_off}
+            cfg.update(over)
+            ctx = sdot.Context(cfg)
+            ctxs.append(ctx)
+            return ctx
+
+        # strict-fault broker: breakers/hedging OFF so count-rule fire
+        # totals depend only on the plan, not on breaker skips
+        broker = mk_broker(nodes_r2, 2, plan,
+                           **{"sdot.cluster.breaker.failures": 0})
+        # breaker/hedge broker: same plan text, its own injector
+        broker_hb = mk_broker(nodes_r2, 2, plan, **{
+            "sdot.cluster.breaker.failures": 2,
+            "sdot.cluster.breaker.cooldown.seconds": 0.05,
+            "sdot.cluster.hedge.enabled": True,
+            "sdot.cluster.hedge.after.ms": 100})
+        broker_r1 = mk_broker(nodes_r1, 1, degr_plan, **{
+            "sdot.cluster.partial.results": True,
+            "sdot.cluster.retry.tries": 1})
+
+        want = {}
+        for q in CHAOS_QUERIES:            # warm + baseline differential
+            want[q] = single.sql(q).to_pandas()
+            for b in (broker, broker_hb, broker_r1):
+                if not _frames_close(b.sql(q).to_pandas(), want[q]):
+                    print(f"[chaos] WARMUP MISMATCH: {q}")
+                    sys.exit(1)
+
+        f1 = hists[1].ctx.engine.fault
+
+        def heal_node0():
+            # a refused connect marks node 0 down, and a downed node is
+            # only re-attempted when the healthy one fails — 500 node 1
+            # for one query so the chain falls through to node 0, whose
+            # success marks it back up
+            with f1.scope("hist500"):
+                got = broker.sql(CHAOS_QUERIES[0]).to_pandas()
+            check("heal_node0", _frames_close(got, want[CHAOS_QUERIES[0]]))
+
+        print("[chaos] strict legs (every reply differentially checked)")
+        leg_seq("baseline", broker, want)
+        # drop leg: three drop -> failover -> heal rounds, one refused
+        # connect each (the down-mark shields node 0 for the rest of a
+        # round), so the count rule's fire total is exactly 3
+        inj0 = broker.engine.fault
+        drop_before = dict(inj0.stats()["by_site"])
+        fo0 = broker.cluster.counters["failovers"]
+        mism_drop = 0
+        for rnd in range(3):
+            with inj0.scope("rpc_drop"):
+                for q in CHAOS_QUERIES:
+                    if not _frames_close(broker.sql(q).to_pandas(),
+                                         want[q]):
+                        mism_drop += 1
+                        print(f"  [rpc_drop] MISMATCH: {q[:60]}")
+            heal_node0()
+        drop_fired = fired_delta(inj0, drop_before)
+        legs["rpc_drop"] = {
+            "n": 3 * len(CHAOS_QUERIES), "mismatches": mism_drop,
+            "errors": 0, "fired": {"rpc.connect":
+                                   drop_fired.get("rpc.connect", 0)},
+            "failovers": broker.cluster.counters["failovers"] - fo0}
+        digest_src.append(["rpc_drop",
+                           drop_fired.get("rpc.connect", 0), mism_drop])
+        check("rpc_drop", mism_drop == 0
+              and drop_fired.get("rpc.connect", 0) == 3
+              and broker.cluster.counters["failovers"] - fo0 >= 3,
+              json.dumps(legs["rpc_drop"]))
+        print(f"  [rpc_drop] {json.dumps(legs['rpc_drop'])}")
+        c0 = dict(broker.cluster.counters)
+        leg_seq("rpc_delay", broker, want, scopes=("rpc_delay",))
+        leg_seq("rpc_corrupt", broker, want, scopes=("rpc_corrupt",))
+        corrupt = broker.cluster.counters["wire_corrupt"] \
+            - c0["wire_corrupt"]
+        check("rpc_corrupt.crc", corrupt == 3, f"wire_corrupt={corrupt}")
+        leg_seq("wlm", broker, want, scopes=("wlm",), n_iters=24,
+                allow=(AdmissionRejected,))
+        check("wlm.exercised",
+              legs["wlm"]["fired"].get("wlm.admit", 0) >= 1)
+
+        # breaker leg: node 0 answers every subquery 500 until its
+        # breaker opens; answers stay exact via node 1. Past the
+        # cooldown the half-open probe closes it again.
+        f0 = hists[0].ctx.engine.fault
+        with f0.scope("hist500"):
+            leg_seq("breaker_500s", broker_hb, want, n_iters=6)
+        snap = broker_hb.cluster.breakers.snapshot()
+        check("breaker.opened",
+              snap["states"][0] == "open" and snap["opens"] == 1,
+              json.dumps(snap))
+        time.sleep(0.08)
+        # past the cooldown, fail node 1 so the chain falls through to
+        # node 0's cooled breaker: its single half-open probe succeeds
+        with f1.scope("hist500"):
+            leg_seq("breaker_recovery", broker_hb, want, n_iters=4)
+        snap2 = broker_hb.cluster.breakers.snapshot()
+        check("breaker.closed",
+              snap2["states"][0] == "closed" and snap2["closes"] >= 1,
+              json.dumps(snap2))
+        digest_src.append(["breaker", snap2["opens"], snap2["closes"],
+                           snap2["states"]])
+
+        h0 = dict(broker_hb.cluster.counters)
+        leg_seq("hedge", broker_hb, want, scopes=("hedge",), n_iters=4)
+        hc = broker_hb.cluster.counters
+        check("hedge.launched",
+              hc["hedges_launched"] - h0["hedges_launched"] >= 1
+              and hc["hedges_won"] - h0["hedges_won"] >= 1)
+        legs["hedge"]["hedges_launched"] = \
+            hc["hedges_launched"] - h0["hedges_launched"]
+        legs["hedge"]["hedges_won"] = hc["hedges_won"] - h0["hedges_won"]
+
+        # degraded leg: node 1 of the replication-1 ring is down, so
+        # exactly its shards go missing. The reference is the full
+        # datasource RESTRICTED to the surviving shards' segments.
+        print("[chaos] degraded leg (partial results, replication 1)")
+        dp = broker_r1.cluster.plan.datasources["sales"]
+        lost = sorted(sh.index for sh in dp.shards if sh.owners == (1,))
+        kept = [sh for sh in dp.shards if sh.owners != (1,)]
+        kept_rows = sum(sh.rows for sh in kept)
+        surv_idx = sorted(i for sh in kept for i in sh.segment_indexes)
+        ref = sdot.Context(caches_off)
+        ctxs.append(ref)
+        ref.store.restore(
+            slice_segments(single.store.get("sales"), surv_idx,
+                           name="sales"), ingest_version=1)
+        inj1 = broker_r1.engine.fault
+        deg_ann, mism = [], 0
+        for trial in range(2):             # same annotation both times
+            with inj1.scope("degraded"):
+                for q in CHAOS_QUERIES:
+                    r = broker_r1.sql(q)
+                    if r.degraded is None or not _frames_close(
+                            r.to_pandas(), ref.sql(q).to_pandas()):
+                        mism += 1
+                        print(f"  [degraded] MISMATCH: {q[:60]}")
+                    if trial == 0:
+                        deg_ann.append(r.degraded)
+        ann_ok = all(
+            d == {"missing_shards": lost, "coverage_rows": kept_rows,
+                  "total_rows": dp.num_rows} for d in deg_ann)
+        check("degraded", mism == 0 and ann_ok and lost and kept,
+              json.dumps(deg_ann[:1]))
+        legs["degraded"] = {
+            "n": 2 * len(CHAOS_QUERIES), "mismatches": mism,
+            "missing_shards": lost, "coverage_rows": kept_rows,
+            "total_rows": dp.num_rows}
+        digest_src.append(["degraded", deg_ann])
+
+        # torn-WAL leg: one guaranteed torn append plus seed-dependent
+        # extras; torn batches are never acked and never resurface
+        print("[chaos] torn-WAL leg")
+        wroot = os.path.join(root, "walleg")
+        wctx = sdot.Context({
+            "sdot.persist.enabled": True, "sdot.persist.path": wroot,
+            "sdot.fault.plan": json.dumps({"seed": S ^ 0xA5, "rules": [
+                {"site": "wal.append", "action": "truncate", "arg": 11,
+                 "count": 1, "after": 2, "scope": "torn"},
+                {"site": "wal.append", "action": "truncate", "arg": 7,
+                 "p": 0.3, "scope": "torn"}]})})
+        acked = []
+        with wctx.engine.fault.scope("torn"):
+            for i in range(14):
+                df = pd.DataFrame({
+                    "t": pd.to_datetime("2024-01-01"),
+                    "k": [f"k{i:02d}"] * 50,
+                    "v": np.arange(i * 50, (i + 1) * 50, dtype=np.int64)})
+                try:
+                    wctx.stream_ingest("events", df, time_column="t")
+                    acked.append(i)
+                except OSError:
+                    pass
+        wctx.close()
+        wctx2 = sdot.Context({"sdot.persist.enabled": True,
+                              "sdot.persist.path": wroot})
+        ctxs.append(wctx2)
+        if acked:
+            n = int(wctx2.sql("select count(*) as n from events")
+                    .data["n"][0])
+            ks = sorted(set(wctx2.sql("select k from events")
+                            .data["k"].tolist()))
+        else:
+            n, ks = 0, []
+        torn = 14 - len(acked)
+        check("torn_wal", torn >= 1 and acked and n == 50 * len(acked)
+              and ks == [f"k{i:02d}" for i in acked],
+              f"acked={acked} recovered_rows={n}")
+        legs["torn_wal"] = {"batches": 14, "torn": torn,
+                            "acked": len(acked), "recovered_rows": n}
+        digest_src.append(["torn_wal", acked])
+
+        # cold-tier CRC leg: a flipped blob quarantines the newest
+        # snapshot version; the retry answers exactly from the older one
+        print("[chaos] cold-tier CRC-flip leg")
+        troot = os.path.join(root, "tierleg")
+        tq = ("select region, sum(qty) as q, count(*) as n from tsales "
+              "group by region order by region")
+        si = dict(time_column="ts",
+                  dimensions=["region", "product", "flag", "status"],
+                  metrics=["qty", "price"])
+        t1 = sdot.Context({"sdot.persist.path": troot, **caches_off})
+        t1.stream_ingest("tsales", _synthetic_sales(20_000), **si)
+        want_t = t1.sql(tq).to_pandas()
+        t1.checkpoint("tsales")
+        t1.stream_ingest("tsales", _synthetic_sales(2_000), **si)
+        t1.checkpoint("tsales")
+        cur = SNAP.current_version(t1.persist._ds_root("tsales"))
+        t1.close()
+        t2 = sdot.Context({
+            "sdot.persist.path": troot, "sdot.tier.enabled": True,
+            "sdot.fault.plan": json.dumps({"seed": S ^ 0x5C, "rules": [
+                {"site": "tier.verify", "action": "flip", "count": 1}]}),
+            **caches_off})
+        ctxs.append(t2)
+        corrupt_seen = False
+        try:
+            t2.sql(tq)
+        except SNAP.SnapshotCorrupt:
+            corrupt_seen = True
+        rep = t2.persist.recovery_report
+        tier_ok = (corrupt_seen and len(rep["quarantined"]) == 1
+                   and rep["quarantined"][0]["version"] == cur
+                   and _frames_close(t2.sql(tq).to_pandas(), want_t)
+                   and t2.persist.tier.counters["crc_failures"] == 1)
+        check("cold_crc", tier_ok, json.dumps(rep["quarantined"]))
+        legs["cold_crc"] = {"quarantined_version": cur,
+                            "recovered_exact": tier_ok}
+        digest_src.append(["cold_crc", cur, corrupt_seen])
+
+        # mixed threaded storm: every survivable fault class at once;
+        # timing-dependent, so it gates on zero mismatches/errors but
+        # stays out of the replay digest
+        storm_s = min(args.duration, 8.0)
+        print(f"[chaos] mixed storm ({min(args.threads, 8)} threads x "
+              f"{storm_s:.0f}s)")
+        mism_storm = [0]
+        mlock = threading.Lock()
+
+        def storm_call(sql):
+            got = broker.sql(sql).to_pandas()
+            if not _frames_close(got, want[sql]):
+                with mlock:
+                    mism_storm[0] += 1
+
+        tok = broker.engine.fault.begin_scope("storm")
+        try:
+            total, errs_s, elapsed, _ = run(
+                lambda: storm_call, CHAOS_QUERIES,
+                min(args.threads, 8), storm_s)
+        finally:
+            broker.engine.fault.end_scope(tok)
+        check("storm", errs_s == 0 and mism_storm[0] == 0,
+              f"errors={errs_s} mismatches={mism_storm[0]}")
+        legs["storm"] = {"n": int(total), "errors": int(errs_s),
+                         "mismatches": mism_storm[0],
+                         "qps": round(total / max(elapsed, 1e-9), 1)}
+
+        digest = hashlib.sha256(
+            json.dumps(digest_src, sort_keys=True).encode()
+        ).hexdigest()[:16]
+        out = {"mode": "chaos", "seed": S, "scenarios": len(legs),
+               "failures": failures, "replay_digest": digest,
+               "legs": legs}
+        print("\n" + json.dumps(out))
+        if failures:
+            print(f"CHAOS FAILURES: {failures}")
+            sys.exit(1)
+        print(f"OK: {len(legs)} chaos scenarios, zero mismatches; "
+              f"replay digest {digest} (stable for --seed {S})")
+        sys.exit(0)
+    finally:
+        for h in hists:
+            try:
+                h.stop()
+            except Exception:   # noqa: BLE001
+                pass
+        for c in ctxs:
+            try:
+                c.close()
+            except Exception:   # noqa: BLE001
+                pass
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_cluster(args):
     """Multi-process distributed-serving benchmark (cluster/): build +
     checkpoint a synthetic store, spawn N historical subprocesses over
@@ -1123,6 +1567,20 @@ def main():
                     "reports fan-out, merge latency, per-node coalesce "
                     "rates, failover detection, and the qps ratio "
                     "(exit 0 needs zero mismatches and >= 2x qps)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="seeded fault-injection differential: an "
+                    "in-process two-node cluster runs the dashboard mix "
+                    "under a FaultPlan derived from --seed (RPC drops/"
+                    "delays/corruption, breaker trips, hedges, a "
+                    "replication-1 partial outage, torn WAL appends, a "
+                    "cold-tier CRC flip, WLM shed); strict replies must "
+                    "match a single-process reference, degraded replies "
+                    "the reference restricted to surviving shards; "
+                    "prints a seed-stable replay digest (exit 1 on any "
+                    "mismatch)")
+    ap.add_argument("--seed", type=int, default=42,
+                    help="FaultPlan seed for --chaos: the same seed "
+                    "replays the same fault schedule and digest")
     ap.add_argument("--wlm", action="store_true",
                     help="in-process overload comparison: interactive + "
                     "heavy query mix at 4x the interactive lane's "
@@ -1133,6 +1591,8 @@ def main():
     if args.threads is None:
         args.threads = 32 if args.cluster else 8
 
+    if args.chaos:
+        return run_chaos(args)
     if args.cluster:
         return run_cluster(args)
     if args.coldstart:

@@ -23,9 +23,21 @@ partials; Theseus's scatter–gather over partition-local operators):
    so distribution is an accelerator, never a new failure mode.
 
 Node health: RPC connection errors / timeouts mark the node down
-reactively; a background prober (GET /readyz) marks nodes down AND back
-up, so a restarted historical resumes primary routing without operator
-action.
+reactively; a background prober (GET /readyz, decorrelated-jitter
+interval) marks nodes down AND back up, so a restarted historical
+resumes primary routing without operator action. On top of reactive
+marks, graceful degradation (docs/CHAOS.md):
+
+- per-node circuit breakers (cluster/breaker.py) skip a node without an
+  RPC after K consecutive failures, with half-open probes after a
+  cooldown;
+- hedged scatter: a subquery that hasn't answered within the hedge
+  delay (fixed, or a latency quantile of recent RPCs) races a duplicate
+  to the next replica and takes the first answer;
+- ``sdot.cluster.partial.results``: when every replica of a shard is
+  unreachable, surviving shards still answer, annotated with
+  ``degraded={missing_shards, coverage_rows}`` — never cached. Strict
+  mode keeps the exact-or-ShardUnavailable contract.
 """
 
 from __future__ import annotations
@@ -33,8 +45,10 @@ from __future__ import annotations
 import dataclasses
 import http.client
 import json
+import random as _random
 import threading
 import time as _time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
@@ -42,13 +56,22 @@ from spark_druid_olap_tpu.cluster import merge as MG
 from spark_druid_olap_tpu.cluster import wire as WIRE
 from spark_druid_olap_tpu.cluster.assign import (
     ClusterPlan, parse_nodes, plan_cluster, shard_name)
+from spark_druid_olap_tpu.cluster.breaker import BreakerBoard
 from spark_druid_olap_tpu.ir import serde as SERDE
 from spark_druid_olap_tpu.ir import spec as S
 from spark_druid_olap_tpu.result import QueryResult
 from spark_druid_olap_tpu.utils.config import (
+    CLUSTER_BREAKER_COOLDOWN_SECONDS,
+    CLUSTER_BREAKER_FAILURES,
+    CLUSTER_HEDGE_AFTER_MS,
+    CLUSTER_HEDGE_ENABLED,
+    CLUSTER_HEDGE_MIN_MS,
+    CLUSTER_HEDGE_QUANTILE,
     CLUSTER_LOCAL_FALLBACK,
     CLUSTER_NODES,
+    CLUSTER_PARTIAL_RESULTS,
     CLUSTER_PROBE_INTERVAL_SECONDS,
+    CLUSTER_PROBE_JITTER,
     CLUSTER_REPLICATION,
     CLUSTER_RETRY_BACKOFF_CAP_SECONDS,
     CLUSTER_RETRY_BACKOFF_START_SECONDS,
@@ -64,6 +87,56 @@ from spark_druid_olap_tpu.utils.retry import backoff
 class ClusterError(RuntimeError):
     """A shard stayed unreachable through every replica and retry pass,
     and local fallback is disabled."""
+
+
+class ShardUnavailable(ClusterError):
+    """Every replica of a shard stayed unreachable. In strict mode this
+    propagates to the caller; in partial-results mode the broker catches
+    it per shard and answers degraded from the survivors."""
+
+
+class _BreakerOpen(Exception):
+    """Internal: the node's circuit breaker refused the attempt."""
+
+    def __init__(self, node_id: int):
+        super().__init__(f"breaker open for node {node_id}")
+        self.node_id = node_id
+
+
+class _HedgeRace:
+    """First-success race between a primary RPC leg and a delayed hedge
+    leg. ``close()`` (sdlint leaks pair) cancels the race so a late
+    loser can neither win nor leak into the next attempt."""
+
+    def __init__(self, total: int):
+        self._lock = threading.Lock()   # leaf — never calls out while held
+        self.done = threading.Event()
+        self.total = total
+        self.finished = 0
+        self.cancelled = False
+        self.winner = None              # (status, body, node_id)
+        self.errors: List[Tuple[int, Exception]] = []
+
+    def settle(self, nid, out, err) -> None:
+        """One leg finished (out), failed (err), or stood down (both
+        None — a hedge whose primary answered inside the delay)."""
+        with self._lock:
+            self.finished += 1
+            if err is not None:
+                self.errors.append((nid, err))
+            elif out is not None and self.winner is None \
+                    and not self.cancelled:
+                self.winner = out
+            if self.winner is not None or self.finished >= self.total:
+                self.done.set()
+
+    def result(self):
+        with self._lock:
+            return self.winner, list(self.errors)
+
+    def close(self) -> None:
+        with self._lock:
+            self.cancelled = True
 
 
 class _LocalFallback(Exception):
@@ -99,12 +172,25 @@ class ClusterClient:
         self.backoff_cap = float(
             self.config.get(CLUSTER_RETRY_BACKOFF_CAP_SECONDS))
         self.local_fallback = bool(self.config.get(CLUSTER_LOCAL_FALLBACK))
+        self.fault = getattr(ctx.engine, "fault", None)
+        self.breakers = BreakerBoard(
+            len(self.nodes),
+            int(self.config.get(CLUSTER_BREAKER_FAILURES)),
+            float(self.config.get(CLUSTER_BREAKER_COOLDOWN_SECONDS)))
+        self.hedge_enabled = bool(self.config.get(CLUSTER_HEDGE_ENABLED))
+        self.hedge_after_ms = float(self.config.get(CLUSTER_HEDGE_AFTER_MS))
+        self.hedge_quantile = float(self.config.get(CLUSTER_HEDGE_QUANTILE))
+        self.hedge_min_ms = float(self.config.get(CLUSTER_HEDGE_MIN_MS))
+        self.probe_jitter = bool(self.config.get(CLUSTER_PROBE_JITTER))
+        self._latencies = deque(maxlen=512)     # recent subquery RPC seconds
         self._lock = threading.Lock()
         self._down: Dict[int, float] = {}       # node id -> down-since
         self.counters = {"queries": 0, "scatters": 0, "subqueries": 0,
                          "retries": 0, "failovers": 0, "local_fallbacks": 0,
                          "shards_pruned": 0, "merge_ms": 0.0,
-                         "probe_marks_down": 0, "probe_marks_up": 0}
+                         "probe_marks_down": 0, "probe_marks_up": 0,
+                         "wire_corrupt": 0, "hedges_launched": 0,
+                         "hedges_won": 0, "degraded_queries": 0}
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, int(self.config.get(CLUSTER_SCATTER_THREADS))),
             thread_name_prefix="sdot-scatter")
@@ -142,7 +228,12 @@ class ClusterClient:
             return node_id in self._down
 
     def _probe_loop(self, interval: float) -> None:
-        while not self._stop.wait(interval):
+        # decorrelated jitter so N brokers probing the same rejoining
+        # historical spread out instead of thundering in lockstep; each
+        # tick lands in [interval/2, 1.5*interval]
+        rng = _random.Random()
+        delay = interval
+        while not self._stop.wait(delay):
             for nid in range(len(self.nodes)):
                 if self._stop.is_set():
                     return
@@ -150,6 +241,11 @@ class ClusterClient:
                     self._mark_up(nid, probe=True)
                 else:
                     self._mark_down(nid, probe=True)
+            if self.probe_jitter:
+                delay = backoff(interval * 0.5, interval * 1.5, 1,
+                                prev=delay, rng=rng)
+            else:
+                delay = interval
 
     def _probe(self, node_id: int) -> bool:
         host, port = self.nodes[node_id]
@@ -217,30 +313,50 @@ class ClusterClient:
             # every shard outside the interval: the empty answer is
             # cheaper (and shape-exact) on the broker's local engine
             return self._local("all shards pruned by query interval")
+        partial = bool(self.config.get(CLUSTER_PARTIAL_RESULTS))
         futs = []
         for sh in shards:
             name = shard_name(q.datasource, sh.index, dp.n_shards)
-            futs.append(self._pool.submit(
-                self._run_shard, body, name, sh.owners, deadline))
+            futs.append((sh, self._pool.submit(
+                self._run_shard, body, name, sh.owners, deadline, partial)))
         self.counters["scatters"] += len(futs)
         parts, nodes_used = [], set()
+        missing, covered_rows, total_rows = [], 0, 0
         err: Optional[Exception] = None
-        for f in futs:
+        for sh, f in futs:
+            total_rows += sh.rows
             try:
                 data, nid = f.result()
                 parts.append(data)
                 nodes_used.add(nid)
+                covered_rows += sh.rows
+            except ShardUnavailable as e:
+                # degraded mode: answer from the survivors and say so
+                if partial:
+                    missing.append(sh.index)
+                    continue
+                if err is None:
+                    err = e
             except Exception as e:  # noqa: BLE001 — every shard must drain
                 if err is None:
                     err = e
         if err is not None:
             if isinstance(err, _LocalFallback):
                 return self._local(err.reason)
-            if isinstance(err, ClusterError):
-                raise err
             raise err
+        degraded = None
+        if missing:
+            self.counters["degraded_queries"] += 1
+            degraded = {"missing_shards": sorted(missing),
+                        "coverage_rows": covered_rows,
+                        "total_rows": total_rows}
         t_m = _time.perf_counter()
-        columns, data, n = MG.merge_partials(parts, key_cols, aggs)
+        if parts:
+            columns, data, n = MG.merge_partials(parts, key_cols, aggs)
+        else:
+            # every shard missing (degraded): shape-exact empty answer
+            columns, data, n = \
+                list(key_cols) + [name for name, _ in aggs], {}, 0
         merge_ms = (_time.perf_counter() - t_m) * 1000
         self.counters["merge_ms"] += merge_ms
         names = list(columns)
@@ -252,10 +368,14 @@ class ClusterClient:
             data = self.engine._agg_epilogue(data, names, posts, having,
                                              limit)
             r = QueryResult(names, data)
-        self.engine.last_stats["cluster"] = {
+        r.degraded = degraded
+        cl_stats = {
             "mode": "scatter", "shards": len(futs),
             "shards_pruned": pruned, "nodes": sorted(nodes_used),
             "merge_ms": round(merge_ms, 3)}
+        if degraded is not None:
+            cl_stats["degraded"] = degraded
+        self.engine.last_stats["cluster"] = cl_stats
         self.engine.last_stats["datasource"] = q.datasource
         self.engine.last_stats["total_ms"] = \
             (_time.perf_counter() - t0) * 1000
@@ -268,78 +388,193 @@ class ClusterClient:
         return None
 
     def _run_shard(self, body: bytes, shard_ds: str,
-                   owners: Tuple[int, ...], deadline: Optional[float]):
+                   owners: Tuple[int, ...], deadline: Optional[float],
+                   partial: bool = False):
         """One shard's replica chain. Returns (data dict, serving node).
         Raises _LocalFallback for conditions remote retries cannot fix,
-        ClusterError when every replica stayed unreachable and local
-        fallback is off."""
+        ShardUnavailable when every replica stayed unreachable (caught
+        per shard in partial mode; otherwise strict-mode contract, with
+        whole-query local fallback when that is enabled)."""
         payload = _patch_datasource(body, shard_ds)
         delay = None
         attempt = 0
         last = "no attempt"
         for _pass in range(self.tries):
-            # up-nodes first; downed replicas are still tried last (the
-            # prober may lag a recovery)
-            chain = sorted(owners, key=self._is_down)
-            for nid in chain:
+            # up-and-closed nodes first; downed / breaker-open replicas
+            # are still tried last (the prober may lag a recovery, and a
+            # cooled-down breaker admits a half-open probe)
+            chain = sorted(owners, key=lambda n: (self._is_down(n),
+                                                  self.breakers.is_open(n)))
+            hedge_after = self._hedge_after_s() if _pass == 0 else None
+            for pos, nid in enumerate(chain):
                 if deadline is not None and _time.time() >= deadline:
                     raise _LocalFallback("deadline during scatter")
                 self.counters["subqueries"] += 1
-                if _pass or nid != chain[0]:
+                if _pass or pos:
                     self.counters["retries"] += 1
+                backup = chain[1] if (hedge_after is not None and pos == 0
+                                      and len(chain) > 1) else None
                 try:
-                    status, resp = self._rpc(nid, payload, deadline)
+                    status, resp, served = self._attempt(
+                        nid, payload, deadline, backup, hedge_after)
+                except _BreakerOpen as e:
+                    last = f"node {e.node_id}: breaker open"
+                    continue
                 except OSError as e:
-                    self._mark_down(nid)
                     self.counters["failovers"] += 1
                     last = f"node {nid}: {type(e).__name__}"
                     continue
-                self._mark_up(nid)
                 if status == 200:
                     try:
                         _, data, _stats = WIRE.decode_result(resp)
                     except ValueError as e:
-                        raise _LocalFallback(f"wire: {e}") from e
-                    return data, nid
+                        # corrupt / truncated frame: the bytes are bad,
+                        # not the query — retryable on a replica
+                        self.counters["wire_corrupt"] += 1
+                        last = f"node {served}: {e}"
+                        continue
+                    return data, served
                 info = WIRE.decode_error(resp)
                 kind = info.get("error", "")
                 if kind in ("EngineFallback", "Unsupported", "BadQuery"):
                     # the node cannot answer this query shape; neither
                     # will any replica — run the whole query locally
-                    raise _LocalFallback(f"node {nid}: {kind}: "
+                    raise _LocalFallback(f"node {served}: {kind}: "
                                          f"{info.get('message', '')[:120]}")
                 # AdmissionRejected (node shedding), unknown shard
                 # (stale rejoin), or a node-side crash: retryable on a
                 # replica / next pass
-                last = f"node {nid}: http {status} {kind}"
+                last = f"node {served}: http {status} {kind}"
                 if status == 404:
-                    self._mark_down(nid)
+                    self._mark_down(served)
             delay = backoff(self.backoff_start, self.backoff_cap,
                             attempt, prev=delay)
             attempt += 1
             if self._stop.wait(delay):
                 break
+        if partial:
+            # degraded mode supersedes whole-query local fallback: the
+            # caller answers from the surviving shards
+            raise ShardUnavailable(
+                f"shard {shard_ds} unreachable on nodes {list(owners)} "
+                f"after {self.tries} passes ({last})")
         if self.local_fallback:
             raise _LocalFallback(f"replicas exhausted for {shard_ds} "
                                  f"({last})")
-        raise ClusterError(f"shard {shard_ds} unreachable on nodes "
-                           f"{list(owners)} after {self.tries} passes "
-                           f"({last})")
+        raise ShardUnavailable(
+            f"shard {shard_ds} unreachable on nodes {list(owners)} "
+            f"after {self.tries} passes ({last})")
+
+    # -- one attempt: breakers + optional hedge --------------------------------
+    def _hedge_after_s(self) -> Optional[float]:
+        """Hedge delay in seconds, or None when hedging shouldn't run
+        (disabled, or the auto quantile has too few samples)."""
+        if not self.hedge_enabled:
+            return None
+        if self.hedge_after_ms > 0:
+            return self.hedge_after_ms / 1000.0
+        with self._lock:
+            lat = sorted(self._latencies)
+        if len(lat) < 32:
+            return None
+        q = lat[min(len(lat) - 1, int(len(lat) * self.hedge_quantile))]
+        return max(q, self.hedge_min_ms / 1000.0)
+
+    def _attempt(self, nid: int, payload: bytes, deadline: Optional[float],
+                 backup: Optional[int], hedge_after: Optional[float]):
+        """One subquery attempt against ``nid``, optionally racing a
+        hedge to ``backup`` after ``hedge_after`` seconds. Returns
+        (status, body, serving node)."""
+        if backup is None or hedge_after is None:
+            status, resp = self._guarded_rpc(nid, payload, deadline)
+            return status, resp, nid
+        race = _HedgeRace(total=2)
+        try:
+            for leg_nid, leg_delay in ((nid, 0.0), (backup, hedge_after)):
+                threading.Thread(
+                    target=self._race_leg,
+                    args=(race, leg_nid, payload, deadline, leg_delay),
+                    name="sdot-hedge", daemon=True).start()
+            race.done.wait(self.rpc_timeout + hedge_after + 5.0)
+            win, errors = race.result()
+        finally:
+            race.close()
+        if win is not None:
+            status, resp, served = win
+            if served != nid:
+                with self._lock:
+                    self.counters["hedges_won"] += 1
+            return status, resp, served
+        for err_nid, err in errors:     # prefer the primary's error
+            if err_nid == nid:
+                raise err
+        if errors:
+            raise errors[0][1]
+        raise OSError(f"hedge race against nodes {nid}/{backup} timed out")
+
+    def _race_leg(self, race: _HedgeRace, nid: int, payload: bytes,
+                  deadline: Optional[float], delay_s: float) -> None:
+        out, err = None, None
+        try:
+            if delay_s > 0:
+                if race.done.wait(delay_s) or race.cancelled:
+                    return          # primary answered inside the delay
+                with self._lock:
+                    self.counters["hedges_launched"] += 1
+            try:
+                status, resp = self._guarded_rpc(nid, payload, deadline)
+                out = (status, resp, nid)
+            except (_BreakerOpen, OSError) as e:
+                err = e
+        finally:
+            race.settle(nid, out, err)
+
+    def _guarded_rpc(self, node_id: int, payload: bytes,
+                     deadline: Optional[float]) -> Tuple[int, bytes]:
+        """_rpc wrapped in the node's circuit breaker + health marks."""
+        tok = self.breakers.before_attempt(node_id)
+        ok = False
+        try:
+            if tok is None:
+                raise _BreakerOpen(node_id)
+            try:
+                status, resp = self._rpc(node_id, payload, deadline)
+            except OSError:
+                self._mark_down(node_id)
+                raise
+            ok = status < 500       # any coherent reply = node is alive
+        finally:
+            if tok is not None:
+                self.breakers.settle(tok, ok)
+        self._mark_up(node_id)
+        return status, resp
 
     def _rpc(self, node_id: int, payload: bytes,
              deadline: Optional[float]) -> Tuple[int, bytes]:
+        inj = self.fault
+        key = f"node:{node_id}"
+        if inj is not None:
+            inj.fire("rpc.connect", key)
         host, port = self.nodes[node_id]
         timeout = self.rpc_timeout
         if deadline is not None:
             timeout = max(0.05, min(timeout, deadline - _time.time()))
+        t0 = _time.perf_counter()
         conn = http.client.HTTPConnection(host, port, timeout=timeout)
         try:
+            if inj is not None:
+                inj.fire("rpc.request", key)
             conn.request("POST", "/cluster/subquery", payload,
                          {"Content-Type": "application/json"})
             resp = conn.getresponse()
-            return resp.status, resp.read()
+            body = resp.read()
         finally:
             conn.close()
+        with self._lock:
+            self._latencies.append(_time.perf_counter() - t0)
+        if inj is not None:
+            body = inj.mutate("rpc.response", body, key)
+        return resp.status, body
 
     # -- introspection ---------------------------------------------------------
     def stats(self) -> dict:
@@ -354,6 +589,7 @@ class ClusterClient:
                        "down_seconds": down.get(i)}
                       for i, (h, p) in enumerate(self.nodes)],
             "replication": self.plan.replication,
+            "breakers": self.breakers.snapshot(),
             "datasources": {
                 name: {"shards": dp.n_shards,
                        "segments": dp.num_segments,

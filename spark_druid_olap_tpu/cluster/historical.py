@@ -182,6 +182,16 @@ class HistoricalNode:
             return 503, WIRE.encode_error(
                 "NotReady", "recovery / shard load in progress"), \
                 "application/json"
+        inj = getattr(self.ctx.engine, "fault", None)
+        if inj is not None:
+            from spark_druid_olap_tpu.fault import FaultInjected
+            try:
+                # chaos site: a delay rule models a slow node, an error
+                # rule a node-side 5xx crash (retryable on a replica)
+                inj.fire("hist.handle", key=f"node:{self.node_id}")
+            except FaultInjected as e:
+                return 500, WIRE.encode_error("Injected", str(e)), \
+                    "application/json"
         from spark_druid_olap_tpu.ir.serde import query_from_dict
         from spark_druid_olap_tpu.parallel.executor import (
             EngineFallback, QueryCancelled, QueryTimeout)

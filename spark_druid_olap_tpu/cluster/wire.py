@@ -1,6 +1,7 @@
 """Pickle-free wire format for subquery results.
 
-Layout: ``b"SDW1" + uint32le(header_len) + header_json + buffers``.
+Layout: ``b"SDW1" + uint32le(header_len) + header_json + buffers +
+uint32le(crc32 of everything before it)``.
 Numeric / datetime columns travel as raw little-endian buffers described
 by ``dtype.str`` + shape in the header (2-D shapes carry partial sketch
 register blocks); object columns (decoded strings, wide ints, None
@@ -8,6 +9,12 @@ nulls) travel as JSON lists — Python ints survive JSON with arbitrary
 precision, which is what keeps exact int128-ish sums exact across the
 wire. No pickle anywhere: a historical's RPC port must not be a
 remote-code-execution port.
+
+The CRC32 trailer makes a truncated or bit-flipped frame *detectable*:
+without it a corrupted raw LE buffer decodes into plausible garbage and
+silently poisons the broker merge. ``decode_result`` raises ValueError
+on mismatch and the broker treats that as a retryable failure (ask a
+replica) rather than trusting the bytes.
 """
 
 from __future__ import annotations
@@ -15,6 +22,7 @@ from __future__ import annotations
 import json
 import math
 import struct
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -54,14 +62,19 @@ def encode_result(columns: List[str], data: Dict[str, np.ndarray],
                 "shape": list(arr.shape), "nbytes": len(raw)})
             bufs.append(raw)
     hb = json.dumps(header, separators=(",", ":")).encode("utf-8")
-    return b"".join([MAGIC, _LEN.pack(len(hb)), hb] + bufs)
+    body = b"".join([MAGIC, _LEN.pack(len(hb)), hb] + bufs)
+    return body + _LEN.pack(zlib.crc32(body))
 
 
 def decode_result(payload: bytes) -> Tuple[List[str], Dict[str, np.ndarray],
                                            dict]:
     """-> (columns, data, stats). Raises ValueError on a malformed frame."""
-    if payload[:4] != MAGIC:
+    if len(payload) < 12 or payload[:4] != MAGIC:
         raise ValueError("bad wire magic")
+    (crc,) = _LEN.unpack_from(payload, len(payload) - 4)
+    if zlib.crc32(payload[:-4]) != crc:
+        raise ValueError("wire CRC mismatch (truncated or corrupt frame)")
+    payload = payload[:-4]
     (hlen,) = _LEN.unpack_from(payload, 4)
     off = 8 + hlen
     header = json.loads(payload[8:off].decode("utf-8"))

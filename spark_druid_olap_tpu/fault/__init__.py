@@ -1,0 +1,5 @@
+"""Deterministic fault injection (chaos) — see docs/CHAOS.md."""
+
+from .plan import FaultInjected, FaultInjector, FaultPlan, FaultRule
+
+__all__ = ["FaultInjected", "FaultInjector", "FaultPlan", "FaultRule"]

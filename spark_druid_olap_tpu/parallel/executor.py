@@ -748,6 +748,14 @@ class QueryEngine:
             SharedScanCoalescer)
         self.sharedscan = SharedScanCoalescer(self)
         self.wlm.sharedscan = self.sharedscan
+        # deterministic fault injection (fault/, docs/CHAOS.md): None
+        # unless sdot.fault.plan is set, and every site guards on None
+        # so the un-injected hot path pays nothing. The WLM site is
+        # wired here; broker / persist / tier pick the injector up from
+        # this attribute in their own constructors.
+        from spark_druid_olap_tpu.fault import FaultInjector
+        self.fault = FaultInjector.from_config(self.config)
+        self.wlm.fault = self.fault
         # distributed serving tier (cluster/): on a broker this is the
         # scatter/merge client (cluster/broker.py:ClusterClient) wired
         # in by Context; None on single-process engines and historicals
@@ -939,6 +947,10 @@ class QueryEngine:
             finally:
                 if qid is not None:
                     self.release_query(qid)
+            # after the releases: a failing stats snapshot must not be
+            # able to strand the pin or the cancel flag
+            if self.fault is not None:
+                self.last_stats["fault"] = self.fault.stats()
 
     def _execute_admitted(self, q: S.QuerySpec, t0: float) -> QueryResult:
         try:
@@ -973,7 +985,10 @@ class QueryEngine:
                 # enabled) — fall through to ordinary local execution.
                 r = self.cluster.execute(q, t0)
                 if r is not None:
-                    if use_cache:
+                    # degraded (partial-results) answers must NEVER enter
+                    # the result cache: a later healthy run would serve
+                    # the hole forever
+                    if use_cache and r.degraded is None:
                         cache.put(q, ds_version, r)
                         self.last_stats["cache"] = "miss"
                     return r
@@ -3369,6 +3384,12 @@ def _is_backend_loss(e: BaseException) -> bool:
     connection errors after _device_put_retry exhausts its backoff)."""
     if isinstance(e, EngineFallback) \
             or not isinstance(e, (RuntimeError, OSError)):
+        return False
+    from spark_druid_olap_tpu.cluster.broker import ClusterError
+    if isinstance(e, ClusterError):
+        # a shard unreachable over the NETWORK says nothing about the
+        # local device backend — strict mode must surface it, not demote
+        # it to a host fallback
         return False
     s = str(e).lower()
     return any(m in s for m in _LOST_MARKERS)

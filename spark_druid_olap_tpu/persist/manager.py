@@ -75,6 +75,9 @@ class PersistManager:
         )
         self.ctx = ctx
         self.root = os.path.abspath(root)
+        # fault injector (fault/, docs/CHAOS.md): threaded into every
+        # WAL, the snapshot publish path, and the cold tier below
+        self.fault = getattr(ctx.engine, "fault", None)
         os.makedirs(self.root, exist_ok=True)
         # LOCK ORDER: checkpoint paths read the session query history
         # (QueryHistory._lock) while this lock is held — the global
@@ -110,6 +113,9 @@ class PersistManager:
                 verify=bool(cfg.get(TIER_VERIFY_CHECKSUMS)),
                 popularity=self._tier_popularity,
                 on_corrupt=self._on_tier_corrupt)
+            # .fault on the tier store is the demand-fault METHOD, so
+            # the injector rides a different name there
+            self.tier.chaos = self.fault
             if bool(cfg.get(TIER_PREFETCH_ENABLED)):
                 self.tier.start_prefetcher(
                     int(cfg.get(TIER_PREFETCH_THREADS)))
@@ -124,7 +130,7 @@ class PersistManager:
         if w is None:
             w = self._wals[name] = WAL.WriteAheadLog(
                 os.path.join(self._ds_root(name), "wal.log"),
-                fsync=self.wal_fsync)
+                fsync=self.wal_fsync, fault=self.fault)
         return w
 
     def _next_seq(self, name: str) -> int:
@@ -317,6 +323,11 @@ class PersistManager:
             if wal_seq is None:
                 wal_seq = self._wal_for(name).last_seq() or 0
                 self._wal_seq[name] = wal_seq
+            if self.fault is not None:
+                # chaos site: a publish-time I/O error (fsync failure,
+                # disk full). The WAL is untouched, so nothing is lost —
+                # the datasource just stays dirty for the next pass.
+                self.fault.fire("snapshot.write", key=name)
             manifest = SNAP.write_snapshot(
                 self._ds_root(name), ds, iv, wal_seq, keep=self.keep)
             # snapshot covers every journaled record — drop them
@@ -497,6 +508,9 @@ class PersistManager:
         covered = int(manifest["wal_seq"]) if manifest is not None else 0
         replayed = 0
         wal = self._wal_for(name)
+        # a crash mid-append leaves a torn tail; trim it NOW so live
+        # appends after recovery land where replay can see them
+        wal.repair()
         for header, body in wal.replay():
             seq = int(header.get("seq", 0))
             if seq <= covered:

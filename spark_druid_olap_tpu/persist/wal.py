@@ -63,9 +63,10 @@ def decode_batch(body: bytes):
 class WriteAheadLog:
     """Append-only framed journal with crash-tolerant replay."""
 
-    def __init__(self, path: str, fsync: bool = True):
+    def __init__(self, path: str, fsync: bool = True, fault=None):
         self.path = path
         self.fsync = fsync
+        self.fault = fault      # fault injector (docs/CHAOS.md) or None
         self._f = None
 
     # -- write ----------------------------------------------------------------
@@ -76,17 +77,48 @@ class WriteAheadLog:
         return self._f
 
     def append(self, header: dict, body: bytes) -> None:
-        """Write one record; on return (with fsync on) it is durable."""
+        """Write one record; on return (with fsync on) it is durable.
+
+        A failed append SELF-HEALS: any exception mid-write truncates
+        the file back to the pre-append offset, so a torn or corrupt
+        record left by the failure cannot poison later appends (replay
+        stops at the first bad record — garbage in the middle would
+        silently drop every durable record after it)."""
         hdr = json.dumps(header, separators=(",", ":")).encode()
         crc = zlib.crc32(hdr)
         crc = zlib.crc32(body, crc)
+        rec = _FRAME.pack(_MAGIC, len(hdr), len(body), crc) + hdr + body
+        inj = self.fault
         f = self._file()
-        f.write(_FRAME.pack(_MAGIC, len(hdr), len(body), crc))
-        f.write(hdr)
-        f.write(body)
-        f.flush()
-        if self.fsync:
-            os.fsync(f.fileno())
+        pos = f.seek(0, os.SEEK_END)    # append-mode tell() may lag reality
+        try:
+            if inj is not None:
+                # chaos sites: "wal.append" truncate/flip corrupts the
+                # record (a torn write — the append FAILS, the batch is
+                # never acked), "wal.fsync" raises a simulated I/O error
+                cut = inj.mutate("wal.append", rec, key=self.path)
+                if cut is not rec:
+                    f.write(cut)
+                    f.flush()
+                    raise OSError("fault-injected torn WAL append")
+                f.write(rec)
+                f.flush()
+                inj.fire("wal.fsync", key=self.path)
+            else:
+                f.write(rec)
+                f.flush()
+            if self.fsync:
+                os.fsync(f.fileno())
+        except BaseException:
+            # roll the partial record back so the journal stays appendable
+            try:
+                f.truncate(pos)
+                f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
+            except OSError:
+                pass        # repair() at next recovery trims it instead
+            raise
 
     def close(self) -> None:
         if self._f is not None and not self._f.closed:
@@ -98,6 +130,7 @@ class WriteAheadLog:
         first torn/corrupt one (crash tail). Missing file = no records."""
         if not os.path.exists(self.path):
             return
+        end = self.size_bytes()
         with open(self.path, "rb") as f:
             while True:
                 frame = f.read(_FRAME.size)
@@ -106,6 +139,10 @@ class WriteAheadLog:
                 magic, hlen, blen, crc = _FRAME.unpack(frame)
                 if magic != _MAGIC:
                     return                      # corrupt frame boundary
+                if hlen + blen > end - f.tell():
+                    # lengths from a torn frame can be garbage: bound by
+                    # the actual file size before allocating the read
+                    return
                 hdr = f.read(hlen)
                 body = f.read(blen)
                 if len(hdr) < hlen or len(body) < blen:
@@ -121,6 +158,42 @@ class WriteAheadLog:
 
     def records(self) -> List[Tuple[dict, bytes]]:
         return list(self.replay())
+
+    def repair(self) -> int:
+        """Trim a torn/corrupt tail left by a crash mid-append, so the
+        journal is appendable again (a live append after un-trimmed
+        garbage would be unreachable to replay). Returns bytes trimmed.
+        Called at recovery, before any new appends."""
+        if not os.path.exists(self.path):
+            return 0
+        end = self.size_bytes()
+        good = 0
+        with open(self.path, "rb") as f:
+            while True:
+                frame = f.read(_FRAME.size)
+                if len(frame) < _FRAME.size:
+                    break
+                magic, hlen, blen, crc = _FRAME.unpack(frame)
+                if magic != _MAGIC:
+                    break
+                if hlen + blen > end - f.tell():
+                    break       # garbage lengths from a torn frame
+                hdr = f.read(hlen)
+                body = f.read(blen)
+                if len(hdr) < hlen or len(body) < blen:
+                    break
+                c = zlib.crc32(hdr)
+                if zlib.crc32(body, c) != crc:
+                    break
+                good += _FRAME.size + hlen + blen
+        torn = self.size_bytes() - good
+        if torn > 0:
+            self.close()
+            with open(self.path, "r+b") as f:
+                f.truncate(good)
+                f.flush()
+                os.fsync(f.fileno())
+        return max(0, torn)
 
     # -- maintenance ----------------------------------------------------------
     def size_bytes(self) -> int:

@@ -14,6 +14,12 @@ import pandas as pd
 
 
 class QueryResult:
+    # Set by the broker in partial-results mode when shards were
+    # unreachable: {"missing_shards": [...], "coverage_rows": int,
+    # "total_rows": int}. None = exact full answer (degraded results
+    # never enter the result cache).
+    degraded = None
+
     def __init__(self, columns: List[str], data: Dict[str, np.ndarray]):
         self.columns = list(columns)
         self.data = data

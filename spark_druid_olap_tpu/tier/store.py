@@ -106,6 +106,9 @@ class TieredColumnStore:
         self.verify = bool(verify)
         self.popularity = popularity
         self.on_corrupt = on_corrupt
+        # fault injector (docs/CHAOS.md); named "chaos" because "fault"
+        # is this store's demand-fault method
+        self.chaos = None
         self._lock = threading.RLock()
         self._hot: Dict[tuple, _Entry] = {}
         self._pins: Dict[tuple, int] = {}
@@ -234,6 +237,10 @@ class TieredColumnStore:
         return e.arr
 
     def _load_cold(self, ds_name: str, ref: BlobRef) -> np.ndarray:
+        inj = self.chaos
+        if inj is not None:
+            # chaos site: delay = slow cold read, error = mmap I/O error
+            inj.fire("tier.read", key=ref.path)
         self._verify_blob(ds_name, ref)
         if ref.count == 0:
             return np.empty(0, dtype=np.dtype(ref.dtype))
@@ -260,6 +267,11 @@ class TieredColumnStore:
                 data = f.read()
         except OSError as e:
             raise SnapshotCorrupt(f"missing blob {ref.path}: {e}") from e
+        inj = self.chaos
+        if inj is not None:
+            # chaos site: a flip rule simulates cold-tier bit rot — the
+            # CRC below catches it and triggers quarantine/re-recovery
+            data = inj.mutate("tier.verify", data, key=ref.path)
         ok = len(data) == int(ref.file_bytes) \
             and zlib.crc32(data) == int(ref.crc)
         ms = (time.perf_counter() - t0) * 1000.0

@@ -74,6 +74,18 @@ REGISTRY: Tuple[Resource, ...] = (
     # query faulted resident forever, silently growing the hot set past
     # its byte budget (tier/store.py pin protocol)
     Resource("tier-pin", (("acquire_pins",),), (("release_pins",),)),
+    # fault-injection scopes: an unbalanced begin_scope leaves the named
+    # scope refcounted open forever, so every rule gated on it keeps
+    # firing after the leg that opened it ends (fault/plan.py)
+    Resource("fault-scope", (("begin_scope",),), (("end_scope",),)),
+    # circuit-breaker claims: an unsettled claim wedges a half-open
+    # breaker — its single probe slot never frees, so the node is
+    # skipped forever even after it recovers (cluster/breaker.py)
+    Resource("breaker-claim", (("before_attempt",),), (("settle",),)),
+    # hedge races: close() marks the race cancelled so the losing leg's
+    # thread stands down instead of holding its reply buffer and done-
+    # event waiters alive (cluster/broker.py)
+    Resource("hedge-race", (), (("close",),), ctor="_HedgeRace"),
     Resource("wal-handle", (), (("close",),), ctor="WriteAheadLog"),
     # cluster RPC: every HTTPConnection the broker opens (subquery
     # scatter, readyz probes) must close on all paths — leaked sockets

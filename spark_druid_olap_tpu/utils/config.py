@@ -513,6 +513,63 @@ CLUSTER_LOCAL_FALLBACK = _entry(
     "query on the broker's own engine (it holds a full recovered copy) "
     "instead of failing. Answers are identical; only placement changes.",
     semantic=False)
+CLUSTER_PARTIAL_RESULTS = _entry(
+    "sdot.cluster.partial.results", False,
+    "Degraded mode: when every replica of some shard is unreachable, "
+    "answer from the surviving shards and annotate the result with "
+    "degraded={missing_shards, coverage_rows} instead of raising "
+    "ShardUnavailable / falling back whole-query (this takes precedence "
+    "over sdot.cluster.local.fallback for unreachable shards). Degraded "
+    "answers are NEVER cached, so cached entries stay exact full "
+    "answers and the key needs no new term.", semantic=False)
+CLUSTER_BREAKER_FAILURES = _entry(
+    "sdot.cluster.breaker.failures", 3,
+    "Consecutive subquery failures against one node that open its "
+    "circuit breaker (the broker then skips the node without an RPC "
+    "until the cooldown elapses). 0 disables breakers.",
+    int, semantic=False)
+CLUSTER_BREAKER_COOLDOWN_SECONDS = _entry(
+    "sdot.cluster.breaker.cooldown.seconds", 5.0,
+    "How long an open breaker rejects attempts before letting ONE "
+    "half-open probe RPC through; that probe's outcome closes or "
+    "re-opens the breaker.", float, semantic=False)
+CLUSTER_HEDGE_ENABLED = _entry(
+    "sdot.cluster.hedge.enabled", False,
+    "Hedged scatter: when a subquery RPC has not answered within the "
+    "hedge delay, race a duplicate request to the next replica and take "
+    "whichever answers first (the loser is discarded; replicas are "
+    "exact copies, so answers are identical either way).",
+    semantic=False)
+CLUSTER_HEDGE_AFTER_MS = _entry(
+    "sdot.cluster.hedge.after.ms", 0.0,
+    "Fixed hedge delay in milliseconds; 0 = automatic (the observed "
+    "subquery-latency quantile below, once enough samples exist).",
+    float, semantic=False)
+CLUSTER_HEDGE_QUANTILE = _entry(
+    "sdot.cluster.hedge.quantile", 0.95,
+    "Latency quantile of recent subquery RPCs used as the automatic "
+    "hedge delay when sdot.cluster.hedge.after.ms is 0.",
+    float, semantic=False)
+CLUSTER_HEDGE_MIN_MS = _entry(
+    "sdot.cluster.hedge.min.ms", 10.0,
+    "Floor for the automatic hedge delay (keeps the quantile estimate "
+    "from hedging every RPC while the sample window is still cold).",
+    float, semantic=False)
+CLUSTER_PROBE_JITTER = _entry(
+    "sdot.cluster.probe.jitter", True,
+    "Decorrelated jitter (utils/retry.backoff) on the background "
+    "readyz prober's interval so N brokers don't probe a rejoining "
+    "historical in lockstep; each tick lands in [0.5x, 1.5x] of "
+    "sdot.cluster.probe.interval.seconds.", semantic=False)
+# --- deterministic fault injection (fault/) -----------------------------------
+FAULT_PLAN = _entry(
+    "sdot.fault.plan", "",
+    "JSON FaultPlan ({\"seed\": S, \"rules\": [...]}) activating named "
+    "injection sites across cluster RPC, persist I/O, the cold tier, "
+    "and WLM admission — see docs/CHAOS.md for the site catalog and "
+    "rule schema. Empty (default) = every site is a zero-cost no-op. "
+    "Injected faults only provoke the recovery paths; strict-mode "
+    "answers remain exact, so results stay cacheable.", semantic=False)
 # --- out-of-core tiered storage (tier/) ---------------------------------------
 TIER_ENABLED = _entry(
     "sdot.tier.enabled", False,

@@ -87,6 +87,9 @@ class WorkloadManager:
         # set by the owning QueryEngine; lets queued waiters hand off to
         # an open shared-scan group instead of draining serially
         self.sharedscan = None
+        # fault injector (fault/, docs/CHAOS.md) wired by the owning
+        # QueryEngine; None unless sdot.fault.plan is set
+        self.fault = None
 
     # -- configuration ---------------------------------------------------------
     @property
@@ -189,6 +192,11 @@ class WorkloadManager:
               cancel_event: Optional[threading.Event] = None) -> Ticket:
         """Block until a lane slot is granted (or raise). ``t0`` is the
         engine's query start — queue wait counts against the deadline."""
+        inj = self.fault
+        if inj is not None:
+            # chaos site (before the lock — a delay rule models slot
+            # starvation, an error rule a queue-full shed)
+            inj.fire("wlm.admit")
         with self._lock:
             self._refresh_locked()
             lane_name, est, demoted, tenant, priority = \
