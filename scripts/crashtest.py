@@ -248,7 +248,10 @@ def run_cluster_mode(args):
     reply before, during, and after the kill must match the
     single-process reference, the node must rejoin after a restart and
     serve exact answers again, and recovery over the same persist root
-    must see every acknowledged commit and none of the torn ones."""
+    must see every acknowledged commit and none of the torn ones. A
+    final kill-during-handover round publishes an epoch that adds a
+    third node, SIGKILLs the joiner mid-warm, then respawns it —
+    answers must stay exact throughout and the swap must complete."""
     import tempfile
     import threading
     import spark_druid_olap_tpu as sdot
@@ -348,6 +351,44 @@ def run_cluster_mode(args):
         _wait_ready(ports[1], proc=procs[1])
         time.sleep(0.6)             # a couple of prober ticks to re-mark
         rejoined = {q: broker.sql(q).to_pandas() for q in CLUSTER_QUERIES}
+
+        # kill-during-handover round (cluster/epoch.py): publish an
+        # epoch that adds a third node, SIGKILL the joiner mid-warm,
+        # verify the storm stays exact, then respawn it and watch the
+        # handover complete. The broker may or may not have swapped by
+        # the time of the kill (replicas cover either way) — the
+        # contract is zero mismatches plus eventual convergence.
+        from spark_druid_olap_tpu.cluster import epoch as EP
+        port3 = _free_port()
+        nodes3 = nodes + f",127.0.0.1:{port3}"
+        erec = EP.publish_epoch(root, nodes3.split(","), note="add-node")
+        print(f"[cluster] epoch {erec.epoch} published (add-node); "
+              f"spawning joiner ...")
+        procs[2] = _spawn_historical(root, nodes3, 2)
+        time.sleep(0.4)
+        print("[cluster] kill -9 joining historical mid-handover")
+        os.kill(procs[2].pid, signal.SIGKILL)
+        procs[2].wait()
+        time.sleep(0.6)
+        mid_handover = {q: broker.sql(q).to_pandas()
+                        for q in CLUSTER_QUERIES}
+        print("[cluster] respawning joiner; waiting for the swap ...")
+        procs[2] = _spawn_historical(root, nodes3, 2)
+        _wait_ready(port3, proc=procs[2])
+        deadline = time.monotonic() + 60.0
+        while (time.monotonic() < deadline
+               and broker.cluster.stats()["epoch"]["active"]
+               != erec.epoch):
+            time.sleep(0.1)
+        swapped = broker.cluster.stats()["epoch"]["active"] == erec.epoch
+        post_swap = {q: broker.sql(q).to_pandas()
+                     for q in CLUSTER_QUERIES}
+        handover_ok = (swapped
+                       and all(_close(mid_handover[q], want[q])
+                               for q in CLUSTER_QUERIES)
+                       and all(_close(post_swap[q], want[q])
+                               for q in CLUSTER_QUERIES))
+
         stop.set()
         for t in threads:
             t.join()
@@ -377,20 +418,21 @@ def run_cluster_mode(args):
                "storm_mismatches": len(mism), "acked": len(acked),
                "torn": len(torn), "recovered_rows": n_rows,
                "rejoin_exact": rejoin_ok,
+               "handover_epoch": erec.epoch, "handover_ok": handover_ok,
                "failovers": c.get("failovers", 0),
                "wire_corrupt": c.get("wire_corrupt", 0),
                "recovery_mismatches": rec_mism}
         print(json.dumps(out))
         ok = (not mism and errs[0] == 0 and rejoin_ok and not rec_mism
               and n_rows == len(acked) * args.rows
-              and torn and acked
+              and torn and acked and handover_ok
               and c.get("failovers", 0) >= 1)
         if not ok:
             print("CLUSTER CRASHTEST FAILED")
             sys.exit(1)
         print(f"OK: {served[0]} storm replies exact through a kill -9 + "
-              f"rejoin, {len(acked)} acked commits recovered, "
-              f"{len(torn)} torn appends never acked")
+              f"rejoin + a killed epoch handover, {len(acked)} acked "
+              f"commits recovered, {len(torn)} torn appends never acked")
     finally:
         for p in procs.values():
             if p.poll() is None:

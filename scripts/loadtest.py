@@ -849,7 +849,11 @@ def run_chaos(args):
     cluster — RPC connection drops, slow replies, corrupt wire frames,
     historical 500s that trip and then close a circuit breaker, hedged
     scatter, a replication-1 partial outage, torn WAL appends, a
-    cold-tier CRC flip, WLM shed/starvation, and a threaded mixed storm.
+    cold-tier CRC flip, WLM shed/starvation, epoch-based elasticity
+    (scale-out / scale-in / node killed mid-transition, each under a
+    query storm, with measured shard movement checked against the
+    modular-rotation naive bound), a subquery-cache hit curve, and a
+    threaded mixed storm.
 
     Every strict-mode reply is differentially checked against a
     single-process reference (byte-exact up to float ulps); the degraded
@@ -904,7 +908,11 @@ def run_chaos(args):
         {"site": "hist.handle", "action": "error", "scope": "hist500"}]})
 
     caches_off = {"sdot.cache.enabled": False,
-                  "sdot.plan.cache.enabled": False}
+                  "sdot.plan.cache.enabled": False,
+                  # the shard-result cache would absorb the repeat
+                  # queries the fault legs rely on to exercise the RPC
+                  # path; the hit-curve leg opts back in explicitly
+                  "sdot.cluster.subq.cache.enabled": False}
     root = tempfile.mkdtemp(prefix="sdot-chaos-")
     hists, ctxs = [], []
     legs, digest_src, failures = {}, [], []
@@ -1221,6 +1229,209 @@ def run_chaos(args):
                             "recovered_exact": tier_ok}
         digest_src.append(["cold_crc", cur, corrupt_seen])
 
+        # ---- elasticity legs: epoch-based rolling topology under a
+        # storm (cluster/epoch.py). Own persist root: the r2/r1 rings
+        # above must never observe a topology change. Movement counts
+        # hash into the replay digest — logical node ids are
+        # deterministic, so the diff is too.
+        print("[chaos] elasticity legs (epoch rolling topology)")
+        from spark_druid_olap_tpu.cluster import epoch as EPO
+        from spark_druid_olap_tpu.cluster.assign import (
+            plan_cluster, plan_diff)
+        from spark_druid_olap_tpu.fault import (
+            FaultInjected, FaultInjector, FaultPlan)
+        eroot = os.path.join(root, "elastic")
+        es = sdot.Context({"sdot.persist.path": eroot, **caches_off})
+        ctxs.append(es)
+        es.ingest_dataframe("esales", _synthetic_sales(60_000),
+                            time_column="ts", target_rows=4096)
+        es.checkpoint()
+        eaddrs = [f"127.0.0.1:{_free_port()}" for _ in range(4)]
+        drain_kill = json.dumps({"seed": S ^ 0xE1, "rules": [
+            {"site": "node.drain", "action": "error", "count": 1}]})
+        ecommon = {"sdot.persist.path": eroot,
+                   "sdot.cluster.replication": 2,
+                   # FIXED shard count: shard identity must survive the
+                   # node-count changes below
+                   "sdot.cluster.shards": 4,
+                   "sdot.cluster.epoch.poll.seconds": 0.05,
+                   "sdot.cluster.epoch.drain.grace.seconds": 0.05,
+                   "sdot.cluster.epoch.drain.timeout.seconds": 5.0,
+                   "sdot.cluster.retry.backoff.start.seconds": 0.01,
+                   **caches_off}
+
+        def estart(addr, csv, extra=None):
+            h = HistoricalNode(
+                {**ecommon, "sdot.cluster.nodes": csv, **(extra or {})},
+                node_id=csv.split(",").index(addr)).start()
+            hists.append(h)
+            return h
+
+        ecsv2 = ",".join(eaddrs[:2])
+        for a in eaddrs[:2]:
+            estart(a, ecsv2)
+        ebroker = sdot.Context({
+            **ecommon, "sdot.cluster.nodes": ecsv2,
+            "sdot.cluster.role": "broker",
+            "sdot.cluster.probe.interval.seconds": 0.05})
+        ctxs.append(ebroker)
+        EQ = ["select region, sum(qty) as q, count(*) as c from esales "
+              "group by region order by region",
+              "select product, sum(price) as rev from esales "
+              "group by product order by rev desc, product limit 10",
+              "select region, approx_count_distinct(product) as dp "
+              "from esales group by region order by region"]
+        ewant = {q: es.sql(q).to_pandas() for q in EQ}
+        for q in EQ:
+            if not _frames_close(ebroker.sql(q).to_pandas(), ewant[q]):
+                print(f"[chaos] ELASTIC WARMUP MISMATCH: {q}")
+                sys.exit(1)
+
+        def naive_moved(n_old, n_new):
+            return plan_diff(
+                plan_cluster(eroot, n_old, 2, n_shards=4,
+                             strategy="modular"),
+                plan_cluster(eroot, n_new, 2, n_shards=4,
+                             strategy="modular")).moved
+
+        def elastic_leg(name, fn):
+            """Run the topology change ``fn`` while a hammer thread
+            storms the broker; ``fn`` returns the epoch the broker must
+            converge to. Zero mismatches is the bar."""
+            stop_ev = threading.Event()
+            mism, errs, n = [0], [0], [0]
+
+            def hammer():
+                i = 0
+                while not stop_ev.is_set():
+                    q = EQ[i % len(EQ)]
+                    i += 1
+                    n[0] += 1
+                    try:
+                        got = ebroker.sql(q).to_pandas()
+                    except Exception as e:      # noqa: BLE001
+                        errs[0] += 1
+                        print(f"  [{name}] ERROR "
+                              f"{type(e).__name__}: {e}")
+                        continue
+                    if not _frames_close(got, ewant[q]):
+                        mism[0] += 1
+                        print(f"  [{name}] MISMATCH: {q[:60]}")
+
+            th = threading.Thread(target=hammer)
+            th.start()
+            try:
+                want_epoch = fn()
+                deadline = time.monotonic() + 20.0
+                while (time.monotonic() < deadline
+                       and ebroker.cluster.stats()["epoch"]["active"]
+                       != want_epoch):
+                    time.sleep(0.05)
+            finally:
+                stop_ev.set()
+                th.join()
+            swapped = ebroker.cluster.stats()["epoch"]["active"] \
+                == want_epoch
+            reb = ebroker.cluster.last_rebalance or {}
+            leg = {"n": n[0], "mismatches": mism[0], "errors": errs[0],
+                   "to_epoch": want_epoch, "swapped": swapped,
+                   "moved": reb.get("moved"), "total": reb.get("total")}
+            legs[name] = leg
+            digest_src.append([name, want_epoch, leg["moved"],
+                               leg["total"], mism[0]])
+            check(name, swapped and mism[0] == 0 and errs[0] == 0,
+                  json.dumps(leg))
+            print(f"  [{name}] {json.dumps(leg)}")
+            return leg
+
+        # scale-out mid-storm: N -> N+2; the broker must keep serving
+        # the old epoch until both joiners warm + advertise
+        def scale_out():
+            rec = EPO.publish_epoch(eroot, eaddrs, note="scale-out")
+            csv = ",".join(rec.nodes)
+            estart(eaddrs[2], csv)
+            # the second joiner carries a one-shot node.drain error: it
+            # dies mid-handover when a later epoch drops it
+            estart(eaddrs[3], csv, extra={"sdot.fault.plan": drain_kill})
+            return rec.epoch
+
+        leg = elastic_leg("elastic_scale_out", scale_out)
+        nm = naive_moved(2, 4)
+        check("elastic_scale_out.movement",
+              leg["moved"] is not None and leg["moved"] <= nm,
+              f"moved={leg['moved']} naive={nm}")
+        legs["elastic_scale_out"]["naive_moved"] = nm
+
+        # node killed during epoch transition: the publisher "crashes"
+        # between the record write and the CURRENT flip (inert orphan,
+        # readers hold), the re-publish allocates past it, and the
+        # node being removed dies at its node.drain site instead of
+        # draining gracefully — replicas absorb both
+        pub_hold = []
+
+        def kill_transition():
+            prev = EPO.read_epoch(eroot).epoch
+            inj_pub = FaultInjector(FaultPlan.parse(json.dumps(
+                {"seed": S ^ 0x3E, "rules": [
+                    {"site": "epoch.publish", "action": "error",
+                     "count": 1}]})))
+            try:
+                EPO.publish_epoch(eroot, eaddrs[:3], note="kill-leg",
+                                  fault=inj_pub)
+                pub_hold.append(False)
+            except FaultInjected:
+                pub_hold.append(EPO.read_epoch(eroot).epoch == prev)
+            rec = EPO.publish_epoch(eroot, eaddrs[:3], note="kill-retry")
+            return rec.epoch
+
+        elastic_leg("elastic_kill_transition", kill_transition)
+        check("elastic_kill_transition.publish_crash",
+              pub_hold == [True], f"pub_hold={pub_hold}")
+        digest_src.append(["elastic_publish_crash", pub_hold])
+
+        # scale-in mid-storm: back to N; the leaver drains in-flight
+        # subqueries and fences only after the survivors cover its
+        # shards
+        def scale_in():
+            return EPO.publish_epoch(eroot, eaddrs[:2],
+                                     note="scale-in").epoch
+
+        leg = elastic_leg("elastic_scale_in", scale_in)
+        nm = naive_moved(3, 2)
+        check("elastic_scale_in.movement",
+              leg["moved"] is not None and leg["moved"] <= nm,
+              f"moved={leg['moved']} naive={nm}")
+        legs["elastic_scale_in"]["naive_moved"] = nm
+
+        # subquery-cache hit curve: a cache-on broker must answer
+        # byte-identically to the cache-off reference while its hit
+        # counter climbs and its miss counter plateaus after round one
+        print("[chaos] subquery-cache hit curve (cache on vs off)")
+        cbroker = sdot.Context({
+            **ecommon, "sdot.cluster.nodes": ecsv2,
+            "sdot.cluster.role": "broker",
+            "sdot.cluster.probe.interval.seconds": 0,
+            "sdot.cluster.subq.cache.enabled": True})
+        ctxs.append(cbroker)
+        curve, mism_c = [], 0
+        for _rnd in range(4):
+            for q in EQ:
+                if not _frames_close(cbroker.sql(q).to_pandas(),
+                                     ewant[q]):
+                    mism_c += 1
+                    print(f"  [subq_cache] MISMATCH: {q[:60]}")
+            cc = cbroker.cluster.counters
+            curve.append([cc["subq_cache_hits"],
+                          cc["subq_cache_misses"]])
+        hit_ok = (curve[0][0] == 0
+                  and all(curve[i][0] > curve[i - 1][0]
+                          for i in range(1, len(curve)))
+                  and curve[-1][1] == curve[0][1])
+        legs["subq_cache"] = {"curve": curve, "mismatches": mism_c}
+        digest_src.append(["subq_cache", curve, mism_c])
+        check("subq_cache", mism_c == 0 and hit_ok, json.dumps(curve))
+        print(f"  [subq_cache] {json.dumps(legs['subq_cache'])}")
+
         # mixed threaded storm: every survivable fault class at once;
         # timing-dependent, so it gates on zero mismatches/errors but
         # stays out of the replay digest
@@ -1302,7 +1513,8 @@ def run_cluster(args):
     window_ms = args.window if args.window is not None else 25.0
     root = tempfile.mkdtemp(prefix="sdot-cluster-bench-")
     caches_off = {"sdot.cache.enabled": False,
-                  "sdot.plan.cache.enabled": False}
+                  "sdot.plan.cache.enabled": False,
+                  "sdot.cluster.subq.cache.enabled": False}
     procs, broker, single = [], None, None
     try:
         seed = sdot.Context({"sdot.persist.path": root})

@@ -11,8 +11,48 @@
 # them on broker-port with the ordinary SQL HTTP surface. Ctrl-C tears
 # the whole tree down. Logs land next to the persist root as
 # historical-<i>.log.
+#
+# Elastic topology (no restart of the running members):
+#
+#   scripts/start-sdot-cluster.sh add-node <persist-root> <host:port>
+#       publishes the grown epoch record AND starts the joining
+#       historical in the foreground (it warms its shards before
+#       advertising ready; the broker swaps on its own).
+#   scripts/start-sdot-cluster.sh remove-node <persist-root> <host:port>
+#       publishes the shrunken record; the removed node drains its
+#       in-flight subqueries and fences itself — no kill needed.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+case "${1:-}" in
+add-node)
+    ROOT="${2:?usage: start-sdot-cluster.sh add-node <persist-root> <host:port>}"
+    ADDR="${3:?usage: start-sdot-cluster.sh add-node <persist-root> <host:port>}"
+    shift 3
+    python -m spark_druid_olap_tpu.cluster epoch add-node "$ADDR" \
+        --persist "$ROOT" --note "start-sdot-cluster.sh add-node"
+    NODES=$(python -m spark_druid_olap_tpu.cluster epoch show \
+        --persist "$ROOT" |
+        python -c 'import json,sys; print(",".join(json.load(sys.stdin)["nodes"]))')
+    NODE_ID=$(NODES="$NODES" ADDR="$ADDR" python -c \
+        'import os; print(os.environ["NODES"].split(",").index(os.environ["ADDR"]))')
+    echo "epoch published; starting historical $NODE_ID on $ADDR"
+    # SDOT_HISTORICAL_ARGS: extra --set overrides, same as the spawn path
+    # shellcheck disable=SC2086 — word splitting is the point
+    exec python -m spark_druid_olap_tpu.cluster historical \
+        --persist "$ROOT" --nodes "$NODES" --node-id "$NODE_ID" \
+        ${SDOT_HISTORICAL_ARGS:-} "$@"
+    ;;
+remove-node)
+    ROOT="${2:?usage: start-sdot-cluster.sh remove-node <persist-root> <host:port>}"
+    ADDR="${3:?usage: start-sdot-cluster.sh remove-node <persist-root> <host:port>}"
+    python -m spark_druid_olap_tpu.cluster epoch remove-node "$ADDR" \
+        --persist "$ROOT" --note "start-sdot-cluster.sh remove-node"
+    echo "epoch published; $ADDR will drain and fence itself once the"
+    echo "survivors cover its shards (watch its /readyz flip to 503)"
+    exit 0
+    ;;
+esac
 
 ROOT="${1:?usage: start-sdot-cluster.sh <persist-root> [n] [broker-port] [base-port]}"
 N="${2:-2}"

@@ -13,6 +13,21 @@ Broker (fronts the cluster on the ordinary SQL HTTP surface)::
 Every member must see the same --persist root and the same --nodes
 list: the shard plan is recomputed identically from those two inputs.
 ``scripts/start-sdot-cluster.sh`` wraps the N+1 process spawn.
+
+Topology changes go through plan epochs (cluster/epoch.py) — no
+restart of the running members::
+
+    python -m spark_druid_olap_tpu.cluster epoch show --persist /data/sdot
+    python -m spark_druid_olap_tpu.cluster epoch add-node h2:9103 \
+        --persist /data/sdot
+    python -m spark_druid_olap_tpu.cluster epoch remove-node h1:9102 \
+        --persist /data/sdot
+
+``add-node`` publishes the record; the new historical process is
+started separately (``scripts/start-sdot-cluster.sh add-node`` does
+both). ``remove-node`` publishes the shrunken record; the removed
+node drains its in-flight subqueries and fences itself once the
+survivors cover its shards.
 """
 
 from __future__ import annotations
@@ -35,6 +50,44 @@ def _common(p: argparse.ArgumentParser) -> None:
                    help="extra sdot.* config overrides (repeatable)")
 
 
+def _epoch_cmd(ap: argparse.ArgumentParser, args) -> int:
+    import json
+
+    from spark_druid_olap_tpu.cluster import epoch as EP
+
+    rec = EP.read_epoch(args.persist)
+    if args.action == "show":
+        if rec is None:
+            print(json.dumps({"epoch": None, "nodes": [],
+                              "note": "no epoch record published; "
+                                      "members use the static --nodes "
+                                      "bootstrap"}))
+        else:
+            print(json.dumps(rec.to_dict()))
+        return 0
+    if not args.address:
+        ap.error(f"epoch {args.action} needs a host:port address")
+    base = rec.nodes if rec is not None else tuple(
+        n.strip() for n in args.nodes.split(",") if n.strip())
+    if not base and args.action == "add-node":
+        ap.error("no epoch record exists yet; pass the current "
+                 "membership via --nodes")
+    if args.action == "add-node":
+        if args.address in base:
+            ap.error(f"{args.address} is already a member")
+        new_nodes = tuple(base) + (args.address,)
+    else:
+        if args.address not in base:
+            ap.error(f"{args.address} is not a member of {list(base)}")
+        new_nodes = tuple(n for n in base if n != args.address)
+        if not new_nodes:
+            ap.error("refusing to publish an empty cluster")
+    out = EP.publish_epoch(args.persist, new_nodes,
+                           note=args.note or args.action)
+    print(json.dumps(out.to_dict()))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m spark_druid_olap_tpu.cluster")
     sub = ap.add_subparsers(dest="role", required=True)
@@ -46,7 +99,22 @@ def main(argv=None) -> int:
     _common(b)
     b.add_argument("--host", default="0.0.0.0")
     b.add_argument("--port", type=int, default=8082)
+    e = sub.add_parser("epoch", help="show or roll the plan epoch "
+                                     "(elastic topology, no restart)")
+    e.add_argument("action", choices=["show", "add-node", "remove-node"])
+    e.add_argument("address", nargs="?",
+                   help="host:port for add-node / remove-node")
+    e.add_argument("--persist", required=True,
+                   help="deep storage root (the coordination substrate)")
+    e.add_argument("--nodes", default="",
+                   help="bootstrap host:port list; only needed when no "
+                        "epoch record has been published yet")
+    e.add_argument("--note", default="",
+                   help="free-form note stored in the epoch record")
     args = ap.parse_args(argv)
+
+    if args.role == "epoch":
+        return _epoch_cmd(ap, args)
 
     overrides = {
         "sdot.persist.path": args.persist,

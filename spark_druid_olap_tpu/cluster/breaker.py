@@ -99,6 +99,16 @@ class BreakerBoard:
                     b.open_since = time.monotonic()
                     self.counters["opens"] += 1
 
+    def reset(self, node_id):
+        """Forget one node's breaker state (close it, zero the failure
+        count). Called when the node's *generation* changes — a
+        historical that left and rejoined, or a restarted process
+        reusing the slot — so the successor never inherits the
+        predecessor's open circuit (the PR 12 rejoin bug). Counter
+        totals are preserved; only per-node state clears."""
+        with self._lock:
+            self._nodes[node_id] = _Breaker()
+
     def is_open(self, node_id):
         """True when attempts against the node are currently refused
         (used only to order replica chains, never to skip outright)."""

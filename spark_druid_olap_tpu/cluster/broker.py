@@ -38,6 +38,25 @@ marks, graceful degradation (docs/CHAOS.md):
   unreachable, surviving shards still answer, annotated with
   ``degraded={missing_shards, coverage_rows}`` — never cached. Strict
   mode keeps the exact-or-ShardUnavailable contract.
+
+Elastic topology (cluster/epoch.py): all routing state lives in an
+immutable-per-epoch ``_EpochState`` (node list, plan, breaker board,
+down map). The prober tick polls deep storage for a newer epoch record;
+a newer one becomes the *pending* state, and the broker keeps
+scattering against the ACTIVE state until every shard of the pending
+plan has at least one owner advertising it warm on the extended
+``/readyz`` (``assign.plan_fully_warm``) — then the swap is one
+reference assignment, and in-flight scatters (which captured the old
+state at entry) finish against nodes that are still draining, never
+fenced. Each epoch gets a FRESH breaker board, and within an epoch a
+node whose ``/readyz`` boot generation changes gets its breaker reset —
+a rejoining process never inherits its predecessor's open circuit.
+
+The shard-level subquery cache (cluster/subqcache.py) sits in front of
+the scatter: partials are keyed by (subquery shape, shard identity,
+ingest version) — node- and epoch-free — so a repeated dashboard storm
+re-sends RPCs only for shards whose data could have changed, and a
+topology change invalidates nothing.
 """
 
 from __future__ import annotations
@@ -52,15 +71,22 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
+from spark_druid_olap_tpu.cluster import epoch as EP
 from spark_druid_olap_tpu.cluster import merge as MG
+from spark_druid_olap_tpu.cluster import subqcache as SQC
 from spark_druid_olap_tpu.cluster import wire as WIRE
 from spark_druid_olap_tpu.cluster.assign import (
-    ClusterPlan, parse_nodes, plan_cluster, shard_name)
+    parse_nodes, plan_cluster, plan_diff, plan_fully_warm, shard_name)
+from spark_druid_olap_tpu.cluster.autoscale import AutoscaleHook
 from spark_druid_olap_tpu.cluster.breaker import BreakerBoard
 from spark_druid_olap_tpu.ir import serde as SERDE
 from spark_druid_olap_tpu.ir import spec as S
 from spark_druid_olap_tpu.result import QueryResult
 from spark_druid_olap_tpu.utils.config import (
+    CLUSTER_AUTOSCALE_COOLDOWN_SECONDS,
+    CLUSTER_AUTOSCALE_ENABLED,
+    CLUSTER_AUTOSCALE_QUEUE_HIGH,
+    CLUSTER_AUTOSCALE_QUEUE_LOW,
     CLUSTER_BREAKER_COOLDOWN_SECONDS,
     CLUSTER_BREAKER_FAILURES,
     CLUSTER_HEDGE_AFTER_MS,
@@ -72,6 +98,7 @@ from spark_druid_olap_tpu.utils.config import (
     CLUSTER_PARTIAL_RESULTS,
     CLUSTER_PROBE_INTERVAL_SECONDS,
     CLUSTER_PROBE_JITTER,
+    CLUSTER_REBALANCE_STRATEGY,
     CLUSTER_REPLICATION,
     CLUSTER_RETRY_BACKOFF_CAP_SECONDS,
     CLUSTER_RETRY_BACKOFF_START_SECONDS,
@@ -79,6 +106,8 @@ from spark_druid_olap_tpu.utils.config import (
     CLUSTER_RPC_TIMEOUT_SECONDS,
     CLUSTER_SCATTER_THREADS,
     CLUSTER_SHARDS,
+    CLUSTER_SUBQ_CACHE_ENABLED,
+    CLUSTER_SUBQ_CACHE_MAX_BYTES,
     PERSIST_PATH,
 )
 from spark_druid_olap_tpu.utils.retry import backoff
@@ -147,23 +176,39 @@ class _LocalFallback(Exception):
         self.reason = reason
 
 
+class _EpochState:
+    """Everything the scatter path reads about ONE topology epoch —
+    captured once at query entry, so an epoch swap mid-scatter cannot
+    mix node lists, plans, or breaker boards. ``down`` and ``boot_ids``
+    are mutable (guarded by the client lock) but die with the state."""
+
+    __slots__ = ("record", "nodes", "plan", "breakers", "down",
+                 "boot_ids")
+
+    def __init__(self, record, plan, breakers):
+        self.record = record                # EpochRecord
+        self.nodes = record.addresses       # ((host, port), ...)
+        self.plan = plan
+        self.breakers = breakers
+        self.down: Dict[int, float] = {}    # node id -> down-since
+        self.boot_ids: Dict[int, str] = {}  # node id -> last seen boot gen
+
+
 class ClusterClient:
     def __init__(self, ctx):
         self.ctx = ctx
         self.engine = ctx.engine
         self.config = ctx.config
-        self.nodes = parse_nodes(self.config.get(CLUSTER_NODES))
-        if not self.nodes:
+        boot_nodes = parse_nodes(self.config.get(CLUSTER_NODES))
+        if not boot_nodes:
             raise ValueError("ClusterClient needs sdot.cluster.nodes")
         root = self.config.get(PERSIST_PATH)
         if not root:
             raise ValueError(
                 "the cluster tier coordinates through deep storage; "
                 "set sdot.persist.path on every member")
-        self.plan: ClusterPlan = plan_cluster(
-            root, len(self.nodes),
-            int(self.config.get(CLUSTER_REPLICATION)),
-            int(self.config.get(CLUSTER_SHARDS)))
+        self.root = root
+        self.strategy = str(self.config.get(CLUSTER_REBALANCE_STRATEGY))
         self.rpc_timeout = float(
             self.config.get(CLUSTER_RPC_TIMEOUT_SECONDS))
         self.tries = max(1, int(self.config.get(CLUSTER_RETRY_TRIES)))
@@ -173,10 +218,25 @@ class ClusterClient:
             self.config.get(CLUSTER_RETRY_BACKOFF_CAP_SECONDS))
         self.local_fallback = bool(self.config.get(CLUSTER_LOCAL_FALLBACK))
         self.fault = getattr(ctx.engine, "fault", None)
-        self.breakers = BreakerBoard(
-            len(self.nodes),
-            int(self.config.get(CLUSTER_BREAKER_FAILURES)),
-            float(self.config.get(CLUSTER_BREAKER_COOLDOWN_SECONDS)))
+        # epoch 0 is implicit (the static config list) unless deep
+        # storage already holds a published record — then that record,
+        # not the config, is the fleet's truth
+        rec = EP.read_epoch(root)
+        if rec is None:
+            rec = EP.bootstrap_record(
+                tuple(f"{h}:{p}" for h, p in boot_nodes))
+        self._active: _EpochState = self._mk_state(rec)
+        self._pending: Optional[_EpochState] = None
+        self.last_rebalance: Optional[dict] = None
+        self.subq_cache = SQC.SubqueryCache(
+            int(self.config.get(CLUSTER_SUBQ_CACHE_MAX_BYTES))
+            if bool(self.config.get(CLUSTER_SUBQ_CACHE_ENABLED)) else 0)
+        self.autoscale: Optional[AutoscaleHook] = None
+        if bool(self.config.get(CLUSTER_AUTOSCALE_ENABLED)):
+            self.autoscale = AutoscaleHook(
+                float(self.config.get(CLUSTER_AUTOSCALE_QUEUE_HIGH)),
+                float(self.config.get(CLUSTER_AUTOSCALE_QUEUE_LOW)),
+                float(self.config.get(CLUSTER_AUTOSCALE_COOLDOWN_SECONDS)))
         self.hedge_enabled = bool(self.config.get(CLUSTER_HEDGE_ENABLED))
         self.hedge_after_ms = float(self.config.get(CLUSTER_HEDGE_AFTER_MS))
         self.hedge_quantile = float(self.config.get(CLUSTER_HEDGE_QUANTILE))
@@ -184,13 +244,15 @@ class ClusterClient:
         self.probe_jitter = bool(self.config.get(CLUSTER_PROBE_JITTER))
         self._latencies = deque(maxlen=512)     # recent subquery RPC seconds
         self._lock = threading.Lock()
-        self._down: Dict[int, float] = {}       # node id -> down-since
         self.counters = {"queries": 0, "scatters": 0, "subqueries": 0,
                          "retries": 0, "failovers": 0, "local_fallbacks": 0,
                          "shards_pruned": 0, "merge_ms": 0.0,
                          "probe_marks_down": 0, "probe_marks_up": 0,
                          "wire_corrupt": 0, "hedges_launched": 0,
-                         "hedges_won": 0, "degraded_queries": 0}
+                         "hedges_won": 0, "degraded_queries": 0,
+                         "epoch_checks": 0, "epoch_swaps": 0,
+                         "breaker_resets": 0,
+                         "subq_cache_hits": 0, "subq_cache_misses": 0}
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, int(self.config.get(CLUSTER_SCATTER_THREADS))),
             thread_name_prefix="sdot-scatter")
@@ -210,22 +272,107 @@ class ClusterClient:
             self._prober = None
         self._pool.shutdown(wait=False)
 
-    # -- health ----------------------------------------------------------------
-    def _mark_down(self, node_id: int, probe: bool = False) -> None:
+    # -- epoch state -----------------------------------------------------------
+    # back-compat views over the ACTIVE epoch: code and tests written
+    # against the static-topology broker keep reading .nodes/.plan/
+    # .breakers and transparently follow swaps
+    @property
+    def nodes(self):
+        return self._active.nodes
+
+    @property
+    def plan(self):
+        return self._active.plan
+
+    @property
+    def breakers(self):
+        return self._active.breakers
+
+    def _mk_state(self, record) -> _EpochState:
+        plan = plan_cluster(
+            self.root, len(record.nodes),
+            int(self.config.get(CLUSTER_REPLICATION)),
+            int(self.config.get(CLUSTER_SHARDS)),
+            node_keys=record.ids, epoch=record.epoch,
+            strategy=self.strategy)
+        # a FRESH breaker board per epoch: node id i of epoch E+1 may be
+        # a different machine than node id i of epoch E, and must not
+        # inherit its circuit state (satellite bugfix, structurally)
+        breakers = BreakerBoard(
+            len(record.nodes),
+            int(self.config.get(CLUSTER_BREAKER_FAILURES)),
+            float(self.config.get(CLUSTER_BREAKER_COOLDOWN_SECONDS)))
+        return _EpochState(record, plan, breakers)
+
+    def check_epoch(self) -> bool:
+        """One step of the broker's handover dance: adopt a newer disk
+        record as the *pending* state, and swap it active once every
+        shard of its plan is advertised warm by at least one owner.
+        Called from the prober tick; tests with the prober disabled call
+        it directly. Returns True when the active epoch changed."""
         with self._lock:
-            if node_id not in self._down:
-                self._down[node_id] = _time.time()
+            self.counters["epoch_checks"] += 1
+        try:
+            rec = EP.read_epoch(self.root)
+        except EP.EpochCorrupt:
+            return False        # stay on the running epoch; nothing sane on disk
+        if rec is None:
+            return False
+        act = self._active
+        pend = self._pending
+        if rec.epoch > act.record.epoch and (
+                pend is None or pend.record.epoch != rec.epoch):
+            # a newer record supersedes any half-warmed pending epoch —
+            # its nodes re-advertise under the newest epoch instead
+            pend = self._mk_state(rec)
+            with self._lock:
+                self._pending = pend
+        if pend is None:
+            return False
+        if not plan_fully_warm(pend.plan, self._gather_adverts(pend)):
+            return False
+        diff = plan_diff(act.plan, pend.plan)
+        with self._lock:
+            self._active = pend
+            self._pending = None
+            self.counters["epoch_swaps"] += 1
+            self.last_rebalance = {
+                "from_epoch": act.record.epoch,
+                "to_epoch": pend.record.epoch,
+                "strategy": self.strategy, **diff.summary()}
+        return True
+
+    def _gather_adverts(self, st: _EpochState) -> Dict[int, set]:
+        """node id -> shard-store names that node advertises warm for
+        ``st``'s epoch (extended /readyz). Unreachable nodes simply
+        advertise nothing — the gate stays closed until they answer."""
+        out: Dict[int, set] = {}
+        want = str(st.record.epoch)
+        for nid in range(len(st.nodes)):
+            _ok, info = self._probe(st, nid)
+            ep = ((info or {}).get("epochs") or {}).get(want)
+            if isinstance(ep, dict) and ep.get("ready"):
+                out[nid] = set(ep.get("shards") or ())
+        return out
+
+    # -- health ----------------------------------------------------------------
+    def _mark_down(self, st: _EpochState, node_id: int,
+                   probe: bool = False) -> None:
+        with self._lock:
+            if node_id not in st.down:
+                st.down[node_id] = _time.time()
                 if probe:
                     self.counters["probe_marks_down"] += 1
 
-    def _mark_up(self, node_id: int, probe: bool = False) -> None:
+    def _mark_up(self, st: _EpochState, node_id: int,
+                 probe: bool = False) -> None:
         with self._lock:
-            if self._down.pop(node_id, None) is not None and probe:
+            if st.down.pop(node_id, None) is not None and probe:
                 self.counters["probe_marks_up"] += 1
 
-    def _is_down(self, node_id: int) -> bool:
+    def _is_down(self, st: _EpochState, node_id: int) -> bool:
         with self._lock:
-            return node_id in self._down
+            return node_id in st.down
 
     def _probe_loop(self, interval: float) -> None:
         # decorrelated jitter so N brokers probing the same rejoining
@@ -234,30 +381,78 @@ class ClusterClient:
         rng = _random.Random()
         delay = interval
         while not self._stop.wait(delay):
-            for nid in range(len(self.nodes)):
+            try:
+                self.check_epoch()
+            except Exception:  # noqa: BLE001 — a bad record must not kill probes
+                pass
+            st = self._active
+            depths = []
+            for nid in range(len(st.nodes)):
                 if self._stop.is_set():
                     return
-                if self._probe(nid):
-                    self._mark_up(nid, probe=True)
+                ok, info = self._probe(st, nid)
+                if ok:
+                    self._mark_up(st, nid, probe=True)
                 else:
-                    self._mark_down(nid, probe=True)
+                    self._mark_down(st, nid, probe=True)
+                boot = (info or {}).get("boot")
+                if boot is not None:
+                    prev = st.boot_ids.get(nid)
+                    if prev is not None and prev != boot:
+                        # same address, new process generation: the
+                        # predecessor's circuit state is meaningless
+                        st.breakers.reset(nid)
+                        with self._lock:
+                            self.counters["breaker_resets"] += 1
+                    st.boot_ids[nid] = boot
+                if ok and self.autoscale is not None:
+                    d = self._wlm_depth(st, nid)
+                    if d is not None:
+                        depths.append(d)
+            if self.autoscale is not None:
+                self.autoscale.observe(
+                    depths,
+                    handover_in_progress=self._pending is not None)
             if self.probe_jitter:
                 delay = backoff(interval * 0.5, interval * 1.5, 1,
                                 prev=delay, rng=rng)
             else:
                 delay = interval
 
-    def _probe(self, node_id: int) -> bool:
-        host, port = self.nodes[node_id]
+    def _probe(self, st: _EpochState, node_id: int):
+        """GET /readyz -> (ready, parsed body or None)."""
+        host, port = st.nodes[node_id]
         conn = http.client.HTTPConnection(
             host, port, timeout=min(2.0, self.rpc_timeout))
         try:
             conn.request("GET", "/readyz")
             resp = conn.getresponse()
-            resp.read()
-            return resp.status == 200
+            body = resp.read()
+            try:
+                info = json.loads(body.decode("utf-8"))
+            except ValueError:
+                info = None
+            return resp.status == 200, info
         except OSError:
-            return False
+            return False, None
+        finally:
+            conn.close()
+
+    def _wlm_depth(self, st: _EpochState, node_id: int) -> Optional[float]:
+        """One node's total queued-query depth (autoscale signal)."""
+        host, port = st.nodes[node_id]
+        conn = http.client.HTTPConnection(
+            host, port, timeout=min(2.0, self.rpc_timeout))
+        try:
+            conn.request("GET", "/metadata/wlm")
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                return None
+            lanes = json.loads(body.decode("utf-8")).get("lanes") or []
+            return float(sum(ln.get("queued", 0) for ln in lanes))
+        except (OSError, ValueError):
+            return None
         finally:
             conn.close()
 
@@ -285,12 +480,18 @@ class ClusterClient:
         """Distributed answer, or None to run locally (never raises for
         conditions local execution can absorb)."""
         self.counters["queries"] += 1
+        # capture the epoch state ONCE: a swap mid-scatter must not mix
+        # the old plan with the new node list (old-epoch nodes keep
+        # serving through their drain grace precisely for us)
+        st = self._active
         try:
             sub, posts, having, limit, key_cols, aggs = _strip(q)
             body = json.dumps(SERDE.query_to_dict(sub)).encode("utf-8")
         except (ValueError, TypeError) as e:
             return self._local(f"serde: {e}")
-        dp = self.plan.datasources[q.datasource]
+        dp = st.plan.datasources.get(q.datasource)
+        if dp is None:
+            return self._local("datasource not in the captured plan")
         deadline = None
         tm = getattr(q.context, "timeout_millis", None)
         if tm:
@@ -314,22 +515,42 @@ class ClusterClient:
             # cheaper (and shape-exact) on the broker's local engine
             return self._local("all shards pruned by query interval")
         partial = bool(self.config.get(CLUSTER_PARTIAL_RESULTS))
+        # shard-level cache in front of the scatter: a hit replays the
+        # decoded partial (merge never mutates parts) with zero RPCs;
+        # keys are (shape, shard, ingest version) — node- and
+        # epoch-free, so entries survive topology changes
+        bkey = SQC.body_key(body)
+        cache = self.subq_cache
         futs = []
-        for sh in shards:
-            name = shard_name(q.datasource, sh.index, dp.n_shards)
-            futs.append((sh, self._pool.submit(
-                self._run_shard, body, name, sh.owners, deadline, partial)))
-        self.counters["scatters"] += len(futs)
         parts, nodes_used = [], set()
         missing, covered_rows, total_rows = [], 0, 0
-        err: Optional[Exception] = None
-        for sh, f in futs:
+        cache_hits = 0
+        for sh in shards:
             total_rows += sh.rows
+            ck = cache.key(bkey, q.datasource, sh.index, dp.n_shards,
+                           dp.ingest_version)
+            data = cache.get(ck) if cache.enabled else None
+            if data is not None:
+                cache_hits += 1
+                parts.append(data)
+                covered_rows += sh.rows
+                continue
+            name = shard_name(q.datasource, sh.index, dp.n_shards)
+            futs.append((sh, ck, self._pool.submit(
+                self._run_shard, st, body, name, sh.owners, deadline,
+                partial)))
+        self.counters["scatters"] += len(futs)
+        if cache.enabled:
+            self.counters["subq_cache_hits"] += cache_hits
+            self.counters["subq_cache_misses"] += len(futs)
+        err: Optional[Exception] = None
+        for sh, ck, f in futs:
             try:
-                data, nid = f.result()
+                data, nid, nbytes = f.result()
                 parts.append(data)
                 nodes_used.add(nid)
                 covered_rows += sh.rows
+                cache.put(ck, data, nbytes)
             except ShardUnavailable as e:
                 # degraded mode: answer from the survivors and say so
                 if partial:
@@ -370,9 +591,12 @@ class ClusterClient:
             r = QueryResult(names, data)
         r.degraded = degraded
         cl_stats = {
-            "mode": "scatter", "shards": len(futs),
+            "mode": "scatter", "shards": len(futs) + cache_hits,
             "shards_pruned": pruned, "nodes": sorted(nodes_used),
+            "epoch": st.record.epoch,
             "merge_ms": round(merge_ms, 3)}
+        if cache.enabled:
+            cl_stats["subq_cache_hits"] = cache_hits
         if degraded is not None:
             cl_stats["degraded"] = degraded
         self.engine.last_stats["cluster"] = cl_stats
@@ -387,15 +611,17 @@ class ClusterClient:
                                              "reason": reason[:200]}
         return None
 
-    def _run_shard(self, body: bytes, shard_ds: str,
+    def _run_shard(self, st: _EpochState, body: bytes, shard_ds: str,
                    owners: Tuple[int, ...], deadline: Optional[float],
                    partial: bool = False):
-        """One shard's replica chain. Returns (data dict, serving node).
-        Raises _LocalFallback for conditions remote retries cannot fix,
+        """One shard's replica chain against one captured epoch state.
+        Returns (data dict, serving node, encoded frame bytes). Raises
+        _LocalFallback for conditions remote retries cannot fix,
         ShardUnavailable when every replica stayed unreachable (caught
         per shard in partial mode; otherwise strict-mode contract, with
         whole-query local fallback when that is enabled)."""
-        payload = _patch_datasource(body, shard_ds)
+        payload = WIRE.patch_subquery(body, shard_ds,
+                                      epoch=st.record.epoch)
         delay = None
         attempt = 0
         last = "no attempt"
@@ -403,8 +629,8 @@ class ClusterClient:
             # up-and-closed nodes first; downed / breaker-open replicas
             # are still tried last (the prober may lag a recovery, and a
             # cooled-down breaker admits a half-open probe)
-            chain = sorted(owners, key=lambda n: (self._is_down(n),
-                                                  self.breakers.is_open(n)))
+            chain = sorted(owners, key=lambda n: (self._is_down(st, n),
+                                                  st.breakers.is_open(n)))
             hedge_after = self._hedge_after_s() if _pass == 0 else None
             for pos, nid in enumerate(chain):
                 if deadline is not None and _time.time() >= deadline:
@@ -416,7 +642,7 @@ class ClusterClient:
                                       and len(chain) > 1) else None
                 try:
                     status, resp, served = self._attempt(
-                        nid, payload, deadline, backup, hedge_after)
+                        st, nid, payload, deadline, backup, hedge_after)
                 except _BreakerOpen as e:
                     last = f"node {e.node_id}: breaker open"
                     continue
@@ -433,7 +659,7 @@ class ClusterClient:
                         self.counters["wire_corrupt"] += 1
                         last = f"node {served}: {e}"
                         continue
-                    return data, served
+                    return data, served, len(resp)
                 info = WIRE.decode_error(resp)
                 kind = info.get("error", "")
                 if kind in ("EngineFallback", "Unsupported", "BadQuery"):
@@ -442,11 +668,11 @@ class ClusterClient:
                     raise _LocalFallback(f"node {served}: {kind}: "
                                          f"{info.get('message', '')[:120]}")
                 # AdmissionRejected (node shedding), unknown shard
-                # (stale rejoin), or a node-side crash: retryable on a
-                # replica / next pass
+                # (stale rejoin), Draining (mid-handover fence), or a
+                # node-side crash: retryable on a replica / next pass
                 last = f"node {served}: http {status} {kind}"
                 if status == 404:
-                    self._mark_down(served)
+                    self._mark_down(st, served)
             delay = backoff(self.backoff_start, self.backoff_cap,
                             attempt, prev=delay)
             attempt += 1
@@ -480,20 +706,21 @@ class ClusterClient:
         q = lat[min(len(lat) - 1, int(len(lat) * self.hedge_quantile))]
         return max(q, self.hedge_min_ms / 1000.0)
 
-    def _attempt(self, nid: int, payload: bytes, deadline: Optional[float],
+    def _attempt(self, st: _EpochState, nid: int, payload: bytes,
+                 deadline: Optional[float],
                  backup: Optional[int], hedge_after: Optional[float]):
         """One subquery attempt against ``nid``, optionally racing a
         hedge to ``backup`` after ``hedge_after`` seconds. Returns
         (status, body, serving node)."""
         if backup is None or hedge_after is None:
-            status, resp = self._guarded_rpc(nid, payload, deadline)
+            status, resp = self._guarded_rpc(st, nid, payload, deadline)
             return status, resp, nid
         race = _HedgeRace(total=2)
         try:
             for leg_nid, leg_delay in ((nid, 0.0), (backup, hedge_after)):
                 threading.Thread(
                     target=self._race_leg,
-                    args=(race, leg_nid, payload, deadline, leg_delay),
+                    args=(race, st, leg_nid, payload, deadline, leg_delay),
                     name="sdot-hedge", daemon=True).start()
             race.done.wait(self.rpc_timeout + hedge_after + 5.0)
             win, errors = race.result()
@@ -512,8 +739,9 @@ class ClusterClient:
             raise errors[0][1]
         raise OSError(f"hedge race against nodes {nid}/{backup} timed out")
 
-    def _race_leg(self, race: _HedgeRace, nid: int, payload: bytes,
-                  deadline: Optional[float], delay_s: float) -> None:
+    def _race_leg(self, race: _HedgeRace, st: _EpochState, nid: int,
+                  payload: bytes, deadline: Optional[float],
+                  delay_s: float) -> None:
         out, err = None, None
         try:
             if delay_s > 0:
@@ -522,40 +750,40 @@ class ClusterClient:
                 with self._lock:
                     self.counters["hedges_launched"] += 1
             try:
-                status, resp = self._guarded_rpc(nid, payload, deadline)
+                status, resp = self._guarded_rpc(st, nid, payload, deadline)
                 out = (status, resp, nid)
             except (_BreakerOpen, OSError) as e:
                 err = e
         finally:
             race.settle(nid, out, err)
 
-    def _guarded_rpc(self, node_id: int, payload: bytes,
+    def _guarded_rpc(self, st: _EpochState, node_id: int, payload: bytes,
                      deadline: Optional[float]) -> Tuple[int, bytes]:
         """_rpc wrapped in the node's circuit breaker + health marks."""
-        tok = self.breakers.before_attempt(node_id)
+        tok = st.breakers.before_attempt(node_id)
         ok = False
         try:
             if tok is None:
                 raise _BreakerOpen(node_id)
             try:
-                status, resp = self._rpc(node_id, payload, deadline)
+                status, resp = self._rpc(st, node_id, payload, deadline)
             except OSError:
-                self._mark_down(node_id)
+                self._mark_down(st, node_id)
                 raise
             ok = status < 500       # any coherent reply = node is alive
         finally:
             if tok is not None:
-                self.breakers.settle(tok, ok)
-        self._mark_up(node_id)
+                st.breakers.settle(tok, ok)
+        self._mark_up(st, node_id)
         return status, resp
 
-    def _rpc(self, node_id: int, payload: bytes,
+    def _rpc(self, st: _EpochState, node_id: int, payload: bytes,
              deadline: Optional[float]) -> Tuple[int, bytes]:
         inj = self.fault
         key = f"node:{node_id}"
         if inj is not None:
             inj.fire("rpc.connect", key)
-        host, port = self.nodes[node_id]
+        host, port = st.nodes[node_id]
         timeout = self.rpc_timeout
         if deadline is not None:
             timeout = max(0.05, min(timeout, deadline - _time.time()))
@@ -578,18 +806,23 @@ class ClusterClient:
 
     # -- introspection ---------------------------------------------------------
     def stats(self) -> dict:
+        st = self._active
+        pend = self._pending
         with self._lock:
             down = {nid: round(_time.time() - t, 1)
-                    for nid, t in self._down.items()}
+                    for nid, t in st.down.items()}
             counters = dict(self.counters)
-        return {
+            rebalance = dict(self.last_rebalance) \
+                if self.last_rebalance else None
+        out = {
             "enabled": True,
             "nodes": [{"id": i, "host": h, "port": p,
+                       "key": st.record.ids[i],
                        "state": "down" if i in down else "up",
                        "down_seconds": down.get(i)}
-                      for i, (h, p) in enumerate(self.nodes)],
-            "replication": self.plan.replication,
-            "breakers": self.breakers.snapshot(),
+                      for i, (h, p) in enumerate(st.nodes)],
+            "replication": st.plan.replication,
+            "breakers": st.breakers.snapshot(),
             "datasources": {
                 name: {"shards": dp.n_shards,
                        "segments": dp.num_segments,
@@ -597,9 +830,18 @@ class ClusterClient:
                        "ingest_version": dp.ingest_version,
                        "owners": {str(sh.index): list(sh.owners)
                                   for sh in dp.shards}}
-                for name, dp in self.plan.datasources.items()},
+                for name, dp in st.plan.datasources.items()},
             "counters": counters,
+            "epoch": {"active": st.record.epoch,
+                      "pending": pend.record.epoch
+                      if pend is not None else None,
+                      "strategy": self.strategy},
+            "rebalance": rebalance,
+            "subq_cache": self.subq_cache.stats(),
         }
+        if self.autoscale is not None:
+            out["autoscale"] = self.autoscale.stats()
+        return out
 
 
 def _strip(q):
@@ -634,11 +876,3 @@ def _strip(q):
         + [d.output_name for d in dims]
     aggs = [(a.name, a.kind) for a in q.aggregations]
     return sub, posts, having, limit, key_cols, aggs
-
-
-def _patch_datasource(body: bytes, shard_ds: str) -> bytes:
-    """Retarget an encoded subquery at one shard store. Decoding the
-    JSON once per shard beats re-running full spec serde per shard."""
-    d = json.loads(body.decode("utf-8"))
-    d["dataSource"] = shard_ds
-    return json.dumps(d, separators=(",", ":")).encode("utf-8")

@@ -26,27 +26,105 @@ A datasource whose recovered state runs PAST the planned manifest (WAL
 tail appended after the last checkpoint) is kept whole and unsliced:
 the broker's matching ingest-version check already serves it locally,
 and slicing would silently drop the WAL rows here.
+
+Elastic topology (cluster/epoch.py): a watcher thread polls deep
+storage for a newer plan epoch and runs this node's side of the
+handover —
+
+- still a member: **warm before advertise** — newly owned shards are
+  re-recovered from the cold tier (``PersistManager.restore`` +
+  ``slice_tiered``/``slice_segments``) while the node keeps serving the
+  old epoch; only when every new shard is registered does the node
+  advertise the epoch on the extended ``/readyz``, which is what the
+  broker's swap gate reads. Old-epoch-only shard stores are retired
+  lazily, once a request stamped with the new epoch proves the broker
+  has swapped.
+- dropped from the record: **drain then fence** — the node keeps
+  serving until it observes the same every-shard-warm condition the
+  broker gates on (``assign.plan_fully_warm``), waits a grace period
+  for the broker's poll lag, fires the ``node.drain`` chaos site, stops
+  admitting subqueries (503 ``Draining``), waits for in-flight ones to
+  finish (bounded), and fences. The begin/end subquery pair is
+  registered with the sdlint leaks pass: no path may leave drain
+  holding an in-flight count.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
-from typing import Optional
+import time
+from typing import Dict, Optional
 from urllib.parse import urlparse
 
+from spark_druid_olap_tpu.cluster import epoch as EP
 from spark_druid_olap_tpu.cluster import wire as WIRE
 from spark_druid_olap_tpu.cluster.assign import (
-    parse_nodes, plan_cluster, shard_name)
+    parse_nodes, plan_cluster, plan_fully_warm, shard_name)
 from spark_druid_olap_tpu.server.http import SqlServer
 from spark_druid_olap_tpu.utils.config import (
+    CLUSTER_EPOCH_DRAIN_GRACE_SECONDS,
+    CLUSTER_EPOCH_DRAIN_TIMEOUT_SECONDS,
+    CLUSTER_EPOCH_POLL_SECONDS,
     CLUSTER_NODE_ID,
     CLUSTER_NODES,
+    CLUSTER_REBALANCE_STRATEGY,
     CLUSTER_REPLICATION,
     CLUSTER_ROLE,
     CLUSTER_SHARDS,
     PERSIST_PATH,
 )
+
+
+class DrainGate:
+    """In-flight subquery accounting for the leave protocol. Every
+    admitted subquery holds a token from :meth:`begin_subquery` that
+    MUST be returned via :meth:`end_subquery` (sdlint leaks pair);
+    after :meth:`start_drain` no new tokens are issued and
+    :meth:`wait_drained` blocks until the outstanding ones return."""
+
+    def __init__(self):
+        self._lock = threading.Lock()   # leaf — never calls out while held
+        self._inflight = 0
+        self._draining = False
+        self._idle = threading.Event()
+        self._idle.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+    def begin_subquery(self):
+        """Admit one subquery; None once draining (caller fences)."""
+        with self._lock:
+            if self._draining:
+                return None
+            self._inflight += 1
+            self._idle.clear()
+            return True
+
+    def end_subquery(self, tok) -> None:
+        if tok is None:
+            return
+        with self._lock:
+            self._inflight -= 1
+            if self._inflight <= 0:
+                self._idle.set()
+
+    def start_drain(self) -> None:
+        with self._lock:
+            self._draining = True
+            if self._inflight <= 0:
+                self._idle.set()
+
+    def wait_drained(self, timeout_s: float) -> bool:
+        """True when every in-flight subquery finished in time."""
+        return self._idle.wait(timeout_s)
 
 
 class HistoricalServer(SqlServer):
@@ -59,6 +137,11 @@ class HistoricalServer(SqlServer):
         super().__init__(None, host, port)   # ctx attaches after boot
         self.node = node
         self.ready_check = lambda: node.ready
+        # extended readiness: per-epoch shard adverts (the broker's
+        # handover gate), the boot generation (breaker reset on rejoin)
+        # and the draining flag — all plain attribute reads, keeping
+        # the lock-free /readyz contract
+        self.ready_info = node.ready_info
 
     def _handle_post(self, h):
         if urlparse(h.path).path == "/cluster/subquery":
@@ -93,10 +176,28 @@ class HistoricalNode:
             raise ValueError(
                 f"node id {self.node_id} outside the node list "
                 f"(n={len(self.addresses)})")
+        # this process's identity is its ADDRESS; node_id is just its
+        # index within the current epoch's node list and is recomputed
+        # on every epoch change
+        host, port = self.addresses[self.node_id]
+        self.address = f"{host}:{port}"
+        # fresh per process: a broker seeing this change behind the same
+        # address resets that node's breaker (rejoin must not inherit
+        # the predecessor's open circuit)
+        self.boot_id = f"{os.getpid()}.{time.time_ns()}"
         self.ready = False
         self.ctx = None
         self.plan = None
+        self.epoch_record: Optional[EP.EpochRecord] = None
         self.shards_loaded = 0
+        self.shards_warmed = 0          # via epoch handover, post-boot
+        self.epochs_joined = 0
+        self.drain = DrainGate()
+        self.fenced = False
+        self._epochs: Dict[int, dict] = {}   # epoch -> readyz advert
+        self._max_req_epoch = 0              # newest clusterEpoch seen
+        self._watch_stop = threading.Event()
+        self._watcher: Optional[threading.Thread] = None
         self.server: Optional[HistoricalServer] = None
 
     # -- lifecycle ------------------------------------------------------------
@@ -122,15 +223,42 @@ class HistoricalNode:
         # solo and the fused shared-scan decode honor this flag)
         self.ctx.engine.partial_sketches = True
         cfg = self.ctx.config
+        # a published epoch record supersedes the static config list;
+        # with none, the implicit bootstrap epoch 0 reproduces the
+        # pre-elasticity behavior byte for byte
+        rec = EP.read_epoch(cfg.get(PERSIST_PATH))
+        if rec is None:
+            rec = EP.bootstrap_record(
+                tuple(f"{h}:{p}" for h, p in self.addresses))
+        self.epoch_record = rec
+        my = rec.nodes.index(self.address) if self.address in rec.nodes \
+            else None
         self.plan = plan_cluster(
-            cfg.get(PERSIST_PATH), len(self.addresses),
+            cfg.get(PERSIST_PATH), len(rec.nodes),
             int(cfg.get(CLUSTER_REPLICATION)),
-            int(cfg.get(CLUSTER_SHARDS)))
-        self._load_shards()
+            int(cfg.get(CLUSTER_SHARDS)),
+            node_keys=rec.ids, epoch=rec.epoch,
+            strategy=str(cfg.get(CLUSTER_REBALANCE_STRATEGY)))
+        if my is not None:
+            self.node_id = my
+            self._load_shards()
+            self._advertise(rec.epoch)
+        # a node booted BEFORE the epoch that adds it: serve nothing,
+        # stay process-ready, and let the watcher warm it on join
         self.ready = True
+        poll = float(cfg.get(CLUSTER_EPOCH_POLL_SECONDS))
+        if poll > 0:
+            self._watcher = threading.Thread(
+                target=self._watch_loop, args=(poll,),
+                name="sdot-epoch-watch", daemon=True)
+            self._watcher.start()
 
     def stop(self) -> None:
         self.ready = False
+        self._watch_stop.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=2.0)
+            self._watcher = None
         if self.server is not None:
             self.server.stop()
         if self.ctx is not None:
@@ -172,16 +300,223 @@ class HistoricalNode:
             # assigned rows, the point of the tier
             store.drop(name)
 
+    # -- epoch lifecycle -------------------------------------------------------
+    def _advertise(self, epoch: int) -> None:
+        """Publish this node's warm-shard set for ``epoch`` on the
+        extended /readyz. Only fully-warmed epochs are ever advertised —
+        the broker's swap gate reads exactly this."""
+        owned = []
+        for name, shs in self.plan.shards_of(self.node_id).items():
+            dp = self.plan.datasources[name]
+            owned += [shard_name(name, sh.index, dp.n_shards)
+                      for sh in shs]
+        self._epochs[epoch] = {"ready": True, "shards": sorted(owned)}
+        for e in sorted(self._epochs)[:-2]:
+            del self._epochs[e]     # older epochs can no longer swap in
+
+    def ready_info(self) -> dict:
+        """Extra /readyz fields (lock-free: attribute reads only)."""
+        rec = self.epoch_record
+        return {"node": self.node_id, "boot": self.boot_id,
+                "epoch": rec.epoch if rec is not None else None,
+                "draining": self.drain.draining,
+                "epochs": dict(self._epochs)}
+
+    def _watch_loop(self, poll_s: float) -> None:
+        while not self._watch_stop.wait(poll_s):
+            try:
+                self.check_epoch()
+            except Exception:  # noqa: BLE001 — a bad record must not kill the node
+                pass
+            if self.fenced:
+                return
+
+    def check_epoch(self) -> Optional[str]:
+        """Run one step of this node's handover dance against the
+        current deep-storage epoch record. Called from the watcher
+        thread; tests with the watcher disabled call it directly.
+        Returns "warmed" (joined / rebalanced into the new epoch),
+        "left" (drained and fenced), or None (nothing newer)."""
+        if self.ctx is None or self.fenced:
+            return None
+        cfg = self.ctx.config
+        root = cfg.get(PERSIST_PATH)
+        try:
+            rec = EP.read_epoch(root)
+        except EP.EpochCorrupt:
+            return None             # stay on the running epoch
+        cur = self.epoch_record
+        if rec is None or (cur is not None and rec.epoch <= cur.epoch):
+            self._retire_stale()
+            return None
+        new_plan = plan_cluster(
+            root, len(rec.nodes),
+            int(cfg.get(CLUSTER_REPLICATION)),
+            int(cfg.get(CLUSTER_SHARDS)),
+            node_keys=rec.ids, epoch=rec.epoch,
+            strategy=str(cfg.get(CLUSTER_REBALANCE_STRATEGY)))
+        if self.address in rec.nodes:
+            self._warm_epoch(rec, new_plan)
+            return "warmed"
+        self._leave(rec, new_plan)
+        return "left"
+
+    def _warm_epoch(self, rec: EP.EpochRecord, new_plan) -> None:
+        """Warm every newly owned shard from the cold tier, THEN flip
+        to the new epoch and advertise. The node keeps serving the old
+        epoch's shards throughout (both shard-store sets coexist until
+        a new-epoch request proves the broker swapped)."""
+        from spark_druid_olap_tpu.segment.store import slice_segments
+        my = rec.nodes.index(self.address)
+        store = self.ctx.store
+        for name, shs in new_plan.shards_of(my).items():
+            dp = new_plan.datasources[name]
+            have = set(store.names())
+            need = [sh for sh in shs
+                    if shard_name(name, sh.index, dp.n_shards) not in have]
+            if not need:
+                continue
+            had_full = name in have
+            if not had_full:
+                # re-materialize the full datasource from deep storage
+                # (tiered snapshots recover as loadable handles, so this
+                # faults in only what slicing touches)
+                self.ctx.persist.restore(name)
+            full = store.get(name)
+            if store.datasource_version(name) != dp.ingest_version \
+                    or full.num_segments != dp.num_segments:
+                # WAL past the manifest (see _load_shards): every broker
+                # recovered the same tail and serves this datasource
+                # locally, so its shards are vacuously warm
+                if not had_full:
+                    store.drop(name)
+                continue
+            tiered = getattr(full, "tier", None) is not None
+            if tiered:
+                from spark_druid_olap_tpu.tier.loader import slice_tiered
+            for sh in need:
+                sname = shard_name(name, sh.index, dp.n_shards)
+                shard = slice_tiered(full, sh.segment_indexes,
+                                     name=sname) if tiered \
+                    else slice_segments(full, sh.segment_indexes,
+                                        name=sname)
+                store.restore(shard, ingest_version=dp.ingest_version)
+                self.shards_warmed += 1
+            if not had_full:
+                store.drop(name)
+        self.plan = new_plan
+        self.node_id = my
+        self.epoch_record = rec
+        self.epochs_joined += 1
+        self._advertise(rec.epoch)
+
+    def _leave(self, rec: EP.EpochRecord, new_plan) -> None:
+        """The new epoch dropped this node: keep serving until the new
+        epoch can answer without us, then drain in-flight subqueries
+        and fence."""
+        cfg = self.ctx.config
+        grace = float(cfg.get(CLUSTER_EPOCH_DRAIN_GRACE_SECONDS))
+        timeout = float(cfg.get(CLUSTER_EPOCH_DRAIN_TIMEOUT_SECONDS))
+        deadline = time.monotonic() + timeout
+        # same pure gate the broker swaps on: neither side can observe
+        # "ready" before the other could
+        while (time.monotonic() < deadline
+               and not self._watch_stop.is_set()
+               and not plan_fully_warm(new_plan,
+                                       self._gather_adverts(rec))):
+            self._watch_stop.wait(0.05)
+        # absorb the broker's poll lag: it may still scatter the OLD
+        # epoch at us for one more probe interval after warm
+        self._watch_stop.wait(grace)
+        inj = getattr(self.ctx.engine, "fault", None)
+        if inj is not None:
+            from spark_druid_olap_tpu.fault import FaultInjected
+            try:
+                # chaos site: an error rule models the node dying
+                # mid-handover instead of draining gracefully
+                inj.fire("node.drain", key=f"node:{self.node_id}")
+            except FaultInjected:
+                self.drain.start_drain()    # hard fence, no drain wait
+                self._fence(rec, new_plan)
+                return
+        self.drain.start_drain()
+        # bounded: a stuck query must not pin a retired node forever
+        self.drain.wait_drained(timeout)
+        self._fence(rec, new_plan)
+
+    def _fence(self, rec: EP.EpochRecord, new_plan) -> None:
+        self.fenced = True
+        self.ready = False              # /readyz goes 503
+        self.epoch_record = rec
+        self.plan = new_plan
+        self._epochs.clear()            # advertise nothing
+
+    def _gather_adverts(self, rec: EP.EpochRecord) -> Dict[int, set]:
+        """node id -> warm shard names advertised for ``rec``'s epoch
+        (same shape the broker gathers; unreachable nodes advertise
+        nothing)."""
+        import http.client
+        out: Dict[int, set] = {}
+        want = str(rec.epoch)
+        for nid, (host, port) in enumerate(rec.addresses):
+            conn = http.client.HTTPConnection(host, port, timeout=2.0)
+            try:
+                conn.request("GET", "/readyz")
+                resp = conn.getresponse()
+                info = json.loads(resp.read().decode("utf-8"))
+                ep = (info.get("epochs") or {}).get(want)
+                if isinstance(ep, dict) and ep.get("ready"):
+                    out[nid] = set(ep.get("shards") or ())
+            except (OSError, ValueError):
+                pass
+            finally:
+                conn.close()
+        return out
+
+    def _retire_stale(self) -> None:
+        """Drop shard stores the current plan no longer assigns here —
+        but only after a request stamped with the current (or a newer)
+        epoch proves the requesting broker swapped; until then the old
+        epoch's scatters still need them."""
+        rec = self.epoch_record
+        if rec is None or self.plan is None \
+                or self._max_req_epoch < rec.epoch:
+            return
+        keep = set()
+        for name, shs in self.plan.shards_of(self.node_id).items():
+            dp = self.plan.datasources[name]
+            keep |= {shard_name(name, sh.index, dp.n_shards)
+                     for sh in shs}
+        store = self.ctx.store
+        for n in list(store.names()):
+            if "::shard" in n and n not in keep:
+                store.drop(n)
+
     # -- RPC ------------------------------------------------------------------
     def handle_subquery(self, raw: bytes):
         """-> (http status, payload, content type). 200 carries a wire-
         encoded partial result; everything else is a JSON error whose
         ``error`` kind the broker uses to pick retry-on-replica vs
-        fall-back-to-local."""
+        fall-back-to-local. Every admitted subquery holds a drain token
+        for its whole execution — the leave protocol's fence waits on
+        exactly these."""
         if not self.ready:
             return 503, WIRE.encode_error(
                 "NotReady", "recovery / shard load in progress"), \
                 "application/json"
+        tok = self.drain.begin_subquery()
+        try:
+            if tok is None:
+                # fencing mid-handover: retryable — the broker's replica
+                # chain (or its local fallback) absorbs it
+                return 503, WIRE.encode_error(
+                    "Draining", "node draining for epoch handover"), \
+                    "application/json"
+            return self._subquery_admitted(raw)
+        finally:
+            self.drain.end_subquery(tok)
+
+    def _subquery_admitted(self, raw: bytes):
         inj = getattr(self.ctx.engine, "fault", None)
         if inj is not None:
             from spark_druid_olap_tpu.fault import FaultInjected
@@ -197,10 +532,16 @@ class HistoricalNode:
             EngineFallback, QueryCancelled, QueryTimeout)
         from spark_druid_olap_tpu.wlm.lanes import AdmissionRejected
         try:
-            q = query_from_dict(json.loads(raw.decode("utf-8")))
+            d, req_epoch = WIRE.split_subquery(raw)
+            q = query_from_dict(d)
         except (ValueError, KeyError, TypeError) as e:
             return 400, WIRE.encode_error("BadQuery", str(e)), \
                 "application/json"
+        if req_epoch is not None and req_epoch > self._max_req_epoch:
+            # a broker stamped a newer epoch: proof it swapped, so
+            # old-epoch-only shard stores can be retired (done on the
+            # watcher tick, not in the query path)
+            self._max_req_epoch = req_epoch
         engine = self.ctx.engine
         try:
             r = engine.execute(q)

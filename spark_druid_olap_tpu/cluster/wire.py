@@ -98,6 +98,31 @@ def decode_result(payload: bytes) -> Tuple[List[str], Dict[str, np.ndarray],
     return columns, data, header.get("stats", {})
 
 
+def patch_subquery(body: bytes, shard_ds: str,
+                   epoch: Optional[int] = None) -> bytes:
+    """Retarget an encoded subquery at one shard store and stamp the
+    broker's plan epoch into the request envelope. Decoding the JSON
+    once per shard beats re-running full spec serde per shard.
+
+    ``clusterEpoch`` is an envelope field, not part of the query spec:
+    the historical pops it before serde (:func:`split_subquery`) and
+    uses it to learn which epoch the requesting broker has swapped to —
+    the signal that old-epoch-only shard stores can be retired."""
+    d = json.loads(body.decode("utf-8"))
+    d["dataSource"] = shard_ds
+    if epoch is not None:
+        d["clusterEpoch"] = int(epoch)
+    return json.dumps(d, separators=(",", ":")).encode("utf-8")
+
+
+def split_subquery(raw: bytes) -> Tuple[dict, Optional[int]]:
+    """Decode a subquery request into (spec dict, clusterEpoch or None),
+    removing the envelope field so spec serde sees only the query."""
+    d = json.loads(raw.decode("utf-8"))
+    ep = d.pop("clusterEpoch", None)
+    return d, (int(ep) if ep is not None else None)
+
+
 def encode_error(kind: str, message: str, **extra) -> bytes:
     return json.dumps({"error": kind, "message": message, **extra},
                       separators=(",", ":")).encode("utf-8")

@@ -97,6 +97,12 @@ class SqlServer:
         # assigned shards loaded). MUST be lock-free and engine-free:
         # health answers may not queue behind long queries.
         self.ready_check = None
+        # optional () -> dict merged into the /readyz body (same
+        # lock-free contract): a cluster historical advertises its
+        # epoch, boot generation, draining flag and per-epoch warm
+        # shard lists here so the broker can gate an epoch handover
+        # on actual shard readiness instead of process liveness
+        self.ready_info = None
         # queries run CONCURRENTLY (one thread per request, like the
         # reference thriftserver's pooled sessions, DruidClient.scala:46-74);
         # the engine serializes only compile-cache population internally,
@@ -223,8 +229,14 @@ class SqlServer:
             ok = True if chk is None else bool(chk())
         except Exception:  # noqa: BLE001 — a broken predicate is "not ready"
             ok = False
-        h._send(200 if ok else 503,
-                b'{"ready": true}' if ok else b'{"ready": false}')
+        body = {"ready": ok}
+        info = self.ready_info
+        if info is not None:
+            try:
+                body.update(info())
+            except Exception:  # noqa: BLE001 — advert failure ≠ unhealthy
+                pass
+        h._send(200 if ok else 503, json.dumps(body).encode())
 
     def _handle_get(self, h):
         url = urlparse(h.path)

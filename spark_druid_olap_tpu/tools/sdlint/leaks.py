@@ -98,6 +98,15 @@ REGISTRY: Tuple[Resource, ...] = (
              ctor="ThreadPoolExecutor"),
     Resource("tmpdir", (("os", "makedirs"),),
              (("os", "replace"), ("rmtree",)), tmp_named=True),
+    # epoch publish lock: an unreleased claim wedges topology changes
+    # cluster-wide until the stale-lock timeout (cluster/epoch.py)
+    Resource("epoch-claim", (("claim_publish",),),
+             (("release_publish",),)),
+    # drain tokens: an unended begin_subquery keeps wait_drained
+    # blocked, so a leaving historical can never fence (historical.py
+    # DrainGate protocol)
+    Resource("drain-token", (("begin_subquery",),),
+             (("end_subquery",),)),
 )
 
 

@@ -561,6 +561,75 @@ CLUSTER_PROBE_JITTER = _entry(
     "readyz prober's interval so N brokers don't probe a rejoining "
     "historical in lockstep; each tick lands in [0.5x, 1.5x] of "
     "sdot.cluster.probe.interval.seconds.", semantic=False)
+# --- elastic topology: plan epochs (cluster/epoch.py) -------------------------
+CLUSTER_EPOCH_POLL_SECONDS = _entry(
+    "sdot.cluster.epoch.poll.seconds", 1.0,
+    "Cadence at which a HISTORICAL polls deep storage for a newer plan "
+    "epoch (cluster/epoch.py) and runs its side of the handover — warm "
+    "newly owned shards before advertising, or drain-then-fence when "
+    "the new epoch drops it. 0 disables the watcher thread (tests "
+    "drive node.check_epoch() manually). The broker piggybacks its "
+    "epoch check on the readyz prober interval.", float, semantic=False)
+CLUSTER_EPOCH_DRAIN_GRACE_SECONDS = _entry(
+    "sdot.cluster.epoch.drain.grace.seconds", 0.5,
+    "How long a leaving historical keeps serving AFTER it observes the "
+    "new epoch fully warm, before it starts draining — absorbs the "
+    "window where the broker has not yet polled the same readiness and "
+    "still scatters against the old epoch.", float, semantic=False)
+CLUSTER_EPOCH_DRAIN_TIMEOUT_SECONDS = _entry(
+    "sdot.cluster.epoch.drain.timeout.seconds", 10.0,
+    "Upper bound a leaving historical waits for its in-flight "
+    "subqueries to finish before fencing anyway (a stuck query must "
+    "not pin a retired node forever).", float, semantic=False)
+CLUSTER_REBALANCE_STRATEGY = _entry(
+    "sdot.cluster.rebalance.strategy", "stable",
+    "Shard owner placement: 'stable' (rendezvous hashing over logical "
+    "node ids — an N->N+1 epoch moves ~1/(N+1) of the assignments, see "
+    "cluster/assign.py) or 'modular' (the legacy CRC rotation, kept as "
+    "a kill switch; nearly every owner moves on any topology change). "
+    "Placement never changes answers, only which node serves a shard.",
+    semantic=False)
+CLUSTER_SUBQ_CACHE_ENABLED = _entry(
+    "sdot.cluster.subq.cache.enabled", False,
+    "Broker-side shard-level subquery result cache: partial results "
+    "are cached per (subquery shape, shard identity, ingest version), "
+    "so a repeated dashboard storm skips unchanged shards entirely. "
+    "Keys carry shard identity — not node identity — so entries "
+    "survive epoch transitions; the ingest-version term makes staleness "
+    "impossible, so answers are bit-identical with the cache off. "
+    "Opt-in: identical repeated queries are already absorbed by the "
+    "broker's semantic result cache, and chaos/failover tests rely on "
+    "repeats actually exercising the RPC path — enable it for mixed "
+    "dashboard workloads whose queries share subquery shapes.",
+    semantic=False)
+CLUSTER_SUBQ_CACHE_MAX_BYTES = _entry(
+    "sdot.cluster.subq.cache.max.bytes", 64 << 20,
+    "Byte budget of the broker's shard-level subquery cache (LRU "
+    "eviction).", int, semantic=False)
+CLUSTER_AUTOSCALE_ENABLED = _entry(
+    "sdot.cluster.autoscale.enabled", False,
+    "Autoscale hook (cluster/autoscale.py): the broker samples every "
+    "historical's WLM queue depth on the prober cadence and calls the "
+    "registered spawn/retire callbacks — which publish a new plan "
+    "epoch — when the fleet-mean depth crosses the high/low marks. "
+    "Without registered callbacks, decisions only increment counters "
+    "(dry run).", semantic=False)
+CLUSTER_AUTOSCALE_QUEUE_HIGH = _entry(
+    "sdot.cluster.autoscale.queue.high", 8.0,
+    "Fleet-mean WLM queued-query depth above which the autoscale hook "
+    "signals scale-out (spawn a historical, publish an epoch adding "
+    "it).", float, semantic=False)
+CLUSTER_AUTOSCALE_QUEUE_LOW = _entry(
+    "sdot.cluster.autoscale.queue.low", 0.5,
+    "Fleet-mean WLM queued-query depth below which the autoscale hook "
+    "signals scale-in (drain and retire one historical via a new "
+    "epoch). Must be well under the high mark or the fleet flaps.",
+    float, semantic=False)
+CLUSTER_AUTOSCALE_COOLDOWN_SECONDS = _entry(
+    "sdot.cluster.autoscale.cooldown.seconds", 30.0,
+    "Minimum wall-clock spacing between autoscale decisions; epoch "
+    "handovers in progress also suppress new signals.",
+    float, semantic=False)
 # --- deterministic fault injection (fault/) -----------------------------------
 FAULT_PLAN = _entry(
     "sdot.fault.plan", "",
