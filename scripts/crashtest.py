@@ -67,6 +67,240 @@ INGEST = dict(time_column="ts", dimensions=["region", "product"],
               metrics=["qty", "price"])
 
 
+def make_key_batch(key, rows):
+    """One producer batch as a pure function of its marker ``key``
+    (``p<tid>b<b>``): the parent rebuilds any recovered batch from the
+    key alone, so the acked set is the only channel it needs."""
+    import numpy as np
+    import pandas as pd
+    b = int(key.rsplit("b", 1)[1])
+    return pd.DataFrame({
+        # descending days so background compaction genuinely re-sorts
+        "ts": pd.to_datetime("2024-01-28") - pd.to_timedelta(b % 27, "D"),
+        "k": [key] * rows,
+        "v": np.arange(rows, dtype=np.int64)})
+
+
+INGEST_KEYED = dict(time_column="ts", dimensions=["k"], metrics=["v"],
+                    target_rows=512)
+
+INGEST_QUERIES = [
+    "select k, sum(v) as s, count(*) as n from events "
+    "group by k order by k",
+    "select k, min(v) as mn, max(v) as mx from events "
+    "group by k order by k",
+    "select count(*) as n, sum(v) as s from events",
+]
+
+
+def ingest_child_main(args):
+    """Production-shaped child for ``--ingest``: four producer threads
+    share the group-committed WAL in bursts while a pacer briefly
+    quiesces them so the compactor can win its generation swap (under
+    sustained four-way ingest the swap's version race-check loses every
+    retry — real deployments compact in ingest lulls too). Markers,
+    each fsynced before the next line: the batch key per ACK, ``c``
+    when a compaction attempt starts, ``C`` when its swap publishes —
+    the start/done pair is how the parent lands a kill genuinely
+    mid-compaction."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, ROOT)
+    import threading
+    import spark_druid_olap_tpu as sdot
+
+    ctx = sdot.Context({"sdot.persist.path": args.persist_root})
+    mlock = threading.Lock()
+    mf = open(args.marker, "a")
+
+    def ack(line):
+        with mlock:
+            mf.write(line + "\n")
+            mf.flush()
+            os.fsync(mf.fileno())
+
+    stop = threading.Event()
+    gate = threading.Event()    # producers stream only while set
+    gate.set()
+
+    def producer(tid):
+        for b in range(args.batches):
+            gate.wait()
+            key = f"p{tid}b{b}"
+            ctx.stream_ingest("events", make_key_batch(key, args.rows),
+                              **INGEST_KEYED)
+            ack(key)
+
+    def pacer():
+        while not stop.is_set():
+            time.sleep(0.2)             # ingest burst
+            gate.clear()
+            time.sleep(0.03)            # in-flight commits drain
+            try:
+                ds = ctx.store.get("events")
+                if len(ds.segments) > 1:
+                    ack("c")
+                    if ctx.persist.compact("events"):
+                        ack("C")
+            except Exception:   # noqa: BLE001 — a late append may still
+                pass            # win the race; next cycle retries
+            gate.set()
+
+    pt = threading.Thread(target=pacer, daemon=True)
+    pt.start()
+    threads = [threading.Thread(target=producer, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    pt.join()
+    print("CHILD_DONE", flush=True)
+    ctx.close()
+
+
+def run_ingest_round(rnd, args, tmpdir):
+    """kill -9 the ingest child mid-group-commit (even rounds) or
+    mid-compaction (odd rounds), then recover and check the three
+    durability invariants: no acked batch lost, no partial batch
+    surfaced, answers match a reference rebuilt from the recovered
+    keys."""
+    import random
+    import spark_druid_olap_tpu as sdot
+
+    persist_root = os.path.join(tmpdir, f"ingest{rnd}")
+    marker = os.path.join(tmpdir, f"ingest{rnd}.marker")
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child", "--ingest",
+         "--persist-root", persist_root, "--marker", marker,
+         "--batches", str(args.batches), "--rows", str(args.rows)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    def _lines():
+        try:
+            with open(marker) as f:
+                return [ln.strip() for ln in f if ln.strip()]
+        except OSError:
+            return []
+
+    mid_compaction = bool(rnd % 2)
+    rng = random.Random(7000 + rnd)
+    kill_after = rng.randrange(6, 30)
+    deadline = time.monotonic() + args.warmup_s + 120.0
+    while time.monotonic() < deadline and child.poll() is None:
+        lines = _lines()
+        acks = sum(1 for ln in lines if ln not in ("c", "C"))
+        starts = sum(1 for ln in lines if ln == "c")
+        dones = sum(1 for ln in lines if ln == "C")
+        if acks >= kill_after:
+            # group-commit style kills on the next commit; compaction
+            # style waits for an open start-without-done marker so the
+            # SIGKILL lands inside the rebuild-or-publish window
+            if not mid_compaction:
+                time.sleep(rng.uniform(0.0, 0.02))
+                break
+            if starts > dones:
+                break
+        time.sleep(0.002)
+    if child.poll() is None:
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait()
+        killed = True
+    else:
+        killed = False
+        print(f"  [ingest {rnd}] child finished before the kill "
+              f"(consider more --batches)")
+
+    lines = _lines()
+    acked = {ln for ln in lines if ln not in ("c", "C")}
+    starts = sum(1 for ln in lines if ln == "c")
+    comps = sum(1 for ln in lines if ln == "C")
+    if mid_compaction and killed:
+        assert starts >= 1, "mid-compaction round saw no compaction start"
+
+    ctx = sdot.Context({"sdot.persist.path": persist_root})
+    try:
+        ctx.store.get("events")
+        have = True
+    except KeyError:
+        have = False
+    recovered = {}
+    if have:
+        df = ctx.sql(INGEST_QUERIES[0]).to_pandas()
+        recovered = {k: (int(s), int(n))
+                     for k, s, n in zip(df["k"], df["s"], df["n"])}
+
+    info = dict(ctx.store.recovery_info.get("events") or {})
+    print(f"  [ingest {rnd}] killed={killed} "
+          f"style={'compact' if mid_compaction else 'group-commit'} "
+          f"acked={len(acked)} recovered={len(recovered)} "
+          f"compactions={comps}/{starts} source={info.get('source')} "
+          f"wal_records={info.get('wal_records')}")
+
+    # (1) durability: every acknowledged batch survived the kill
+    lost = sorted(acked - set(recovered))
+    assert not lost, f"LOST COMMITTED DATA: {lost}"
+    # (2) batch atomicity: every recovered batch is whole (a torn group
+    # frame must be repaired away, never half-applied)
+    want_s = args.rows * (args.rows - 1) // 2
+    bad = [k for k, (s, n) in recovered.items()
+           if n != args.rows or s != want_s]
+    assert not bad, f"partial batches recovered: {bad}"
+    # (3) bounded in-flight: beyond the acks, at most one un-marked
+    # batch per producer (committed but killed before its marker write)
+    extras = sorted(set(recovered) - acked)
+    assert len(extras) <= 4, \
+        f"recovered {len(extras)} unacked batches (> 1 per producer)"
+
+    # full differential vs an in-memory reference of the recovered keys
+    ref = sdot.Context()
+    for k in sorted(recovered):
+        ref.stream_ingest("events", make_key_batch(k, args.rows),
+                          **INGEST_KEYED)
+    mism = [q for q in (INGEST_QUERIES if recovered else [])
+            if not ctx.sql(q).to_pandas().equals(ref.sql(q).to_pandas())]
+    assert not mism, f"recovered answers differ on: {mism}"
+
+    # the recovered root must still compact: roll the replayed tail and
+    # re-check the differential across the post-crash generation swap
+    post = ctx.persist.compact("events") if recovered else []
+    mism = [q for q in (INGEST_QUERIES if recovered else [])
+            if not ctx.sql(q).to_pandas().equals(ref.sql(q).to_pandas())]
+    assert not mism, f"post-recovery compaction changed answers: {mism}"
+    ctx.close()
+    ref.close()
+    return {"round": rnd, "killed": killed,
+            "style": "compact" if mid_compaction else "group-commit",
+            "acked": len(acked), "recovered": len(recovered),
+            "extras": len(extras), "compactions": comps,
+            "post_compacted": sum(c.get("segments_before", 0)
+                                  for c in post),
+            "source": info.get("source"),
+            "wal_records": info.get("wal_records")}
+
+
+def run_ingest_mode(args):
+    import tempfile
+    results = []
+    with tempfile.TemporaryDirectory(prefix="sdot-crashtest-ing-") as tmp:
+        for rnd in range(args.rounds):
+            results.append(run_ingest_round(rnd, args, tmp))
+    n_killed = sum(1 for r in results if r["killed"])
+    out = {"mode": "crashtest-ingest", "rounds": len(results),
+           "killed": n_killed, "results": results}
+    print(json.dumps(out))
+    if n_killed == 0:
+        print("WARNING: no round actually killed the child mid-stream; "
+              "raise --batches or lower --warmup-s", file=sys.stderr)
+        sys.exit(2)
+    total_acked = sum(r["acked"] for r in results)
+    print(f"OK: {len(results)} ingest rounds, {n_killed} mid-pipeline "
+          f"kills, {total_acked} acked commits all recovered, zero "
+          f"partial batches, all differentials byte-identical")
+
+
 def child_main(args):
     """Stream batches forever; after each commit RETURNS, append its
     index to the marker file and fsync (the acknowledgement)."""
@@ -467,17 +701,31 @@ def main():
                     "exactly the acknowledged commits")
     ap.add_argument("--seed", type=int, default=42,
                     help="FaultPlan seed for --cluster")
+    ap.add_argument("--ingest", action="store_true",
+                    help="kill -9 a production-shaped ingest child (four "
+                    "producers sharing group commits while a compactor "
+                    "rolls generations) mid-group-commit and "
+                    "mid-compaction: recovery must hold every acked "
+                    "batch whole, at most one unacked batch per "
+                    "producer, and answer the query mix identically to "
+                    "a reference rebuilt from the recovered keys")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--persist-root", help=argparse.SUPPRESS)
     ap.add_argument("--marker", help=argparse.SUPPRESS)
     args = ap.parse_args()
 
     if args.child:
+        if args.ingest:
+            return ingest_child_main(args)
         return child_main(args)
 
     import jax
     jax.config.update("jax_platforms", "cpu")
     sys.path.insert(0, ROOT)
+    if args.ingest:
+        if args.rows == BATCH_ROWS_DEFAULT:
+            args.rows = 200      # four producers: keep per-batch cost low
+        return run_ingest_mode(args)
     if args.cluster:
         if args.batches == 200:
             args.batches = 60   # the cluster storm paces ingest at 50ms

@@ -1191,6 +1191,140 @@ def run_chaos(args):
                             "acked": len(acked), "recovered_rows": n}
         digest_src.append(["torn_wal", acked])
 
+        # group-commit leg: concurrent producers share covering fsyncs;
+        # an injected covering-fsync failure un-acks the WHOLE batch
+        # and rolls it back. Recovery must serve exactly the acked set
+        # — nothing more (no un-acked resurrection), nothing less
+        # (ACK-implies-durable). Which producers land in the two failed
+        # batches is timing-dependent, so the acked membership gates
+        # but stays out of the digest; the fire count (count-based) and
+        # the exactness verdict hash in.
+        print("[chaos] group-commit leg")
+        from spark_druid_olap_tpu.fault import FaultInjected as _FI
+        groot = os.path.join(root, "gcleg")
+        gctx = sdot.Context({
+            "sdot.persist.enabled": True, "sdot.persist.path": groot,
+            "sdot.fault.plan": json.dumps({"seed": S ^ 0xB7, "rules": [
+                {"site": "wal.group_commit", "action": "error",
+                 "count": 2, "after": 1, "scope": "gc"}]})})
+        acked_g, alock = set(), threading.Lock()
+
+        def gc_producer(tid):
+            for b in range(6):
+                key = f"p{tid}b{b}"
+                df = pd.DataFrame({
+                    "t": pd.to_datetime("2024-01-01"),
+                    "k": [key] * 40,
+                    "v": np.arange(40, dtype=np.int64)})
+                try:
+                    gctx.stream_ingest("gevents", df, time_column="t")
+                    with alock:
+                        acked_g.add(key)
+                except (_FI, OSError):
+                    pass
+
+        with gctx.engine.fault.scope("gc"):
+            gths = [threading.Thread(target=gc_producer, args=(i,))
+                    for i in range(4)]
+            for th in gths:
+                th.start()
+            for th in gths:
+                th.join()
+        gfired = gctx.engine.fault.stats()["by_site"] \
+            .get("wal.group_commit", 0)
+        gc_stats = gctx.persist.stats()["groupCommit"]
+        gctx.close()
+        gctx2 = sdot.Context({"sdot.persist.enabled": True,
+                              "sdot.persist.path": groot})
+        ctxs.append(gctx2)
+        if acked_g:
+            gn = int(gctx2.sql("select count(*) as n from gevents")
+                     .data["n"][0])
+            gks = sorted(set(gctx2.sql("select k from gevents")
+                             .data["k"].tolist()))
+        else:
+            gn, gks = 0, []
+        # every frame in a committed group was acked and vice versa,
+        # so the lifetime frame counter equals the acked batch count
+        gc_exact = (gn == 40 * len(acked_g)
+                    and gks == sorted(acked_g)
+                    and gc_stats["frames"] == len(acked_g)
+                    and 1 <= gc_stats["commits"] <= gc_stats["frames"])
+        check("group_commit", gfired == 2 and len(acked_g) < 24
+              and gc_exact,
+              f"fired={gfired} acked={len(acked_g)}/24 "
+              f"commits={gc_stats['commits']} "
+              f"frames={gc_stats['frames']} rows={gn}")
+        legs["group_commit"] = {
+            "producers": 4, "batches": 24, "acked": len(acked_g),
+            "fired": gfired, "commits": gc_stats["commits"],
+            "frames": gc_stats["frames"], "recovered_rows": gn}
+        digest_src.append(["group_commit", gfired, gc_exact])
+        print(f"  [group_commit] {json.dumps(legs['group_commit'])}")
+
+        # compact-publish leg: a crash at the compaction publish site
+        # must leave the OLD generation fully readable with the WAL
+        # untouched; the retry swaps generations without moving the
+        # ingest version, and answers stay byte-identical throughout
+        print("[chaos] compact-publish leg")
+        croot = os.path.join(root, "compactleg")
+        cq = ("select k, sum(v) as s, count(*) as n from cevents "
+              "group by k order by k")
+        cctx = sdot.Context({
+            "sdot.persist.enabled": True, "sdot.persist.path": croot,
+            "sdot.fault.plan": json.dumps({"seed": S ^ 0xC3, "rules": [
+                {"site": "compact.publish", "action": "error",
+                 "count": 1}]}), **caches_off})
+        for i in range(8):
+            # descending days: compaction must re-sort globally
+            df = pd.DataFrame({
+                "t": pd.to_datetime(f"2024-01-{8 - i:02d}"),
+                "k": [f"c{i % 3}"] * 64,
+                "v": np.arange(i * 64, (i + 1) * 64, dtype=np.int64)})
+            cctx.stream_ingest("cevents", df, time_column="t",
+                               target_rows=48)
+        want_c = cctx.sql(cq).to_pandas()
+        segs0 = len(cctx.store.get("cevents").segments)
+        wal_b0 = cctx.persist._wal_for("cevents").size_bytes()
+        crashed = False
+        try:
+            cctx.persist.compact("cevents")
+        except _FI:
+            crashed = True
+        old_ok = (crashed and wal_b0 > 0
+                  and cctx.persist._wal_for("cevents").size_bytes()
+                  == wal_b0
+                  and len(cctx.store.get("cevents").segments) == segs0
+                  and _frames_close(cctx.sql(cq).to_pandas(), want_c))
+        cctx.close()
+        # the crash "for real": recover from disk (old generation), then
+        # retry the compaction fault-free and re-check the differential
+        cctx2 = sdot.Context({"sdot.persist.enabled": True,
+                              "sdot.persist.path": croot, **caches_off})
+        ctxs.append(cctx2)
+        rec_ok = _frames_close(cctx2.sql(cq).to_pandas(), want_c)
+        iv0 = cctx2.store.datasource_version("cevents")
+        summ = (cctx2.persist.compact("cevents") or [None])[0]
+        swap_ok = (summ is not None
+                   and summ["segments_after"] < segs0
+                   and cctx2.store.datasource_version("cevents") == iv0
+                   and cctx2.persist._wal_for("cevents").size_bytes()
+                   < wal_b0
+                   and _frames_close(cctx2.sql(cq).to_pandas(), want_c))
+        check("compact_publish", old_ok and rec_ok and swap_ok,
+              f"crashed={crashed} segs0={segs0} summ={summ}")
+        legs["compact_publish"] = {
+            "crashed": crashed, "segments_before": segs0,
+            "segments_after": summ["segments_after"] if summ else None,
+            "rows": summ["rows"] if summ else None,
+            "old_generation_readable": old_ok,
+            "recovered_exact": rec_ok, "swap_exact": swap_ok}
+        digest_src.append(["compact_publish", crashed, segs0,
+                           summ["segments_after"] if summ else None,
+                           summ["rows"] if summ else None])
+        print(f"  [compact_publish] "
+              f"{json.dumps(legs['compact_publish'])}")
+
         # cold-tier CRC leg: a flipped blob quarantines the newest
         # snapshot version; the retry answers exactly from the older one
         print("[chaos] cold-tier CRC-flip leg")
@@ -1487,6 +1621,220 @@ def run_chaos(args):
         shutil.rmtree(root, ignore_errors=True)
 
 
+INGEST_BATCH_ROWS = 256
+
+
+def _ingest_batch(key, rows=INGEST_BATCH_ROWS, day=1):
+    import numpy as np
+    import pandas as pd
+    return pd.DataFrame({
+        "ts": pd.to_datetime(f"2024-01-{day:02d}"),
+        "k": [key] * rows,
+        "v": np.arange(rows, dtype=np.int64)})
+
+
+def run_ingest(args):
+    """Streaming-ingest benchmark (persist/wal.py group commit): T
+    producer threads stream keyed batches into one WAL-backed
+    datasource with group commit OFF (every ACK pays its own covering
+    fsync, commits serialized under the build lock) then ON (one
+    covering fsync amortized over every frame staged while the leader
+    held the file). Reports rows/s, ACK p50/p99, fsyncs and
+    frames-per-fsync, plus read-your-writes probes (an ACKed batch must
+    be queryable immediately). Every leg is differentially checked —
+    live keys/counts must be exactly the acked set, and a fresh context
+    over the same root must recover identically. With --cluster N the
+    same stream runs through an in-process broker over N historicals
+    (push-on-ingest), timing ACK-to-visible staleness through the
+    scatter path. Exit 0 needs zero mismatches, zero stale probes, and
+    grouped throughput >= the serialized leg."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+    import numpy as np
+    sys.path.insert(0, ".")
+    import spark_druid_olap_tpu as sdot
+
+    T = min(args.threads, 8)
+    B = max(10, int(args.duration))     # batches per producer per leg
+    rows = INGEST_BATCH_ROWS
+    tmp = tempfile.mkdtemp(prefix="sdot-ingest-")
+    failures = []
+    q_keys = ("select k, count(*) as n from events "
+              "group by k order by k")
+
+    def pct(vals, p):
+        return round(float(np.percentile(vals, p)) * 1000, 2) \
+            if vals else None
+
+    def produce(ctx, label):
+        """T producers x B batches; returns (wall_s, ack_lat, ryw)."""
+        lat, ryw, lock = [], [], threading.Lock()
+
+        def producer(tid):
+            for b in range(B):
+                key = f"p{tid}b{b}"
+                df = _ingest_batch(key, rows, day=(b % 27) + 1)
+                t0 = time.perf_counter()
+                ctx.stream_ingest("events", df, time_column="ts",
+                                  target_rows=8192)
+                dt = time.perf_counter() - t0
+                probe = None
+                if b % 4 == 0:
+                    # read-your-writes: the ACK promises this key is
+                    # queryable NOW; time to first *correct* answer is
+                    # the staleness
+                    t1 = time.perf_counter()
+                    while True:
+                        n = int(ctx.sql(
+                            "select count(*) as n from events "
+                            f"where k = '{key}'").data["n"][0])
+                        if n == rows:
+                            probe = (time.perf_counter() - t1, True)
+                            break
+                        if time.perf_counter() - t1 > 5.0:
+                            probe = (time.perf_counter() - t1, False)
+                            break
+                with lock:
+                    lat.append(dt)
+                    if probe is not None:
+                        ryw.append(probe)
+
+        ths = [threading.Thread(target=producer, args=(t,))
+               for t in range(T)]
+        t0 = time.perf_counter()
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        return time.perf_counter() - t0, lat, ryw
+
+    def check(root, label, got):
+        """Differential: live answers == acked set == recovered."""
+        want = sorted(f"p{t}b{b}" for t in range(T) for b in range(B))
+        live_ok = (got["k"].tolist() == want
+                   and bool((got["n"] == rows).all()))
+        if not live_ok:
+            failures.append(f"{label}: live differential")
+        rec = sdot.Context({"sdot.persist.enabled": True,
+                            "sdot.persist.path": root,
+                            "sdot.cache.enabled": False})
+        rec_ok = rec.sql(q_keys).to_pandas().equals(got)
+        rec.close()
+        if not rec_ok:
+            failures.append(f"{label}: recovery differential")
+        return live_ok and rec_ok
+
+    def leg(label, group_on):
+        root = os.path.join(tmp, label)
+        ctx = sdot.Context({
+            "sdot.persist.enabled": True, "sdot.persist.path": root,
+            "sdot.persist.wal.group.commit": group_on,
+            "sdot.cache.enabled": False})
+        wall, lat, ryw = produce(ctx, label)
+        got = ctx.sql(q_keys).to_pandas()
+        st = ctx.persist.stats()
+        gc, appends = st["groupCommit"], st["counters"]["wal_appends"]
+        ctx.close()
+        ok = check(root, label, got)
+        stale = sum(1 for _, fresh in ryw if not fresh)
+        if stale:
+            failures.append(f"{label}: {stale} stale RYW probes")
+        fsyncs = gc["commits"] if group_on else appends
+        out = {"label": label, "acks": len(lat),
+               "rows_s": round(T * B * rows / wall, 1),
+               "acks_s": round(len(lat) / wall, 1),
+               "ack_p50_ms": pct(lat, 50), "ack_p99_ms": pct(lat, 99),
+               "fsyncs": fsyncs,
+               "frames_per_fsync": round(
+                   gc["frames"] / max(gc["commits"], 1), 2)
+               if group_on else 1.0,
+               "ryw_probe_p99_ms": pct([d for d, _ in ryw], 99),
+               "stale_probes": stale, "differential_ok": ok}
+        print(f"  [{label}] {json.dumps(out)}")
+        return out
+
+    def cluster_leg(n_nodes):
+        from spark_druid_olap_tpu.cluster.historical import HistoricalNode
+        root = os.path.join(tmp, "cluster")
+        seeder = sdot.Context({"sdot.persist.path": root,
+                               "sdot.cache.enabled": False})
+        seeder.stream_ingest("events", _ingest_batch("seed", rows),
+                             time_column="ts", target_rows=8192)
+        seeder.checkpoint()
+        seeder.close()
+        addrs = [f"127.0.0.1:{_free_port()}" for _ in range(n_nodes)]
+        common = {"sdot.persist.path": root,
+                  "sdot.cluster.nodes": ",".join(addrs),
+                  "sdot.cluster.shards": max(2, n_nodes),
+                  "sdot.cluster.replication": min(2, n_nodes),
+                  "sdot.cluster.retry.backoff.start.seconds": 0.01,
+                  "sdot.cache.enabled": False}
+        hists, broker = [], None
+        try:
+            for i in range(n_nodes):
+                hists.append(HistoricalNode(dict(common),
+                                            node_id=i).start())
+            broker = sdot.Context({
+                **common, "sdot.cluster.role": "broker",
+                "sdot.cluster.probe.interval.seconds": 0.1})
+            wall, lat, ryw = produce(broker, "cluster")
+            got = broker.sql(q_keys).to_pandas()
+            want = sorted(["seed"] + [f"p{t}b{b}" for t in range(T)
+                                      for b in range(B)])
+            if got["k"].tolist() != want \
+                    or not bool((got["n"] == rows).all()):
+                failures.append("cluster: live differential")
+            ing = broker.cluster.stats()["ingest"]
+            mode = (broker.engine.last_stats.get("cluster")
+                    or {}).get("mode")
+            stale = sum(1 for _, fresh in ryw if not fresh)
+            if stale:
+                failures.append(f"cluster: {stale} stale RYW probes")
+            out = {"label": f"cluster-{n_nodes}", "acks": len(lat),
+                   "rows_s": round(T * B * rows / wall, 1),
+                   "ack_p50_ms": pct(lat, 50),
+                   "ack_p99_ms": pct(lat, 99),
+                   "ryw_staleness_p99_ms": pct([d for d, _ in ryw], 99),
+                   "stale_probes": stale, "mode": mode,
+                   "pushes": broker.cluster.counters.get(
+                       "ingest_pushes", 0),
+                   "push_enabled": ing.get("push_enabled")}
+            print(f"  [cluster-{n_nodes}] {json.dumps(out)}")
+            return out
+        finally:
+            for h in hists:
+                h.stop()
+            if broker is not None:
+                broker.close()
+
+    try:
+        print(f"[ingest] {T} producers x {B} batches x {rows} rows "
+              f"per leg")
+        base = leg("serialized", False)
+        grouped = leg("group-commit", True)
+        cluster = cluster_leg(args.cluster) if args.cluster else None
+        ratio = round(grouped["rows_s"] / max(base["rows_s"], 1e-9), 2)
+        if ratio < 1.0:
+            failures.append(
+                f"group commit slower than serialized ({ratio}x)")
+        out = {"mode": "ingest", "threads": T, "batches": T * B,
+               "rows_per_batch": rows, "serialized": base,
+               "grouped": grouped, "speedup": ratio,
+               "cluster": cluster, "failures": failures}
+        print(json.dumps(out))
+        if failures:
+            print(f"INGEST FAILED: {failures}")
+            sys.exit(1)
+        print(f"OK: group commit {ratio}x serialized rows/s "
+              f"({grouped['frames_per_fsync']} frames/fsync vs 1.0), "
+              f"zero differential mismatches, zero stale "
+              f"read-your-writes probes")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_cluster(args):
     """Multi-process distributed-serving benchmark (cluster/): build +
     checkpoint a synthetic store, spawn N historical subprocesses over
@@ -1779,6 +2127,16 @@ def main():
                     "reports fan-out, merge latency, per-node coalesce "
                     "rates, failover detection, and the qps ratio "
                     "(exit 0 needs zero mismatches and >= 2x qps)")
+    ap.add_argument("--ingest", action="store_true",
+                    help="streaming-ingest benchmark: producer threads "
+                    "stream keyed batches through the WAL with group "
+                    "commit off then on (rows/s, ACK p50/p99, frames "
+                    "per fsync, read-your-writes probes; every leg "
+                    "differentially checked live and after recovery); "
+                    "with --cluster N the stream also runs through an "
+                    "in-process broker over N historicals, timing "
+                    "ACK-to-visible staleness (exit 0 needs zero "
+                    "mismatches and grouped >= serialized rows/s)")
     ap.add_argument("--chaos", action="store_true",
                     help="seeded fault-injection differential: an "
                     "in-process two-node cluster runs the dashboard mix "
@@ -1805,6 +2163,8 @@ def main():
 
     if args.chaos:
         return run_chaos(args)
+    if args.ingest:
+        return run_ingest(args)
     if args.cluster:
         return run_cluster(args)
     if args.coldstart:

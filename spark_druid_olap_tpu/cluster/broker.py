@@ -64,6 +64,7 @@ from __future__ import annotations
 import dataclasses
 import http.client
 import json
+import os
 import random as _random
 import threading
 import time as _time
@@ -93,6 +94,7 @@ from spark_druid_olap_tpu.utils.config import (
     CLUSTER_HEDGE_ENABLED,
     CLUSTER_HEDGE_MIN_MS,
     CLUSTER_HEDGE_QUANTILE,
+    CLUSTER_INGEST_PUSH,
     CLUSTER_LOCAL_FALLBACK,
     CLUSTER_NODES,
     CLUSTER_PARTIAL_RESULTS,
@@ -244,6 +246,19 @@ class ClusterClient:
         self.probe_jitter = bool(self.config.get(CLUSTER_PROBE_JITTER))
         self._latencies = deque(maxlen=512)     # recent subquery RPC seconds
         self._lock = threading.Lock()
+        # distributed ingest (read-your-writes): per-datasource push
+        # state — which owners confirmed which shards, and whether any
+        # acked batch is still in flight to its owners. LOCK ORDER:
+        # _lock before _ingest_lock (neither calls out while held).
+        self.ingest_push_enabled = bool(
+            self.config.get(CLUSTER_INGEST_PUSH))
+        self._ingest_lock = threading.Lock()
+        self._ingested: Dict[str, dict] = {}
+        # per-shard-store batch ids, dense from 1: the historical's
+        # out-of-order dedup collapses a contiguous prefix into its
+        # watermark, which only works when ids have no per-shard gaps
+        self._ingest_seq: Dict[str, int] = {}
+        self._boot_id = f"{os.getpid()}.{_time.time_ns()}"
         self.counters = {"queries": 0, "scatters": 0, "subqueries": 0,
                          "retries": 0, "failovers": 0, "local_fallbacks": 0,
                          "shards_pruned": 0, "merge_ms": 0.0,
@@ -252,7 +267,9 @@ class ClusterClient:
                          "hedges_won": 0, "degraded_queries": 0,
                          "epoch_checks": 0, "epoch_swaps": 0,
                          "breaker_resets": 0,
-                         "subq_cache_hits": 0, "subq_cache_misses": 0}
+                         "subq_cache_hits": 0, "subq_cache_misses": 0,
+                         "ingest_pushes": 0, "ingest_push_failures": 0,
+                         "ingest_rows_pushed": 0, "ryw_scatters": 0}
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, int(self.config.get(CLUSTER_SCATTER_THREADS))),
             thread_name_prefix="sdot-scatter")
@@ -340,6 +357,16 @@ class ClusterClient:
                 "from_epoch": act.record.epoch,
                 "to_epoch": pend.record.epoch,
                 "strategy": self.strategy, **diff.summary()}
+            # the new epoch's nodes re-slice shards from the MANIFEST:
+            # pushed-but-uncheckpointed batches are not in their stores,
+            # so every read-your-writes confirmation is void. Dropping
+            # the state fails the version/confirmation gate and the
+            # broker serves those datasources locally — acked batches
+            # are its own journaled rows, so an epoch swap can never
+            # drop one. In-flight pushes hold references to the OLD
+            # state objects and land harmlessly there.
+            with self._ingest_lock:
+                self._ingested = {}
         return True
 
     def _gather_adverts(self, st: _EpochState) -> Dict[int, set]:
@@ -456,6 +483,166 @@ class ClusterClient:
         finally:
             conn.close()
 
+    # -- distributed ingest (read-your-writes) ---------------------------------
+    def ingest_begin(self, name: str):
+        """First half of a cluster write: called by Context.stream_ingest
+        BEFORE the batch is journaled locally, so there is no instant at
+        which a batch is acked but not accounted in-flight. Returns a
+        token for :meth:`ingest_finish`, or None when the datasource is
+        not in the active plan (push pointless — broker-local anyway)."""
+        if not self.ingest_push_enabled:
+            return None
+        st = self._active
+        if st.plan.datasources.get(name) is None:
+            return None
+        with self._ingest_lock:
+            state = self._ingested.setdefault(name, {
+                "epoch": st.record.epoch, "inflight": 0,
+                "version": -1, "target": -1, "shards": {}})
+            state["inflight"] += 1
+        # the token pins the state OBJECT: an epoch swap mid-push
+        # replaces self._ingested wholesale, and a finish landing on the
+        # orphaned object can never corrupt the new epoch's accounting
+        return (st, state)
+
+    def ingest_finish(self, token, name: str, df, kwargs: dict) -> None:
+        """Second half: push the (already locally durable and acked)
+        batch to every owner of its time-matched shard, then settle the
+        read-your-writes watermark. ``df=None`` means the local apply
+        failed — nothing was acked, just release the in-flight slot.
+        Never raises: a push failure only costs scatter eligibility."""
+        st, state = token
+        sh = None
+        confirmed: set = set()
+        try:
+            dp = st.plan.datasources.get(name)
+            if df is not None and len(df) and dp is not None:
+                sh = self._target_shard(dp, name, df, kwargs)
+                if sh is not None:
+                    confirmed = self._push_to_owners(
+                        st, dp, name, sh, df, kwargs)
+        except Exception:  # noqa: BLE001 — ACK already happened; never re-raise
+            with self._lock:
+                self.counters["ingest_push_failures"] += 1
+        finally:
+            ver = self.engine.store.datasource_version(name)
+            with self._ingest_lock:
+                if sh is not None:
+                    prior = state["shards"].get(sh.index)
+                    if prior is None:
+                        # first push to this shard: before it, every
+                        # owner held exactly the manifest rows
+                        prior = set(sh.owners)
+                    state["shards"][sh.index] = prior & confirmed
+                # ``target`` tracks the newest local version observed at
+                # a settle — when the LAST in-flight push settles, every
+                # acked batch has been offered to its owners, so target
+                # is exactly the version whose content they confirm
+                state["target"] = max(state["target"], ver)
+                state["inflight"] -= 1
+                if state["inflight"] <= 0:
+                    state["inflight"] = 0
+                    state["version"] = state["target"]
+
+    def _target_shard(self, dp, name: str, df, kwargs: dict):
+        """The shard whose time envelope best matches the batch (max
+        overlap; for a batch past every envelope — the common streaming
+        case — the nearest, i.e. newest, shard)."""
+        shards = dp.shards
+        if not shards:
+            return None
+        tc = kwargs.get("time_column")
+        if not tc:
+            ds = self.engine.store._datasources.get(name)
+            t = getattr(ds, "time", None)
+            tc = t.name if t is not None else None
+        if not tc or tc not in df.columns:
+            return shards[-1]
+        from spark_druid_olap_tpu.segment.ingest import _to_epoch_millis
+        millis = _to_epoch_millis(df[tc])
+        lo, hi = int(millis.min()), int(millis.max())
+        best, best_ov = None, None
+        for sh in shards:
+            ov = min(hi, sh.max_ms) - max(lo, sh.min_ms)
+            if best_ov is None or ov > best_ov:
+                best, best_ov = sh, ov
+        return best
+
+    def _push_to_owners(self, st: _EpochState, dp, name: str, sh, df,
+                        kwargs: dict) -> set:
+        """Offer one batch to every owner of ``sh``; -> confirmed node
+        ids. ALL replicas must apply for scatter read-your-writes to
+        hold (a scatter may read any replica), so a down owner simply
+        drops out of the confirmed set and the broker serves this
+        datasource locally until a checkpoint + epoch re-plan."""
+        from spark_druid_olap_tpu.persist.wal import encode_batch
+        from spark_druid_olap_tpu.segment.append import wal_kwargs_to_dict
+        body = encode_batch(df)
+        sname = shard_name(name, sh.index, dp.n_shards)
+        with self._ingest_lock:
+            bid = self._ingest_seq.get(sname, 0) + 1
+            self._ingest_seq[sname] = bid
+        payload = WIRE.encode_ingest(name, sname, bid,
+                                     wal_kwargs_to_dict(kwargs), body,
+                                     src=self._boot_id)
+        confirmed = set()
+        for nid in sh.owners:
+            for _attempt in range(2):       # one retry on connect error
+                try:
+                    status, _resp = self._ingest_rpc(st, nid, payload)
+                except OSError:
+                    self._mark_down(st, nid)
+                    continue
+                self._mark_up(st, nid)
+                if status == 200:
+                    confirmed.add(nid)
+                break       # a coherent non-200 won't improve on retry
+        with self._lock:
+            self.counters["ingest_pushes"] += 1
+            self.counters["ingest_rows_pushed"] += len(df)
+            if confirmed != set(sh.owners):
+                self.counters["ingest_push_failures"] += 1
+        return confirmed
+
+    def _ingest_rpc(self, st: _EpochState, node_id: int,
+                    payload: bytes) -> Tuple[int, bytes]:
+        inj = self.fault
+        key = f"node:{node_id}"
+        if inj is not None:
+            # chaos site: the push leg dying on the wire (the batch is
+            # already durable + acked on the broker; the only stake is
+            # scatter eligibility)
+            inj.fire("rpc.ingest", key)
+        host, port = st.nodes[node_id]
+        conn = http.client.HTTPConnection(host, port,
+                                          timeout=self.rpc_timeout)
+        try:
+            conn.request("POST", "/cluster/ingest", payload,
+                         {"Content-Type": "application/octet-stream"})
+            resp = conn.getresponse()
+            body = resp.read()
+        finally:
+            conn.close()
+        return resp.status, body
+
+    def _ryw_state(self, name: str, ver: int) -> Optional[dict]:
+        """The push state iff it proves every owner of every touched
+        shard holds ALL acked batches for ``name`` at local version
+        ``ver`` — i.e. scattering now preserves read-your-writes.
+        None -> serve locally (always safe: the broker holds the rows)."""
+        st = self._active
+        with self._ingest_lock:
+            state = self._ingested.get(name)
+            if state is None \
+                    or state.get("epoch") != st.record.epoch \
+                    or state["inflight"] != 0 \
+                    or state["version"] != ver:
+                return None
+            if not all(bool(s) for s in state["shards"].values()):
+                return None     # some touched shard lost all its owners
+            return {i: tuple(sorted(s))
+                    for i, s in state["shards"].items()}
+
     # -- eligibility -----------------------------------------------------------
     def should_distribute(self, q) -> bool:
         if not isinstance(q, (S.GroupByQuerySpec, S.TimeseriesQuerySpec,
@@ -466,9 +653,11 @@ class ClusterClient:
             return False
         # read-your-writes: post-boot ingest/appends bumped the broker's
         # in-memory version past the planned manifest — serve locally so
-        # writes are immediately visible
-        if self.engine.store.datasource_version(q.datasource) \
-                != dp.ingest_version:
+        # writes are immediately visible, UNLESS the ingest push path
+        # proves every owner already applied every acked batch
+        ver = self.engine.store.datasource_version(q.datasource)
+        if ver != dp.ingest_version \
+                and self._ryw_state(q.datasource, ver) is None:
             return False
         for a in q.aggregations:
             if a.kind not in MG.MERGEABLE_KINDS:
@@ -492,6 +681,19 @@ class ClusterClient:
         dp = st.plan.datasources.get(q.datasource)
         if dp is None:
             return self._local("datasource not in the captured plan")
+        # read-your-writes scatter: the local version ran past the
+        # manifest but the push path confirmed every owner — scatter,
+        # restricted to the confirmed replica sets. A version that fails
+        # the proof (including races since should_distribute) serves
+        # locally, which is always correct.
+        ver = self.engine.store.datasource_version(q.datasource)
+        ryw = None
+        if ver != dp.ingest_version:
+            ryw = self._ryw_state(q.datasource, ver)
+            if ryw is None:
+                return self._local(
+                    "post-manifest writes not confirmed on owners")
+            self.counters["ryw_scatters"] += 1
         deadline = None
         tm = getattr(q.context, "timeout_millis", None)
         if tm:
@@ -499,10 +701,12 @@ class ClusterClient:
         # interval pruning: shards are contiguous time blocks, so a shard
         # whose [min_ms, max_ms] envelope cannot overlap any query
         # interval need not be scattered to at all (≈ Druid's time-chunk
-        # pruning on the broker)
+        # pruning on the broker). Pushed appends grow a shard PAST its
+        # planned envelope, so pruning is off in read-your-writes mode —
+        # stale bounds must not prune the shard holding the new rows.
         shards = dp.shards
         pruned = 0
-        if getattr(q, "intervals", None):
+        if getattr(q, "intervals", None) and ryw is None:
             keep = tuple(
                 sh for sh in shards
                 if any(sh.max_ms >= lo and sh.min_ms < hi
@@ -527,8 +731,12 @@ class ClusterClient:
         cache_hits = 0
         for sh in shards:
             total_rows += sh.rows
+            # cache under the broker's LOCAL version (== the manifest
+            # version outside read-your-writes mode): every acked append
+            # bumps it, so a partial computed over pushed rows can never
+            # be replayed for a version that has since grown
             ck = cache.key(bkey, q.datasource, sh.index, dp.n_shards,
-                           dp.ingest_version)
+                           ver)
             data = cache.get(ck) if cache.enabled else None
             if data is not None:
                 cache_hits += 1
@@ -536,8 +744,10 @@ class ClusterClient:
                 covered_rows += sh.rows
                 continue
             name = shard_name(q.datasource, sh.index, dp.n_shards)
+            owners = sh.owners if ryw is None \
+                else ryw.get(sh.index, sh.owners)
             futs.append((sh, ck, self._pool.submit(
-                self._run_shard, st, body, name, sh.owners, deadline,
+                self._run_shard, st, body, name, owners, deadline,
                 partial)))
         self.counters["scatters"] += len(futs)
         if cache.enabled:
@@ -838,10 +1048,22 @@ class ClusterClient:
                       "strategy": self.strategy},
             "rebalance": rebalance,
             "subq_cache": self.subq_cache.stats(),
+            "ingest": self._ingest_stats(),
         }
         if self.autoscale is not None:
             out["autoscale"] = self.autoscale.stats()
         return out
+
+    def _ingest_stats(self) -> dict:
+        with self._ingest_lock:
+            return {
+                "push_enabled": self.ingest_push_enabled,
+                "datasources": {
+                    name: {"version": state["version"],
+                           "inflight": state["inflight"],
+                           "shards": {str(i): sorted(s) for i, s in
+                                      state["shards"].items()}}
+                    for name, state in self._ingested.items()}}
 
 
 def _strip(q):

@@ -144,10 +144,17 @@ class HistoricalServer(SqlServer):
         self.ready_info = node.ready_info
 
     def _handle_post(self, h):
-        if urlparse(h.path).path == "/cluster/subquery":
+        path = urlparse(h.path).path
+        if path == "/cluster/subquery":
             n = int(h.headers.get("Content-Length", "0"))
             raw = h.rfile.read(n) if n else b"{}"
             code, body, ctype = self.node.handle_subquery(raw)
+            h._send(code, body, ctype)
+            return
+        if path == "/cluster/ingest":
+            n = int(h.headers.get("Content-Length", "0"))
+            raw = h.rfile.read(n) if n else b""
+            code, body, ctype = self.node.handle_ingest(raw)
             h._send(code, body, ctype)
             return
         super()._handle_post(h)
@@ -196,6 +203,18 @@ class HistoricalNode:
         self.fenced = False
         self._epochs: Dict[int, dict] = {}   # epoch -> readyz advert
         self._max_req_epoch = 0              # newest clusterEpoch seen
+        # distributed ingest: pushed batches apply serially per node,
+        # deduped on (broker boot generation, push counter) so a broker
+        # retry after a lost ACK never double-applies rows
+        self._ingest_lock = threading.Lock()
+        # shard -> (src, watermark, pending ids > watermark): concurrent
+        # producers race their pushes onto the wire, so batch ids arrive
+        # OUT OF ORDER per shard — a high-watermark alone would swallow
+        # a late-arriving earlier id as a duplicate (confirmed but never
+        # applied, silently breaking scatter read-your-writes)
+        self._applied_batches: Dict[str, tuple] = {}
+        self.batches_applied = 0
+        self.batch_rows_applied = 0
         self._watch_stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
         self.server: Optional[HistoricalServer] = None
@@ -401,6 +420,10 @@ class HistoricalNode:
                     else slice_segments(full, sh.segment_indexes,
                                         name=sname)
                 store.restore(shard, ingest_version=dp.ingest_version)
+                # a freshly sliced shard holds only manifest rows:
+                # pushed-batch history no longer applies to it
+                with self._ingest_lock:
+                    self._applied_batches.pop(sname, None)
                 self.shards_warmed += 1
             if not had_full:
                 store.drop(name)
@@ -515,6 +538,97 @@ class HistoricalNode:
             return self._subquery_admitted(raw)
         finally:
             self.drain.end_subquery(tok)
+
+    def handle_ingest(self, raw: bytes):
+        """Apply one pushed ingest batch to an owned shard store.
+
+        -> (http status, payload, content type). The broker already
+        journaled and acked the batch — this node holds NO durability
+        responsibility; it only folds the rows into its in-memory shard
+        so distributed scatters keep read-your-writes. Every error here
+        is therefore safe: the broker just serves the datasource locally
+        until the next checkpoint re-plans the shard.
+
+        Applies are idempotent per (broker boot, push counter): a retry
+        after a lost confirmation re-acks without re-appending."""
+        if not self.ready:
+            return 503, WIRE.encode_error(
+                "NotReady", "recovery / shard load in progress"), \
+                "application/json"
+        tok = self.drain.begin_subquery()
+        try:
+            if tok is None:
+                return 503, WIRE.encode_error(
+                    "Draining", "node draining for epoch handover"), \
+                    "application/json"
+            return self._ingest_admitted(raw)
+        finally:
+            self.drain.end_subquery(tok)
+
+    def _ingest_admitted(self, raw: bytes):
+        from spark_druid_olap_tpu.persist.wal import decode_batch
+        from spark_druid_olap_tpu.segment.append import append_dataframe
+        inj = getattr(self.ctx.engine, "fault", None)
+        if inj is not None:
+            from spark_druid_olap_tpu.fault import FaultInjected
+            try:
+                # chaos site: an owner that crashes applying a pushed
+                # batch (retryable on a replica; never loses the batch —
+                # the broker's journal is the durability point)
+                inj.fire("hist.ingest", key=f"node:{self.node_id}")
+            except FaultInjected as e:
+                return 500, WIRE.encode_error("Injected", str(e)), \
+                    "application/json"
+        try:
+            header, body = WIRE.decode_ingest(raw)
+            sname = str(header["shard"])
+            batch_key = (str(header.get("src") or ""),
+                         int(header["batch"]))
+        except (ValueError, KeyError, TypeError) as e:
+            return 400, WIRE.encode_error("BadIngest", str(e)), \
+                "application/json"
+        store = self.ctx.store
+        with self._ingest_lock:
+            if store._datasources.get(sname) is None:
+                # not an owned shard under the current plan: stale
+                # broker plan or mid-rejoin — broker tries a replica
+                return 404, WIRE.encode_error(
+                    "UnknownShard", f"shard {sname!r} not loaded"), \
+                    "application/json"
+            src, bid = batch_key
+            state = self._applied_batches.get(sname)
+            if state is None or state[0] != src:
+                state = (src, 0, set())     # new broker boot resets ids
+            _, mark, pending = state
+            if bid <= mark or bid in pending:
+                return 200, json.dumps(
+                    {"applied": False, "duplicate": True,
+                     "shard": sname, "batch": bid}
+                ).encode("utf-8"), "application/json"
+            try:
+                df = decode_batch(body)
+                kwargs = header.get("kwargs") or {}
+                new_ds = append_dataframe(
+                    store._datasources[sname], df,
+                    target_rows=int(kwargs.get("target_rows") or (1 << 20)))
+                # register (not restore): the version bump invalidates
+                # this node's result cache for the shard, exactly as a
+                # local append would
+                store.register(new_ds)
+            except Exception as e:  # noqa: BLE001 — apply errors are retryable
+                return 500, WIRE.encode_error(
+                    "IngestFailed", f"{type(e).__name__}: {e}"), \
+                    "application/json"
+            pending.add(bid)
+            while mark + 1 in pending:      # keep the pending set tiny:
+                mark += 1                   # contiguous prefix collapses
+                pending.discard(mark)       # into the watermark
+            self._applied_batches[sname] = (src, mark, pending)
+            self.batches_applied += 1
+            self.batch_rows_applied += len(df)
+        return 200, json.dumps(
+            {"applied": True, "shard": sname, "batch": bid,
+             "rows": len(df)}).encode("utf-8"), "application/json"
 
     def _subquery_admitted(self, raw: bytes):
         inj = getattr(self.ctx.engine, "fault", None)

@@ -123,6 +123,44 @@ def split_subquery(raw: bytes) -> Tuple[dict, Optional[int]]:
     return d, (int(ep) if ep is not None else None)
 
 
+_INGEST_MAGIC = b"SDI1"
+
+
+def encode_ingest(name: str, shard: str, batch_id: int, kwargs: dict,
+                  body: bytes, src: str = "") -> bytes:
+    """One pushed ingest batch: ``b"SDI1" + uint32le(header_len) +
+    header_json + body + uint32le(crc32 of everything before it)``.
+    ``body`` is the batch in the SAME Arrow-IPC encoding the WAL
+    journals (persist/wal.py:encode_batch) — the broker pushes the
+    exact bytes it committed, so owner and journal can never disagree
+    about the rows. ``(src, batch_id)`` identifies the push: ``src`` is
+    the broker's boot generation and ``batch_id`` its per-process push
+    counter; owners dedup on the pair so a retried push never
+    double-applies, and a restarted broker (fresh ``src``) never has
+    its counter restart read as a replay."""
+    header = {"name": name, "shard": shard, "batch": int(batch_id),
+              "src": src, "kwargs": kwargs}
+    hb = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    frame = b"".join([_INGEST_MAGIC, _LEN.pack(len(hb)), hb, body])
+    return frame + _LEN.pack(zlib.crc32(frame))
+
+
+def decode_ingest(payload: bytes) -> Tuple[dict, bytes]:
+    """-> (header dict, body bytes). Raises ValueError on a malformed
+    frame — same detectability contract as the subquery wire format."""
+    if len(payload) < 12 or payload[:4] != _INGEST_MAGIC:
+        raise ValueError("bad ingest wire magic")
+    (crc,) = _LEN.unpack_from(payload, len(payload) - 4)
+    if zlib.crc32(payload[:-4]) != crc:
+        raise ValueError(
+            "ingest wire CRC mismatch (truncated or corrupt frame)")
+    payload = payload[:-4]
+    (hlen,) = _LEN.unpack_from(payload, 4)
+    off = 8 + hlen
+    header = json.loads(payload[8:off].decode("utf-8"))
+    return header, payload[off:]
+
+
 def encode_error(kind: str, message: str, **extra) -> bytes:
     return json.dumps({"error": kind, "message": message, **extra},
                       separators=(",", ":")).encode("utf-8")

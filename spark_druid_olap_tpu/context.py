@@ -163,12 +163,35 @@ class Context:
         persistence on (sdot.persist.path) each batch is journaled to
         the write-ahead log and fsynced BEFORE it becomes queryable, so
         a committed batch survives kill -9 (persist/wal.py). Returns the
-        new immutable Datasource value."""
+        new immutable Datasource value.
+
+        When an ``ingest`` WLM lane is configured, each batch takes a
+        lane slot for its local apply — producers share the same
+        admission fabric as queries instead of starving them. On a
+        broker, an acked batch is additionally pushed to the
+        time-matched shard's owners (cluster/broker.py) so distributed
+        reads keep read-your-writes; the push is an optimization, never
+        part of the durability or ACK path."""
         kwargs = self._ingest_kwargs(kwargs)
-        if self.persist is not None:
-            return self.persist.stream_ingest(name, df, kwargs)
-        from spark_druid_olap_tpu.segment.append import apply_stream_ingest
-        return apply_stream_ingest(self, name, df, kwargs)
+        wlm = getattr(self.engine, "wlm", None)
+        ticket = wlm.admit_ingest() if wlm is not None else None
+        cl = self.cluster
+        token = cl.ingest_begin(name) if cl is not None else None
+        acked_df = None
+        try:
+            if self.persist is not None:
+                ds = self.persist.stream_ingest(name, df, kwargs)
+            else:
+                from spark_druid_olap_tpu.segment.append import (
+                    apply_stream_ingest)
+                ds = apply_stream_ingest(self, name, df, kwargs)
+            acked_df = df
+            return ds
+        finally:
+            if token is not None:
+                cl.ingest_finish(token, name, acked_df, kwargs)
+            if ticket is not None:
+                wlm.release(ticket)
 
     def checkpoint(self, name: Optional[str] = None):
         """Publish snapshot(s) to deep storage (requires

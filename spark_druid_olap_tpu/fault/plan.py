@@ -24,6 +24,18 @@ Determinism: every rule gets its own ``random.Random`` seeded from
 reorder which *evaluation* draws which number, but the draw sequence per
 rule is fixed). Injection sites are zero-cost no-ops when no plan is
 configured — callers hold ``inj = <owner>.fault`` and guard on ``None``.
+
+The full site catalog lives in ``docs/CHAOS.md``. The durability sites
+deserve a note here because their *placement* is the contract:
+``wal.group_commit`` fires in the group-commit leader after the batch's
+writes but before the covering fsync (so an injected crash leaves every
+frame in the batch un-acked — none may survive as committed);
+``compact.publish`` fires before the compacted generation's snapshot is
+written (an injected crash must leave the OLD generation fully readable
+with the WAL untouched); ``hist.ingest`` / ``rpc.ingest`` sit on the
+distributed-ingest push path, where the broker's local journal — not
+the push — is the durability point, so injected failures may only
+affect read-your-writes scatter eligibility, never ACKed data.
 """
 
 from __future__ import annotations

@@ -67,8 +67,12 @@ def _ds_bytes(ds) -> int:
 class PersistManager:
     def __init__(self, ctx, root: str):
         from spark_druid_olap_tpu.utils.config import (
+            PERSIST_APPEND_PARALLEL,
             PERSIST_CHECKPOINT_MAX_BYTES,
             PERSIST_CHECKPOINT_SECONDS,
+            PERSIST_COMPACT_MIN_SEGMENTS,
+            PERSIST_COMPACT_SECONDS,
+            PERSIST_GROUP_COMMIT,
             PERSIST_KEEP_SNAPSHOTS,
             PERSIST_VERIFY_CHECKSUMS,
             PERSIST_WAL_FSYNC,
@@ -79,10 +83,12 @@ class PersistManager:
         # WAL, the snapshot publish path, and the cold tier below
         self.fault = getattr(ctx.engine, "fault", None)
         os.makedirs(self.root, exist_ok=True)
-        # LOCK ORDER: checkpoint paths read the session query history
-        # (QueryHistory._lock) while this lock is held — the global
-        # order is PersistManager.lock BEFORE QueryHistory._lock
-        # (docs/LINT.md); history code must never call into persist.
+        # LOCK ORDER: a per-datasource build lock (serializing the
+        # order-preserving append chain) comes BEFORE this manager lock,
+        # which comes BEFORE QueryHistory._lock (docs/LINT.md; checkpoint
+        # paths read the session query history under this lock). History
+        # code must never call into persist, and nothing may acquire a
+        # ds build lock while holding this lock.
         self.lock = threading.RLock()
         cfg = ctx.config
         self.wal_fsync = bool(cfg.get(PERSIST_WAL_FSYNC))
@@ -90,13 +96,36 @@ class PersistManager:
         self.verify = bool(cfg.get(PERSIST_VERIFY_CHECKSUMS))
         self.interval_s = float(cfg.get(PERSIST_CHECKPOINT_SECONDS))
         self.pass_budget = int(cfg.get(PERSIST_CHECKPOINT_MAX_BYTES))
+        self.group_commit = bool(cfg.get(PERSIST_GROUP_COMMIT))
+        self.append_parallel = bool(cfg.get(PERSIST_APPEND_PARALLEL))
+        self.compact_interval_s = float(cfg.get(PERSIST_COMPACT_SECONDS))
+        self.compact_min_segments = int(
+            cfg.get(PERSIST_COMPACT_MIN_SEGMENTS))
         self._wals: Dict[str, WAL.WriteAheadLog] = {}
-        self._wal_seq: Dict[str, int] = {}      # last seq written, per ds
+        self._wal_seq: Dict[str, int] = {}      # last seq ASSIGNED, per ds
+        self._reg_seq: Dict[str, int] = {}      # last seq REGISTERED, per ds
+        # name -> newest built-but-not-yet-registered Datasource value:
+        # the base the next concurrent producer's append builds on, so
+        # the order-preserving chain survives the build lock being
+        # released before the covering group fsync lands
+        self._tail_ds: Dict[str, object] = {}
+        # name -> in-flight build chain, seq order: every entry is a
+        # built-but-unregistered batch ({seq, ds, df, kwargs, ticket}).
+        # Kept so a frame that FAILS its commit (torn write, failed
+        # covering fsync) can be excised and its successors' builds —
+        # which chained on the rejected rows — rebuilt before any of
+        # them registers: rows never become queryable unless their
+        # journal record is durable (guarded by the ds build lock)
+        self._tail_chain: Dict[str, list] = {}
+        self._ds_locks: Dict[str, threading.RLock] = {}
         self._dirty = set()                     # names needing a checkpoint
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._last_compact = 0.0
         self.counters = {"checkpoints": 0, "checkpoint_bytes": 0,
                          "wal_appends": 0, "wal_replayed": 0,
+                         "wal_repaired": 0, "wal_repaired_bytes": 0,
+                         "compactions": 0, "compacted_segments": 0,
                          "quarantined": 0, "errors": 0}
         self.recovery_report: Optional[dict] = None
         # out-of-core tiered storage: when enabled, recovery hands back
@@ -126,12 +155,24 @@ class PersistManager:
         return os.path.join(self.root, SNAP.sanitize(name))
 
     def _wal_for(self, name: str) -> WAL.WriteAheadLog:
-        w = self._wals.get(name)
-        if w is None:
-            w = self._wals[name] = WAL.WriteAheadLog(
-                os.path.join(self._ds_root(name), "wal.log"),
-                fsync=self.wal_fsync, fault=self.fault)
-        return w
+        with self.lock:
+            w = self._wals.get(name)
+            if w is None:
+                w = self._wals[name] = WAL.WriteAheadLog(
+                    os.path.join(self._ds_root(name), "wal.log"),
+                    fsync=self.wal_fsync, fault=self.fault)
+            return w
+
+    def _ds_lock(self, name: str) -> threading.RLock:
+        """Per-datasource build lock (acquired BEFORE self.lock). It
+        serializes the order-preserving append chain and the checkpoint/
+        compact commit phases for one datasource without stalling
+        producers on every other datasource."""
+        with self.lock:
+            lk = self._ds_locks.get(name)
+            if lk is None:
+                lk = self._ds_locks[name] = threading.RLock()
+            return lk
 
     def _next_seq(self, name: str) -> int:
         seq = self._wal_seq.get(name)
@@ -145,6 +186,11 @@ class PersistManager:
                         root, cur).get("wal_seq", 0)))
                 except (OSError, ValueError):
                     pass
+            # everything journaled before this session's first append is
+            # already folded into whatever state checkpoint would
+            # snapshot — it is the registered watermark, NOT the
+            # in-flight appends about to be assigned seqs past it
+            self._reg_seq.setdefault(name, seq)
         seq += 1
         self._wal_seq[name] = seq
         return seq
@@ -158,11 +204,17 @@ class PersistManager:
         elif event == "drop":
             self._dirty.discard(name)
             self._wal_seq.pop(name, None)
+            self._reg_seq.pop(name, None)
+            self._tail_ds.pop(name, None)
+            self._tail_chain.pop(name, None)
             if self.tier is not None:
                 self.tier.drop_datasource(name)
         elif event == "clear":
             self._dirty.clear()
             self._wal_seq.clear()
+            self._reg_seq.clear()
+            self._tail_ds.clear()
+            self._tail_chain.clear()
             if self.tier is not None:
                 self.tier.clear()
 
@@ -231,56 +283,188 @@ class PersistManager:
     # -- durable stream ingest ------------------------------------------------
     def stream_ingest(self, name: str, df: pd.DataFrame,
                       kwargs: dict):
+        """Durable append, safe for concurrent producers.
+
+        The per-datasource build lock is held only for the build + seq
+        assignment + enqueue; the covering group fsync is awaited
+        OUTSIDE it, so concurrent producers on one datasource share a
+        single fsync (persist/wal.py group commit) instead of paying one
+        each. Ordering survives the split: seqs are assigned and frames
+        enqueued under the build lock (journal order == seq order), each
+        build chains on the newest built tail (``_tail_chain``), and
+        registration is monotone by seq — a later batch's Datasource is
+        a superset of every earlier one's, so the highest-seq register
+        wins and earlier producers just ACK.
+
+        Failure resolution: a frame that fails its commit (torn write,
+        failed covering fsync) is excised from the chain and every
+        successor build — which chained on the rejected rows — is
+        rebuilt from its surviving base before anything registers
+        (``_excise_failed``). The WAL resolves tickets in enqueue
+        order, so whichever producer reaches the lock first (the
+        failed one's except path or a successor's ACK path) sees the
+        failure and repairs the chain; no build containing un-durable
+        rows can ever become queryable. With group commit OFF the
+        append runs synchronously under the build lock (the original
+        one-fsync-per-append path) and failure rollback is immediate.
+        """
         from spark_druid_olap_tpu.segment.append import (
             append_dataframe, wal_kwargs_to_dict)
         from spark_druid_olap_tpu.segment.ingest import ingest_dataframe
-        with self.lock:
-            store = self.ctx.store
+        store = self.ctx.store
+        dslock = self._ds_lock(name)
+        with dslock:
             existing = store._datasources.get(name)
             if existing is not None and len(df) == 0:
                 return existing     # no-op: nothing to journal or apply
-            if existing is None:
-                # new incarnation of this name: any on-disk state belongs
-                # to a previous one (dropped / cleared without PURGE) and
-                # recovery must never merge the two, so fence the old
-                # snapshot + WAL aside before journaling the create
-                self._fence_stale_state(name)
-            elif SNAP.current_version(self._ds_root(name)) is None:
-                # first append to a datasource that was batch-ingested in
-                # memory only: a WAL replay needs a base to append onto,
-                # so publish one synchronously before journaling
-                self.checkpoint(name)
-            kind = "create" if existing is None else "append"
-            if existing is not None \
-                    and getattr(existing, "tier", None) is not None:
-                # appends mutate column arrays (dataclasses.replace +
-                # concatenate) — swap the tiered store for an eager copy
-                # first. Quiet swap: no version bump, no store events;
-                # the register below marks dirty as usual.
-                existing = existing.materialize()
-                store._datasources[name] = existing
-                self.tier.drop_datasource(name)
+            base = self._tail_ds.get(name)
+            if base is None:
+                base = existing
+                if base is None:
+                    # new incarnation of this name: any on-disk state
+                    # belongs to a previous one (dropped / cleared
+                    # without PURGE) and recovery must never merge the
+                    # two, so fence the old snapshot + WAL aside before
+                    # journaling the create
+                    self._fence_stale_state(name)
+                elif SNAP.current_version(self._ds_root(name)) is None:
+                    # first append to a datasource that was batch-
+                    # ingested in memory only: a WAL replay needs a base
+                    # to append onto, so publish one synchronously
+                    # before journaling
+                    self.checkpoint(name)
+                if base is not None \
+                        and getattr(base, "tier", None) is not None:
+                    # appends mutate column arrays (dataclasses.replace
+                    # + concatenate) — swap the tiered store for an
+                    # eager copy first. Quiet swap: no version bump, no
+                    # store events; the register below marks dirty as
+                    # usual.
+                    base = base.materialize()
+                    store._datasources[name] = base
+                    self.tier.drop_datasource(name)
+            kind = "create" if base is None else "append"
             # Build the new Datasource value BEFORE journaling: the WAL
             # append is the commit point, and a batch the build rejects
             # (unknown column, missing time column, bad dtype) must never
             # be journaled — a journaled reject would deterministically
             # fail again on every replay, shadowing later committed
             # batches behind it.
-            if existing is None:
+            if base is None:
                 new_ds = ingest_dataframe(name, df, **kwargs)
             else:
                 new_ds = append_dataframe(
-                    existing, df,
+                    base, df,
                     target_rows=int(kwargs.get("target_rows")
-                                    or (1 << 20)))
-            header = {"seq": self._next_seq(name), "datasource": name,
-                      "kind": kind,
+                                    or (1 << 20)),
+                    parallel=self.append_parallel)
+            seq = self._next_seq(name)
+            header = {"seq": seq, "datasource": name, "kind": kind,
                       "kwargs": wal_kwargs_to_dict(kwargs)}
             body = WAL.encode_batch(df)
-            self._wal_for(name).append(header, body)   # <-- commit point
-            self.counters["wal_appends"] += 1
-            store.register(new_ds)
-            return new_ds
+            wal = self._wal_for(name)
+            entry = {"seq": seq, "ds": new_ds, "df": df,
+                     "kwargs": dict(kwargs), "ticket": None}
+            self._tail_chain.setdefault(name, []).append(entry)
+            self._tail_ds[name] = new_ds
+            if not self.group_commit:
+                # legacy path: one fsync per append, committed under
+                # the build lock — serialized, so the chain is just
+                # this entry and rollback is a pop
+                try:
+                    wal.append(header, body)
+                except BaseException:
+                    self._set_chain(name,
+                                    self._tail_chain[name][:-1])
+                    raise
+                with self.lock:
+                    self.counters["wal_appends"] += 1
+                return self._register_through(name, seq)
+            # enqueue while still holding the build lock: journal
+            # order == seq order, and ticket resolution order follows
+            entry["ticket"] = wal.enqueue(header, body)
+        # -- commit point: outside the build lock so the fsync can cover
+        # every frame concurrent producers queued meanwhile ------------------
+        try:
+            wal.commit(entry["ticket"])
+        except BaseException:
+            with dslock:
+                self._excise_failed(name)
+            raise
+        with dslock:
+            with self.lock:
+                self.counters["wal_appends"] += 1
+            # my ACK implies every earlier-enqueued frame has resolved:
+            # drop any that failed (rebuilding their successors) before
+            # registering, so torn rows never become queryable
+            self._excise_failed(name)
+            return self._register_through(name, seq)
+
+    def _set_chain(self, name: str, entries: list) -> None:
+        """Install the in-flight build chain for ``name`` (build lock
+        held), keeping the newest-tail shortcut in lockstep."""
+        if entries:
+            self._tail_chain[name] = entries
+            self._tail_ds[name] = entries[-1]["ds"]
+        else:
+            self._tail_chain.pop(name, None)
+            self._tail_ds.pop(name, None)
+
+    def _register_through(self, name: str, seq: int):
+        """Register the chain entry carrying ``seq`` and drop every
+        entry it covers (build lock held). Absent entry = a later
+        producer's ACK already registered a superset and removed it —
+        the rows are servable and durable, nothing to do."""
+        chain = self._tail_chain.get(name) or []
+        mine = next((e for e in chain if e["seq"] == seq), None)
+        if mine is None:
+            return self.ctx.store._datasources.get(name)
+        if seq > self._reg_seq.get(name, -1):
+            self._reg_seq[name] = seq
+            self.ctx.store.register(mine["ds"])
+        self._set_chain(name,
+                        [e for e in chain if e["seq"] > seq])
+        return mine["ds"]
+
+    def _excise_failed(self, name: str) -> None:
+        """Drop every chain entry whose commit FAILED (ticket resolved
+        with an error) and rebuild the builds downstream of the first
+        casualty — they chained on the rejected rows (build lock held).
+        Rebuilds replay the surviving entries' own DataFrames in seq
+        order from the last intact base, exactly what WAL replay does
+        at recovery, so the live state and the journal stay one."""
+        from spark_druid_olap_tpu.segment.append import append_dataframe
+        from spark_druid_olap_tpu.segment.ingest import ingest_dataframe
+        chain = self._tail_chain.get(name) or []
+        dead = {i for i, e in enumerate(chain)
+                if e["ticket"] is not None and e["ticket"].event.is_set()
+                and e["ticket"].error is not None}
+        if not dead:
+            return
+        out, dirty = [], False
+        cur = self.ctx.store._datasources.get(name)
+        for i, e in enumerate(chain):
+            if i in dead:
+                dirty = True
+                continue
+            if not dirty:            # upstream of every failure: intact
+                out.append(e)
+                cur = e["ds"]
+                continue
+            if cur is None:
+                # the journaled 'create' itself was rejected; replay
+                # treats the first surviving append as the create
+                # (segment/append.py apply_stream_ingest), so do the same
+                e["ds"] = ingest_dataframe(name, e["df"], **e["kwargs"])
+            else:
+                e["ds"] = append_dataframe(
+                    cur, e["df"],
+                    target_rows=int(e["kwargs"].get("target_rows")
+                                    or (1 << 20)),
+                    parallel=self.append_parallel)
+            cur = e["ds"]
+            out.append(e)
+        self._set_chain(name, out)
 
     def _fence_stale_state(self, name: str) -> None:
         """Move a previous incarnation's on-disk snapshot/WAL aside
@@ -296,6 +480,9 @@ class PersistManager:
         if w is not None:
             w.close()
         self._wal_seq.pop(name, None)
+        self._reg_seq.pop(name, None)
+        self._tail_ds.pop(name, None)
+        self._tail_chain.pop(name, None)
         base = os.path.join(
             self.root,
             f".dropped-{int(time.time())}-{os.path.basename(p)}")
@@ -313,32 +500,56 @@ class PersistManager:
             shutil.rmtree(p, ignore_errors=True)
 
     # -- checkpoint -----------------------------------------------------------
+    def _covered_seq(self, name: str) -> int:
+        """Highest WAL seq the REGISTERED state reflects — the watermark
+        a snapshot of that state may truncate through. Never the
+        allocation watermark (``_wal_seq``): a seq assigned to an
+        in-flight producer whose frame/register hasn't landed yet is NOT
+        covered, and truncating through it would drop an acked batch.
+        Callers already hold ``self.lock``; taken again (RLock) so the
+        watermark read-modify-write is guarded in its own right."""
+        with self.lock:
+            seq = self._reg_seq.get(name)
+            if seq is not None:
+                return seq
+            if name in self._wal_seq:
+                # seqs were assigned this session but none registered:
+                # only the pre-session journal (folded in at _next_seq
+                # init) is covered — and that init seeded _reg_seq, so
+                # reaching here means nothing is
+                return 0
+            seq = self._wal_for(name).last_seq() or 0
+            self._reg_seq[name] = seq
+            return seq
+
     def checkpoint(self, name: str) -> dict:
         """Publish one datasource's current state; returns a summary."""
-        with self.lock:
-            ds = self.ctx.store.get(name)
-            ds.require_complete("checkpoint")
-            iv = self.ctx.store.datasource_version(name)
-            wal_seq = self._wal_seq.get(name)
-            if wal_seq is None:
-                wal_seq = self._wal_for(name).last_seq() or 0
-                self._wal_seq[name] = wal_seq
-            if self.fault is not None:
-                # chaos site: a publish-time I/O error (fsync failure,
-                # disk full). The WAL is untouched, so nothing is lost —
-                # the datasource just stays dirty for the next pass.
-                self.fault.fire("snapshot.write", key=name)
-            manifest = SNAP.write_snapshot(
-                self._ds_root(name), ds, iv, wal_seq, keep=self.keep)
-            # snapshot covers every journaled record — drop them
-            self._wal_for(name).truncate_through(wal_seq)
-            self._dirty.discard(name)
-            self.counters["checkpoints"] += 1
-            self.counters["checkpoint_bytes"] += int(manifest["bytes"])
-            self._write_catalog()
-            return {"datasource": name, "version": iv,
-                    "rows": manifest["num_rows"],
-                    "bytes": manifest["bytes"]}
+        with self._ds_lock(name):
+            with self.lock:
+                ds = self.ctx.store.get(name)
+                ds.require_complete("checkpoint")
+                iv = self.ctx.store.datasource_version(name)
+                wal_seq = self._covered_seq(name)
+                if self.fault is not None:
+                    # chaos site: a publish-time I/O error (fsync
+                    # failure, disk full). The WAL is untouched, so
+                    # nothing is lost — the datasource just stays dirty
+                    # for the next pass.
+                    self.fault.fire("snapshot.write", key=name)
+                manifest = SNAP.write_snapshot(
+                    self._ds_root(name), ds, iv, wal_seq, keep=self.keep)
+                # snapshot covers every journaled record at or below the
+                # registered watermark — drop them (in-flight frames
+                # past it survive the rewrite)
+                self._wal_for(name).truncate_through(wal_seq)
+                self._dirty.discard(name)
+                self.counters["checkpoints"] += 1
+                self.counters["checkpoint_bytes"] += int(
+                    manifest["bytes"])
+                self._write_catalog()
+                return {"datasource": name, "version": iv,
+                        "rows": manifest["num_rows"],
+                        "bytes": manifest["bytes"]}
 
     def checkpoint_all(self, only_dirty: bool = False,
                        byte_budget: Optional[int] = None) -> List[dict]:
@@ -509,8 +720,15 @@ class PersistManager:
         replayed = 0
         wal = self._wal_for(name)
         # a crash mid-append leaves a torn tail; trim it NOW so live
-        # appends after recovery land where replay can see them
-        wal.repair()
+        # appends after recovery land where replay can see them. The
+        # self-heal is no longer silent: operators watching
+        # GET /metadata/persist see how often crashes tear the journal.
+        repaired_bytes = wal.repair()
+        if repaired_bytes > 0:
+            self.counters["wal_repaired"] += 1
+            self.counters["wal_repaired_bytes"] += int(repaired_bytes)
+            report.setdefault("repaired", []).append(
+                {"datasource": name, "bytes": int(repaired_bytes)})
         for header, body in wal.replay():
             seq = int(header.get("seq", 0))
             if seq <= covered:
@@ -539,6 +757,11 @@ class PersistManager:
                                     # committed batches behind it
             replayed += 1
         self.counters["wal_replayed"] += replayed
+        # the registered state now reflects everything replayed (and the
+        # allocation watermark, advanced past failing records above) —
+        # that is the watermark a later checkpoint may truncate through
+        self._reg_seq[name] = max(self._wal_seq.get(name, 0), covered,
+                                  self._reg_seq.get(name, 0))
         if manifest is None and replayed == 0:
             return None
         source = ("snapshot+wal" if manifest is not None and replayed
@@ -546,7 +769,8 @@ class PersistManager:
         info = {"source": source,
                 "snapshot_version": loaded_version,
                 "checksum_verify_ms": round(verify_ms, 3),
-                "wal_records": replayed}
+                "wal_records": replayed,
+                "wal_repaired_bytes": int(repaired_bytes)}
         report["datasources"].append({"datasource": name, **info})
         return info
 
@@ -635,6 +859,9 @@ class PersistManager:
                 if w is not None:
                     w.close()
                 self._wal_seq.pop(name, None)
+                self._reg_seq.pop(name, None)
+                self._tail_ds.pop(name, None)
+                self._tail_chain.pop(name, None)
                 self._dirty.discard(name)
                 if os.path.isdir(p):
                     shutil.rmtree(p, ignore_errors=True)
@@ -660,27 +887,71 @@ class PersistManager:
                 w.close()
             self._wals.clear()
             self._wal_seq.clear()
+            self._reg_seq.clear()
+            self._tail_ds.clear()
+            self._tail_chain.clear()
             self._dirty.clear()
             return removed
 
-    # -- background checkpointer ----------------------------------------------
+    # -- compaction -----------------------------------------------------------
+    def compact(self, name: Optional[str] = None,
+                target_rows: Optional[int] = None) -> List[dict]:
+        """Roll stream-appended tails into time-partitioned segments
+        (persist/compact.py). With a name: force-compact that datasource;
+        without: sweep every datasource past the segment-count floor."""
+        from spark_druid_olap_tpu.persist.compact import compact_datasource
+        out: List[dict] = []
+        if name is not None:
+            r = compact_datasource(self, name, target_rows=target_rows,
+                                   force=True)
+            return [r] if r else []
+        for n in list(self.ctx.store.names()):
+            try:
+                r = compact_datasource(self, n, target_rows=target_rows)
+            except Exception:  # noqa: BLE001 — one bad ds can't stop
+                with self.lock:  # the sweep
+                    self.counters["errors"] += 1
+                continue
+            if r:
+                out.append(r)
+        return out
+
+    # -- background checkpointer / compactor ----------------------------------
     def start_background(self) -> None:
-        if self.interval_s <= 0 or self._thread is not None:
+        periods = [p for p in (self.interval_s, self.compact_interval_s)
+                   if p > 0]
+        if not periods or self._thread is not None:
             return
+        self._bg_period = min(periods)
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._bg_loop, name="sdot-checkpointer", daemon=True)
         self._thread.start()
 
     def _bg_loop(self) -> None:
-        while not self._stop.wait(self.interval_s):
-            try:
-                self.checkpoint_all(
-                    only_dirty=True,
-                    byte_budget=self.pass_budget or None)
-            except Exception:  # noqa: BLE001 — the loop must survive
-                with self.lock:
-                    self.counters["errors"] += 1
+        last_ckpt = last_compact = time.monotonic()
+        slack = self._bg_period * 0.05
+        while not self._stop.wait(self._bg_period):
+            now = time.monotonic()
+            if self.interval_s > 0 \
+                    and now - last_ckpt >= self.interval_s - slack:
+                last_ckpt = now
+                try:
+                    self.checkpoint_all(
+                        only_dirty=True,
+                        byte_budget=self.pass_budget or None)
+                except Exception:  # noqa: BLE001 — the loop must survive
+                    with self.lock:
+                        self.counters["errors"] += 1
+            if self.compact_interval_s > 0 \
+                    and now - last_compact >= self.compact_interval_s \
+                    - slack:
+                last_compact = now
+                try:
+                    self.compact()
+                except Exception:  # noqa: BLE001 — the loop must survive
+                    with self.lock:
+                        self.counters["errors"] += 1
 
     def stop(self) -> None:
         self._stop.set()
@@ -750,9 +1021,17 @@ class PersistManager:
                 "datasources": per_ds,
                 "dirty": sorted(self._dirty),
                 "counters": dict(self.counters),
+                "groupCommit": {
+                    "enabled": self.group_commit,
+                    "commits": sum(w.group_commits
+                                   for w in self._wals.values()),
+                    "frames": sum(w.group_frames
+                                  for w in self._wals.values()),
+                },
                 "background": {
                     "intervalSeconds": self.interval_s,
                     "passByteBudget": self.pass_budget,
+                    "compactIntervalSeconds": self.compact_interval_s,
                     "running": self._thread is not None
                     and self._thread.is_alive(),
                 },

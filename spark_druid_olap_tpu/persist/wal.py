@@ -24,6 +24,7 @@ import io
 import json
 import os
 import struct
+import threading
 import zlib
 from typing import Iterator, List, Optional, Tuple
 
@@ -60,14 +61,59 @@ def decode_batch(body: bytes):
         return r.read_all().to_pandas()
 
 
+def _pack_record(header: dict, body: bytes) -> bytes:
+    hdr = json.dumps(header, separators=(",", ":")).encode()
+    crc = zlib.crc32(hdr)
+    crc = zlib.crc32(body, crc)
+    return _FRAME.pack(_MAGIC, len(hdr), len(body), crc) + hdr + body
+
+
+class _Ticket:
+    """One producer's frame waiting in the group-commit queue."""
+
+    __slots__ = ("header", "body", "event", "error")
+
+    def __init__(self, header: dict, body: bytes):
+        self.header = header
+        self.body = body
+        self.event = threading.Event()
+        self.error: Optional[BaseException] = None
+
+
 class WriteAheadLog:
-    """Append-only framed journal with crash-tolerant replay."""
+    """Append-only framed journal with crash-tolerant replay.
+
+    Two write paths share the same framing and durability contract:
+
+    - :meth:`append` — one record, one fsync (the original path).
+    - :meth:`append_group` — the record joins a shared commit queue; one
+      producer becomes the flush leader, writes every queued frame in
+      enqueue order, and a SINGLE fsync covers the whole batch. The ACK
+      (the call returning) is released only after the covering fsync, so
+      ACK-implies-durable holds exactly as on the single path — the
+      fsync cost is just amortized across concurrent producers.
+
+    Torn-tail semantics are identical on both paths: a frame that fails
+    mid-write (fault-injected cut, real I/O error) is truncated back out
+    so the journal stays appendable, and only THAT producer's append
+    fails; a covering fsync that fails rolls the whole un-durable group
+    back and fails every producer in it (none were acked).
+    """
 
     def __init__(self, path: str, fsync: bool = True, fault=None):
         self.path = path
         self.fsync = fsync
         self.fault = fault      # fault injector (docs/CHAOS.md) or None
         self._f = None
+        # group commit state: _q_lock guards the pending queue, _io_lock
+        # serializes every file mutation (group flush, single append,
+        # truncate_through, repair) so a journal rewrite can never race
+        # a half-written group. LOCK ORDER: _io_lock before _q_lock.
+        self._q_lock = threading.Lock()
+        self._io_lock = threading.RLock()
+        self._pending: List[_Ticket] = []
+        self.group_commits = 0      # covering fsyncs issued
+        self.group_frames = 0       # frames those fsyncs covered
 
     # -- write ----------------------------------------------------------------
     def _file(self):
@@ -84,45 +130,162 @@ class WriteAheadLog:
         record left by the failure cannot poison later appends (replay
         stops at the first bad record — garbage in the middle would
         silently drop every durable record after it)."""
-        hdr = json.dumps(header, separators=(",", ":")).encode()
-        crc = zlib.crc32(hdr)
-        crc = zlib.crc32(body, crc)
-        rec = _FRAME.pack(_MAGIC, len(hdr), len(body), crc) + hdr + body
+        rec = _pack_record(header, body)
         inj = self.fault
-        f = self._file()
-        pos = f.seek(0, os.SEEK_END)    # append-mode tell() may lag reality
-        try:
-            if inj is not None:
-                # chaos sites: "wal.append" truncate/flip corrupts the
-                # record (a torn write — the append FAILS, the batch is
-                # never acked), "wal.fsync" raises a simulated I/O error
-                cut = inj.mutate("wal.append", rec, key=self.path)
-                if cut is not rec:
-                    f.write(cut)
+        with self._io_lock:
+            f = self._file()
+            pos = f.seek(0, os.SEEK_END)    # append-mode tell() may lag
+            try:
+                if inj is not None:
+                    # chaos sites: "wal.append" truncate/flip corrupts the
+                    # record (a torn write — the append FAILS, the batch is
+                    # never acked), "wal.fsync" raises a simulated I/O error
+                    cut = inj.mutate("wal.append", rec, key=self.path)
+                    if cut is not rec:
+                        f.write(cut)
+                        f.flush()
+                        raise OSError("fault-injected torn WAL append")
+                    f.write(rec)
                     f.flush()
-                    raise OSError("fault-injected torn WAL append")
+                    inj.fire("wal.fsync", key=self.path)
+                else:
+                    f.write(rec)
+                    f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
+            except BaseException:
+                # roll the partial record back so the journal stays
+                # appendable
+                try:
+                    f.truncate(pos)
+                    f.flush()
+                    if self.fsync:
+                        os.fsync(f.fileno())
+                except OSError:
+                    pass    # repair() at next recovery trims it instead
+                raise
+
+    # -- group commit ---------------------------------------------------------
+    def enqueue(self, header: dict, body: bytes) -> _Ticket:
+        """Stage one record on the shared commit queue and return its
+        ticket (no blocking, no I/O). Enqueue order is preserved on
+        disk, so callers that assign sequence numbers under their own
+        lock and enqueue before releasing it get seq-ordered journals
+        for free — and by the time any later-enqueued ticket resolves,
+        every earlier ticket has resolved too (the leader drains the
+        queue in order and settles a whole batch before releasing the
+        io lock), which is what lets the persist manager excise a
+        failed frame's build from the in-memory append chain before a
+        successor registers on top of it."""
+        t = _Ticket(header, body)
+        with self._q_lock:
+            self._pending.append(t)
+        return t
+
+    def commit(self, t: _Ticket) -> None:
+        """Block until ``t``'s covering fsync made it durable, or raise
+        its failure (an error means NOT acked, exactly like
+        :meth:`append`)."""
+        while not t.event.is_set():
+            # leader election: whoever gets the io lock drains the queue
+            # and commits the batch. A producer whose frame was covered
+            # by a previous leader's fsync just wakes and returns.
+            acquired = self._io_lock.acquire(timeout=0.02)
+            if not acquired:
+                continue
+            try:
+                if t.event.is_set():
+                    break
+                with self._q_lock:
+                    batch, self._pending = self._pending, []
+                if batch:
+                    self._write_group(batch)
+            finally:
+                self._io_lock.release()
+        if t.error is not None:
+            raise t.error
+
+    def append_group(self, header: dict, body: bytes) -> None:
+        """:meth:`enqueue` + :meth:`commit` in one call, for callers
+        with no ordering stake of their own."""
+        self.commit(self.enqueue(header, body))
+
+    def _write_group(self, batch: List[_Ticket]) -> None:
+        """Write every frame in ``batch``, then one covering fsync.
+        Called with the io lock held. Never raises: outcomes are
+        delivered per-ticket. A frame that fails mid-write is truncated
+        back out (that producer alone fails, the group continues); a
+        failing covering fsync rolls the whole un-durable suffix back
+        and fails every producer whose frame it covered."""
+        inj = self.fault
+        try:
+            f = self._file()
+            group_start = f.seek(0, os.SEEK_END)
+        except OSError as e:
+            for t in batch:
+                t.error = e
+                t.event.set()
+            return
+        pos = group_start
+        wrote: List[_Ticket] = []
+        for t in batch:
+            rec = _pack_record(t.header, t.body)
+            try:
+                if inj is not None:
+                    # same per-frame chaos semantics as append(): a
+                    # mutate rule tears THIS frame only
+                    cut = inj.mutate("wal.append", rec, key=self.path)
+                    if cut is not rec:
+                        f.write(cut)
+                        f.flush()
+                        raise OSError("fault-injected torn WAL append")
                 f.write(rec)
-                f.flush()
+            except BaseException as e:  # noqa: BLE001 — per-ticket fate
+                try:
+                    f.flush()
+                    f.truncate(pos)
+                    f.flush()
+                except OSError:
+                    pass    # repair() at next recovery trims it
+                t.error = e
+                t.event.set()
+                continue
+            pos += len(rec)
+            wrote.append(t)
+        try:
+            f.flush()
+            if inj is not None and wrote:
+                # chaos sites: "wal.group_commit" models the covering
+                # fsync failing (the WHOLE batch is un-acked and rolled
+                # back), "wal.fsync" keeps its single-path meaning
+                inj.fire("wal.group_commit", key=self.path)
                 inj.fire("wal.fsync", key=self.path)
-            else:
-                f.write(rec)
-                f.flush()
             if self.fsync:
                 os.fsync(f.fileno())
-        except BaseException:
-            # roll the partial record back so the journal stays appendable
+        except BaseException as e:  # noqa: BLE001 — per-ticket fate
+            # nothing past group_start is durable: roll it all back so
+            # the journal stays appendable, and fail every producer (no
+            # ACK was released, so ACK-implies-durable holds)
             try:
-                f.truncate(pos)
+                f.truncate(group_start)
                 f.flush()
                 if self.fsync:
                     os.fsync(f.fileno())
             except OSError:
-                pass        # repair() at next recovery trims it instead
-            raise
+                pass
+            for t in wrote:
+                t.error = e
+                t.event.set()
+            return
+        self.group_commits += 1
+        self.group_frames += len(wrote)
+        for t in wrote:
+            t.event.set()
 
     def close(self) -> None:
-        if self._f is not None and not self._f.closed:
-            self._f.close()
+        with self._io_lock:
+            if self._f is not None and not self._f.closed:
+                self._f.close()
 
     # -- read -----------------------------------------------------------------
     def replay(self) -> Iterator[Tuple[dict, bytes]]:
@@ -188,11 +351,12 @@ class WriteAheadLog:
                 good += _FRAME.size + hlen + blen
         torn = self.size_bytes() - good
         if torn > 0:
-            self.close()
-            with open(self.path, "r+b") as f:
-                f.truncate(good)
-                f.flush()
-                os.fsync(f.fileno())
+            with self._io_lock:
+                self.close()
+                with open(self.path, "r+b") as f:
+                    f.truncate(good)
+                    f.flush()
+                    os.fsync(f.fileno())
         return max(0, torn)
 
     # -- maintenance ----------------------------------------------------------
@@ -207,32 +371,32 @@ class WriteAheadLog:
         are folded into a published snapshot) by atomically rewriting the
         journal with the surviving tail. The torn tail (if any) is
         discarded too — it was never committed."""
-        keep = [(h, b) for h, b in self.replay()
-                if int(h.get("seq", 0)) > seq]
-        self.close()
-        if not keep:
-            try:
-                os.remove(self.path)
-            except OSError:
-                pass
-            return
-        tmp = self.path + ".tmp"
-        with open(tmp, "wb") as f:
-            for header, body in keep:
-                hdr = json.dumps(header, separators=(",", ":")).encode()
-                c = zlib.crc32(hdr)
-                c = zlib.crc32(body, c)
-                f.write(_FRAME.pack(_MAGIC, len(hdr), len(body), c))
-                f.write(hdr)
-                f.write(body)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
-        # the rewritten journal replaces records a snapshot already owns;
-        # if the rename itself is lost on crash, replay re-applies them —
-        # harmless for idempotent restores but the dir entry must still
-        # be durable before the caller drops the covering snapshot refs
-        _fsync_dir(os.path.dirname(self.path) or ".")
+        # the io lock excludes an in-flight group flush: a rewrite under
+        # a half-committed group would orphan its frames in the replaced
+        # file (acked data lost through a dead fd)
+        with self._io_lock:
+            keep = [(h, b) for h, b in self.replay()
+                    if int(h.get("seq", 0)) > seq]
+            self.close()
+            if not keep:
+                try:
+                    os.remove(self.path)
+                except OSError:
+                    pass
+                return
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as f:
+                for header, body in keep:
+                    f.write(_pack_record(header, body))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            # the rewritten journal replaces records a snapshot already
+            # owns; if the rename itself is lost on crash, replay
+            # re-applies them — harmless for idempotent restores but the
+            # dir entry must still be durable before the caller drops
+            # the covering snapshot refs
+            _fsync_dir(os.path.dirname(self.path) or ".")
 
     def last_seq(self) -> Optional[int]:
         last = None

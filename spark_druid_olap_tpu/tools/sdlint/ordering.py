@@ -27,6 +27,24 @@ error propagation, not a missing durability step):
   call: truncating the journal before the snapshot that supersedes it
   is durable destroys the only recovery source. (Exception edges count
   here: a failed snapshot must not fall through to the truncate.)
+- **O5 swap-before-truncate** — a function that performs a generation
+  swap (``os.replace``) but can reach a WAL ``truncate_through`` from
+  entry WITHOUT passing the swap: the journal records are destroyed
+  while the old generation is still the published one, so a crash
+  recovers the old snapshot minus the rows the journal held.
+  (Exception edges count: a failed swap must not fall through to the
+  truncate.)
+- **O6 dir-fsync-after-swap** — an ``os.replace`` can reach a destroy
+  step (``truncate_through`` / ``rmtree``) without an intervening
+  ``*fsync_dir*``: the rename may still be sitting in an un-synced
+  directory inode when its superseded recovery source is destroyed —
+  a crash can lose BOTH generations.
+- **O7 no-register-before-publish** — a ``store.register``-style call
+  from which an ``os.replace`` or ``write_snapshot`` is still
+  reachable: rows become servable before the durable publish that
+  backs them, so a crash in between acknowledges a generation that
+  recovery cannot reproduce (the compaction swap protocol requires
+  publish-then-swap-in-memory, never the reverse).
 """
 
 from __future__ import annotations
@@ -75,7 +93,7 @@ def _check_function(project: Project, mod, qual: str,
         g, lambda ch: any("fsync_dir" in seg for seg in ch))
     writes = _chain_nodes(g, lambda ch: ch[-1] in WRITE_SEGS)
     wal_append = _chain_nodes(
-        g, lambda ch: ch[-1] == "append"
+        g, lambda ch: ch[-1] in ("append", "append_group")
         and any("wal" in seg.lower() for seg in ch[:-1]))
     register = _chain_nodes(
         g, lambda ch: ch[-1] == "register" and len(ch) >= 2)
@@ -83,6 +101,11 @@ def _check_function(project: Project, mod, qual: str,
     ckpt = _chain_nodes(
         g, lambda ch: ch[-1] == "write_snapshot"
         or any("checkpoint" in seg for seg in ch))
+    destroy = _chain_nodes(
+        g, lambda ch: ch[-1] in ("truncate_through", "rmtree"))
+    publish = _chain_nodes(
+        g, lambda ch: _suffix(ch, ("os", "replace"))
+        or ch[-1] == "write_snapshot")
 
     def emit(rule: str, n: int, anchor: str, msg: str) -> None:
         out.append(Finding("ordering", rule, mod.relpath, _line(g, n),
@@ -129,6 +152,42 @@ def _check_function(project: Project, mod, qual: str,
                  "write_snapshot/checkpoint on the same path — the only "
                  "recovery source is destroyed before its replacement "
                  "is durable")
+
+    # O5: the function swaps generations, but a truncate can run first
+    # (exception edges count: a failed swap must not fall through)
+    if replace:
+        for tn in truncate:
+            if g.reachable_avoiding(g.entry, {tn},
+                                    set(replace) - {tn}):
+                emit("swap-before-truncate", tn, "truncate_through",
+                     "WAL truncate_through reachable before the "
+                     "generation swap (os.replace) completes — the "
+                     "journal is destroyed while the OLD generation is "
+                     "still published, so a crash loses its rows")
+
+    # O6: swap reaches a destroy step with no directory fsync between
+    for rn in replace:
+        for dn in destroy:
+            if dn == rn:
+                continue
+            if g.reachable_avoiding(rn, {dn}, set(dsync) - {rn, dn},
+                                    normal_only=True):
+                emit("dir-fsync-after-swap", rn, "os.replace",
+                     "rename publish reaches a destroy step "
+                     "(truncate_through/rmtree) without a directory "
+                     "fsync in between — a crash can lose both the new "
+                     "generation and its superseded recovery source")
+                break
+
+    # O7: rows registered while their durable publish is still ahead
+    for rn in register:
+        if g.reachable_avoiding(rn, set(publish) - {rn}, set(),
+                                normal_only=True):
+            emit("no-register-before-publish", rn, "register",
+                 "datasource registered before the durable publish "
+                 "(write_snapshot/os.replace) that backs it — a crash "
+                 "in between acknowledges a generation recovery cannot "
+                 "reproduce")
     return out
 
 

@@ -440,6 +440,32 @@ PERSIST_VERIFY_CHECKSUMS = _entry(
     "recovery. A mismatch quarantines that snapshot version and recovery "
     "falls back to the previous one (or the WAL alone) — the engine "
     "always starts.", semantic=False)
+PERSIST_GROUP_COMMIT = _entry(
+    "sdot.persist.wal.group.commit", True,
+    "Route stream-ingest WAL appends through the shared commit queue: "
+    "one fsync covers every frame queued by concurrent producers, and "
+    "each ACK is released only after its covering fsync (ACK-implies-"
+    "durable unchanged, fsync cost amortized). Off = one fsync per "
+    "append, the original path.", semantic=False)
+PERSIST_APPEND_PARALLEL = _entry(
+    "sdot.persist.append.parallel", True,
+    "Build a stream-append's dimension/metric columns across a thread "
+    "pool (per-column dictionary union + order-preserving remap are "
+    "independent, so the result is bit-identical to the serial build). "
+    "Only engages past a small batch-row floor.", semantic=False)
+PERSIST_COMPACT_SECONDS = _entry(
+    "sdot.persist.compact.interval.seconds", 0.0,
+    "Cadence of the background compactor rolling a stream-appended tail "
+    "of many small segments into time-partitioned segments (atomic "
+    "generation swap: snapshot publish + WAL truncate + quiet in-memory "
+    "swap, no ingest-version bump — caches and rollup staleness are "
+    "untouched because the rows are identical). 0 disables the thread; "
+    "PersistManager.compact() still works.", float, semantic=False)
+PERSIST_COMPACT_MIN_SEGMENTS = _entry(
+    "sdot.persist.compact.min.segments", 8,
+    "Segment-count floor below which the compactor leaves a datasource "
+    "alone (compacting a handful of segments buys nothing and churns "
+    "snapshot versions).", int, semantic=False)
 # --- host-tier safety valve ---------------------------------------------------
 HOST_GATHER_PAGE_BYTES = _entry(
     "sdot.host.gather.page.bytes", 32 << 20,
@@ -606,6 +632,16 @@ CLUSTER_SUBQ_CACHE_MAX_BYTES = _entry(
     "sdot.cluster.subq.cache.max.bytes", 64 << 20,
     "Byte budget of the broker's shard-level subquery cache (LRU "
     "eviction).", int, semantic=False)
+CLUSTER_INGEST_PUSH = _entry(
+    "sdot.cluster.ingest.push", True,
+    "Distributed ingest: after a stream-ingest batch is journaled and "
+    "acknowledged on the broker (durability is ALWAYS local), push it "
+    "to the time-matched shard's owners so distributed queries keep "
+    "read-your-writes instead of falling back to broker-local serving "
+    "until the next checkpoint. Off, or when any owner push fails, the "
+    "broker's ingest-version check simply serves the datasource locally "
+    "— never a correctness difference, only where the scan runs.",
+    semantic=False)
 CLUSTER_AUTOSCALE_ENABLED = _entry(
     "sdot.cluster.autoscale.enabled", False,
     "Autoscale hook (cluster/autoscale.py): the broker samples every "

@@ -26,3 +26,31 @@ def ingest(store, wal, name, rows):
     store.register(ds)
     wal.append({"seq": 1, "datasource": name}, rows)
     return ds
+
+
+def compact_swap(root, wal, snap, ds, seq):
+    # seeded: the journal is truncated BEFORE the generation swap
+    # completes — swap-before-truncate
+    snap.write_snapshot(root, ds, seq)
+    wal.truncate_through(seq)
+    tmp = os.path.join(root, "generation.tmp")
+    os.replace(tmp, os.path.join(root, "generation"))
+    snap.fsync_dir(root)
+
+
+def swap_generations(root, wal, snap, ds, seq):
+    # seeded: the swap rename reaches the WAL truncate with no directory
+    # fsync in between — dir-fsync-after-swap
+    snap.write_snapshot(root, ds, seq)
+    tmp = os.path.join(root, "generation.tmp")
+    os.replace(tmp, os.path.join(root, "generation"))
+    wal.truncate_through(seq)
+    snap.fsync_dir(root)
+
+
+def publish_compacted(root, store, snap, ds, seq):
+    # seeded: the compacted generation is registered (servable) before
+    # its snapshot publish is durable — no-register-before-publish
+    store.register(ds)
+    snap.write_snapshot(root, ds, seq)
+    snap.fsync_dir(root)

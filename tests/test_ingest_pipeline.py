@@ -1,0 +1,316 @@
+"""Crash-safe production-rate ingest: group-committed WAL, background
+compaction, and distributed read-your-writes.
+
+The acceptance bar is differential throughout: whatever torn frames,
+failed covering fsyncs, racing compactions, or mid-write epoch swaps the
+pipeline absorbs, the served rows must be EXACTLY the acked batches —
+live (before any restart) and after recovery. "Exactly" cuts both ways:
+an acked batch may never be lost (ACK-implies-durable) and an un-acked
+batch may never surface (no resurrection of rolled-back frames, even
+when later producers chained their builds on one).
+
+True kill -9 coverage lives in ``scripts/crashtest.py --ingest``; the
+seeded chaos legs in ``scripts/loadtest.py --chaos`` replay the same
+fault sites deterministically. Neither is tier-1; this file is.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pandas as pd
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.fault import FaultInjected
+
+from conftest import assert_frames_equal
+
+
+def _batch(key: str, n=40, day="2024-01-01") -> pd.DataFrame:
+    return pd.DataFrame({
+        "t": pd.to_datetime(day),
+        "k": [key] * n,
+        "v": np.arange(n, dtype=np.int64)})
+
+
+def _keys(ctx, name="ev"):
+    return sorted(set(ctx.sql(f"select k from {name}").data["k"].tolist()))
+
+
+def _count(ctx, name="ev"):
+    return int(ctx.sql(f"select count(*) as n from {name}").data["n"][0])
+
+
+# -- (a) torn group commit: exactly the acked prefix survives -----------------
+
+def test_torn_group_commit_recovers_exactly_acked(tmp_path):
+    """Four producers share covering fsyncs; injected covering-fsync
+    failures un-ack whole batches and torn writes un-ack single frames.
+    Both live state and recovery must serve exactly the acked set."""
+    root = str(tmp_path / "p")
+    ctx = sdot.Context({
+        "sdot.persist.enabled": True, "sdot.persist.path": root,
+        "sdot.fault.plan": json.dumps({"seed": 7, "rules": [
+            # two failed covering fsyncs (whole batch un-acked) ...
+            {"site": "wal.group_commit", "action": "error",
+             "count": 2, "after": 1, "scope": "gc"},
+            # ... plus one torn frame (that producer alone un-acked)
+            {"site": "wal.append", "action": "truncate", "arg": 9,
+             "count": 1, "after": 4, "scope": "gc"}]})})
+    acked, lock = set(), threading.Lock()
+
+    def producer(tid):
+        for b in range(6):
+            key = f"p{tid}b{b}"
+            try:
+                ctx.stream_ingest("ev", _batch(key), time_column="t")
+                with lock:
+                    acked.add(key)
+            except (FaultInjected, OSError):
+                pass
+
+    with ctx.engine.fault.scope("gc"):
+        ths = [threading.Thread(target=producer, args=(i,))
+               for i in range(4)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+
+    fired = ctx.engine.fault.stats()["by_site"]
+    assert fired.get("wal.group_commit") == 2
+    assert fired.get("wal.append") == 1
+    assert 0 < len(acked) < 24
+    # LIVE exactness: a build chained on a failed frame must have been
+    # excised and rebuilt before registering — no phantom rows
+    assert _keys(ctx) == sorted(acked)
+    assert _count(ctx) == 40 * len(acked)
+    # every acked frame rode a committed group, and vice versa
+    gc = ctx.persist.stats()["groupCommit"]
+    assert gc["enabled"] and gc["frames"] == len(acked)
+    assert 1 <= gc["commits"] <= gc["frames"]
+    ctx.close()
+
+    # recovery (replay of the journal alone) serves the same exact set
+    ctx2 = sdot.Context({"sdot.persist.enabled": True,
+                         "sdot.persist.path": root})
+    try:
+        assert _keys(ctx2) == sorted(acked)
+        assert _count(ctx2) == 40 * len(acked)
+    finally:
+        ctx2.close()
+
+
+# -- (b) compaction racing live stream ingest ---------------------------------
+
+def test_compaction_races_live_ingest_differential(tmp_path):
+    """Producers stream batches while the compactor repeatedly rolls the
+    tail into time-partitioned generations. Every row must survive with
+    identical aggregates, live and after recovery, and the generation
+    swaps must never move the ingest version (quiet swap contract)."""
+    root = str(tmp_path / "p")
+    ctx = sdot.Context({"sdot.persist.enabled": True,
+                        "sdot.persist.path": root,
+                        "sdot.cache.enabled": False})
+    stop = threading.Event()
+    compactions = []
+
+    def producer(tid):
+        for b in range(8):
+            key = f"p{tid}b{b}"
+            # descending days so compaction really re-sorts
+            ctx.stream_ingest(
+                "ev", _batch(key, day=f"2024-01-{28 - b:02d}"),
+                time_column="t", target_rows=64)
+
+    def compactor():
+        while not stop.is_set():
+            compactions.extend(ctx.persist.compact("ev"))
+
+    ths = [threading.Thread(target=producer, args=(i,)) for i in range(3)]
+    ct = threading.Thread(target=compactor)
+    ct.start()
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    stop.set()
+    ct.join()
+    ver = ctx.store.datasource_version("ev")
+    compactions.extend(ctx.persist.compact("ev"))   # roll the last tail
+    assert compactions, "forced compaction never engaged"
+    assert ctx.store.datasource_version("ev") == ver, \
+        "generation swap moved the ingest version"
+
+    q = "select k, sum(v) as s, count(*) as n from ev group by k order by k"
+    want = pd.DataFrame({
+        "k": sorted(f"p{t}b{b}" for t in range(3) for b in range(8)),
+        "s": np.int64(np.arange(40).sum()),
+        "n": np.int64(40)})
+    assert_frames_equal(ctx.sql(q).to_pandas(), want)
+    # the compacted generation is globally time-sorted
+    ds = ctx.store.get("ev")
+    assert len(ds.segments) < 24
+    millis = (ds.time.days.astype(np.int64) * 86_400_000
+              + ds.time.ms_in_day.astype(np.int64))
+    assert bool(np.all(np.diff(millis) >= 0))
+    ctx.close()
+
+    ctx2 = sdot.Context({"sdot.persist.enabled": True,
+                         "sdot.persist.path": root,
+                         "sdot.cache.enabled": False})
+    try:
+        assert_frames_equal(ctx2.sql(q).to_pandas(), want)
+    finally:
+        ctx2.close()
+
+
+# -- (c) rollup staleness across a generation swap ----------------------------
+
+def test_rollup_staleness_survives_generation_swap(tmp_path):
+    """A compaction swap registers no ingest event: a rollup fresh
+    before the swap is still fresh (and still serves the rewrite) after
+    it, and a stale one stays stale — in both directions the answers
+    match the base leg."""
+    root = str(tmp_path / "p")
+    ctx = sdot.Context({"sdot.persist.enabled": True,
+                        "sdot.persist.path": root,
+                        "sdot.cache.enabled": False})
+    for b in range(6):
+        ctx.stream_ingest("ev", _batch(f"b{b}", day=f"2024-01-{b + 1:02d}"),
+                          time_column="t", target_rows=64)
+    ctx.sql("create rollup kcube on ev dimensions (k) "
+            "aggregations (sum(v), count(*)) granularity day")
+    q = "select k, sum(v) as s from ev group by k order by k"
+
+    def status():
+        return ctx.history.entries()[-1].stats.get("rollup")
+
+    fresh = ctx.sql(q).to_pandas()
+    assert status() == "rollup:kcube"
+
+    assert ctx.persist.compact("ev"), "forced compaction skipped"
+    assert_frames_equal(ctx.sql(q).to_pandas(), fresh)
+    assert status() == "rollup:kcube", \
+        "generation swap flipped a fresh rollup stale"
+
+    # a real append DOES flip it stale — and a second swap keeps it so
+    ctx.stream_ingest("ev", _batch("b6", day="2024-01-07"),
+                      time_column="t", target_rows=64)
+    after = ctx.sql(q).to_pandas()
+    assert status() == "base"
+    assert len(after) == len(fresh) + 1
+    for _ in range(3):      # past the segment floor so the sweep engages
+        ctx.stream_ingest("ev", _batch("b6", day="2024-01-07"),
+                          time_column="t", target_rows=64)
+    assert ctx.persist.compact("ev")
+    got = ctx.sql(q).to_pandas()
+    assert status() == "base", \
+        "generation swap resurrected a stale rollup"
+    assert_frames_equal(
+        got[got["k"] != "b6"].reset_index(drop=True), fresh)
+    ctx.close()
+
+
+# -- (d) cluster ingest across an epoch swap mid-write ------------------------
+
+def test_cluster_ingest_survives_epoch_swap(tmp_path):
+    """Broker-side stream ingest keeps acking while the topology rolls
+    to a new epoch; every acked batch is servable afterwards (the swap
+    voids owner confirmations, never the broker's own journal)."""
+    import socket
+
+    from spark_druid_olap_tpu.cluster import epoch as EPO
+    from spark_druid_olap_tpu.cluster.historical import HistoricalNode
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    root = str(tmp_path / "p")
+    seed = sdot.Context({"sdot.persist.path": root})
+    for b in range(4):
+        seed.stream_ingest("ev", _batch(f"seed{b}",
+                                        day=f"2024-01-{b + 1:02d}"),
+                           time_column="t", target_rows=32)
+    seed.checkpoint()
+    seed.close()
+
+    addrs = [f"127.0.0.1:{free_port()}" for _ in range(3)]
+    common = {"sdot.persist.path": root,
+              "sdot.cluster.replication": 2,
+              "sdot.cluster.shards": 2,
+              "sdot.cluster.epoch.poll.seconds": 0.05,
+              "sdot.cluster.epoch.drain.grace.seconds": 0.05,
+              "sdot.cluster.retry.backoff.start.seconds": 0.01,
+              "sdot.cache.enabled": False}
+    hists, broker = [], None
+    try:
+        csv2 = ",".join(addrs[:2])
+        for i in range(2):
+            hists.append(HistoricalNode(
+                {**common, "sdot.cluster.nodes": csv2},
+                node_id=i).start())
+        broker = sdot.Context({
+            **common, "sdot.cluster.nodes": csv2,
+            "sdot.cluster.role": "broker",
+            "sdot.cluster.probe.interval.seconds": 0.05})
+
+        acked, lock = [], threading.Lock()
+        stop = threading.Event()
+
+        def producer():
+            b = 0
+            while not stop.is_set() or b < 6:
+                key = f"live{b}"
+                broker.stream_ingest(
+                    "ev", _batch(key, day=f"2024-02-{(b % 27) + 1:02d}"),
+                    time_column="t", target_rows=32)
+                with lock:
+                    acked.append(key)
+                b += 1
+                if b >= 40:
+                    break
+
+        th = threading.Thread(target=producer)
+        th.start()
+        try:
+            import time
+            time.sleep(0.2)               # a few pre-swap batches land
+            rec = EPO.publish_epoch(root, addrs, note="scale-out")
+            hists.append(HistoricalNode(
+                {**common, "sdot.cluster.nodes": ",".join(rec.nodes)},
+                node_id=2).start())
+            deadline = time.monotonic() + 20.0
+            while (time.monotonic() < deadline
+                   and broker.cluster.stats()["epoch"]["active"]
+                   != rec.epoch):
+                time.sleep(0.05)
+            assert broker.cluster.stats()["epoch"]["active"] == rec.epoch
+        finally:
+            stop.set()
+            th.join()
+
+        # every acked batch — before, during, and after the swap — is
+        # servable with exact aggregates
+        q = ("select k, sum(v) as s, count(*) as n from ev "
+             "group by k order by k")
+        keys = sorted([f"seed{b}" for b in range(4)] + sorted(set(acked)))
+        want = pd.DataFrame({
+            "k": keys,
+            "s": np.int64(np.arange(40).sum()),
+            "n": np.int64(40)})
+        assert_frames_equal(broker.sql(q).to_pandas(), want)
+        st = broker.engine.last_stats.get("cluster") or {}
+        assert st.get("mode") in ("scatter", "local"), st
+        ing = broker.cluster.stats()["ingest"]
+        assert ing["push_enabled"]
+        assert broker.cluster.counters["ingest_pushes"] >= 1
+    finally:
+        for h in hists:
+            h.stop()
+        if broker is not None:
+            broker.close()

@@ -161,6 +161,16 @@ def test_ordering_pass_fires_on_ordering_fixture():
     assert by_rule["truncate-without-checkpoint"].symbol == \
         "compact:truncate_through"
     assert by_rule["register-before-wal-commit"].symbol == "ingest:register"
+    assert by_rule["swap-before-truncate"].symbol == \
+        "compact_swap:truncate_through"
+    assert by_rule["dir-fsync-after-swap"].symbol == \
+        "swap_generations:os.replace"
+    assert by_rule["no-register-before-publish"].symbol == \
+        "publish_compacted:register"
+    # each seeded compaction-protocol function fires EXACTLY its own
+    # rule — the three orderings differ only in statement order, so any
+    # cross-fire means a rule's reachability predicate is too loose
+    assert len(by_rule) == 7, sorted(by_rule)
 
 
 def test_new_fixtures_are_quiet_when_their_pass_is_disabled():
