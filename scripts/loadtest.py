@@ -16,6 +16,7 @@ dataset first (no external setup needed).
 
 import argparse
 import json
+import os
 import sys
 import threading
 import time
@@ -667,11 +668,13 @@ def run_wlm(args):
 def run_sharedscan(args):
     """Shared-scan comparison: K client threads replay a fixed BI
     dashboard mix over one TPC-H star (in process, caches off so every
-    rep executes), with query coalescing off then on. Reports qps and
-    p50/p99 per leg, the coalescing rate, and device-dispatch totals;
-    every reply is checked against the sequential reference answers and
-    any mismatch exit-codes 1 (answers must be identical whether or not
-    the query shared a scan)."""
+    rep executes), across four legs — coalescing off, coalesced unfused,
+    fused (jaxpr), and fused through the hand-scheduled pallas wave
+    kernel (where the backend supports it). Reports qps and p50/p99 per
+    leg, the coalescing rate, device-dispatch totals, and wave-kernel
+    launches; every reply is checked against the sequential reference
+    answers and any mismatch exit-codes 1 (answers must be identical
+    whichever path served the scan)."""
     sys.path.insert(0, ".")
     import bench
     sf = args.tpch if args.tpch is not None else 1.0
@@ -688,16 +691,29 @@ def run_sharedscan(args):
         answers[q] = ctx.sql(q).to_pandas()
 
     legs, mismatched = {}, []
-    # three legs: coalescing off, coalesced but UNFUSED (fusion planner
-    # disabled — the pre-fusion per-lane-re-eval program), and fully
-    # fused. All three are differentially checked against the sequential
-    # reference, so "fused == pre-fusion fused == solo" is enforced
-    # byte-for-byte on every reply.
-    for leg, enabled, fused in (("sharedscan_off", False, True),
-                                ("sharedscan_on_nofusion", True, False),
-                                ("sharedscan_on", True, True)):
+    # four legs: coalescing off, coalesced but UNFUSED (fusion planner
+    # disabled — the pre-fusion per-lane-re-eval program), fully fused
+    # on the jaxpr path, and fused + hand-scheduled pallas wave kernel.
+    # All are differentially checked against the sequential reference,
+    # so "pallas == fused == pre-fusion fused == solo" is enforced
+    # byte-for-byte on every reply. The pallas leg only runs where the
+    # wave can engage (TPU backend, or SDOT_PALLAS=interpret on CPU).
+    from spark_druid_olap_tpu.ops import pallas_groupby as _PG
+    wave_available = (os.environ.get("SDOT_PALLAS", "") == "interpret"
+                      or _PG._tpu_backend())
+    leg_plan = [("sharedscan_off", False, True, False),
+                ("sharedscan_on_nofusion", True, False, False),
+                ("sharedscan_on", True, True, False)]
+    if wave_available:
+        leg_plan.append(("sharedscan_on_pallas", True, True, True))
+    else:
+        print("  [sharedscan_on_pallas] skipped: wave kernel unavailable "
+              "on this backend (set SDOT_PALLAS=interpret to run it on "
+              "CPU)")
+    for leg, enabled, fused, wave in leg_plan:
         ctx.config.set("sdot.sharedscan.enabled", enabled)
         ctx.config.set("sdot.sharedscan.fusion.enabled", fused)
+        ctx.config.set("sdot.pallas.wave.enabled", wave)
         coal0 = dict(ctx.engine.sharedscan.stats())
         lat, errors, dispatches = [], [0], [0]
         lock = threading.Lock()
@@ -767,6 +783,10 @@ def run_sharedscan(args):
                                      - f0["column_streams_saved"]),
             "plan_fallbacks": f1["plan_fallbacks"] - f0["plan_fallbacks"],
             "cse_hit_rate": round(saved / evals, 4) if evals else 0.0}
+        p0, p1 = coal0.get("pallas") or {}, coal1.get("pallas") or {}
+        legs[leg]["pallas"] = {
+            k: int(p1.get(k, 0)) - int(p0.get(k, 0))
+            for k in ("launches", "tiles", "fallbacks")}
         print(f"  [{leg}] qps={legs[leg]['qps']:7.1f} "
               f"p50={legs[leg]['p50_ms']:7.1f}ms "
               f"p99={legs[leg]['p99_ms']:7.1f}ms n={served:5d} "
@@ -781,16 +801,23 @@ def run_sharedscan(args):
     disp_per_q_off = off["dispatches"] / max(off["n"], 1)
     disp_per_q_on = on["dispatches"] / max(on["n"], 1)
     disp_x = disp_per_q_off / max(disp_per_q_on, 1e-9)
+    pal = legs.get("sharedscan_on_pallas")
+    pal_note = ""
+    if pal is not None:
+        pal_note = (f"; pallas leg: p50={pal['p50_ms']:.1f}ms "
+                    f"launches={pal['pallas']['launches']} "
+                    f"fallbacks={pal['pallas']['fallbacks']}")
     print(f"  qps speedup {qps_x:.2f}x; dispatches/query "
           f"{disp_per_q_off:.2f} -> {disp_per_q_on:.2f} ({disp_x:.2f}x "
           f"fewer); fusion: cse_hit_rate={fus['cse_hit_rate']:.1%} "
           f"evals_saved={fus['predicate_evals_saved']} "
-          f"col_streams_saved={fus['column_streams_saved']}"
+          f"col_streams_saved={fus['column_streams_saved']}" + pal_note
           + (f"; RESULT MISMATCH on {sorted(set(mismatched))}"
              if mismatched else ""))
     out = {"mode": "sharedscan", "sf": sf, "rows": n_rows,
            "threads": args.threads, "duration_s": args.duration,
            "window_ms": window_ms, "legs": legs,
+           "pallas_available": bool(wave_available),
            "qps_speedup": round(qps_x, 2),
            "dispatch_reduction": round(disp_x, 2),
            "result_mismatches": sorted(set(mismatched))}
@@ -801,7 +828,13 @@ def run_sharedscan(args):
         and legs["sharedscan_on_nofusion"]["n"] > 0 \
         and on["queries_coalesced"] > 0 \
         and fus["predicate_evals_saved"] > 0 \
-        and fus["column_streams_saved"] > 0
+        and fus["column_streams_saved"] > 0 \
+        and on["pallas"]["launches"] == 0
+    if pal is not None:
+        # when the wave can engage, the pallas leg must have served
+        # traffic THROUGH the kernel (launches > 0, differentially
+        # checked above like every other leg)
+        ok = ok and pal["n"] > 0 and pal["pallas"]["launches"] > 0
     sys.exit(0 if ok else 1)
 
 

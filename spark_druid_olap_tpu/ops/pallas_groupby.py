@@ -157,12 +157,54 @@ def _row_offsets(specs):
     return offs, rpk
 
 
+def init_rows(out_ref, row: int, kind: str) -> None:
+    """Fill one agg's accumulator row(s) with its identity (shared by this
+    kernel and the shared-scan wave mega-kernel, ops/pallas_wave.py)."""
+    out_ref[row, :] = jnp.full((LANES,), jnp.float32(_INIT[kind]),
+                               dtype=jnp.float32)
+    if kind in ("count", "sum"):
+        out_ref[row + 1, :] = jnp.zeros((LANES,), dtype=jnp.float32)
+
+
+def accumulate_rows(out_ref, row: int, kind: str, part) -> None:
+    """Fold one [LANES] block partial into the accumulator rows at
+    ``row``. Sums/counts use per-lane NEUMAIER accumulation across grid
+    steps: 2Sum's branch captures the EXACT roundoff of ``cur + part``
+    regardless of relative magnitudes (plain Kahan's 'part - comp' can
+    itself round once the accumulator is large); integer roundoffs are
+    integers, so comp accumulates exactly within the eligible() growth
+    bound. True total = acc + comp. min/max fold exactly."""
+    cur = out_ref[row, :]
+    if kind in ("count", "sum"):
+        comp = out_ref[row + 1, :]
+        t = cur + part
+        big = jnp.abs(cur) >= jnp.abs(part)
+        err = jnp.where(big, (cur - t) + part, (part - t) + cur)
+        out_ref[row + 1, :] = comp + err
+        out_ref[row, :] = t
+    elif kind == "min":
+        out_ref[row, :] = jnp.minimum(cur, part)
+    else:
+        out_ref[row, :] = jnp.maximum(cur, part)
+
+
+def block_partial(kind: str, eff, values):
+    """One [B, LANES] tile -> [LANES] per-VPU-lane block partial for one
+    (agg, key) pair; ``eff`` is the effective row mask (key match & agg
+    filter), ``values`` the f32 value tile (None for count)."""
+    fmax = 3.4e38     # python literal: kernels may not close over jnp consts
+    if kind == "count":
+        return jnp.sum(eff.astype(jnp.float32), axis=0)
+    if kind == "sum":
+        return jnp.sum(jnp.where(eff, values, 0.0), axis=0)
+    if kind == "min":
+        return jnp.min(jnp.where(eff, values, fmax), axis=0)
+    return jnp.max(jnp.where(eff, values, -fmax), axis=0)
+
+
 def _make_kernel(n_keys: int, specs, n_in: int):
     """specs: list of (kind, value_ref_idx or None, mask_ref_idx or None)."""
     offs, rpk = _row_offsets(specs)
-    # python-float literals only: pallas kernels may not close over jnp
-    # constants
-    fmax = 3.4e38
 
     def kernel(key_ref, *refs):
         out_ref = refs[n_in]
@@ -171,52 +213,17 @@ def _make_kernel(n_keys: int, specs, n_in: int):
         @pl.when(step == 0)
         def _():
             for m, (kind, _, _) in enumerate(specs):
-                fill = jnp.float32(_INIT[kind])
                 for k in range(n_keys):
-                    row = k * rpk + offs[m]
-                    out_ref[row, :] = jnp.full((LANES,), fill,
-                                               dtype=jnp.float32)
-                    if kind in ("count", "sum"):
-                        out_ref[row + 1, :] = jnp.zeros((LANES,),
-                                                        dtype=jnp.float32)
+                    init_rows(out_ref, k * rpk + offs[m], kind)
 
         kb = key_ref[:]                                   # [B, 128] int32
         for k in range(n_keys):
             mk = kb == k
             for m, (kind, vi, mi) in enumerate(specs):
                 eff = mk if mi is None else (mk & (refs[mi][:] != 0))
-                row = k * rpk + offs[m]
-                if kind == "count":
-                    part = jnp.sum(eff.astype(jnp.float32), axis=0)
-                elif kind == "sum":
-                    part = jnp.sum(
-                        jnp.where(eff, refs[vi][:], 0.0), axis=0)
-                elif kind == "min":
-                    part = jnp.min(
-                        jnp.where(eff, refs[vi][:], fmax), axis=0)
-                else:
-                    part = jnp.max(
-                        jnp.where(eff, refs[vi][:], -fmax), axis=0)
-                cur = out_ref[row, :]
-                if kind in ("count", "sum"):
-                    # per-lane NEUMAIER accumulation across grid steps:
-                    # 2Sum's branch captures the EXACT roundoff of
-                    # cur + part regardless of relative magnitudes
-                    # (plain Kahan's 'part - comp' can itself round once
-                    # the accumulator is large); integer roundoffs are
-                    # integers, so comp accumulates exactly within the
-                    # eligible() growth bound. True total = acc + comp.
-                    comp = out_ref[row + 1, :]
-                    t = cur + part
-                    big = jnp.abs(cur) >= jnp.abs(part)
-                    err = jnp.where(big, (cur - t) + part,
-                                    (part - t) + cur)
-                    out_ref[row + 1, :] = comp + err
-                    out_ref[row, :] = t
-                elif kind == "min":
-                    out_ref[row, :] = jnp.minimum(cur, part)
-                else:
-                    out_ref[row, :] = jnp.maximum(cur, part)
+                part = block_partial(
+                    kind, eff, None if vi is None else refs[vi][:])
+                accumulate_rows(out_ref, k * rpk + offs[m], kind, part)
 
     return kernel
 

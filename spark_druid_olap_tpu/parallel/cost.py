@@ -261,6 +261,25 @@ def bytes_per_segment(ds, names) -> int:
     return int(ds.padded_rows) * sum(array_itemsize(ds, k) for k in names)
 
 
+def wave_tile_itemsize(ds, key: str) -> int:
+    """Per-row VMEM bytes of one union array inside the wave mega-kernel
+    (ops/pallas_wave.py) AFTER its input prep: validity masks ship as i8
+    (1 byte), narrow integer codes widen to i32 on the host side of the
+    kernel (uniform Mosaic tiling), wide types keep their itemsize."""
+    from spark_druid_olap_tpu.ops.scan import NULL_VALID_PREFIX, ROW_VALID_KEY
+    if key == ROW_VALID_KEY or key.startswith(NULL_VALID_PREFIX):
+        return 1
+    return max(4, array_itemsize(ds, key))
+
+
+def pallas_tile_budget_bytes(conf) -> int:
+    """VMEM byte budget the wave mega-kernel's tile planner
+    (planner/fusion.py:plan_wave_tiles) fits the double-buffered input
+    tiles plus the resident scratch block into."""
+    from spark_druid_olap_tpu.utils.config import PALLAS_WAVE_TILE_BYTES
+    return int(conf.get(PALLAS_WAVE_TILE_BYTES))
+
+
 def wave_budget_bytes(conf) -> Optional[int]:
     """Per-device byte budget for one wave's scan arrays. Config override,
     else 60% of the device's reported HBM limit, else None (single wave)."""

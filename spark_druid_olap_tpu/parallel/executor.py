@@ -775,14 +775,18 @@ class QueryEngine:
 
     @property
     def dispatch_counts(self):
-        """Thread-local MONOTONE [program_dispatches, host_transfers]
-        counters (never reset by execute); statement layers diff them
-        around a statement to report device round trips. On the tunneled
-        chip each round trip costs the dispatch floor (~80ms), so this is
-        the per-query wall-time budget made visible."""
+        """Thread-local MONOTONE [program_dispatches, host_transfers,
+        wave_kernel_launches] counters (never reset by execute);
+        statement layers diff them around a statement to report device
+        round trips. On the tunneled chip each round trip costs the
+        dispatch floor (~80ms), so this is the per-query wall-time
+        budget made visible. Slot 2 counts hand-scheduled Pallas wave
+        mega-kernel launches (parallel/sharedscan.py wave path) — a
+        subset-annotation of slot 0, surfaced as ``kernel_launches`` in
+        statement stats."""
         c = getattr(self._tls, "dcount", None)
-        if c is None:
-            c = self._tls.dcount = [0, 0]
+        if c is None or len(c) < 3:
+            c = self._tls.dcount = [0, 0, 0]
         return c
 
     def _tick(self, kind: int = 0, n: int = 1):

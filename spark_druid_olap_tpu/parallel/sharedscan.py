@@ -51,6 +51,7 @@ from spark_druid_olap_tpu.ops import filters as F
 from spark_druid_olap_tpu.ops import groupby as G
 from spark_druid_olap_tpu.ops import hll as HLL
 from spark_druid_olap_tpu.ops import theta as TH
+from spark_druid_olap_tpu.ops import pallas_wave as PW
 from spark_druid_olap_tpu.ops import time_ops as T
 from spark_druid_olap_tpu.ops.scan import ScanContext, array_dtype, array_names
 from spark_druid_olap_tpu.parallel import cost as C
@@ -60,6 +61,9 @@ from spark_druid_olap_tpu.utils.config import (
     GROUPBY_DENSE_MAX_KEYS,
     GROUPBY_MATMUL_MAX_KEYS,
     HLL_LOG2M,
+    PALLAS_WAVE_ENABLED,
+    PALLAS_WAVE_MAX_LANES,
+    PALLAS_WAVE_TILE_BYTES,
     SHAREDSCAN_ENABLED,
     SHAREDSCAN_FUSION_ENABLED,
     SHAREDSCAN_FUSION_MAX_NODES,
@@ -153,6 +157,14 @@ class SharedScanCoalescer:
         # sub-predicates, e.g. OR-of-bounds over one column)
         self.fusion_solo_evals_saved = 0
         self.fusion_solo_evals_total = 0
+        # pallas wave mega-kernel (ops/pallas_wave.py): one hand-
+        # scheduled kernel launch per dispatch wave when the group is
+        # wave-eligible; fallbacks count build-time lowerings back to
+        # the jaxpr program (routing tiers unchanged)
+        self.pallas_launches = 0
+        self.pallas_tiles = 0
+        self.pallas_fallbacks = 0
+        self.pallas_vmem_peak = 0
 
     # -- eligibility -----------------------------------------------------------
     def enabled(self) -> bool:
@@ -354,6 +366,10 @@ class SharedScanCoalescer:
                 with self._lock:
                     self.fusion_fallbacks += 1
 
+        wave_ok = bool(eng.config.get(PALLAS_WAVE_ENABLED)) \
+            and PW.wave_eligible(
+                lanes, int(eng.config.get(PALLAS_WAVE_MAX_LANES)))
+
         sig = ("aggmulti", ds.name, id(ds), s_pad, ds.padded_rows,
                min_day, max_day, tuple(union_names),
                eng.config.get(TZ_ID),
@@ -365,14 +381,37 @@ class SharedScanCoalescer:
                # independent), None when planning declined or failed
                bool(eng.config.get(SHAREDSCAN_FUSION_ENABLED)),
                int(eng.config.get(SHAREDSCAN_FUSION_MAX_NODES)),
-               fplan.token() if fplan is not None else None)
-        prog_fn, unpacks = eng._cached_program(
-            sig, lambda: self._build_fused_program(
-                ds, lanes, min_day, max_day, fplan))
+               fplan.token() if fplan is not None else None,
+               # wave mega-kernel routing: eligibility is re-derived on
+               # EVERY fused execution from plan metadata + env + config,
+               # so a config flip or backend change re-keys the program
+               wave_ok,
+               bool(eng.config.get(PALLAS_WAVE_ENABLED)),
+               int(eng.config.get(PALLAS_WAVE_TILE_BYTES)),
+               int(eng.config.get(PALLAS_WAVE_MAX_LANES)))
+
+        def _build():
+            """Wave first (one pallas launch per wave), jaxpr-fused on
+            any lowering reject — the group stays FUSED either way, so
+            the wave path can never change routing tiers."""
+            if wave_ok:
+                try:
+                    return self._build_wave_program(
+                        ds, lanes, min_day, max_day, fplan,
+                        union_names=union_names, s_pad=s_pad)
+                except Exception:  # noqa: BLE001 — WaveFallback + lowering errors
+                    with self._lock:
+                        self.pallas_fallbacks += 1
+            fn, unp = self._build_fused_program(ds, lanes, min_day,
+                                                max_day, fplan)
+            return fn, unp, None
+
+        prog_fn, unpacks, wave_info = eng._cached_program(sig, _build)
 
         per_lane_finals = self._dispatch(ds, union_names, seg_u, s_pad,
                                          spw, n_waves, prog_fn, unpacks,
-                                         lanes, live[0])
+                                         lanes, live[0],
+                                         wave_info=wave_info)
         results = [self._decode_lane(eng, ds, lp, fin)
                    for lp, fin in zip(lanes, per_lane_finals)]
 
@@ -381,8 +420,17 @@ class SharedScanCoalescer:
             for _, lp in planned)
         saved_bytes = max(0, solo_bytes - int(seg_bytes) * len(seg_u))
         saved_disp = (len(planned) - 1) * n_waves
+        wave_tiles = 0
+        if wave_info is not None:
+            wave_tiles = -(-(s_pad * ds.padded_rows)
+                           // (wave_info["block_rows"] * PW.LANES))
         with self._lock:
             self.groups_coalesced += 1
+            if wave_info is not None:
+                self.pallas_launches += n_waves
+                self.pallas_tiles += n_waves * wave_tiles
+                self.pallas_vmem_peak = max(self.pallas_vmem_peak,
+                                            wave_info["vmem_bytes"])
             self.queries_coalesced += len(planned)
             self.binds_saved_bytes += saved_bytes
             self.dispatches_saved += saved_disp
@@ -411,7 +459,12 @@ class SharedScanCoalescer:
                     "binds_saved_bytes": saved_bytes,
                     "dispatches_saved": saved_disp,
                     "fusion": (fplan.counters()
-                               if fplan is not None else None)}}
+                               if fplan is not None else None),
+                    "pallas": ({"launches": int(n_waves),
+                                "tiles": int(n_waves * wave_tiles),
+                                "block_rows": wave_info["block_rows"],
+                                "vmem_bytes": wave_info["vmem_bytes"]}
+                               if wave_info is not None else None)}}
             m.outcome = results[li]
             eng.inflight.annotate(m.tok, sharedscan_group=g.gid)
 
@@ -575,13 +628,54 @@ class SharedScanCoalescer:
 
         return jax.jit(fused), [u for _, u in packers]
 
+    def _build_wave_program(self, ds, lanes: List[_LanePlan],
+                            min_day: int, max_day: int, fplan=None, *,
+                            union_names, s_pad):
+        """(jit_fn, [per-lane unpack], wave_info). The group's whole wave
+        lowers through ONE hand-scheduled Pallas mega-kernel
+        (ops/pallas_wave.py); outputs are route-conformant per lane, so
+        the same packers/unpackers/decode as the jaxpr program apply.
+        Raises (typically :class:`PW.WaveFallback`) when the group cannot
+        lower — the caller then builds the jaxpr-fused program, keeping
+        the group fused."""
+        eng = self.engine
+        log2m = eng.config.get(HLL_LOG2M)
+        tz = eng.config.get(TZ_ID)
+        wave_fn, info = PW.build_wave_fn(
+            ds, lanes, min_day, max_day, fplan,
+            union_names=union_names, tz=tz, log2m=log2m,
+            tile_bytes=int(eng.config.get(PALLAS_WAVE_TILE_BYTES)))
+        packers = [eng._agg_meta_packers(lp.agg_plans, lp.routes,
+                                         lp.n_keys, with_idx=False)
+                   for lp in lanes]
+
+        def fused(arrays):
+            outs = wave_fn(arrays)
+            return tuple(pack(o) for (pack, _), o in zip(packers, outs))
+
+        fn = jax.jit(fused)
+        # surface trace/shape errors at BUILD time (abstract eval — no
+        # device compile), so a bad lowering falls back here instead of
+        # failing the group's first dispatch
+        shapes = {k: jax.ShapeDtypeStruct(
+            (s_pad, ds.padded_rows),
+            jnp.zeros((), dtype=array_dtype(ds, k)).dtype)
+            for k in union_names}
+        jax.eval_shape(fn, shapes)
+        return fn, [u for _, u in packers], info
+
     def _dispatch(self, ds, union_names, seg_u, s_pad, spw, n_waves,
-                  prog_fn, unpacks, lanes: List[_LanePlan], leader):
+                  prog_fn, unpacks, lanes: List[_LanePlan], leader,
+                  wave_info=None):
         """One shared bind + ONE program dispatch per wave (double-
         buffered like _run_waves); per-lane unpack -> finals -> cross-
-        wave merge. All device ticks land on the leader's thread."""
+        wave merge. All device ticks land on the leader's thread —
+        including the wave-kernel launch tick (dispatch_counts[2]) when
+        the wave program is live."""
         from spark_druid_olap_tpu.parallel import executor as X
         eng = self.engine
+        if wave_info is not None:
+            eng._tick(2, n_waves)           # pallas kernel launches
         sketch = [[p for p in lp.agg_plans if p.kind in ("hll", "theta")]
                   for lp in lanes]
         if n_waves == 1:
@@ -690,6 +784,11 @@ class SharedScanCoalescer:
                     "binds_saved_bytes": self.binds_saved_bytes,
                     "dispatches_saved": self.dispatches_saved,
                     "wlm_handoffs": self.wlm_handoffs,
+                    "pallas": {
+                        "launches": self.pallas_launches,
+                        "tiles": self.pallas_tiles,
+                        "fallbacks": self.pallas_fallbacks,
+                        "vmem_bytes_peak": self.pallas_vmem_peak},
                     "fusion": {
                         "groups": self.fusion_groups,
                         "plan_fallbacks": self.fusion_fallbacks,

@@ -236,6 +236,8 @@ def _cached_inner(ctx, q2, sql_tag):
         hit = cache.get(key)
         if hit is not None:
             cache.move_to_end(key)           # keep hot entries resident
+            from spark_druid_olap_tpu.sql.session import _note_subquery_hit
+            _note_subquery_hit()             # served_from provenance
             return hit
     from spark_druid_olap_tpu.sql.session import _run_select
     df = _run_select(ctx, q2, sql=sql_tag).to_pandas()

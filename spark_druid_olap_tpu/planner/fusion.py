@@ -235,6 +235,32 @@ def analyze_query(filter_spec: Optional[S.FilterSpec], intervals,
     return totals[0], len(seen)
 
 
+def plan_wave_tiles(itemsizes: Sequence[int],
+                    int_sum_maxabs: Sequence[float],
+                    scratch_rows: int, budget_bytes: int,
+                    min_rows: int = 128, max_rows: int = 2048) -> int:
+    """Tile-shape planning for the wave mega-kernel (ops/pallas_wave.py):
+    the largest power-of-two sublane block depth such that (a) every
+    union-column tile double-buffered PLUS the resident [scratch_rows,
+    128] f32 accumulator block fits the VMEM budget
+    (parallel/cost.py:pallas_tile_budget_bytes), and (b) every integer
+    sum's per-lane block partial stays exactly representable in f32
+    (``maxabs * block_rows < 2^24`` — the same invariant as
+    ops/pallas_groupby.py:choose_block_rows, which this generalizes to
+    a multi-lane scratch layout). Deterministic from plan metadata alone
+    so the compile signature and the kernel dispatch always agree."""
+    lanes = 128                    # TPU VPU lane width (minor axis)
+    per_row = lanes * max(1, int(sum(itemsizes)))
+    scratch = int(scratch_rows) * lanes * 4
+    b = max_rows
+    while b > min_rows and b * per_row * 2 + scratch > budget_bytes:
+        b //= 2
+    for maxabs in int_sum_maxabs:
+        while b > min_rows and float(maxabs) * b >= 2 ** 24:
+            b //= 2
+    return b
+
+
 class CSECache:
     """Memoizing filter lowering bound to ONE ScanContext. Logical nodes
     recurse through the cache (plain ``lower_filter`` would recurse past

@@ -24,6 +24,17 @@ from spark_druid_olap_tpu.result import QueryResult
 from spark_druid_olap_tpu.sql import ast as A
 from spark_druid_olap_tpu.sql.parser import parse_statement
 
+# per-thread count of subquery-channel cache hits (planner/decorrelate
+# _cached_inner): statements diff it to annotate ``served_from`` when a
+# warm rep legitimately reports zero device dispatches
+_subq_tls = __import__("threading").local()
+
+
+def _note_subquery_hit() -> None:
+    """Called by the decorrelation passes when an inlined subquery is
+    served from the gated subquery result cache."""
+    _subq_tls.hits = getattr(_subq_tls, "hits", 0) + 1
+
 
 def resolve_lookups(ctx, stmt: A.SelectStmt) -> A.SelectStmt:
     """Inline registered lookup tables: ``LOOKUP(col, 'name')`` becomes
@@ -320,6 +331,7 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
         return _run_union(ctx, stmt, sql)
     t0 = _time.perf_counter()
     dc0 = list(ctx.engine.dispatch_counts)
+    sq0 = getattr(_subq_tls, "hits", 0)
     _stage = __import__("os").environ.get("SDOT_STAGE_TIMING", "") == "1"
     _marks = {}
 
@@ -434,6 +446,21 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
     dc1 = ctx.engine.dispatch_counts
     stats["n_dispatch"] = dc1[0] - dc0[0]
     stats["n_transfer"] = dc1[1] - dc0[1]
+    # hand-scheduled Pallas wave mega-kernel launches (sharedscan wave
+    # path) attributed to this statement's thread — a subset-annotation
+    # of n_dispatch, 0 on the jaxpr path
+    stats["kernel_launches"] = (dc1[2] - dc0[2]
+                                if len(dc1) > 2 and len(dc0) > 2 else 0)
+    # explicit provenance for LEGITIMATE zero-dispatch engine statements
+    # (bench.py's zero_dispatch_engine guard exempts annotated ones and
+    # flags the rest): a semantic result-cache hit, or a statement whose
+    # decorrelated inners were served by the gated subquery channel and
+    # whose residual plan needed no device work of its own
+    if stats.get("cache") not in (None, "miss"):
+        stats["served_from"] = "result_cache"
+    elif mode == "engine" and stats["n_dispatch"] == 0 \
+            and getattr(_subq_tls, "hits", 0) > sq0:
+        stats["served_from"] = "subquery_cache"
     if plan_cached:
         stats["plan_cached"] = True
     stats.update(_marks)

@@ -20,11 +20,14 @@ program. Three rules:
   ``.item()/.tolist()`` on a tainted value forces a device sync inside
   the trace.
 
-Root discovery understands the repo's wrapper idiom: a function that
+Root discovery understands the repo's wrapper idioms: a function that
 passes one of its own parameters into a jit-like call (e.g.
 ``QueryEngine._shard_wrap``) marks the corresponding argument at every
 call site as a traced root, so nested ``def core(...)`` programs are
-followed even though ``jax.jit`` is two frames away.
+followed even though ``jax.jit`` is two frames away; and a *factory*
+call in kernel position — ``pl.pallas_call(_make_kernel(...), ...)``,
+the pallas group-by/wave idiom — roots the nested defs the factory
+returns, so hand-written kernel bodies obey the same rules.
 """
 
 from __future__ import annotations
@@ -167,6 +170,28 @@ class _Purity:
                             mi, ci, node, local,
                             enclosing_qual=enclosing_qual):
                         self.roots.setdefault(callee, site)
+            return
+        if isinstance(expr, ast.Call):
+            # factory-returned kernels: ``pl.pallas_call(_make_kernel(...),
+            # ...)`` — the factory call runs on the host at build time, but
+            # the function it RETURNS is what gets traced. Root every
+            # nested def the factory returns.
+            for factory in idx.resolve_call(mi, ci, expr, local,
+                                            enclosing_qual=enclosing_qual):
+                ffn = idx.functions.get(factory)
+                if ffn is None:
+                    continue
+                fmi = idx.modules[factory[0]]
+                fci = idx.func_class[factory]
+                flocal = idx.local_types(fmi, fci, ffn)
+                for node in ast.walk(ffn):
+                    if isinstance(node, ast.Return) \
+                            and node.value is not None:
+                        ref = idx.resolve_func_ref(
+                            fmi, fci, node.value, flocal,
+                            enclosing_qual=factory[1])
+                        if ref is not None:
+                            self.roots.setdefault(ref, site)
             return
         ref = idx.resolve_func_ref(mi, ci, expr, local,
                                    enclosing_qual=enclosing_qual)

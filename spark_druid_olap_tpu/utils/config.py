@@ -188,6 +188,25 @@ GROUPBY_PALLAS_MAX_KEYS = _entry(
     "Dense group-by uses the fused single-pass Pallas TPU kernel when the "
     "fused key cardinality is at most this (0 disables). Also honors env "
     "SDOT_PALLAS=0|interpret.")
+PALLAS_WAVE_ENABLED = _entry(
+    "sdot.pallas.wave.enabled", True,
+    "Shared-scan fused groups lower each dispatch wave to ONE "
+    "hand-scheduled Pallas mega-kernel (ops/pallas_wave.py) when every "
+    "lane's aggregations are wave-eligible: union columns tile through "
+    "VMEM once, CSE'd shared predicates evaluate once per tile, and all "
+    "lanes' filtered aggregates accumulate in kernel scratch. False "
+    "routes back to the XLA jaxpr-fused program (kill switch). Requires "
+    "a TPU-class backend or SDOT_PALLAS=interpret (CPU CI).")
+PALLAS_WAVE_TILE_BYTES = _entry(
+    "sdot.pallas.wave.tile.bytes", 8 << 20,
+    "VMEM budget (bytes) the wave mega-kernel's tile planner fits the "
+    "double-buffered union-column tiles plus the resident scratch "
+    "accumulator block into (~half of a v5e core's 16MB VMEM).", int)
+PALLAS_WAVE_MAX_LANES = _entry(
+    "sdot.pallas.wave.max.lanes", 16,
+    "Max fused lanes (distinct constituent plans) a single wave "
+    "mega-kernel accumulates; larger groups fall back to the jaxpr-fused "
+    "program (trace size and scratch rows grow per lane).", int)
 GROUPBY_MATMUL_MAX_KEYS = _entry(
     "sdot.engine.groupby.matmul.max.keys", 4096,
     "Dense group-by uses the MXU one-hot matmul path when the fused key "

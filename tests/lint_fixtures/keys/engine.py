@@ -1,9 +1,11 @@
 """Fixture compile-cache engine. Seeded: both _cached_program sites
 (lambda build and loop-nested local-def build) read HLL_LOG2M during
 program build while the signature only folds TZ_ID —
-compile-sig-missing-config."""
+compile-sig-missing-config. ``run_wave`` seeds the pallas variant: the
+wave-program build reads PALLAS_TILE_BYTES (a kernel tiling knob that
+changes the compiled program) but the sig never folds it."""
 
-from utils.config import HLL_LOG2M, TZ_ID
+from utils.config import HLL_LOG2M, PALLAS_TILE_BYTES, TZ_ID
 
 
 class Engine:
@@ -29,3 +31,10 @@ class Engine:
 
             prog2 = self._cached_program(sig, build)
             return prog, prog2
+
+    def _build_wave(self, q):
+        return ("wave", q.datasource, self.config.get(PALLAS_TILE_BYTES))
+
+    def run_wave(self, q):
+        sig = ("wave", q.datasource, self.config.get(TZ_ID))
+        return self._cached_program(sig, lambda: self._build_wave(q))

@@ -12,6 +12,8 @@ TZ_ID = _entry("sdot.fixture.timezone", "UTC", "bucketing timezone",
                semantic=False)
 HLL_LOG2M = _entry("sdot.fixture.hll.log2m", 11, "sketch precision")
 WLM_POLL_MS = _entry("sdot.fixture.wlm.poll.ms", 5, "queue poll cadence")
+PALLAS_TILE_BYTES = _entry("sdot.fixture.pallas.tile.bytes", 1 << 20,
+                           "wave kernel VMEM tile budget")
 
 
 class Config:

@@ -1,11 +1,14 @@
 """Seeded purity-pass violations: a jitted function that branches on a
-traced value and touches host-only APIs. Never imported — analyzed as
-ast only (jax need not be installed)."""
+traced value and touches host-only APIs, and a factory-returned pallas
+kernel with the same sins (the factory call runs on the host, but the
+kernel it returns is traced). Never imported — analyzed as ast only
+(jax need not be installed)."""
 
 import time
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
 
 
 @jax.jit
@@ -15,3 +18,17 @@ def bad_kernel(x):
         time.sleep(0.01)             # host-call under trace
     print("total", total)            # host-call under trace
     return total * 2
+
+
+def _make_bad_wave(n_keys):
+    def wave_kernel(in_ref, out_ref):
+        vals = jnp.sum(in_ref[:])
+        if vals > 0:                 # traced-branch inside pallas body
+            time.sleep(0.01)         # host-call inside pallas body
+        out_ref[0] = vals
+
+    return wave_kernel
+
+
+def launch_wave(x):
+    return pl.pallas_call(_make_bad_wave(4), grid=(1,))(x)

@@ -94,6 +94,12 @@ def test_purity_pass_fires_on_impure_jit_fixture():
     assert "host-call" in rules
     # the host calls are attributed to the jitted function itself
     assert any(f.symbol.startswith("bad_kernel") for f in found)
+    # factory-returned pallas kernels are roots too: the violations in
+    # ``_make_bad_wave``'s returned kernel fire even though the kernel
+    # reaches pallas_call only through the factory's return value
+    wave = [f for f in found
+            if f.symbol.startswith("_make_bad_wave.wave_kernel")]
+    assert {f.rule for f in wave} == {"traced-branch", "host-call"}, found
 
 
 def test_contracts_pass_fires_on_undeclared_key_fixture():
@@ -127,11 +133,14 @@ def test_keys_pass_fires_on_keys_fixture():
     by_rule = {}
     for f in found:
         by_rule.setdefault(f.rule, []).append(f)
-    # both _cached_program call shapes resolve: the lambda build AND the
-    # loop-nested local ``def build`` (engine.py:25 / engine.py:30)
+    # three _cached_program call shapes resolve: the lambda build, the
+    # loop-nested local ``def build`` (engine.py:27 / engine.py:32), and
+    # the pallas wave build reading a tiling key (engine.py:40)
     k1 = by_rule["compile-sig-missing-config"]
-    assert {f.symbol for f in k1} == {"Engine.run:HLL_LOG2M"}, found
-    assert sorted(f.line for f in k1) == [25, 30], \
+    assert {f.symbol for f in k1} == {
+        "Engine.run:HLL_LOG2M",
+        "Engine.run_wave:PALLAS_TILE_BYTES"}, found
+    assert sorted(f.line for f in k1) == [27, 32, 40], \
         [f.render() for f in k1]
     assert by_rule["key-missing-field"][0].symbol == \
         "normalize_spec:granularity"

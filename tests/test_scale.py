@@ -7,6 +7,12 @@ waves engaged — the correctness/perf evidence tiny shapes cannot give.
 Excluded from the default suite (pytest.ini: -m "not scale"); run as
   python -m pytest tests/ -m scale -q
 Wall times land in docs/bench/SCALE_SHARDED_CPU_r05.json.
+
+Also marked ``slow``: an explicit ``-m 'not slow'`` on the command line
+REPLACES the ini's ``-m "not scale"`` default, which silently pulled
+these 6M-row benchmarks-as-tests into the tier-1 sweep (minutes each —
+past the suite budget). The double marker keeps them out of any
+``not slow`` invocation while ``-m scale`` still selects them.
 """
 
 import json
@@ -22,7 +28,7 @@ from spark_druid_olap_tpu.parallel.mesh import make_mesh
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-pytestmark = pytest.mark.scale
+pytestmark = [pytest.mark.scale, pytest.mark.slow]
 
 
 def _record(name, payload):
