@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
-# One-command sdlint entrypoint: all seven passes (locks, purity,
-# contracts, mergeclosure, keys, leaks, ordering) over the package,
-# gated by tools/sdlint/baseline.json. Args pass straight through:
+# One-command sdlint entrypoint: all nine passes (locks, purity,
+# contracts, mergeclosure, keys, leaks, ordering, kernels, mesh) over
+# the package, gated by tools/sdlint/baseline.json. Args pass straight
+# through:
 #
 #   scripts/lint.sh                      # full run, human output
 #   scripts/lint.sh --changed-only       # only git-dirty files (pre-commit)

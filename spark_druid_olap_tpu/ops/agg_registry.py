@@ -20,6 +20,10 @@ Fields per druid-level kind:
   reject it (sketch registers are not closed over stored partials).
 - ``sketch`` — "hll"/"theta" for register-valued aggregates that need
   their own shared-scan demux + wave-merge handling, else None.
+- ``merge``  — for sketches, the register algebra cross-chip merges
+  must use: "max" (HLL rho registers) or "min" (theta k-min hashes).
+  Summing registers double-counts silently; the ``mesh`` sdlint pass
+  checks ``ops/<sketch>.py:merge_registers`` against this field.
 
 Kept import-free and ``ast.literal_eval``-parseable on purpose: sdlint
 reads this file without importing it (and so without jax installed).
@@ -41,9 +45,9 @@ AGG_CLOSURE = {
     "doublemax":   {"route": "max", "dtype": "float64",
                     "reagg": "doublemax", "sketch": None},
     "cardinality": {"route": "hll", "dtype": "int64",
-                    "reagg": None, "sketch": "hll"},
+                    "reagg": None, "sketch": "hll", "merge": "max"},
     "thetasketch": {"route": "theta", "dtype": "int64",
-                    "reagg": None, "sketch": "theta"},
+                    "reagg": None, "sketch": "theta", "merge": "min"},
     "anyvalue":    {"route": "max", "dtype": "float64",
                     "reagg": "anyvalue", "sketch": None},
 }

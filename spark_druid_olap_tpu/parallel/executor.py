@@ -56,7 +56,8 @@ from spark_druid_olap_tpu.ops.scan import (
     TIME_MS_KEY,
 )
 from spark_druid_olap_tpu.parallel import cost as C
-from spark_druid_olap_tpu.parallel.mesh import SEGMENT_AXIS, mesh_size
+from spark_druid_olap_tpu.parallel.mesh import (SEGMENT_AXIS, mesh_size,
+                                                 shard_map)
 from spark_druid_olap_tpu.planner import fusion as FU
 from spark_druid_olap_tpu.result import QueryResult
 from spark_druid_olap_tpu.segment.column import ColumnKind
@@ -2119,7 +2120,7 @@ class QueryEngine:
                         out.pop(k), SEGMENT_AXIS, tiled=True)
                         for k in tuple(gather_only) if k in out}
                     return gathered, out
-                smfn = jax.shard_map(
+                smfn = shard_map(
                     fn2, mesh=self.mesh, in_specs=(in_spec,),
                     out_specs=(P(), P(SEGMENT_AXIS)), check_vma=False)
                 jfn = jax.jit(smfn)
@@ -2128,7 +2129,7 @@ class QueryEngine:
                     g, rest = jfn(x)
                     return {**g, **rest}
                 return wrapped
-        smfn = jax.shard_map(fn, mesh=self.mesh, in_specs=(in_spec,),
+        smfn = shard_map(fn, mesh=self.mesh, in_specs=(in_spec,),
                              out_specs=out_spec, check_vma=False)
         return jax.jit(smfn)
 
@@ -2326,7 +2327,7 @@ class QueryEngine:
                                                  tiled=True),
                     inner_run(table))
             out_spec = P()
-        smfn = jax.shard_map(run, mesh=self.mesh, in_specs=(in_specs,),
+        smfn = shard_map(run, mesh=self.mesh, in_specs=(in_specs,),
                              out_specs=out_spec, check_vma=False)
         return jax.jit(lambda table: smfn(table)), unpack
 
@@ -2664,7 +2665,7 @@ class QueryEngine:
                 out_specs = (P(), P())
             else:
                 out_specs = (P(), P(SEGMENT_AXIS))
-            smfn = jax.shard_map(sharded_core, mesh=mesh,
+            smfn = shard_map(sharded_core, mesh=mesh,
                                  in_specs=(P(SEGMENT_AXIS, None),),
                                  out_specs=out_specs,
                                  check_vma=False)
@@ -2804,7 +2805,7 @@ class QueryEngine:
             return finish(merged, SEGMENT_AXIS)
 
         out_specs = self._agg_out_specs(agg_plans, routes)
-        smfn = jax.shard_map(sharded_core, mesh=mesh,
+        smfn = shard_map(sharded_core, mesh=mesh,
                              in_specs=(P(SEGMENT_AXIS, None),),
                              out_specs=out_specs, check_vma=False)
         return jax.jit(lambda arrays: smfn(arrays))
@@ -2870,7 +2871,7 @@ class QueryEngine:
         # '__stats__' was already popped host-side after dispatch 1
         in_specs = self._agg_out_specs(agg_plans, routes, with_stats=False)
         in_specs["__hmask__"] = P()
-        smfn = jax.shard_map(gather, mesh=self.mesh, in_specs=(in_specs,),
+        smfn = shard_map(gather, mesh=self.mesh, in_specs=(in_specs,),
                              out_specs=(P(), P(SEGMENT_AXIS)),
                              check_vma=False)
         return jax.jit(lambda table: smfn(table)), unpack

@@ -42,3 +42,20 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def mesh_size(mesh: Optional[Mesh]) -> int:
     return 1 if mesh is None else int(np.prod(list(mesh.shape.values())))
+
+
+def shard_map(fn, *, mesh: Mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across jax versions. Newer jax exposes it at top
+    level (with ``check_vma``); 0.4.x only ships
+    ``jax.experimental.shard_map`` (same semantics, ``check_rep``). Every
+    engine shard_map site routes through here so the collective paths run
+    on whichever jax the host has — this is what keeps the CPU-emulated
+    8-device mesh (tests/conftest.py) a live surface rather than an
+    AttributeError."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as sm  # jax < 0.5
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=check_vma)

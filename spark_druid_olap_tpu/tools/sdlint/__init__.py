@@ -1,6 +1,6 @@
 """sdlint: domain-aware static analysis for the engine's own invariants.
 
-Seven AST-based passes over the package (no imports, no execution — pure
+Nine AST-based passes over the package (no imports, no execution — pure
 ``ast`` analysis, so fixtures with seeded violations never need their
 dependencies installed):
 
@@ -32,6 +32,18 @@ dependencies installed):
   ``os.replace`` publish, directory fsync after it, WAL commit append
   before ``store.register``, ``truncate_through`` only after a
   completed checkpoint.
+- ``kernels`` — the Pallas kernel contract (docs/KERNELS.md), checked
+  statically: VMEM tile arithmetic stays inside the configured budget
+  and matches the planner clamps and cost-model itemsize floors,
+  ``_prep_dtype`` promotions are applied to every operand, scratch
+  stripes are identity-initialised completely, kernel-reachable code
+  avoids Mosaic-unfriendly primitives, ref indices are traced values.
+- ``mesh`` — SPMD replication safety over every ``shard_map`` site:
+  collective axis names must exist on the mesh, sketch registers merge
+  with their declared register algebra (HLL max / theta min — never
+  psum), min/max merge branches use the matching collective, and
+  shard-reachable code must not call host callbacks / ``jax.random``
+  or write host-global state.
 
 Run as ``python -m spark_druid_olap_tpu.tools.sdlint``; CI runs the
 same passes via ``tests/test_lint.py``. Known-and-justified findings
@@ -47,4 +59,4 @@ from spark_druid_olap_tpu.tools.sdlint.core import (  # noqa: F401
 )
 
 PASSES = ("locks", "purity", "contracts", "mergeclosure", "keys",
-          "leaks", "ordering")
+          "leaks", "ordering", "kernels", "mesh")

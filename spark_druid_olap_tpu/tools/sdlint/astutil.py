@@ -442,6 +442,57 @@ class Index:
         return None
 
 
+def resolve_kernel_refs(idx: Index, mi: ModuleInfo,
+                        ci: Optional[ClassInfo], expr: ast.expr,
+                        local: Dict[str, TypeRef],
+                        enclosing_qual: str = "",
+                        depth: int = 4) -> List[FuncId]:
+    """Every function a kernel-position expression may denote.
+
+    Handles the three spellings the pallas/shard_map call sites use:
+
+    - a direct reference (``pl.pallas_call(kernel, ...)``),
+    - ``functools.partial(kernel, n)`` — unwraps to ``kernel``,
+    - a *factory call* (``pl.pallas_call(_make_kernel(...), ...)``) —
+      resolves to whatever the factory's ``return`` statements denote,
+      recursively, so factories that return partials or call further
+      inner factories still root the innermost def.
+
+    ``depth`` bounds the factory recursion (cycles in pathological
+    trees); unresolvable expressions drop silently, as everywhere else.
+    """
+    out: List[FuncId] = []
+    if depth < 0:
+        return out
+    if isinstance(expr, ast.Call):
+        chain = call_chain(expr.func)
+        if chain and chain[-1] == "partial":
+            if expr.args:
+                out.extend(resolve_kernel_refs(
+                    idx, mi, ci, expr.args[0], local,
+                    enclosing_qual=enclosing_qual, depth=depth))
+            return out
+        for factory in idx.resolve_call(mi, ci, expr, local,
+                                        enclosing_qual=enclosing_qual):
+            ffn = idx.functions.get(factory)
+            if ffn is None:
+                continue
+            fmi = idx.modules[factory[0]]
+            fci = idx.func_class[factory]
+            flocal = idx.local_types(fmi, fci, ffn)
+            for node in ast.walk(ffn):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    out.extend(resolve_kernel_refs(
+                        idx, fmi, fci, node.value, flocal,
+                        enclosing_qual=factory[1], depth=depth - 1))
+        return out
+    ref = idx.resolve_func_ref(mi, ci, expr, local,
+                               enclosing_qual=enclosing_qual)
+    if ref is not None:
+        out.append(ref)
+    return out
+
+
 def dotted_name(expr: ast.expr) -> Optional[str]:
     """'a.b.c' for a pure attribute chain, else None."""
     parts = []

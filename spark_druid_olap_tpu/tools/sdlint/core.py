@@ -208,18 +208,19 @@ class Baseline:
 def run_passes(project: Project,
                passes: Sequence[str] = ("locks", "purity", "contracts",
                                         "mergeclosure", "keys", "leaks",
-                                        "ordering"),
+                                        "ordering", "kernels", "mesh"),
                timing: Optional[Dict[str, float]] = None) -> List[Finding]:
     """Run the named passes; returns suppression-filtered findings.
     With ``timing`` a dict, per-pass wall seconds are written into it
     (plus ``"index"`` for the shared parse/index build)."""
     import time as _time
-    from spark_druid_olap_tpu.tools.sdlint import (contracts, keys, leaks,
-                                                   locks, mergeclosure,
-                                                   ordering, purity)
+    from spark_druid_olap_tpu.tools.sdlint import (contracts, kernels, keys,
+                                                   leaks, locks, mergeclosure,
+                                                   mesh, ordering, purity)
     impl = {"locks": locks.run, "purity": purity.run,
             "contracts": contracts.run, "mergeclosure": mergeclosure.run,
-            "keys": keys.run, "leaks": leaks.run, "ordering": ordering.run}
+            "keys": keys.run, "leaks": leaks.run, "ordering": ordering.run,
+            "kernels": kernels.run, "mesh": mesh.run}
     if timing is not None:
         t0 = _time.perf_counter()
         project.index()

@@ -1,0 +1,723 @@
+"""Pallas kernel-contract pass.
+
+The wave mega-kernel (``ops/pallas_wave.py``) and the dense group-by
+kernel (``ops/pallas_groupby.py``) are guarded at runtime by a trace
+probe against a Mosaic-safe whitelist plus interpreter-mode
+differentials — but the probe only sees the lane *builders*, and the
+VMEM/layout arithmetic it relies on is spread across four modules that
+must stay mutually consistent. This pass verifies the contract
+documented in ``docs/KERNELS.md`` statically, chip-free:
+
+- **vmem-budget** — the resident scratch block (``MAX_OUT_ROWS x 128``
+  f32) plus one floor-sized double-buffered input tile must fit the
+  declared VMEM budget: a tile planner that cannot shrink below its
+  floor would hand Mosaic an overcommitted BlockSpec at exactly the
+  widest storms the clamp exists for.
+- **tile-clamp-mismatch** — ``planner/fusion.py:plan_wave_tiles``
+  generalizes ``pallas_groupby.choose_block_rows`` and *inherits its
+  proof* (`wave_eligible` requires 'ffl' routes, proven at the group-by
+  clamp bounds); its ``min_rows``/``max_rows`` defaults and the
+  ``sdot.pallas.wave.tile.bytes`` default must therefore equal
+  ``MIN_BLOCK_ROWS``/``MAX_BLOCK_ROWS``/``VMEM_BUDGET``.
+- **cost-floor-mismatch** — ``parallel/cost.py:wave_tile_itemsize``
+  must price operands at the dtypes ``_prep_dtype`` actually ships
+  (masks as 1 byte, narrow ints widened to 4), or the planner's budget
+  arithmetic diverges from the kernel's real VMEM footprint.
+- **dtype-promotion-gap** — every promotion ``_prep_dtype`` plans
+  BlockSpecs with must be applied by the dispatch function's operand
+  prep (`.astype(jnp.int8)` / `.astype(jnp.int32)`): a planned-vs-
+  shipped dtype divergence is a Mosaic tiling error on device only.
+- **missing-stripe-init** — a kernel that accumulates across grid
+  steps without a ``@pl.when(step == 0)`` init block reads garbage
+  VMEM on step 0 (TPU grids are sequential; the output block is only a
+  legal accumulator when step 0 writes every stripe's identity).
+- **incomplete-identity-init** — the step-0 identity column must cover
+  every scratch-stripe family the kernel accumulates into: the
+  accumulate-side and init-side row arithmetic must address the same
+  layout fields (this is the bug class the explicit identity-column
+  operand papered over — e.g. theta stripes minimum-folded against
+  uninitialized rows).
+- **non-whitelisted-primitive** — a static complement of the runtime
+  ``_check_jaxpr`` whitelist: code reachable from a kernel *body* that
+  the trace probe does NOT cover (the probe only traces lane builders)
+  must not call gather/sort/scan/dot-class jnp/lax primitives — those
+  fail only at Mosaic compile time on a real chip.
+- **dynamic-ref-index** — ref indices inside kernel bodies must be
+  static Python ints: an index derived from ``pl.program_id`` or from
+  tile *values* is a traced scalar, which Mosaic refs reject (or worse,
+  interpret mode accepts and the TPU build then diverges).
+
+Kernel bodies are discovered at every ``pl.pallas_call`` site through
+``astutil.resolve_kernel_refs`` (direct refs, ``functools.partial``,
+and factory calls, the same rooting the purity pass uses). Anchors
+resolve by path suffix; a missing anchor skips its cross-check, so
+fixture trees carry only what their seeded violation needs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from spark_druid_olap_tpu.tools.sdlint.astutil import (FuncId, call_chain,
+                                                       dotted_name,
+                                                       resolve_kernel_refs,
+                                                       walk_shallow)
+from spark_druid_olap_tpu.tools.sdlint.core import Finding, Module, Project
+
+_WAVE_SUFFIX = "ops/pallas_wave.py"
+_GROUPBY_SUFFIX = "ops/pallas_groupby.py"
+_FUSION_SUFFIX = "planner/fusion.py"
+_COST_SUFFIX = "parallel/cost.py"
+_CONFIG_SUFFIX = "utils/config.py"
+
+_TILE_BYTES_KEY = "sdot.pallas.wave.tile.bytes"
+
+# widest dtype an operand can have after _prep_dtype (f64 under x64
+# canonicalization) — the floor tile must fit even an all-f64 storm
+_MAX_ITEMSIZE = 8
+
+#: jnp/lax call names outside the Mosaic-safe elementwise set
+#: (``pallas_wave._SAFE_PRIMS``): gathers, sorts, scans, contractions,
+#: scatter-class ops. The runtime probe rejects these in lane builders;
+#: this is the static complement for kernel-side helpers the probe
+#: never traces.
+_UNSAFE_CALLS = frozenset({
+    "take", "take_along_axis", "gather", "scatter", "scatter_add",
+    "sort", "argsort", "lexsort", "searchsorted", "unique", "nonzero",
+    "flatnonzero", "argwhere", "argmax", "argmin", "top_k",
+    "approx_max_k", "approx_min_k", "dot", "dot_general", "matmul",
+    "vdot", "tensordot", "einsum", "cumsum", "cumprod", "cummax",
+    "cummin", "associative_scan", "scan", "while_loop", "fori_loop",
+    "cond", "switch", "bincount", "digitize", "histogram",
+    "segment_sum", "segment_min", "segment_max", "segment_prod",
+    "dynamic_slice", "dynamic_update_slice", "convolve",
+    "conv_general_dilated", "roll", "repeat", "sort_key_val",
+})
+_JAX_NS_PREFIXES = ("jnp.", "lax.", "jax.lax.", "jax.numpy.", "jax.nn.",
+                    "jax.ops.", "jsp.")
+
+
+# =============================================================================
+# small static evaluators
+# =============================================================================
+
+def _const(expr: ast.expr) -> Optional[float]:
+    """Compile-time numeric value of an expression (literals, + - * //
+    / % ** << >>, unary minus); None when dynamic."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value,
+                                                     (int, float)):
+        return expr.value
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.USub):
+        v = _const(expr.operand)
+        return None if v is None else -v
+    if isinstance(expr, ast.BinOp):
+        a, b = _const(expr.left), _const(expr.right)
+        if a is None or b is None:
+            return None
+        try:
+            if isinstance(expr.op, ast.Add):
+                return a + b
+            if isinstance(expr.op, ast.Sub):
+                return a - b
+            if isinstance(expr.op, ast.Mult):
+                return a * b
+            if isinstance(expr.op, ast.FloorDiv):
+                return a // b
+            if isinstance(expr.op, ast.Div):
+                return a / b
+            if isinstance(expr.op, ast.Mod):
+                return a % b
+            if isinstance(expr.op, ast.Pow):
+                return a ** b
+            if isinstance(expr.op, ast.LShift):
+                return int(a) << int(b)
+            if isinstance(expr.op, ast.RShift):
+                return int(a) >> int(b)
+        except (ValueError, ZeroDivisionError, OverflowError):
+            return None
+    return None
+
+
+def _module_consts(mod: Module) -> Dict[str, Tuple[float, int]]:
+    """Top-level ``NAME = <const-expr>`` assignments: name -> (value,
+    lineno)."""
+    out: Dict[str, Tuple[float, int]] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            v = _const(node.value)
+            if v is not None:
+                out[node.targets[0].id] = (v, node.lineno)
+    return out
+
+
+def _fn_defaults(fn: ast.FunctionDef) -> Dict[str, float]:
+    """Constant parameter defaults of ``fn`` by name."""
+    out: Dict[str, float] = {}
+    args = fn.args
+    pos = args.posonlyargs + args.args
+    for a, d in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+        v = _const(d)
+        if v is not None:
+            out[a.arg] = v
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if d is not None:
+            v = _const(d)
+            if v is not None:
+                out[a.arg] = v
+    return out
+
+
+def _top_level_fn(mod: Module, name: str) -> Optional[ast.FunctionDef]:
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    return None
+
+
+def _entry_default(mod: Module, key: str) -> Optional[float]:
+    """The declared default of ``_entry("<key>", default, ...)`` in
+    utils/config.py (the same declaration shape the keys pass reads)."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and len(node.args) >= 2 \
+                and call_chain(node.func)[-1:] == ["_entry"] \
+                and isinstance(node.args[0], ast.Constant) \
+                and node.args[0].value == key:
+            return _const(node.args[1])
+    return None
+
+
+# =============================================================================
+# budget / clamp / cost-model cross-checks (module-level arithmetic)
+# =============================================================================
+
+def _budget_findings(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    wave = project.by_suffix(_WAVE_SUFFIX)
+    gb = project.by_suffix(_GROUPBY_SUFFIX)
+    fusion = project.by_suffix(_FUSION_SUFFIX)
+    config = project.by_suffix(_CONFIG_SUFFIX)
+
+    gbc = _module_consts(gb) if gb is not None else {}
+    lanes = int(gbc.get("LANES", (128, 0))[0])
+    min_rows = gbc.get("MIN_BLOCK_ROWS", (None, 0))[0]
+    max_rows = gbc.get("MAX_BLOCK_ROWS", (None, 0))[0]
+    gb_budget = gbc.get("VMEM_BUDGET", (None, 0))[0]
+
+    budget = None
+    if config is not None:
+        budget = _entry_default(config, _TILE_BYTES_KEY)
+    if budget is None:
+        budget = gb_budget
+
+    plan = _top_level_fn(fusion, "plan_wave_tiles") \
+        if fusion is not None else None
+    plan_defaults = _fn_defaults(plan) if plan is not None else {}
+    floor = plan_defaults.get("min_rows", min_rows)
+
+    # -- vmem-budget: scratch + floor tile must fit -----------------------
+    if wave is not None and budget is not None and floor is not None:
+        wc = _module_consts(wave)
+        max_out = wc.get("MAX_OUT_ROWS")
+        if max_out is not None:
+            scratch = max_out[0] * lanes * 4
+            tile = floor * lanes * _MAX_ITEMSIZE * 2
+            if scratch + tile > budget:
+                out.append(Finding(
+                    "kernels", "vmem-budget", wave.relpath, max_out[1],
+                    "MAX_OUT_ROWS",
+                    f"resident scratch block ({int(max_out[0])} rows x "
+                    f"{lanes} lanes f32 = {int(scratch)} bytes) plus one "
+                    f"floor-sized double-buffered tile ({int(tile)} "
+                    f"bytes) exceeds the {int(budget)}-byte VMEM budget; "
+                    f"plan_wave_tiles cannot shrink below its "
+                    f"{int(floor)}-row floor, so wide storms would hand "
+                    f"Mosaic an overcommitted BlockSpec"))
+    if gb is not None and gb_budget is not None and min_rows is not None:
+        # floor block: i32 key + one f32 value per row, double-buffered
+        tile = min_rows * lanes * 8 * 2
+        if tile > gb_budget:
+            out.append(Finding(
+                "kernels", "vmem-budget", gb.relpath,
+                gbc["MIN_BLOCK_ROWS"][1], "MIN_BLOCK_ROWS",
+                f"floor block ({int(min_rows)} rows, key + one value, "
+                f"double-buffered = {int(tile)} bytes) exceeds "
+                f"VMEM_BUDGET ({int(gb_budget)}); choose_block_rows "
+                f"cannot shrink below the floor"))
+
+    # -- tile-clamp-mismatch: plan_wave_tiles must inherit the proven
+    # choose_block_rows bounds -------------------------------------------
+    if plan is not None and gb is not None:
+        for pname, cname, gval in (("min_rows", "MIN_BLOCK_ROWS",
+                                    min_rows),
+                                   ("max_rows", "MAX_BLOCK_ROWS",
+                                    max_rows)):
+            pval = plan_defaults.get(pname)
+            if pval is not None and gval is not None and pval != gval:
+                out.append(Finding(
+                    "kernels", "tile-clamp-mismatch", fusion.relpath,
+                    plan.lineno, f"plan_wave_tiles.{pname}",
+                    f"plan_wave_tiles default {pname}={int(pval)} != "
+                    f"pallas_groupby.{cname}={int(gval)}; wave_eligible "
+                    f"inherits choose_block_rows' exactness proof, which "
+                    f"only holds at the group-by clamp bounds"))
+    if config is not None and gb_budget is not None:
+        cfg_budget = _entry_default(config, _TILE_BYTES_KEY)
+        if cfg_budget is not None and cfg_budget != gb_budget:
+            out.append(Finding(
+                "kernels", "tile-clamp-mismatch", config.relpath, 1,
+                _TILE_BYTES_KEY,
+                f"{_TILE_BYTES_KEY} default ({int(cfg_budget)}) != "
+                f"pallas_groupby.VMEM_BUDGET ({int(gb_budget)}); both "
+                f"kernels share the same VMEM and docs/KERNELS.md "
+                f"documents them as one budget"))
+
+    # -- cost-floor-mismatch: cost model must price _prep_dtype's
+    # shipped dtypes ------------------------------------------------------
+    cost = project.by_suffix(_COST_SUFFIX)
+    if cost is not None and wave is not None:
+        promos = _prep_dtype_targets(wave)
+        fn = _top_level_fn(cost, "wave_tile_itemsize")
+        if fn is not None and promos:
+            consts = {c.value for c in ast.walk(fn)
+                      if isinstance(c, ast.Constant)
+                      and isinstance(c.value, int)}
+            needed = {}
+            if "int8" in promos:
+                needed[1] = "masks ship as int8 (1 byte)"
+            if "int32" in promos:
+                needed[4] = "narrow ints widen to int32 (4 bytes)"
+            if "int64" in promos:
+                needed[8] = "narrow ints widen to int64 (8 bytes)"
+            for size, why in sorted(needed.items()):
+                if size not in consts:
+                    out.append(Finding(
+                        "kernels", "cost-floor-mismatch", cost.relpath,
+                        fn.lineno, f"wave_tile_itemsize:{size}",
+                        f"wave_tile_itemsize never prices {size} "
+                        f"bytes/row but _prep_dtype plans it ({why}); "
+                        f"the planner's VMEM arithmetic diverges from "
+                        f"the kernel's real tile footprint"))
+    return out
+
+
+def _prep_dtype_targets(mod: Module) -> Set[str]:
+    """dtype names ``_prep_dtype`` promotes operands *to* (attribute
+    returns like ``jnp.int8``/``jnp.int32``; the identity passthrough
+    return is a bare name and drops out)."""
+    fn = _top_level_fn(mod, "_prep_dtype")
+    if fn is None:
+        return set()
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) \
+                and isinstance(node.value, ast.Attribute):
+            name = dotted_name(node.value)
+            if name and name.split(".")[0] in ("jnp", "np", "numpy",
+                                               "jax"):
+                out.add(name.split(".")[-1])
+    return out
+
+
+# =============================================================================
+# kernel-body discovery + per-kernel rules
+# =============================================================================
+
+class _Kernels:
+    def __init__(self, project: Project):
+        self.project = project
+        self.index = project.index()
+        # (owner fid, pallas_call node) per site; kernel fid -> site
+        self.sites: List[Tuple[FuncId, ast.Call]] = []
+        self.kernels: Dict[FuncId, Tuple[str, int]] = {}
+        self.probe_covered: Set[FuncId] = set()
+        self._discover()
+
+    def _discover(self) -> None:
+        idx = self.index
+        probe_roots: Set[FuncId] = set()
+        for fid, fn in idx.functions.items():
+            mi = idx.modules[fid[0]]
+            ci = idx.func_class[fid]
+            local = idx.local_types(mi, ci, fn)
+            for node in walk_shallow(fn):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                # ``pl.pallas_call(k, ...)(operands)``: only the inner
+                # call is the site — call_chain sees "pallas_call" from
+                # the outer invocation too (it descends through Calls)
+                if not isinstance(node.func, (ast.Name, ast.Attribute)):
+                    continue
+                chain = call_chain(node.func)
+                if not chain:
+                    continue
+                if chain[-1] == "pallas_call":
+                    self.sites.append((fid, node))
+                    for k in resolve_kernel_refs(
+                            idx, mi, ci, node.args[0], local,
+                            enclosing_qual=fid[1]):
+                        self.kernels.setdefault(
+                            k, (mi.mod.relpath, node.lineno))
+                elif chain[-1] == "make_jaxpr":
+                    # the runtime probe: whatever it traces is covered
+                    # by _check_jaxpr at build time — the static
+                    # whitelist skips it to avoid double jeopardy with
+                    # the (deliberately narrower) runtime set
+                    probe_roots.update(resolve_kernel_refs(
+                        idx, mi, ci, node.args[0], local,
+                        enclosing_qual=fid[1]))
+        self.probe_covered = self._closure(probe_roots)
+
+    def _closure(self, roots: Set[FuncId]) -> Set[FuncId]:
+        idx = self.index
+        seen = set(roots)
+        stack = list(roots)
+        while stack:
+            fid = stack.pop()
+            fn = idx.functions.get(fid)
+            if fn is None:
+                continue
+            mi = idx.modules[fid[0]]
+            ci = idx.func_class[fid]
+            local = idx.local_types(mi, ci, fn)
+            for node in walk_shallow(fn):
+                if isinstance(node, ast.Call):
+                    for callee in idx.resolve_call(
+                            mi, ci, node, local, enclosing_qual=fid[1]):
+                        if callee not in seen:
+                            seen.add(callee)
+                            stack.append(callee)
+        return seen
+
+    # -- dtype-promotion-gap ---------------------------------------------------
+    def promotion_findings(self) -> List[Finding]:
+        out: List[Finding] = []
+        idx = self.index
+        for fid, call in self.sites:
+            mi = idx.modules[fid[0]]
+            promos = _prep_dtype_targets(mi.mod)
+            if not promos:
+                continue
+            fn = idx.functions[fid]
+            applied: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "astype" and node.args:
+                    name = dotted_name(node.args[0])
+                    if name:
+                        applied.add(name.split(".")[-1])
+            for t in sorted(promos - applied):
+                out.append(Finding(
+                    "kernels", "dtype-promotion-gap", mi.mod.relpath,
+                    call.lineno, f"{fid[1]}:{t}",
+                    f"{fid[1]} dispatches pl.pallas_call with BlockSpecs "
+                    f"planned by _prep_dtype but never applies the "
+                    f"{t} promotion to its operands (.astype(jnp.{t})); "
+                    f"planned tile dtype and shipped operand dtype "
+                    f"diverge — a Mosaic tiling error on device only"))
+        return out
+
+    # -- per-kernel scans ------------------------------------------------------
+    def kernel_findings(self) -> List[Finding]:
+        out: List[Finding] = []
+        for kfid in sorted(self.kernels):
+            fn = self.index.functions.get(kfid)
+            if fn is None:
+                continue
+            path = self.index.modules[kfid[0]].mod.relpath
+            refs = _ref_names(fn)
+            out.extend(self._stripe_init(kfid, fn, path, refs))
+            out.extend(self._dynamic_index(kfid, fn, path, refs))
+        out.extend(self._whitelist())
+        return out
+
+    def _stripe_init(self, kfid: FuncId, fn: ast.FunctionDef, path: str,
+                     refs: Set[str]) -> List[Finding]:
+        accum_idx = _accum_index_exprs(fn, refs)
+        if not accum_idx:
+            return []
+        when_blocks = [n for n in ast.walk(fn)
+                       if isinstance(n, ast.FunctionDef) and n is not fn
+                       and any(isinstance(d, ast.Call)
+                               and call_chain(d.func)[-1:] == ["when"]
+                               for d in n.decorator_list)]
+        if not when_blocks:
+            return [Finding(
+                "kernels", "missing-stripe-init", path, fn.lineno,
+                kfid[1],
+                f"{kfid[1]} accumulates into its output ref across grid "
+                f"steps but has no @pl.when(step == 0) init block; the "
+                f"output block is only a legal cross-step accumulator "
+                f"when step 0 writes every stripe's identity (step-0 "
+                f"VMEM contents are undefined)")]
+        # identity-init completeness: accumulate-side row arithmetic
+        # must address the same layout fields the init side writes
+        env = _binding_env(fn)
+        accum_vocab: Set[str] = set()
+        for e in accum_idx:
+            accum_vocab |= _attr_vocab(e, env)
+        init_vocab: Set[str] = set()
+        for wb in when_blocks:
+            wenv = dict(env)
+            wenv.update(_binding_env(wb))
+            for node in ast.walk(wb):
+                if isinstance(node, ast.expr):
+                    init_vocab |= _attr_vocab(node, wenv,
+                                              include_call_args=True)
+        # host-side identity buffers (wave: the init_col operand built
+        # in the enclosing factory) — scan the whole defining module
+        mod = self.index.modules[kfid[0]].mod
+        for ofid, ofn in self.index.functions.items():
+            if ofid[0] != kfid[0]:
+                continue
+            oenv = _binding_env(ofn)
+            for node in walk_shallow(ofn):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Subscript):
+                    base = call_chain(node.targets[0].value)
+                    if base and "init" in base[-1].lower():
+                        init_vocab |= _attr_vocab(
+                            node.targets[0].slice, oenv)
+                elif isinstance(node, ast.Call) \
+                        and call_chain(node.func)[-1:] == ["init_rows"] \
+                        and len(node.args) > 1:
+                    init_vocab |= _attr_vocab(node.args[1], oenv)
+        missing = sorted(accum_vocab - init_vocab)
+        if not missing:
+            return []
+        return [Finding(
+            "kernels", "incomplete-identity-init", path, fn.lineno,
+            f"{kfid[1]}:{','.join(missing)}",
+            f"{kfid[1]} accumulates into scratch stripes addressed via "
+            f"{', '.join(missing)} but the step-0 identity init "
+            f"(pl.when block / identity-column build in "
+            f"{mod.relpath}) never writes rows addressed by "
+            f"{'it' if len(missing) == 1 else 'them'}; those stripes "
+            f"fold against undefined VMEM on step 0")]
+
+    def _dynamic_index(self, kfid: FuncId, fn: ast.FunctionDef,
+                       path: str, refs: Set[str]) -> List[Finding]:
+        out: List[Finding] = []
+        tainted = _traced_names(fn, refs)
+
+        def dynamic(e: ast.expr) -> bool:
+            for node in ast.walk(e):
+                if isinstance(node, ast.Name) and node.id in tainted:
+                    return True
+                if isinstance(node, ast.Call) \
+                        and call_chain(node.func)[-1:] == ["program_id"]:
+                    return True
+            return False
+
+        seen: Set[Tuple[int, str]] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Subscript):
+                continue
+            base = call_chain(node.value)
+            if not base or base[0] not in refs:
+                continue
+            if (node.lineno, base[0]) in seen:
+                continue       # load + store on one line: report once
+            if dynamic(node.slice):
+                seen.add((node.lineno, base[0]))
+                out.append(Finding(
+                    "kernels", "dynamic-ref-index", path, node.lineno,
+                    f"{kfid[1]}:{base[0]}",
+                    f"{kfid[1]} indexes ref {base[0]} with a traced "
+                    f"value (derived from pl.program_id or tile reads); "
+                    f"Mosaic refs require static Python-int indices — "
+                    f"interpret mode accepts this and the TPU build "
+                    f"then diverges"))
+        return out
+
+    def _whitelist(self) -> List[Finding]:
+        out: List[Finding] = []
+        idx = self.index
+        reach = self._closure(set(self.kernels)) - self.probe_covered
+        reach |= set(self.kernels)      # kernel bodies always checked
+        for fid in sorted(reach):
+            fn = idx.functions.get(fid)
+            if fn is None:
+                continue
+            path = idx.modules[fid[0]].mod.relpath
+            for node in walk_shallow(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if not name or not name.startswith(_JAX_NS_PREFIXES):
+                    continue
+                leaf = name.split(".")[-1]
+                if leaf in _UNSAFE_CALLS:
+                    out.append(Finding(
+                        "kernels", "non-whitelisted-primitive", path,
+                        node.lineno, f"{fid[1]}:{name}",
+                        f"{fid[1]} is reachable from a pallas kernel "
+                        f"body outside the trace probe's coverage and "
+                        f"calls {name}(), which lowers outside the "
+                        f"Mosaic-safe elementwise set "
+                        f"(pallas_wave._SAFE_PRIMS); this fails only at "
+                        f"Mosaic compile time on a real chip"))
+        return out
+
+
+def _ref_names(fn: ast.FunctionDef) -> Set[str]:
+    """Kernel parameters (pallas passes refs positionally, ``*refs``
+    included) plus local aliases bound from a plain ref subscript
+    (``out_ref = refs[n_in]`` — a full-slice subscript is a *read* and
+    stays a value)."""
+    refs = {a.arg for a in fn.args.posonlyargs + fn.args.args
+            + fn.args.kwonlyargs}
+    if fn.args.vararg is not None:
+        refs.add(fn.args.vararg.arg)
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Subscript) \
+                    and not _has_slice(node.value.slice):
+                base = call_chain(node.value.value)
+                if base and base[0] in refs \
+                        and node.targets[0].id not in refs:
+                    refs.add(node.targets[0].id)
+                    changed = True
+    return refs
+
+
+def _has_slice(e: ast.expr) -> bool:
+    return any(isinstance(n, ast.Slice) for n in ast.walk(e))
+
+
+def _accum_index_exprs(fn: ast.FunctionDef,
+                       refs: Set[str]) -> List[ast.expr]:
+    """Row-index expressions of cross-step accumulation: subscript
+    stores on a ref whose value re-reads the same ref (read-modify-
+    write), plus ``accumulate_rows(ref, row, ...)`` helper calls."""
+    out: List[ast.expr] = []
+    for node in walk_shallow(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if not isinstance(t, ast.Subscript):
+                    continue
+                base = call_chain(t.value)
+                if not base or base[0] not in refs:
+                    continue
+                rmw = any(isinstance(n, ast.Name) and n.id == base[0]
+                          for n in ast.walk(node.value))
+                if rmw:
+                    out.append(t.slice)
+        elif isinstance(node, ast.Call) \
+                and call_chain(node.func)[-1:] == ["accumulate_rows"] \
+                and len(node.args) > 1:
+            out.append(node.args[1])
+    return out
+
+
+def _binding_env(fn: ast.FunctionDef) -> Dict[str, ast.expr]:
+    """name -> defining expression, for one level of index-arithmetic
+    expansion: plain assignments plus for-loop targets (bound to the
+    loop's iterable — the *source* of the values the name ranges
+    over)."""
+    env: Dict[str, ast.expr] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            env.setdefault(node.targets[0].id, node.value)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    env.setdefault(t.id, node.iter)
+    return env
+
+
+def _attr_vocab(expr: ast.expr, env: Dict[str, ast.expr],
+                depth: int = 3, include_call_args: bool = False) -> Set[str]:
+    """Attribute names an index expression's arithmetic reaches —
+    the layout-field vocabulary (``lay.base``, ``lay.theta_base``,
+    ``TH.K_LANES``). Call *method* names and (by default) call
+    arguments are excluded: ``lay.theta_base.get(p.spec.name)``
+    contributes ``theta_base``, not ``get``/``spec``/``name``. Names
+    expand one ``env`` level at a time through local assignments and
+    for-targets."""
+    out: Set[str] = set()
+
+    def visit(e: ast.expr, d: int) -> None:
+        if isinstance(e, ast.Call):
+            f = e.func
+            if isinstance(f, ast.Attribute):
+                visit(f.value, d)       # drop the method name itself
+            elif isinstance(f, ast.expr):
+                visit(f, d)
+            if include_call_args:
+                for a in e.args:
+                    visit(a, d)
+                for kw in e.keywords:
+                    visit(kw.value, d)
+            return
+        if isinstance(e, ast.Attribute):
+            out.add(e.attr)
+            visit(e.value, d)
+            return
+        if isinstance(e, ast.Name):
+            if d > 0 and e.id in env:
+                visit(env[e.id], d - 1)
+            return
+        for c in ast.iter_child_nodes(e):
+            if isinstance(c, ast.expr):
+                visit(c, d)
+
+    visit(expr, depth)
+    return out
+
+
+def _traced_names(fn: ast.FunctionDef, refs: Set[str]) -> Set[str]:
+    """Names bound to traced scalars inside a kernel body:
+    ``pl.program_id`` results, ref tile reads, and *arithmetic* over
+    them (fixpoint). Taint deliberately does NOT flow through calls or
+    loop targets — kernels interleave traced tiles with host-side plan
+    objects (layout dicts, ``range`` counters) that static analysis
+    cannot tell apart, and an index expression like
+    ``lay.theta_base.get(name) + k * K_LANES`` is a build-time Python
+    int even though its inputs passed through traced-adjacent code.
+    Arithmetic chains rooted directly at ``program_id``/ref loads are
+    the realistic bug shape and resolve unambiguously."""
+    tainted: Set[str] = set()
+
+    def traced(e: ast.expr) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in tainted
+        if isinstance(e, ast.Call):
+            return call_chain(e.func)[-1:] == ["program_id"]
+        if isinstance(e, ast.Subscript):
+            base = call_chain(e.value)
+            return bool(base) and base[0] in refs
+        if isinstance(e, ast.BinOp):
+            return traced(e.left) or traced(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return traced(e.operand)
+        return False
+
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and traced(node.value):
+                name = node.targets[0].id
+                if name not in tainted and name not in refs:
+                    tainted.add(name)
+                    changed = True
+    return tainted
+
+
+def run(project: Project) -> List[Finding]:
+    out = _budget_findings(project)
+    k = _Kernels(project)
+    out.extend(k.promotion_findings())
+    out.extend(k.kernel_findings())
+    return out

@@ -36,7 +36,8 @@ import ast
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from spark_druid_olap_tpu.tools.sdlint.astutil import (FuncId, Index,
-                                                       dotted_name)
+                                                       dotted_name,
+                                                       resolve_kernel_refs)
 from spark_druid_olap_tpu.tools.sdlint.core import Finding, Project
 
 # dotted-name heads/prefixes that mean "this call is jit-like: its
@@ -171,36 +172,16 @@ class _Purity:
                             enclosing_qual=enclosing_qual):
                         self.roots.setdefault(callee, site)
             return
-        if isinstance(expr, ast.Call):
-            # factory-returned kernels: ``pl.pallas_call(_make_kernel(...),
-            # ...)`` — the factory call runs on the host at build time, but
-            # the function it RETURNS is what gets traced. Root every
-            # nested def the factory returns.
-            for factory in idx.resolve_call(mi, ci, expr, local,
-                                            enclosing_qual=enclosing_qual):
-                ffn = idx.functions.get(factory)
-                if ffn is None:
-                    continue
-                fmi = idx.modules[factory[0]]
-                fci = idx.func_class[factory]
-                flocal = idx.local_types(fmi, fci, ffn)
-                for node in ast.walk(ffn):
-                    if isinstance(node, ast.Return) \
-                            and node.value is not None:
-                        ref = idx.resolve_func_ref(
-                            fmi, fci, node.value, flocal,
-                            enclosing_qual=factory[1])
-                        if ref is not None:
-                            self.roots.setdefault(ref, site)
-            return
-        ref = idx.resolve_func_ref(mi, ci, expr, local,
-                                   enclosing_qual=enclosing_qual)
-        if ref is not None:
+        # direct refs, factory-returned kernels (``pl.pallas_call(
+        # _make_kernel(...), ...)``), ``functools.partial``-wrapped
+        # kernels, and factories-returning-factories all resolve through
+        # the shared helper — the factory call runs on the host at build
+        # time, but the function it ultimately denotes is what traces
+        for ref in resolve_kernel_refs(idx, mi, ci, expr, local,
+                                       enclosing_qual=enclosing_qual):
             self.roots.setdefault(ref, site)
-            return
-        # one level of unwrapping: `smfn = jax.shard_map(fn, ...)` then
-        # `jax.jit(smfn)` — handled because shard_map itself is jit-like,
-        # nothing to do here.
+        # `smfn = jax.shard_map(fn, ...)` then `jax.jit(smfn)` needs no
+        # unwrapping here — shard_map itself is jit-like.
 
     def _find_roots(self) -> None:
         idx = self.index

@@ -1,0 +1,33 @@
+"""Fixture group-by kernel constants + a seeded accumulator kernel.
+
+The clamp constants here are the PROVEN bounds the fixture planner
+(``planner/fusion.py``) must inherit — its seeded 64/4096 defaults fire
+tile-clamp-mismatch against these. The factory-returned kernel
+accumulates across grid steps with no ``@pl.when(step == 0)`` block —
+missing-stripe-init. Never imported; pure-ast fixture."""
+
+from jax.experimental import pallas as pl
+
+LANES = 128
+MIN_BLOCK_ROWS = 128
+MAX_BLOCK_ROWS = 2048
+VMEM_BUDGET = 8 << 20
+
+_INIT = {"count": 0.0, "sum": 0.0, "min": 3.4e38, "max": -3.4e38}
+
+
+def _make_kernel(n_in):
+    def kernel(key_ref, *refs):
+        out_ref = refs[n_in]
+        step = pl.program_id(0)
+        # seeded: accumulates into out_ref across steps, but nothing
+        # writes the identity on step 0 -> garbage VMEM folded in
+        x = refs[0][:]
+        out_ref[0, :] = out_ref[0, :] + x
+
+    return kernel
+
+
+def dense_groupby(key, arrays, n_in, block_rows):
+    grid = (arrays[0].shape[0] // block_rows,)
+    return pl.pallas_call(_make_kernel(n_in), grid=grid)(key, *arrays)

@@ -1,0 +1,68 @@
+"""Fixture wave kernel with seeded kernel-contract violations.
+
+- ``MAX_OUT_ROWS`` makes the resident scratch block alone blow the
+  configured VMEM budget (vmem-budget).
+- ``_prep_dtype`` plans int8 + int32 promotions but ``wave_fn`` only
+  applies the int32 one (dtype-promotion-gap: int8).
+- the kernel minimum-folds theta stripes addressed via
+  ``lay.theta_base`` that the step-0 init never writes
+  (incomplete-identity-init).
+- ``out_ref[step, :]`` indexes a ref with the traced program id
+  (dynamic-ref-index).
+- ``_bucket_offsets`` is reachable from the kernel body with no trace
+  probe covering it and calls ``jnp.cumsum`` (non-whitelisted-
+  primitive).
+
+Never imported; pure-ast fixture."""
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LANES = 128
+MAX_OUT_ROWS = 65536     # seeded: 32 MiB of f32 scratch vs a 4 MiB budget
+TH_K_LANES = 16
+
+
+def _prep_dtype(dt):
+    if dt == "bool":
+        return jnp.int8
+    if dt in ("int8", "int16"):
+        return jnp.int32
+    return dt
+
+
+def _bucket_offsets(mask):
+    # seeded: cumsum lowers outside the Mosaic-safe elementwise set
+    return jnp.cumsum(mask.astype(jnp.int32))
+
+
+def build_wave_fn(layouts, n_in, block_rows, out_rows):
+    def kernel(*refs):
+        init_ref = refs[n_in]
+        out_ref = refs[n_in + 1]
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _():
+            for lay in layouts:
+                out_ref[lay.base, :] = init_ref[lay.base, :]
+
+        x = refs[0][:]
+        off = _bucket_offsets(x != 0)
+        for lay in layouts:
+            out_ref[lay.base, :] = out_ref[lay.base, :] + off
+            r = lay.theta_base + TH_K_LANES
+            out_ref[r, :] = jnp.minimum(out_ref[r, :], off)
+        out_ref[step, :] = out_ref[step, :] + x
+
+    def wave_fn(arrays):
+        ops = []
+        for a in arrays:
+            if a.dtype.kind == "i" and a.dtype.itemsize < 4:
+                a = a.astype(jnp.int32)
+            # seeded: bool operands never get .astype(jnp.int8)
+            ops.append(a)
+        blk = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0))
+        return pl.pallas_call(kernel, grid=(4,), in_specs=[blk])(*ops)
+
+    return wave_fn

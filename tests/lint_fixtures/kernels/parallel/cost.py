@@ -1,0 +1,17 @@
+"""Fixture cost model. Seeded: ``wave_tile_itemsize`` prices every
+operand at its stored width, but ``_prep_dtype`` ships masks as int8
+(1 byte) and widens narrow ints to int32 (4 bytes) — the planner's
+VMEM arithmetic diverges from the kernel's real tile footprint
+(cost-floor-mismatch, once per missing width)."""
+
+
+def array_itemsize(ds, key):
+    return ds.schema[key].itemsize
+
+
+def wave_tile_itemsize(ds, key):
+    return array_itemsize(ds, key)
+
+
+def pallas_tile_budget_bytes(conf):
+    return int(conf.get("sdot.pallas.wave.tile.bytes"))

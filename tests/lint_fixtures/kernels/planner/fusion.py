@@ -1,0 +1,14 @@
+"""Fixture wave-tile planner. Seeded: the clamp defaults (64/4096)
+drift from the group-by bounds (128/2048) whose exactness proof
+``wave_eligible`` inherits — tile-clamp-mismatch, twice."""
+
+
+def plan_wave_tiles(itemsizes, scratch_rows, budget_bytes,
+                    min_rows=64, max_rows=4096):
+    lanes = 128
+    per_row = lanes * max(1, sum(itemsizes))
+    scratch = scratch_rows * lanes * 4
+    b = max_rows
+    while b > min_rows and b * per_row * 2 + scratch > budget_bytes:
+        b //= 2
+    return b

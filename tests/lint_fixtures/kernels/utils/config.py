@@ -1,0 +1,12 @@
+"""Fixture config registry. Seeded: the wave tile-budget default
+(4 MiB) drifts from the group-by VMEM_BUDGET (8 MiB) the two kernels
+share — tile-clamp-mismatch — and it is the budget the oversized wave
+scratch block is checked against (vmem-budget)."""
+
+
+def _entry(key, default, doc):
+    return key
+
+
+PALLAS_WAVE_TILE_BYTES = _entry("sdot.pallas.wave.tile.bytes", 4 << 20,
+                                "per-tile VMEM budget for wave kernels")

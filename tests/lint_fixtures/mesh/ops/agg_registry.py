@@ -1,0 +1,10 @@
+"""Fixture aggregate registry: declares the register algebra each
+sketch's cross-chip merge must use (``merge`` field). The seeded
+``ops/hll.py`` psum contradicts the declared "max"."""
+
+AGG_CLOSURE = {
+    "cardinality": {"route": "hll", "dtype": "int64",
+                    "reagg": None, "sketch": "hll", "merge": "max"},
+    "thetasketch": {"route": "theta", "dtype": "int64",
+                    "reagg": None, "sketch": "theta", "merge": "min"},
+}

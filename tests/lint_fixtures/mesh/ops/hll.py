@@ -1,0 +1,9 @@
+"""Fixture HLL sketch. Seeded: rho registers are MAXIMA — summing them
+across chips (psum) double-counts every register silently
+(sketch-merge-mismatch)."""
+
+import jax
+
+
+def merge_registers(regs, axis_name):
+    return jax.lax.psum(regs, axis_name)

@@ -1,0 +1,8 @@
+"""Fixture theta sketch: k-min registers correctly pmin-merged — the
+sketch-merge rule must stay quiet here while firing on ``hll.py``."""
+
+import jax
+
+
+def merge_registers(regs, axis_name):
+    return jax.lax.pmin(regs, axis_name)

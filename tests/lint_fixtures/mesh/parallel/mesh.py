@@ -1,0 +1,12 @@
+"""Fixture mesh anchor: declares the one segment-scan axis the mesh
+pass resolves collective axis names against. Clean on purpose — the
+seeded violations live in ``parallel/sharded.py`` and ``ops/hll.py``."""
+
+import numpy as np
+from jax.sharding import Mesh
+
+SEGMENT_AXIS = "shards"
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices), (SEGMENT_AXIS,))

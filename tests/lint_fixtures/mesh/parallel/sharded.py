@@ -1,0 +1,44 @@
+"""Fixture sharded runner with seeded SPMD replication-safety
+violations:
+
+- ``core`` psums over axis ``"chips"`` and the in_spec partitions over
+  it — the mesh only declares ``"shards"`` (unknown-axis-name, twice).
+- ``core`` draws from ``jax.random`` and escapes through
+  ``io_callback`` inside the shard body (host-call-in-shard, twice).
+- ``core`` writes a module-level stats dict and an engine attribute at
+  trace time (host-state-write-in-shard, twice).
+- ``merge`` psums the ``kind == "min"`` partials (merge-op-mismatch);
+  the max branch uses the matching pmax and must stay quiet.
+
+Never imported; pure-ast fixture."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from fixture.parallel.mesh import SEGMENT_AXIS
+
+_STATS = {}
+
+
+class ShardedRunner:
+    def run(self, blocks, mesh):
+        def core(x):
+            total = jax.lax.psum(x, SEGMENT_AXIS)
+            part = jax.lax.psum(x, "chips")
+            key = jax.random.PRNGKey(0)
+            jax.experimental.io_callback(list, None, x)
+            _STATS["runs"] = 1
+            self.last = total
+            return total + part
+
+        smfn = jax.shard_map(core, mesh=mesh,
+                             in_specs=(P("chips"),),
+                             out_specs=P(SEGMENT_AXIS))
+        return smfn(blocks)
+
+    def merge(self, kind, v):
+        if kind == "min":
+            return jax.lax.psum(v, SEGMENT_AXIS)
+        if kind == "max":
+            return jax.lax.pmax(v, SEGMENT_AXIS)
+        return jax.lax.psum(v, SEGMENT_AXIS)

@@ -1,9 +1,11 @@
 """Seeded purity-pass violations: a jitted function that branches on a
-traced value and touches host-only APIs, and a factory-returned pallas
+traced value and touches host-only APIs, a factory-returned pallas
 kernel with the same sins (the factory call runs on the host, but the
-kernel it returns is traced). Never imported — analyzed as ast only
-(jax need not be installed)."""
+kernel it returns is traced), and the deep-rooting shapes —
+``functools.partial``-wrapped and factory-returning-factory kernels.
+Never imported — analyzed as ast only (jax need not be installed)."""
 
+import functools
 import time
 
 import jax
@@ -32,3 +34,24 @@ def _make_bad_wave(n_keys):
 
 def launch_wave(x):
     return pl.pallas_call(_make_bad_wave(4), grid=(1,))(x)
+
+
+def _make_deep(n_keys):
+    # factory returning a factory's product: the kernel reaches the
+    # pallas_call only through TWO host-time call layers
+    def _inner():
+        def deep_kernel(in_ref, out_ref):
+            t = jnp.sum(in_ref[:])
+            if t > 0:                # traced-branch, two factories deep
+                time.sleep(0.01)     # host-call, two factories deep
+            out_ref[0] = t
+
+        return deep_kernel
+
+    return _inner()
+
+
+def launch_partial(x):
+    # functools.partial around the factory product: still the same
+    # traced body once the partial is peeled
+    return pl.pallas_call(functools.partial(_make_deep(2)), grid=(1,))(x)

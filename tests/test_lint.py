@@ -100,6 +100,11 @@ def test_purity_pass_fires_on_impure_jit_fixture():
     wave = [f for f in found
             if f.symbol.startswith("_make_bad_wave.wave_kernel")]
     assert {f.rule for f in wave} == {"traced-branch", "host-call"}, found
+    # deep rooting: a functools.partial-wrapped factory-of-a-factory
+    # product still resolves to the traced body two host layers down
+    deep = [f for f in found
+            if f.symbol.startswith("_make_deep._inner.deep_kernel")]
+    assert {f.rule for f in deep} == {"traced-branch", "host-call"}, found
 
 
 def test_contracts_pass_fires_on_undeclared_key_fixture():
@@ -182,11 +187,58 @@ def test_ordering_pass_fires_on_ordering_fixture():
     assert len(by_rule) == 7, sorted(by_rule)
 
 
+def test_kernels_pass_fires_on_kernels_fixture():
+    """Every kernel-contract rule fires on its seeded violation: the
+    oversized scratch block, both planner-clamp drifts plus the config
+    budget drift, both unpriced _prep_dtype widths, the unapplied int8
+    promotion, the init-free accumulator kernel, the theta stripes the
+    step-0 init never writes, the program_id-derived ref index, and the
+    cumsum helper outside the probe's coverage."""
+    found = _fixture("kernels", ("kernels",))
+    got = {(f.rule, f.symbol) for f in found}
+    assert got == {
+        ("vmem-budget", "MAX_OUT_ROWS"),
+        ("tile-clamp-mismatch", "plan_wave_tiles.min_rows"),
+        ("tile-clamp-mismatch", "plan_wave_tiles.max_rows"),
+        ("tile-clamp-mismatch", "sdot.pallas.wave.tile.bytes"),
+        ("cost-floor-mismatch", "wave_tile_itemsize:1"),
+        ("cost-floor-mismatch", "wave_tile_itemsize:4"),
+        ("dtype-promotion-gap", "build_wave_fn.wave_fn:int8"),
+        ("missing-stripe-init", "_make_kernel.kernel"),
+        ("incomplete-identity-init", "build_wave_fn.kernel:theta_base"),
+        ("dynamic-ref-index", "build_wave_fn.kernel:out_ref"),
+        ("non-whitelisted-primitive", "_bucket_offsets:jnp.cumsum"),
+    }, sorted(got)
+
+
+def test_mesh_pass_fires_on_mesh_fixture():
+    """Every SPMD replication-safety rule fires on its seeded
+    violation: the undeclared "chips" axis (collective arg AND
+    shard_map spec), the sum-merged HLL registers, the psum'd min
+    branch, the jax.random / io_callback escapes inside the shard body,
+    and both host-state writes (module dict + self attribute). The
+    correctly pmin-merged theta sketch stays quiet."""
+    found = _fixture("mesh", ("mesh",))
+    got = {(f.rule, f.symbol) for f in found}
+    assert got == {
+        ("unknown-axis-name", "ShardedRunner.run.core:chips"),
+        ("unknown-axis-name", "ShardedRunner.run:chips"),
+        ("sketch-merge-mismatch", "hll.merge_registers"),
+        ("merge-op-mismatch", "ShardedRunner.merge:min"),
+        ("host-call-in-shard", "ShardedRunner.run.core:jax.random.PRNGKey"),
+        ("host-call-in-shard",
+         "ShardedRunner.run.core:jax.experimental.io_callback"),
+        ("host-state-write-in-shard", "ShardedRunner.run.core:_STATS[...]"),
+        ("host-state-write-in-shard", "ShardedRunner.run.core:self.last"),
+    }, sorted(got)
+    assert not any(f.path == "ops/theta.py" for f in found), found
+
+
 def test_new_fixtures_are_quiet_when_their_pass_is_disabled():
     """Liveness proof: every finding on the seeded trees comes from the
-    one pass under test — running the other six passes yields nothing,
+    one pass under test — running the other eight passes yields nothing,
     so disabling the pass makes the seeded violations invisible."""
-    for name in ("keys", "leaks", "ordering"):
+    for name in ("keys", "leaks", "ordering", "kernels", "mesh"):
         others = tuple(p for p in PASSES if p != name)
         found = _fixture(name, others)
         assert not found, (name, [f.render() for f in found])
@@ -206,10 +258,21 @@ def test_json_report_matches_golden():
     assert doc == golden, json.dumps(doc, indent=2, sort_keys=True)
 
 
+def test_mesh_json_report_matches_golden():
+    """Same machine-interface pin for the newest pass: the mesh fixture
+    findings render byte-identically to the checked-in golden."""
+    findings = _fixture("mesh", ("mesh",))
+    doc = json.loads(report_json(findings, Baseline()))
+    assert doc["schema_version"] == 2
+    with open(os.path.join(FIXTURES, "mesh", "golden.json")) as f:
+        golden = json.load(f)
+    assert doc == golden, json.dumps(doc, indent=2, sort_keys=True)
+
+
 def test_shared_index_timing_and_perf_budget():
-    """One parse + one Index serves all seven passes; the timing hook
+    """One parse + one Index serves all nine passes; the timing hook
     reports per-pass wall time and the whole run stays inside the CI
-    budget (observed ~4s on this tree; 30s leaves slack for slow CI)."""
+    budget (observed ~7s on this tree; 30s leaves slack for slow CI)."""
     timing = {}
     run_passes(Project(PKG_ROOT), timing=timing)
     assert set(timing) == {"index", *PASSES}, sorted(timing)
@@ -316,6 +379,30 @@ def test_live_tree_stays_clean_of_the_fixed_rules():
                  "unreleased-quota", "unclosed-wal-handle",
                  "publish-not-durable", "rename-before-fsync"):
         assert not by_rule.get(rule), by_rule[rule]
+
+
+def test_kernel_and_mesh_invariants_stay_clean():
+    """Pin the new pass families at zero on the live tree: the VMEM
+    budget arithmetic closes (scratch + floor tile fits the configured
+    clamp), every _prep_dtype promotion is applied at dispatch, both
+    kernels identity-init every stripe they accumulate, kernel-reachable
+    code stays inside the Mosaic-safe set, all collectives run over the
+    declared segment axis, and the sketch merges match the register
+    algebra AGG_CLOSURE declares. A reintroduction fails by rule name."""
+    findings = run_passes(Project(PKG_ROOT), ("kernels", "mesh"))
+    assert not findings, [f.render() for f in findings]
+
+
+def test_registry_declares_sketch_merge_algebra():
+    """The merge field is what the sketch-merge-mismatch rule checks
+    ops/<sketch>.py:merge_registers against — it must stay declared and
+    correct (HLL rho registers are maxima, theta k-min hashes minima)."""
+    from spark_druid_olap_tpu.ops.agg_registry import AGG_CLOSURE
+    for kind, ent in AGG_CLOSURE.items():
+        if ent.get("sketch"):
+            assert ent.get("merge") in ("max", "min"), kind
+    assert AGG_CLOSURE["cardinality"]["merge"] == "max"
+    assert AGG_CLOSURE["thetasketch"]["merge"] == "min"
 
 
 def test_fingerprint_excludes_operational_keys():
