@@ -560,6 +560,140 @@ def run_pallas_ab(reps: int = 3):
     return out
 
 
+def run_encode_ab(reps: int = 3):
+    """Encoded-vs-raw A-B over the cold tier (encode/ + tier/).
+
+    Builds one synthetic store, checkpoints it twice — raw and with
+    ``sdot.encode.enabled`` — then reopens each snapshot through the
+    tiered path at the SAME byte budget and replays one aggregation
+    mix. Reports the on-disk compression ratio, per-leg wall ms, the
+    EFFECTIVE scan rate (LOGICAL bytes scanned per second — the encoded
+    leg faults ratio× fewer physical bytes for the same logical scan),
+    and the hot-set residency each leg ends with under the shared
+    budget (the encoded leg should hold more segment-chunks resident).
+    Differential: both legs must return identical frames.
+    """
+    import shutil
+    import tempfile
+
+    import pandas as pd
+    import spark_druid_olap_tpu as sdot
+
+    rng = np.random.default_rng(11)
+    n = 200_000
+    df = pd.DataFrame({
+        "ts": pd.Timestamp("2015-01-01")
+        + pd.to_timedelta(np.sort(rng.integers(0, 365 * 24 * 3600, n)),
+                          unit="s"),
+        "region": rng.choice(["east", "west", "north", "south"], n),
+        "product": rng.choice([f"p{i:03d}" for i in range(100)], n),
+        "status": rng.choice(["O", "F", "P"], n, p=[0.7, 0.2, 0.1]),
+        "qty": rng.integers(1, 52, n).astype(np.int64),
+        "price": rng.uniform(1.0, 100.0, n),
+    })
+    queries = [
+        "select region, sum(price), sum(qty), count(*) from sales "
+        "group by region",
+        "select product, sum(price) from sales where status = 'O' "
+        "group by product order by sum(price) desc limit 7",
+        "select year(ts) y, month(ts) m, count(*) from sales "
+        "group by year(ts), month(ts)",
+    ]
+    root = tempfile.mkdtemp(prefix="sdot-encab-")
+    try:
+        legs, frames = {}, {}
+        budget = None
+        for leg, enabled in (("raw", False), ("encoded", True)):
+            sub = os.path.join(root, leg)
+            seed = sdot.Context({"sdot.persist.path": sub,
+                                 "sdot.encode.enabled": enabled})
+            seed.ingest_dataframe("sales", df, time_column="ts",
+                                  target_rows=8192)
+            seed.checkpoint()
+            col_bytes = sum(
+                c["size"] for c in
+                seed.store.get("sales").metadata()["columns"].values())
+            seed.close()
+            if budget is None:
+                # sized off the RAW leg so both legs share one number:
+                # raw must evict under it, encoded should mostly fit
+                budget = max(1 << 20, int(col_bytes) // 3)
+            ctx = sdot.Context({"sdot.persist.path": sub,
+                                "sdot.cache.enabled": False,
+                                "sdot.plan.cache.enabled": False,
+                                "sdot.tier.enabled": True,
+                                "sdot.tier.budget.bytes": budget,
+                                "sdot.tier.wave.io.bytes": budget // 4})
+            frames[leg] = {q: ctx.sql(q).to_pandas() for q in queries}
+            ts, logical = [], 0
+            for _ in range(max(reps, 1)):
+                t0 = time.perf_counter()
+                for q in queries:
+                    ctx.sql(q)
+                    st = ctx.history.entries()[-1].stats
+                    logical += int(st.get("bytes_scanned", 0) or 0)
+                ts.append(time.perf_counter() - t0)
+            last = ctx.history.entries()[-1].stats
+            tier_st = (ctx.persist.tier.stats_snapshot()
+                       if ctx.persist.tier else {})
+            enc_st = last.get("encoding") or {}
+            ctx.close()
+            ms = float(np.median(ts)) * 1000
+            legs[leg] = {
+                "wall_ms": round(ms, 2),
+                "column_bytes": int(col_bytes),
+                "bytes_faulted": int(tier_st.get("bytes_faulted", 0)),
+                "hot_entries": int(tier_st.get("hot_entries", 0)),
+                "hot_bytes": int(tier_st.get("hot_bytes", 0)),
+                # effective = LOGICAL bytes the queries scanned per
+                # second of wall; physical fault traffic is ratio× less
+                # on the encoded leg
+                "effective_scan_gbps": round(
+                    (logical / max(len(ts), 1)) / max(ms / 1000, 1e-9)
+                    / 1e9, 3),
+            }
+            if enc_st:
+                legs[leg]["encoding"] = enc_st
+        match = all(
+            _frames_equal(frames["raw"][q], frames["encoded"][q])
+            for q in queries)
+        enc = legs["encoded"].get("encoding", {})
+        out = {"available": True, "budget_bytes": int(budget),
+               "ratio": enc.get("ratio"),
+               "raw": legs["raw"], "encoded": legs["encoded"],
+               "resident_gain": round(
+                   legs["encoded"]["hot_entries"]
+                   / max(legs["raw"]["hot_entries"], 1), 2),
+               "answers_match": bool(match)}
+        log(f"encode A-B: ratio {out['ratio']}x, raw "
+            f"{legs['raw']['wall_ms']:.1f}ms / encoded "
+            f"{legs['encoded']['wall_ms']:.1f}ms, resident "
+            f"{legs['raw']['hot_entries']} -> "
+            f"{legs['encoded']['hot_entries']} chunks (match={match})")
+        return out
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _frames_equal(a, b) -> bool:
+    """Order-insensitive equality with float tolerance (shared by the
+    encode A-B differential)."""
+    cols = sorted(a.columns)
+    if cols != sorted(b.columns) or len(a) != len(b):
+        return False
+    a = a[cols].sort_values(cols).reset_index(drop=True)
+    b = b[cols].sort_values(cols).reset_index(drop=True)
+    for c in cols:
+        av, bv = a[c].to_numpy(), b[c].to_numpy()
+        if av.dtype.kind in "fc":
+            if not np.allclose(av.astype(float), bv.astype(float),
+                               rtol=1e-4, atol=1e-8, equal_nan=True):
+                return False
+        elif not np.array_equal(av, bv):
+            return False
+    return True
+
+
 def main():
     sf = float(os.environ.get("SDOT_BENCH_SF", "1.0"))
     reps = int(os.environ.get("SDOT_BENCH_REPS", "5"))
@@ -931,6 +1065,11 @@ def main():
         out["pallas_ab"] = run_pallas_ab()
     except Exception as e:   # noqa: BLE001 — the A-B leg is advisory
         out["pallas_ab"] = {"available": False,
+                            "error": f"{type(e).__name__}: {e}"}
+    try:
+        out["encode_ab"] = run_encode_ab()
+    except Exception as e:   # noqa: BLE001 — the A-B leg is advisory
+        out["encode_ab"] = {"available": False,
                             "error": f"{type(e).__name__}: {e}"}
     if gbps:
         try:

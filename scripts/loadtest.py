@@ -456,6 +456,88 @@ def run_coldtier(args):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_encoded(args):
+    """Encoded-vs-raw differential (encode/ + tier/): checkpoint the
+    SAME synthetic store twice — once raw, once with
+    ``sdot.encode.enabled`` — capture unbudgeted eager answers, then
+    replay the mix through BOTH tiered recoveries under the same
+    ``--budget``. Every reply on both legs is differentially checked
+    against the eager answers (any mismatch exits 1). Reports the
+    on-disk compression ratio, per-leg p50, physical bytes faulted, and
+    hot-set residency at the shared budget — the encoded leg should
+    hold ratio-times more chunks resident for the same bytes."""
+    sys.path.insert(0, ".")
+    import shutil
+    import tempfile
+    import spark_druid_olap_tpu as sdot
+    root = tempfile.mkdtemp(prefix="sdot-encoded-")
+    try:
+        queries = args.sql or DEFAULT_QUERIES
+        budget = int(args.budget)
+        answers = None
+        legs, mismatches = {}, []
+        for leg, enabled in (("raw", False), ("encoded", True)):
+            sub = os.path.join(root, leg)
+            seed = sdot.Context({"sdot.persist.path": sub,
+                                 "sdot.encode.enabled": enabled})
+            seed.ingest_dataframe("sales", _synthetic_sales(),
+                                  time_column="ts", target_rows=8192)
+            col_bytes = sum(
+                c["size"] for c in
+                seed.store.get("sales").metadata()["columns"].values())
+            seed.checkpoint()
+            seed.close()
+            common = {"sdot.persist.path": sub,
+                      "sdot.cache.enabled": False,
+                      "sdot.plan.cache.enabled": False}
+            if answers is None:
+                # eager (unbudgeted, undecoded-store) reference answers
+                eager = sdot.Context(dict(common))
+                answers = {sql: eager.sql(sql).to_pandas()
+                           for sql in queries}
+                eager.close()
+            ctx = sdot.Context({**common, "sdot.tier.enabled": True,
+                                "sdot.tier.budget.bytes": budget,
+                                "sdot.tier.wave.io.bytes":
+                                    max(64 * 1024, budget // 8)})
+            lat = []
+            for _ in range(5):
+                for sql in queries:
+                    t0 = time.perf_counter()
+                    df = ctx.sql(sql).to_pandas()
+                    lat.append((time.perf_counter() - t0) * 1000)
+                    if not _frames_close(answers[sql], df):
+                        mismatches.append(f"{leg}: {sql}")
+            st = ctx.persist.tier.stats_snapshot()
+            enc = ctx.engine.last_stats.get("encoding") or {}
+            ctx.close()
+            legs[leg] = {
+                "p50_ms": round(float(np.percentile(lat, 50)), 2),
+                "column_bytes": int(col_bytes),
+                "bytes_faulted": int(st["bytes_faulted"]),
+                "hot_entries": int(st["hot_entries"]),
+                "hot_bytes": int(st["hot_bytes"]),
+                "ratio": enc.get("ratio", 1.0),
+            }
+            print(f"[encoded] {leg}: p50 {legs[leg]['p50_ms']}ms, "
+                  f"faulted {legs[leg]['bytes_faulted']:,}B, resident "
+                  f"{legs[leg]['hot_entries']} chunks"
+                  + (f", ratio {legs[leg]['ratio']}x"
+                     if enc else ""))
+        out = {"mode": "encoded", "queries": len(queries),
+               "budget_bytes": budget,
+               "ratio": legs["encoded"]["ratio"],
+               "raw": legs["raw"], "encoded": legs["encoded"],
+               "resident_gain": round(
+                   legs["encoded"]["hot_entries"]
+                   / max(legs["raw"]["hot_entries"], 1), 2),
+               "result_mismatches": mismatches}
+        print(json.dumps(out))
+        sys.exit(1 if mismatches else 0)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_coldstart(args):
     """Warm vs cold startup-to-first-result (persist/): build + checkpoint
     a synthetic store, then compare the first-query latency of the live
@@ -2131,8 +2213,16 @@ def main():
                     "cold/hot p50/p99, hit rate, bytes faulted, and "
                     "prefetch overlap (differential mismatch -> exit 1)")
     ap.add_argument("--budget", type=int, default=1 << 20, metavar="BYTES",
-                    help="hot-set byte budget for --coldtier "
+                    help="hot-set byte budget for --coldtier/--encoded "
                     "(default 1 MiB — far under the synthetic store)")
+    ap.add_argument("--encoded", action="store_true",
+                    help="encoded-vs-raw differential: checkpoint the "
+                    "synthetic store raw and with sdot.encode.enabled, "
+                    "replay the mix through both tiered recoveries at "
+                    "the same --budget, check every reply against "
+                    "unbudgeted eager answers (mismatch -> exit 1); "
+                    "reports compression ratio, bytes faulted, and "
+                    "hot-set residency per leg")
     ap.add_argument("--coldstart", action="store_true",
                     help="warm vs cold startup-to-first-result: build + "
                     "checkpoint a synthetic store, then time a fresh "
@@ -2204,6 +2294,8 @@ def main():
         return run_coldstart(args)
     if args.coldtier:
         return run_coldtier(args)
+    if args.encoded:
+        return run_encoded(args)
     if args.sharedscan:
         return run_sharedscan(args)
     if args.wlm:

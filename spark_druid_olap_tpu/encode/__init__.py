@@ -1,0 +1,18 @@
+"""Compressed columnar subsystem: encoded segments on disk and in the
+tiered hot set, with compute pushed onto the encoded form.
+
+Layers (see docs/ENCODING.md for the full matrix):
+
+- :mod:`codecs` — bit-exact encode/decode (raw, bitpack, rle, fordelta)
+- :mod:`chooser` — per-column codec choice at ingest/checkpoint time
+- :mod:`predicates` — dictionary-predicate rewrite (string filters ->
+  code-domain tests; consumed by ops/filters.py)
+- :mod:`exec` — encoded-domain aggregation and pruning (RLE run
+  aggregation, header zone maps, FoR-domain interval pruning)
+
+The on-disk integration lives in persist/snapshot.py (the manifest's
+``encoding`` block) and tier/ (encoded BlobRef faulting); everything
+here is pure numpy with no engine dependencies above ops/.
+"""
+
+from spark_druid_olap_tpu.encode import codecs, chooser, predicates  # noqa: F401

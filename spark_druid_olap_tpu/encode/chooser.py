@@ -1,0 +1,97 @@
+"""Per-column encoding chooser (ingest / checkpoint / compaction time).
+
+One analytic O(n) pass per column (``codecs.estimate_sizes``) scores
+every eligible codec; the winner must beat raw by at least
+``sdot.encode.min.ratio`` or the column stays raw. Heuristics mirror
+what the estimates measure:
+
+- **bool** (validity masks): bitpack, 1 bit/row — 8x, always wins.
+- **dictionary codes**: bitpack at ``ceil(log2(card))`` bits; when the
+  data is sorted/low-cardinality enough that runs/rows falls under
+  ``sdot.encode.rle.max.run.frac``, RLE competes and wins on long runs.
+- **time days** (monotone after ingest's time sort): fordelta — the
+  per-row cost is the delta width, near-zero on dense time ranges.
+- **LONG/DATE metrics**: bitpack over the value range; RLE when runny.
+- **floats**: raw, always (bit-exactness contract; see codecs.py).
+
+The choice is advisory and per COLUMN; the encoder still falls back to
+raw per SEGMENT chunk when a choice fails to shrink a particular chunk
+(``codecs.encode_chunk``), so an adversarial segment never inflates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+from spark_druid_olap_tpu.encode import codecs as CODECS
+
+
+@dataclasses.dataclass(frozen=True)
+class EncodeOptions:
+    """Checkpoint-time encoding policy, resolved from config ONCE by the
+    PersistManager (sdot.encode.*) and threaded through write_snapshot —
+    snapshot.py itself never reads config."""
+
+    enabled: bool = False
+    min_ratio: float = 1.2
+    rle_max_run_frac: float = 0.5
+
+    @classmethod
+    def from_config(cls, conf) -> "EncodeOptions":
+        from spark_druid_olap_tpu.utils.config import (
+            ENCODE_ENABLED, ENCODE_MIN_RATIO, ENCODE_RLE_MAX_RUN_FRAC)
+        return cls(
+            enabled=bool(conf.get(ENCODE_ENABLED)),
+            min_ratio=float(conf.get(ENCODE_MIN_RATIO)),
+            rle_max_run_frac=float(conf.get(ENCODE_RLE_MAX_RUN_FRAC)))
+
+
+def choose_codec(arr: np.ndarray,
+                 opts: EncodeOptions) -> Optional[str]:
+    """Codec name for one column array, or None for raw. Pure function
+    of (array, options) — ingest and compaction choose identically."""
+    if not opts.enabled or arr.ndim != 1 or len(arr) == 0:
+        return None
+    if arr.dtype.kind == "f":
+        return None
+    sizes = CODECS.estimate_sizes(arr)
+    if not sizes:
+        return None
+    if CODECS.RLE in sizes:
+        # near-unique columns degenerate to ~1 run/row; drop the RLE
+        # candidate before it can win on a fluke estimate
+        runs = sizes[CODECS.RLE] // (arr.dtype.itemsize + 4)
+        if runs > opts.rle_max_run_frac * len(arr):
+            sizes.pop(CODECS.RLE)
+    if not sizes:
+        return None
+    codec = min(sizes, key=lambda c: (sizes[c], c))
+    best = max(1, sizes[codec])
+    if arr.nbytes / best < max(1.0, opts.min_ratio):
+        return None
+    return codec
+
+
+def annotate_datasource(ds, opts: Optional[EncodeOptions] = None) -> Dict[str, str]:
+    """Cheap ingest-time hints: codec candidates derivable WITHOUT a
+    data pass (dictionary cardinality -> bitpack width; bool validity ->
+    bitpack). Stored as ``ds.encodings`` for the cost model and
+    observability; the checkpoint-time chooser (which sees the actual
+    arrays) remains authoritative and re-runs ``choose_codec`` per blob."""
+    hints: Dict[str, str] = {}
+    for name, d in ds.dims.items():
+        if d.code_bits < 8 * d.data_dtype().itemsize:
+            hints[name] = CODECS.BITPACK
+        if d.has_nulls():
+            hints["__nulls__" + name] = CODECS.BITPACK
+    for name, m in ds.metrics.items():
+        if m.has_nulls():
+            hints["__nulls__" + name] = CODECS.BITPACK
+    if ds.time is not None:
+        # ingest time-sorts, so days are monotone by construction
+        hints[ds.time.name] = CODECS.FORDELTA
+    ds.encodings = hints
+    return hints

@@ -12,16 +12,22 @@ stacked segment tensors:
 - like/regex/contains -> host regex over the dictionary -> code-mask gather
 - expr      -> compiled XLA predicate (replaces the JavaScript filter)
 - and/or/not, is-null, time-interval masks
+
+The string->code rewrites live in ``encode/predicates.py``: they are the
+dictionary-predicate half of the compressed columnar subsystem (the code
+tests evaluate identically on plain or bit-packed codes, so an encoded
+store filters without ever decoding a string — or even a code — on
+host). This module owns only the device-mask lowering around them.
 """
 
 from __future__ import annotations
 
-import re
 from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np
 
+from spark_druid_olap_tpu.encode import predicates as P
 from spark_druid_olap_tpu.ir import expr as E
 from spark_druid_olap_tpu.ir import spec as S
 from spark_druid_olap_tpu.ops import expr_compile as EC
@@ -71,7 +77,7 @@ def _selector(f: S.SelectorFilter, ctx):
         nv = ctx.null_valid(f.dimension)
         return ~nv if nv is not None else _false(ctx)
     if kind == ColumnKind.DIM:
-        code = ctx.ds.dims[f.dimension].code_of(str(f.value))
+        code = P.selector_code(ctx.ds.dims[f.dimension], f.value)
         if code < 0:
             return _false(ctx)
         return _nullsafe(ctx.col(f.dimension) == code, f.dimension, ctx)
@@ -92,9 +98,8 @@ def _selector(f: S.SelectorFilter, ctx):
 def _bound(f: S.BoundFilter, ctx):
     kind = ctx.kind(f.dimension)
     if kind == ColumnKind.DIM and not f.numeric:
-        lo, hi = ctx.ds.dims[f.dimension].code_range(
-            None if f.lower is None else str(f.lower),
-            None if f.upper is None else str(f.upper),
+        lo, hi = P.bound_code_range(
+            ctx.ds.dims[f.dimension], f.lower, f.upper,
             f.lower_strict, f.upper_strict)
         if lo >= hi:
             return _false(ctx)
@@ -185,8 +190,7 @@ def _in(f: S.InFilter, ctx):
         return _nullsafe(EC.int_set_membership(arr, vals),
                          f.dimension, ctx)
     if kind == ColumnKind.DIM:
-        mask = np.isin(ctx.dictionary(f.dimension).astype(str),
-                       np.array([str(v) for v in f.values]))
+        mask = P.in_code_mask(ctx.dictionary(f.dimension), f.values)
         return _nullsafe(EC._take_mask(mask, ctx.col(f.dimension)),
                          f.dimension, ctx)
     arr = ctx.col(f.dimension)
@@ -206,17 +210,12 @@ def _in(f: S.InFilter, ctx):
 def _pattern(f: S.PatternFilter, ctx):
     if ctx.kind(f.dimension) != ColumnKind.DIM:
         raise EC.Unsupported("pattern filter on non-string column")
-    vals = ctx.dictionary(f.dimension)
-    if f.kind == "like":
-        rx = re.compile(EC.like_to_regex(f.pattern))
-        mask = np.array([bool(rx.match(s)) for s in vals])
-    elif f.kind == "regex":
-        rx = re.compile(f.pattern)
-        mask = np.array([bool(rx.search(s)) for s in vals])
-    elif f.kind == "contains":
-        mask = np.array([f.pattern in s for s in vals])
-    else:
-        raise EC.Unsupported(f"pattern kind {f.kind}")
+    try:
+        mask = P.pattern_code_mask(ctx.dictionary(f.dimension), f.kind,
+                                   f.pattern,
+                                   like_to_regex=EC.like_to_regex)
+    except ValueError:
+        raise EC.Unsupported(f"pattern kind {f.kind}") from None
     return _nullsafe(EC._take_mask(mask, ctx.col(f.dimension)),
                      f.dimension, ctx)
 
@@ -274,8 +273,7 @@ def interval_mask(intervals, ctx: ScanContext):
     ms = ctx.time_ms()
     out = None
     for lo, hi in intervals:
-        dlo, rlo = divmod(int(lo), time_ops.MILLIS_PER_DAY)
-        dhi, rhi = divmod(int(hi), time_ops.MILLIS_PER_DAY)
+        dlo, rlo, dhi, rhi = time_ops.interval_day_range(lo, hi)
         # open-ended interval bounds carry +-2^63-scale ms; their day
         # numbers overflow the i32 lanes on a 32-bit backend. Scanned days
         # all lie in [min_day, max_day], so clamping one day past that

@@ -230,7 +230,15 @@ def _prep_dtype(dt) -> object:
     """Kernel-side dtype of one union array after input prep: validity
     masks ship as i8 (converted back to bool tiles in the kernel body),
     narrow integer codes widen to i32 (uniform Mosaic tiling), everything
-    else keeps its (device-canonicalized) dtype."""
+    else keeps its (device-canonicalized) dtype.
+
+    Encoded segments (encode/) do NOT change this contract: chunks
+    decode to their logical dtype at fault time (tier/store.py), so the
+    kernel always sees the same widened tiles whether the cold bytes
+    were bit-packed, RLE, or raw — compression buys host I/O and hot-set
+    residency, never a divergent Mosaic tiling. Feeding packed codes
+    straight into the kernel would need a per-codec unpack prologue and
+    a different (data-dependent) tile plan; see docs/KERNELS.md."""
     dt = jnp.zeros((), dtype=dt).dtype      # apply x64 canonicalization
     if dt == jnp.bool_:
         return jnp.int8

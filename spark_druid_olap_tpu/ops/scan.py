@@ -177,8 +177,11 @@ def build_array(ds: Datasource, key: str,
     tb = getattr(ds, "_tier_build", None)
     if tb is not None:
         # tiered store: fault only the requested segments' chunks into
-        # the stacked layout (tier/handles.py). None means the key is
-        # metadata-only (row validity) — fall through to the base path.
+        # the stacked layout (tier/handles.py). Encoded chunks decode
+        # inside the fault (tier/store.py), so this path returns
+        # logical-dtype rows either way — the device never sees packed
+        # bytes. None means the key is metadata-only (row validity) —
+        # fall through to the base path.
         out = tb(key, segment_indices, pad_segments_to)
         if out is not None:
             return out

@@ -25,6 +25,18 @@ import numpy as np
 MILLIS_PER_DAY = 86_400_000
 
 
+def interval_day_range(lo_ms: int, hi_ms: int):
+    """Split a [lo_ms, hi_ms) interval into the (day, millis-in-day)
+    split the engine stores time in: (day_lo, rem_lo, day_hi, rem_hi).
+    Shared by the device residual mask (ops/filters.py:interval_mask)
+    and the FoR-domain chunk pruning (encode/exec.py) — a fordelta time
+    chunk whose header day bounds miss [day_lo, day_hi] is skipped
+    without decoding, the same arithmetic either way."""
+    day_lo, rem_lo = divmod(int(lo_ms), MILLIS_PER_DAY)
+    day_hi, rem_hi = divmod(int(hi_ms), MILLIS_PER_DAY)
+    return day_lo, rem_lo, day_hi, rem_hi
+
+
 def civil_from_days(days):
     """days-since-epoch -> (year, month, day), vectorized int32.
 

@@ -311,9 +311,26 @@ def tier_io_budget(ds, conf) -> Optional[int]:
     return b if b > 0 else None
 
 
+def tier_io_seg_bytes(ds, names) -> Optional[int]:
+    """Per-segment HOST bytes one wave actually faults for the named
+    scan keys — COMPRESSED bytes on an encoded tiered store
+    (``TieredDatasource.host_bytes_per_segment``), None elsewhere. This
+    is the divisor for the cold-tier io cap: an encoded store moves
+    ratio× fewer bytes per segment, so the same ``sdot.tier.wave.io.
+    bytes`` admits ratio× more segments per wave. The HBM-budget term
+    keeps using the LOGICAL ``bytes_per_segment`` — chunks decode
+    before device binding, so device bytes are unchanged by encoding."""
+    fn = getattr(ds, "host_bytes_per_segment", None)
+    if fn is None:
+        return None
+    b = int(fn(names))
+    return b if b > 0 else None
+
+
 def plan_waves(n_segments: int, n_dev: int, seg_bytes: int,
                budget: Optional[int], conf, output_groups: int,
-               n_aggs: int, io_budget: Optional[int] = None) -> tuple:
+               n_aggs: int, io_budget: Optional[int] = None,
+               io_seg_bytes: Optional[int] = None) -> tuple:
     """Min-cost search over segments-per-wave (≈ the reference's
     ``druidQueryMethod`` searching 1..histSegsPerQueryLimit,
     DruidQueryCostModel.scala:343-414). Each wave costs a dispatch plus a
@@ -321,6 +338,9 @@ def plan_waves(n_segments: int, n_dev: int, seg_bytes: int,
     one device must fit ``budget`` bytes. ``io_budget`` additionally caps
     one WAVE's total host bytes (all devices) — the cold-tier I/O term
     (``tier_io_budget``) that keeps load-behind-compute overlap full.
+    ``io_seg_bytes`` is the per-segment divisor for that I/O term when the
+    faulted bytes differ from the device bytes (encoded tiered stores,
+    ``tier_io_seg_bytes``); it defaults to ``seg_bytes``.
 
     Returns (segments_per_wave, n_waves); segments_per_wave is a multiple of
     n_dev.
@@ -338,8 +358,9 @@ def plan_waves(n_segments: int, n_dev: int, seg_bytes: int,
     if budget is not None and seg_bytes > 0:
         per_dev = int(budget // seg_bytes)
         cap = min(cap, max(1, per_dev) * n_dev)
-    if io_budget is not None and seg_bytes > 0:
-        per_wave = max(1, int(io_budget // seg_bytes))
+    io_div = io_seg_bytes if io_seg_bytes is not None else seg_bytes
+    if io_budget is not None and io_div > 0:
+        per_wave = max(1, int(io_budget // io_div))
         cap = min(cap, -(-per_wave // n_dev) * n_dev)
     return cap, -(-n_segments // cap)
 

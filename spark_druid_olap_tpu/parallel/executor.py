@@ -69,6 +69,7 @@ from spark_druid_olap_tpu.utils.config import (
     TZ_ID,
     BACKEND_RETRY_SECONDS,
     DEVICE_CACHE_BYTES,
+    ENCODE_ENABLED,
     GROUPBY_DENSE_MAX_KEYS,
     SCAN_COMPACT,
     SCAN_COMPACT_MIN_ROWS,
@@ -949,6 +950,9 @@ class QueryEngine:
                 if pin_tok is not None:
                     tier.release_pins(pin_tok)
                     self.last_stats["tier"] = tier.stats_snapshot()
+                    enc_info = getattr(tier_ds, "encoding_info", None)
+                    if enc_info is not None:
+                        self.last_stats["encoding"] = enc_info()
             finally:
                 if qid is not None:
                     self.release_query(qid)
@@ -1199,7 +1203,8 @@ class QueryEngine:
             len(seg_idx), n_dev, seg_bytes,
             C.wave_budget_bytes(self.config), self.config, n_keys,
             len(agg_plans),
-            io_budget=C.tier_io_budget(ds, self.config))
+            io_budget=C.tier_io_budget(ds, self.config),
+            io_seg_bytes=C.tier_io_seg_bytes(ds, names))
         s_pad = spw if n_waves > 1 else _pad_segments(len(seg_idx), n_dev)
         n_seg_sel = len(seg_idx)
         multihost = sharded and MH.is_multihost()
@@ -1222,7 +1227,9 @@ class QueryEngine:
                     min_day, max_day, sharded, n_dev, tuple(names),
                     self.config.get(TZ_ID),
                     self.config.get(GROUPBY_MATMUL_MAX_KEYS),
-                    self.config.get(HLL_LOG2M), jax.default_backend(),
+                    self.config.get(HLL_LOG2M),
+                    bool(self.config.get(ENCODE_ENABLED)),
+                    jax.default_backend(),
                     bool(jax.config.jax_enable_x64),
                     bool(self.config.get(SHAREDSCAN_FUSION_ENABLED)))
         if having_dev:
@@ -1663,7 +1670,8 @@ class QueryEngine:
             len(seg_idx), n_dev, seg_bytes,
             C.wave_budget_bytes(self.config), self.config,
             min(rows_sel, T), len(agg_plans),
-            io_budget=C.tier_io_budget(ds, self.config))
+            io_budget=C.tier_io_budget(ds, self.config),
+            io_seg_bytes=C.tier_io_seg_bytes(ds, names))
         s_pad = spw if n_waves > 1 else _pad_segments(len(seg_idx), n_dev)
         n_seg_sel = len(seg_idx)
         multihost = sharded and MH.is_multihost()
@@ -1734,6 +1742,7 @@ class QueryEngine:
                    self.config.get(TZ_ID),
                    self.config.get(GROUPBY_MATMUL_MAX_KEYS),
                    self.config.get(HLL_LOG2M),
+                   bool(self.config.get(ENCODE_ENABLED)),
                    jax.default_backend(), bool(jax.config.jax_enable_x64),
                    bool(self.config.get(SHAREDSCAN_FUSION_ENABLED)))
 

@@ -159,7 +159,7 @@ def _publish_generation(manager, name: str, old_ds, new_ds,
             inj.fire("compact.publish", key=name)
         manifest = SNAP.write_snapshot(
             manager._ds_root(name), new_ds, ingest_version, covered,
-            keep=manager.keep)
+            keep=manager.keep, encode=manager.encode)
         # the new generation is durable — only now may the journal
         # records it covers go (a crash here replays nothing onto it;
         # a crash before the replace recovers the old generation + WAL)

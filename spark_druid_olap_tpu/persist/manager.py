@@ -101,6 +101,13 @@ class PersistManager:
         self.compact_interval_s = float(cfg.get(PERSIST_COMPACT_SECONDS))
         self.compact_min_segments = int(
             cfg.get(PERSIST_COMPACT_MIN_SEGMENTS))
+        # checkpoint-time columnar encoding policy (sdot.encode.*),
+        # resolved once here and threaded through every write_snapshot —
+        # checkpoint and compaction publish with the same policy, WAL
+        # tails stay raw rows by construction (the journal never goes
+        # through the snapshot writer)
+        from spark_druid_olap_tpu.encode.chooser import EncodeOptions
+        self.encode = EncodeOptions.from_config(cfg)
         self._wals: Dict[str, WAL.WriteAheadLog] = {}
         self._wal_seq: Dict[str, int] = {}      # last seq ASSIGNED, per ds
         self._reg_seq: Dict[str, int] = {}      # last seq REGISTERED, per ds
@@ -537,7 +544,8 @@ class PersistManager:
                     # for the next pass.
                     self.fault.fire("snapshot.write", key=name)
                 manifest = SNAP.write_snapshot(
-                    self._ds_root(name), ds, iv, wal_seq, keep=self.keep)
+                    self._ds_root(name), ds, iv, wal_seq, keep=self.keep,
+                    encode=self.encode)
                 # snapshot covers every journaled record at or below the
                 # registered watermark — drop them (in-flight frames
                 # past it survive the rewrite)

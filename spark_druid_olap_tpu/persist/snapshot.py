@@ -84,6 +84,30 @@ def _array_blob(dirpath: str, rel: str, arr: np.ndarray,
                 files, {"dtype": arr.dtype.str, "shape": list(arr.shape)})
 
 
+def _encoded_blob(dirpath: str, rel: str, arr: np.ndarray, bounds,
+                  files: Dict[str, dict], codec: str) -> None:
+    """One column blob as concatenated per-SEGMENT encoded chunks, so a
+    tiered store can fault any segment's byte range independently. The
+    file meta grows a self-describing ``enc`` block — ``codec`` plus one
+    ``[byte_off, byte_len, header]`` entry per segment (headers carry
+    the chunk's codec, row count, params, and integer value bounds; see
+    encode/codecs.py) — while ``dtype``/``shape`` keep describing the
+    LOGICAL array, exactly as the raw format does. A chunk the codec
+    fails to shrink stays raw inside the same file (encode_chunk's
+    fallback), so encoding never inflates a segment."""
+    from spark_druid_olap_tpu.encode import codecs as EN
+    segs, parts, off = [], [], 0
+    for s, e in bounds:
+        payload, header = EN.encode_chunk(
+            np.ascontiguousarray(arr[s:e]), codec)
+        parts.append(payload)
+        segs.append([off, len(payload), header])
+        off += len(payload)
+    _write_blob(dirpath, rel, b"".join(parts), files,
+                {"dtype": arr.dtype.str, "shape": list(arr.shape),
+                 "enc": {"codec": codec, "segments": segs}})
+
+
 def version_dirname(version: int) -> str:
     return f"v{int(version):010d}"
 
@@ -116,13 +140,22 @@ def current_version(ds_root: str) -> Optional[int]:
 
 
 def write_snapshot(ds_root: str, ds, ingest_version: int,
-                   wal_seq: int, keep: int = 2) -> dict:
+                   wal_seq: int, keep: int = 2, encode=None) -> dict:
     """Publish one snapshot of a COMPLETE datasource; returns the
     manifest. Atomic: temp dir -> rename -> CURRENT pointer swap. The
     on-disk version is allocated (max existing + 1), never reused: an
     in-place replace of an existing version dir would open a crash
     window with no directory behind CURRENT after the covering WAL
-    records were already truncated."""
+    records were already truncated.
+
+    ``encode`` (an :class:`encode.chooser.EncodeOptions`, None = raw)
+    turns on per-column compressed blobs: the chooser picks a codec per
+    column, columns it declines stay raw, and the manifest's per-file
+    ``enc`` blocks make the result self-describing — a reader that
+    predates the encoding block only ever sees it on snapshots it never
+    wrote, and readers here fall back to the raw path whenever the
+    block is absent, so raw and encoded versions interoperate under one
+    CURRENT pointer with zero manifest-format churn."""
     ds.require_complete("checkpoint")
     os.makedirs(ds_root, exist_ok=True)
     # collect temp dirs a crashed previous publish left behind
@@ -135,7 +168,7 @@ def write_snapshot(ds_root: str, ds, ingest_version: int,
     os.makedirs(tmp, exist_ok=True)
     try:
         return _fill_and_publish(ds_root, ds, ingest_version, wal_seq,
-                                 keep, publish_version, tmp)
+                                 keep, publish_version, tmp, encode)
     except BaseException:
         # a failed publish must not strand the temp dir until the next
         # write_snapshot's sweep — a crash-restart loop would otherwise
@@ -145,8 +178,32 @@ def write_snapshot(ds_root: str, ds, ingest_version: int,
 
 
 def _fill_and_publish(ds_root: str, ds, ingest_version: int, wal_seq: int,
-                      keep: int, publish_version: int, tmp: str) -> dict:
+                      keep: int, publish_version: int, tmp: str,
+                      encode=None) -> dict:
     files: Dict[str, dict] = {}
+    enc_cols: Dict[str, str] = {}
+    enc_raw_bytes = 0
+
+    def _column_blob(rel: str, arr: np.ndarray) -> None:
+        # per-column codec choice at publish time: the chooser measures
+        # the actual array (not the ingest-time hint) so a compaction
+        # that re-sorts or widens a column re-chooses its codec; columns
+        # the chooser declines (floats, high-entropy ints, ratio below
+        # sdot.encode.min.ratio) stay raw in the SAME snapshot
+        nonlocal enc_raw_bytes
+        codec = None
+        if encode is not None and getattr(encode, "enabled", False):
+            from spark_druid_olap_tpu.encode import chooser as _chooser
+            codec = _chooser.choose_codec(np.asarray(arr), encode)
+        if codec is None:
+            _array_blob(tmp, rel, arr, files)
+        else:
+            _encoded_blob(tmp, rel, arr,
+                          [(s.start_row, s.end_row) for s in ds.segments],
+                          files, codec)
+            enc_cols[rel] = codec
+            enc_raw_bytes += int(arr.nbytes)
+
     manifest = {
         "format": FORMAT_VERSION,
         "datasource": ds.name,
@@ -163,14 +220,14 @@ def _fill_and_publish(ds_root: str, ds, ingest_version: int, wal_seq: int,
         "metrics": [],
     }
     if ds.time is not None:
-        _array_blob(tmp, "time_days.bin", ds.time.days, files)
-        _array_blob(tmp, "time_ms.bin", ds.time.ms_in_day, files)
+        _column_blob("time_days.bin", ds.time.days)
+        _column_blob("time_ms.bin", ds.time.ms_in_day)
         manifest["time"] = {"name": ds.time.name,
                             "days": "time_days.bin", "ms": "time_ms.bin"}
     for i, (name, d) in enumerate(ds.dims.items()):
         codes_f = f"dim_{i:04d}_codes.bin"
         dict_f = f"dim_{i:04d}_dict.json"
-        _array_blob(tmp, codes_f, d.codes, files)
+        _column_blob(codes_f, d.codes)
         _write_blob(tmp, dict_f,
                     json.dumps([str(v) for v in d.dictionary]).encode(),
                     files, {"json": True})
@@ -178,12 +235,12 @@ def _fill_and_publish(ds_root: str, ds, ingest_version: int, wal_seq: int,
                  "validity": None}
         if d.validity is not None:
             vf = f"dim_{i:04d}_valid.bin"
-            _array_blob(tmp, vf, d.validity, files)
+            _column_blob(vf, d.validity)
             entry["validity"] = vf
         manifest["dims"].append(entry)
     for i, (name, m) in enumerate(ds.metrics.items()):
         vals_f = f"met_{i:04d}_values.bin"
-        _array_blob(tmp, vals_f, m.values, files)
+        _column_blob(vals_f, m.values)
         # global (min, max) over valid rows: the cost model's
         # selectivity input. Publishing it keeps a TIERED recovery from
         # faulting a whole column just to plan (tier/loader.py injects
@@ -194,13 +251,32 @@ def _fill_and_publish(ds_root: str, ds, ingest_version: int, wal_seq: int,
                  "validity": None,
                  "min": None if mn is None else float(mn),
                  "max": None if mx is None else float(mx)}
+        # per-SEGMENT (min, max) zone maps, same additive contract as the
+        # global pair above: tiered recovery injects them so broker /
+        # planner pruning never faults a cold blob just to bound a
+        # segment. None marks a segment with no valid rows (JSON has no
+        # +/-inf), which prunes nothing — exactly the in-memory
+        # semantics of an all-null segment's (inf, -inf) bounds.
+        smin, smax = ds.segment_metric_bounds(name)
+        entry["seg_bounds"] = [
+            [float(lo), float(hi)] if np.isfinite(lo) and np.isfinite(hi)
+            else None for lo, hi in zip(smin, smax)]
         if m.validity is not None:
             vf = f"met_{i:04d}_valid.bin"
-            _array_blob(tmp, vf, m.validity, files)
+            _column_blob(vf, m.validity)
             entry["validity"] = vf
         manifest["metrics"].append(entry)
     manifest["files"] = files
     manifest["bytes"] = sum(e["bytes"] for e in files.values())
+    if enc_cols:
+        from spark_druid_olap_tpu.encode import codecs as EN
+        enc_bytes = sum(files[rel]["bytes"] for rel in enc_cols)
+        manifest["encoding"] = {
+            "version": EN.ENCODING_VERSION,
+            "columns": enc_cols,
+            "raw_bytes": int(enc_raw_bytes),
+            "encoded_bytes": int(enc_bytes),
+        }
 
     with open(os.path.join(tmp, MANIFEST), "w") as f:
         json.dump(manifest, f)
@@ -297,6 +373,38 @@ def _read_blob(vdir: str, rel: str, files: dict, verify: bool) -> bytes:
 def _read_array(vdir: str, rel: str, files: dict, verify: bool) -> np.ndarray:
     data = _read_blob(vdir, rel, files, verify)
     meta = files[rel]
+    enc = meta.get("enc")
+    if enc is not None:
+        # encoded blob: decode the per-segment chunks back to the
+        # logical array (the eager recovery path; tiered recovery keeps
+        # the bytes encoded and decodes on fault instead). Manifests
+        # without an ``enc`` block — every pre-encoding snapshot —
+        # never reach this branch, so the raw path below stays
+        # byte-for-byte what it always was.
+        from spark_druid_olap_tpu.encode import codecs as EN
+        dt = np.dtype(meta["dtype"])
+        mv = memoryview(data)
+        parts = []
+        try:
+            for off, length, header in enc["segments"]:
+                parts.append(EN.decode_array(mv[off:off + length], header))
+        except (EN.EncodingError, KeyError, ValueError, TypeError) as e:
+            raise SnapshotCorrupt(f"blob {rel}: bad encoded chunk: {e}") \
+                from e
+        arr = np.concatenate(parts) if parts else np.empty(0, dtype=dt)
+        if arr.dtype != dt:
+            raise SnapshotCorrupt(
+                f"blob {rel}: decoded dtype {arr.dtype.str}, "
+                f"manifest says {meta['dtype']}")
+        try:
+            arr = arr.reshape(meta.get("shape", [-1]))
+        except ValueError as e:
+            raise SnapshotCorrupt(
+                f"blob {rel}: decoded {arr.size} elements, manifest "
+                f"shape {meta.get('shape')}") from e
+        if arr.size and not arr.flags.writeable:
+            arr = arr.copy()
+        return arr
     arr = np.frombuffer(data, dtype=np.dtype(meta["dtype"]))
     # writable copy: Datasource caches mutate nothing, but downstream
     # numpy ops (e.g. in-place sorts in tests) must not hit a read-only

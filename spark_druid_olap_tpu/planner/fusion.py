@@ -248,7 +248,12 @@ def plan_wave_tiles(itemsizes: Sequence[int],
     (``maxabs * block_rows < 2^24`` — the same invariant as
     ops/pallas_groupby.py:choose_block_rows, which this generalizes to
     a multi-lane scratch layout). Deterministic from plan metadata alone
-    so the compile signature and the kernel dispatch always agree."""
+    so the compile signature and the kernel dispatch always agree.
+
+    ``itemsizes`` are the POST-prep widths (``_prep_dtype``): on an
+    encoded store the cold bytes may be bit-packed, but chunks decode
+    at fault time, so the VMEM tiles budgeted here are always logical-
+    width — encoding never perturbs the tile plan or the signature."""
     lanes = 128                    # TPU VPU lane width (minor axis)
     per_row = lanes * max(1, int(sum(itemsizes)))
     scratch = int(scratch_rows) * lanes * 4

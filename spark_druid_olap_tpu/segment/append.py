@@ -250,9 +250,16 @@ def append_dataframe(ds: Datasource, df: pd.DataFrame,
             min_millis=int(millis[s:e].min()),
             max_millis=int(millis[s:e].max())))
 
-    return Datasource(name=ds.name, time=time_col, dims=dims,
-                      metrics=mets, segments=segments,
-                      spatial=dict(ds.spatial))
+    out = Datasource(name=ds.name, time=time_col, dims=dims,
+                     metrics=mets, segments=segments,
+                     spatial=dict(ds.spatial))
+    # re-derive encoding hints rather than carrying the parent's: an
+    # append can widen dictionaries or break a column's sortedness, so
+    # stale hints would steer the checkpoint-time chooser wrong. Cheap —
+    # O(schema), not O(rows).
+    from spark_druid_olap_tpu.encode import chooser as _enc_chooser
+    _enc_chooser.annotate_datasource(out)
+    return out
 
 
 # JSON-serializable keys of the ingest kwargs a WAL create record carries

@@ -58,6 +58,15 @@ class DimColumn:
     def cardinality(self) -> int:
         return int(len(self.dictionary))
 
+    @property
+    def code_bits(self) -> int:
+        """Bits per code at this dictionary's cardinality — the
+        bit-packed width an encoded snapshot stores codes at
+        (encode/codecs.py bitpack; the ingest-time chooser hint).
+        Metadata-only: derived from the dictionary, never the codes, so
+        it is free on tiered columns."""
+        return max(1, int(max(self.cardinality - 1, 0)).bit_length())
+
     def code_of(self, value: str) -> int:
         """Binary-search a value; -1 if absent (selector on absent value ==
         constant-false filter)."""

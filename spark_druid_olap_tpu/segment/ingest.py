@@ -183,6 +183,12 @@ def ingest_dataframe(
 
     ds = Datasource(name=name, time=time_col, dims=dims, metrics=mets,
                     segments=segments, spatial=spatial)
+    # ingest-time encoding hints (cheap, O(schema)): candidate codec per
+    # column from dictionary cardinality / sortedness, consumed by the
+    # checkpoint-time chooser as a starting point. Advisory only — the
+    # snapshot writer re-measures the actual arrays before encoding.
+    from spark_druid_olap_tpu.encode import chooser as _enc_chooser
+    _enc_chooser.annotate_datasource(ds)
     if n_hosts is not None and n_hosts > 1:
         # multi-host partial ingest (in-memory path): every process
         # ingests the same frame deterministically, then keeps only its

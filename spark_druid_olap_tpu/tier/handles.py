@@ -269,6 +269,16 @@ class TieredDatasource(Datasource):
             return super().segment_metric_bounds(name)
         column, ra = ent
         vent = self._tier_refs.get(NULLS_PREFIX + name)
+        if vent is None:
+            # encoded columns carry per-chunk (vmin, vmax) in the codec
+            # headers: zone maps come straight off the refs with ZERO
+            # faults. Only valid without a null mask — headers bound
+            # every stored value, including rows a validity mask voids.
+            from spark_druid_olap_tpu.encode import exec as EX
+            hb = EX.segment_bounds_from_refs(ra.refs)
+            if hb is not None:
+                self._bounds_cache[name] = hb
+                return hb
         mins = np.full(self.num_segments, np.inf)
         maxs = np.full(self.num_segments, -np.inf)
         for i in range(self.num_segments):
@@ -286,6 +296,42 @@ class TieredDatasource(Datasource):
                 maxs[i] = v.max()
         self._bounds_cache[name] = (mins, maxs)
         return mins, maxs
+
+    # -- encoded-store metadata ----------------------------------------------
+    def host_bytes_per_segment(self, names=None) -> int:
+        """Max over segments of the summed HOT-SET bytes the given scan
+        keys fault for one segment — compressed bytes for encoded refs,
+        logical bytes for raw ones. The wave planner divides its io
+        budget by THIS instead of the logical segment size, so a
+        compressed store admits ratio× more segments per wave under the
+        same ``sdot.tier.wave.io.bytes``."""
+        keys = list(self._tier_refs) if names is None else \
+            [k for k in names if k in self._tier_refs]
+        best = 0
+        for i in range(self.num_segments):
+            tot = 0
+            for k in keys:
+                tot += self._tier_refs[k][1].refs[i].nbytes
+            best = max(best, tot)
+        return best
+
+    def encoding_info(self) -> dict:
+        """Residency economics of this datasource's encoded refs (the
+        source of the executor's ``last_stats["encoding"]``)."""
+        enc_bytes = dec_bytes = 0
+        cols = set()
+        for key, (_, ra) in self._tier_refs.items():
+            for r in ra.refs:
+                if r.enc is not None:
+                    enc_bytes += r.nbytes
+                    dec_bytes += r.decoded_nbytes
+                    cols.add(key)
+        return {
+            "encoded_keys": len(cols),
+            "encoded_bytes": int(enc_bytes),
+            "decoded_bytes": int(dec_bytes),
+            "ratio": round(dec_bytes / enc_bytes, 3) if enc_bytes else 1.0,
+        }
 
     # -- escape hatch ---------------------------------------------------------
     def materialize(self) -> Datasource:

@@ -48,13 +48,48 @@ def _ref_array(vdir: str, rel: str, files: dict,
             f"blob {rel}: {size} bytes on disk, manifest says "
             f"{meta['bytes']}")
     dtype = np.dtype(meta["dtype"])
+    total = bounds[-1][1] if bounds else 0
+    enc = meta.get("enc")
+    if enc is not None:
+        # encoded blob: the file holds concatenated per-segment
+        # compressed chunks; verify the chunk table covers the file and
+        # the segment map exactly, then hand out byte-range refs. The
+        # codec header rides each ref as a JSON string so fault-time
+        # decode and header-level zone maps never reopen the manifest.
+        segs = enc.get("segments", [])
+        if len(segs) != len(bounds):
+            raise SnapshotCorrupt(
+                f"blob {rel}: {len(segs)} encoded chunks, segment map "
+                f"says {len(bounds)}")
+        rows = sum(int(h["n"]) for _, _, h in segs)
+        if rows != total:
+            raise SnapshotCorrupt(
+                f"blob {rel}: encoded chunks hold {rows} rows, segment "
+                f"map says {total}")
+        span = (int(segs[-1][0]) + int(segs[-1][1])) if segs else 0
+        if span != size:
+            raise SnapshotCorrupt(
+                f"blob {rel}: chunk table spans {span} bytes, file has "
+                f"{size}")
+        refs = []
+        for (s, e), (off, length, header) in zip(bounds, segs):
+            if int(header["n"]) != e - s:
+                raise SnapshotCorrupt(
+                    f"blob {rel}: chunk at {off} holds {header['n']} "
+                    f"rows, segment [{s}, {e}) wants {e - s}")
+            refs.append(BlobRef(
+                path=path, dtype=dtype.str, start=int(s),
+                count=int(e - s), crc=int(meta["crc"]),
+                file_bytes=int(meta["bytes"]),
+                enc=json.dumps(header, sort_keys=True),
+                byte_start=int(off), byte_len=int(length)))
+        return RefArray(refs=tuple(refs), dtype=dtype.str)
     shape = meta.get("shape", None)
     n = int(np.prod(shape, dtype=np.int64)) if shape is not None \
         else size // dtype.itemsize
     if n * dtype.itemsize != size:
         raise SnapshotCorrupt(
             f"blob {rel}: {size} bytes is not {n} x {dtype}")
-    total = bounds[-1][1] if bounds else 0
     if n != total:
         raise SnapshotCorrupt(
             f"blob {rel}: {n} elements, segment map says {total}")
@@ -133,6 +168,19 @@ def load_tiered_snapshot(ds_root: str, version: int,
         spatial={k: tuple(v) for k, v in manifest["spatial"].items()},
         tier=tier)
     ds._index_refs()
+    # per-segment zone maps from the manifest (``seg_bounds``, written
+    # alongside the global min/max): with these injected, broker and
+    # planner pruning over a freshly recovered tiered store never
+    # decodes a chunk or faults a cold blob just to bound a segment.
+    # None entries are all-null segments -> (inf, -inf), prune-nothing.
+    for e in manifest["metrics"]:
+        sb = e.get("seg_bounds")
+        if sb is not None and len(sb) == len(segments):
+            mins = np.array([np.inf if b is None else float(b[0])
+                             for b in sb])
+            maxs = np.array([-np.inf if b is None else float(b[1])
+                             for b in sb])
+            ds._bounds_cache[e["name"]] = (mins, maxs)
     return ds, manifest, (time.perf_counter() - t0) * 1000.0
 
 

@@ -55,14 +55,24 @@ from spark_druid_olap_tpu.persist.snapshot import SnapshotCorrupt
 @dataclasses.dataclass(frozen=True)
 class BlobRef:
     """One element range of a snapshot blob file (a 1-D column array):
-    the unit the hot set faults, pins, and evicts."""
+    the unit the hot set faults, pins, and evicts.
+
+    An ENCODED ref (``enc`` set) additionally carries the byte range of
+    its compressed chunk and the chunk's codec header as a JSON string
+    (strings keep the dataclass hashable). The hot set then holds the
+    compressed payload and ``nbytes`` is the COMPRESSED size — the same
+    byte budget keeps ratio× more segments resident — while ``dtype``/
+    ``count`` still describe the logical rows a fault decodes to."""
 
     path: str          # absolute blob file path (inside a version dir)
     dtype: str         # numpy dtype str (manifest "dtype")
-    start: int         # element offset into the blob
-    count: int         # element count
+    start: int         # element offset into the blob (logical rows)
+    count: int         # element count (logical rows)
     crc: int           # whole-file CRC32 from the manifest
     file_bytes: int    # whole-file size from the manifest
+    enc: Optional[str] = None   # JSON codec header (encode/codecs.py)
+    byte_start: int = 0         # chunk byte offset (encoded refs)
+    byte_len: int = -1          # chunk byte length (encoded refs)
 
     @property
     def itemsize(self) -> int:
@@ -70,7 +80,23 @@ class BlobRef:
 
     @property
     def nbytes(self) -> int:
+        """Hot-set residency cost: compressed bytes for encoded refs,
+        logical bytes for raw ones."""
+        if self.enc is not None:
+            return max(0, int(self.byte_len))
         return int(self.count) * self.itemsize
+
+    @property
+    def decoded_nbytes(self) -> int:
+        """Logical (decoded) size — what a query actually scans."""
+        return int(self.count) * self.itemsize
+
+    def header(self) -> Optional[dict]:
+        """Parsed codec header (None for raw refs)."""
+        if self.enc is None:
+            return None
+        import json
+        return json.loads(self.enc)
 
 
 class _Entry:
@@ -176,7 +202,21 @@ class TieredColumnStore:
               prefetch: bool = False) -> np.ndarray:
         """The chunk's hot ndarray, loading it from the cold tier if
         needed. Demand faults (prefetch=False) pin into the calling
-        thread's open tokens and count hit/prefetch-overlap stats."""
+        thread's open tokens and count hit/prefetch-overlap stats.
+
+        Encoded refs are held hot in COMPRESSED form and decoded here,
+        per serve, OUTSIDE the store lock — the decode is per-segment
+        numpy work and must not serialize concurrent faulting threads.
+        Prefetch serves skip the decode (the prefetcher only warms
+        bytes; the later demand fault pays the decode it needs)."""
+        stored = self._fault_stored(ds_name, column, ref, prefetch)
+        if ref.enc is None or prefetch:
+            return stored
+        from spark_druid_olap_tpu.encode import codecs as EN
+        return EN.decode_array(stored, ref.header())
+
+    def _fault_stored(self, ds_name: str, column: str, ref: BlobRef,
+                      prefetch: bool) -> np.ndarray:
         key = (ds_name, ref.path, int(ref.start), int(ref.count))
         with self._lock:
             e = self._hot.get(key)
@@ -242,6 +282,18 @@ class TieredColumnStore:
             # chaos site: delay = slow cold read, error = mmap I/O error
             inj.fire("tier.read", key=ref.path)
         self._verify_blob(ds_name, ref)
+        if ref.enc is not None:
+            # encoded chunk: the stored hot entry IS the compressed
+            # payload (uint8); decode happens on serve, in fault()
+            n = max(0, int(ref.byte_len))
+            with open(ref.path, "rb") as f:
+                f.seek(int(ref.byte_start))
+                data = f.read(n)
+            if len(data) != n:
+                raise SnapshotCorrupt(
+                    f"cold blob {os.path.basename(ref.path)}: short read "
+                    f"({len(data)} of {n} bytes at {ref.byte_start})")
+            return np.frombuffer(data, dtype=np.uint8)
         if ref.count == 0:
             return np.empty(0, dtype=np.dtype(ref.dtype))
         mm = np.memmap(ref.path, dtype=np.dtype(ref.dtype), mode="r",

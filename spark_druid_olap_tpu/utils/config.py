@@ -735,6 +735,28 @@ TIER_WAVE_IO_BYTES = _entry(
     "that prefetch can overlap loads with compute. 0 disables the "
     "term. Semantic: changes the wave composition and with it float "
     "accumulation order.", int)
+# --- compressed columnar encoding (encode/) -----------------------------------
+ENCODE_ENABLED = _entry(
+    "sdot.encode.enabled", False,
+    "Write snapshot column blobs ENCODED (bit-packed dictionary codes, "
+    "RLE runs, frame-of-reference+delta time columns — encode/codecs.py) "
+    "with a per-column chooser at checkpoint/compaction time. Snapshots "
+    "without an encoding block load as raw little-endian unchanged; a "
+    "tiered recovery faults encoded bytes, so the hot-set budget holds "
+    "compression-ratio x more data. Decoded arrays are bit-identical to "
+    "the raw path; the flag is still folded into compile signatures "
+    "defensively.", semantic=False)
+ENCODE_MIN_RATIO = _entry(
+    "sdot.encode.min.ratio", 1.2,
+    "Minimum whole-column compression ratio (raw bytes / estimated "
+    "encoded bytes) the chooser demands before it encodes a column at "
+    "all — below it the column stays raw little-endian (encoding that "
+    "barely shrinks only adds decode latency).", float, semantic=False)
+ENCODE_RLE_MAX_RUN_FRAC = _entry(
+    "sdot.encode.rle.max.run.frac", 0.5,
+    "RLE eligibility cutoff: runs/rows above this fraction disqualifies "
+    "the RLE candidate outright (near-unique columns degenerate to one "
+    "run per row, where RLE is larger than raw).", float, semantic=False)
 
 
 # Families of runtime-shaped keys (tenant / datasource suffixes) that

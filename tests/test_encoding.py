@@ -1,0 +1,585 @@
+"""Compressed columnar subsystem (encode/): codecs, chooser, snapshot
+format, tiered faulting, and encoded-domain execution.
+
+The acceptance bar is the bit-exactness contract from encode/codecs.py:
+compression must NEVER change an answer. Every integration test here is
+differential — an encoded store (on disk, in the hot set, or on the
+wire) must answer byte-identically to the raw path that existed before
+this subsystem. Back-compat runs in both directions: enc-less manifests
+load raw under an encode-enabled context, and encoded snapshots recover
+under a raw-config context (the manifest, not config, describes the
+bytes).
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.encode import chooser as CH
+from spark_druid_olap_tpu.encode import codecs as C
+from spark_druid_olap_tpu.encode import predicates as P
+from spark_druid_olap_tpu.persist import snapshot as SNAP
+
+from conftest import assert_frames_equal, make_sales_df
+
+# -- codec round-trips --------------------------------------------------------
+
+_R = np.random.default_rng(13)
+
+ARRAYS = {
+    "all_equal_i64": np.full(5000, 42, np.int64),
+    "low_card_i8": _R.integers(0, 4, 5000).astype(np.int8),
+    "narrow_i32": _R.integers(-50, 50, 3000).astype(np.int32),
+    "sorted_i16": np.sort(_R.integers(0, 300, 4000)).astype(np.int16),
+    "monotone_days_i32": np.sort(
+        _R.integers(16000, 16400, 4000)).astype(np.int32),
+    "adversarial_card_i64": _R.integers(
+        np.iinfo(np.int64).min // 2, np.iinfo(np.int64).max // 2, 2000),
+    "alternating_u16": np.tile(
+        np.array([0, 65535], np.uint16), 1500),
+    "bools": _R.integers(0, 2, 4096).astype(bool),
+    "single": np.array([-7], np.int64),
+    "negative_runs_i64": np.repeat(
+        np.array([-3, -3000000000, 9], np.int64), 700),
+}
+
+
+@pytest.mark.parametrize("name", sorted(ARRAYS))
+@pytest.mark.parametrize("codec", [C.RAW, C.BITPACK, C.RLE, C.FORDELTA])
+def test_codec_roundtrip_bit_exact(name, codec):
+    arr = ARRAYS[name]
+    payload, header = C.encode_array(arr, codec)
+    out = C.decode_array(payload, header)
+    assert out.dtype == arr.dtype
+    np.testing.assert_array_equal(out, arr)
+    assert out.flags.writeable           # fresh, never a frombuffer view
+    assert C.decoded_nbytes(header) == arr.nbytes
+    hb = C.header_bounds(header)
+    if hb is not None:
+        assert hb == (int(np.asarray(arr, np.int64).min()),
+                      int(np.asarray(arr, np.int64).max()))
+
+
+@pytest.mark.parametrize("codec", C.CODECS)
+@pytest.mark.parametrize("dt", ["i1", "i4", "i8", "u2", "b1"])
+def test_codec_empty_roundtrip(codec, dt):
+    arr = np.empty(0, np.dtype(dt))
+    payload, header = C.encode_array(arr, codec)
+    out = C.decode_array(payload, header)
+    assert out.dtype == arr.dtype and len(out) == 0
+    assert C.header_bounds(header) is None
+
+
+def test_encode_chunk_falls_back_to_raw_when_not_smaller():
+    # adversarial cardinality: every row distinct and full-range — RLE
+    # would INFLATE (value + i32 length per run); the chunk must stay raw
+    arr = ARRAYS["adversarial_card_i64"]
+    payload, header = C.encode_chunk(arr, C.RLE)
+    assert header["c"] == C.RAW
+    assert len(payload) == arr.nbytes
+    np.testing.assert_array_equal(C.decode_array(payload, header), arr)
+
+
+def test_rle_runs_aggregates_without_expansion():
+    arr = np.repeat(np.array([7, -2, 7, 0], np.int32), [10, 1, 25, 3])
+    payload, header = C.encode_array(arr, C.RLE)
+    values, lengths = C.rle_runs(payload, header)
+    np.testing.assert_array_equal(values, [7, -2, 7, 0])
+    np.testing.assert_array_equal(lengths, [10, 1, 25, 3])
+    # sum/count from runs == sum/count from rows (the groupby identity)
+    assert int((values.astype(np.int64) * lengths).sum()) == int(arr.sum())
+    assert int(lengths.sum()) == len(arr)
+
+
+def test_malformed_payloads_raise_encoding_error():
+    arr = np.arange(100, dtype=np.int64)
+    payload, header = C.encode_array(arr, C.BITPACK)
+    with pytest.raises(C.EncodingError):
+        C.decode_array(payload[: len(payload) // 2], header)   # truncated
+    rp, rh = C.encode_array(np.repeat(arr, 3), C.RLE)
+    bad = dict(rh, n=rh["n"] + 1)                # lengths no longer sum
+    with pytest.raises(C.EncodingError):
+        C.decode_array(rp, bad)
+    with pytest.raises(C.EncodingError):
+        C.encode_array(arr, "lz77")              # unknown codec
+    with pytest.raises(C.EncodingError):
+        C.encode_array(arr.reshape(10, 10), C.BITPACK)   # 2-D chunk
+    with pytest.raises(C.EncodingError):
+        C.decode_array(b"", {"c": "nope", "n": 0, "dt": "<i8"})
+
+
+def test_uint64_beyond_int64_refused_loudly():
+    arr = np.array([0, np.iinfo(np.uint64).max], np.uint64)
+    with pytest.raises(C.EncodingError):
+        C.encode_array(arr, C.BITPACK)
+
+
+def test_estimate_sizes_shapes():
+    est = C.estimate_sizes(ARRAYS["monotone_days_i32"])
+    assert C.FORDELTA in est and C.BITPACK in est and C.RLE in est
+    assert C.FORDELTA not in C.estimate_sizes(ARRAYS["alternating_u16"])
+    assert C.estimate_sizes(np.random.default_rng(0).uniform(
+        size=100)) == {}                          # floats stay raw
+    assert C.estimate_sizes(np.empty(0, np.int64)) == {}
+
+
+# -- chooser ------------------------------------------------------------------
+
+def test_chooser_picks_and_declines():
+    on = CH.EncodeOptions(enabled=True)
+    off = CH.EncodeOptions(enabled=False)
+    low_card = _R.integers(0, 3, 8000).astype(np.int32)
+    assert CH.choose_codec(low_card, on) in (C.BITPACK, C.RLE)
+    assert CH.choose_codec(low_card, off) is None
+    assert CH.choose_codec(_R.uniform(size=1000), on) is None
+    # full-entropy wide ints: nothing clears the min-ratio bar
+    assert CH.choose_codec(ARRAYS["adversarial_card_i64"], on) is None
+    picky = CH.EncodeOptions(enabled=True, min_ratio=1e9)
+    assert CH.choose_codec(low_card, picky) is None
+    # near-sorted low-run data prefers runs; degenerate runs are dropped
+    sorted_col = np.sort(low_card)
+    assert CH.choose_codec(sorted_col, on) == C.RLE
+
+
+# -- dictionary-predicate rewrite equivalence ---------------------------------
+
+@pytest.fixture(scope="module")
+def sales_dim():
+    from spark_druid_olap_tpu.segment.ingest import ingest_dataframe
+    ds = ingest_dataframe("sales", make_sales_df(4000), time_column="ts",
+                          target_rows=1024)
+    return ds.dims["product"], ds
+
+
+def _string_eval(dictionary, codes, pred):
+    """Brute-force oracle: evaluate the predicate on decoded strings."""
+    return np.array([pred(dictionary[c]) for c in codes])
+
+
+def test_predicate_rewrite_matches_string_eval(sales_dim):
+    dim, ds = sales_dim
+    dictionary = dim.dictionary
+    codes = dim.codes                    # int32 [n], no nulls in product
+
+    # equality -> one code compare (and a miss -> constant false)
+    code = P.selector_code(dim, "p007")
+    np.testing.assert_array_equal(
+        codes == code, _string_eval(dictionary, codes, lambda s: s == "p007"))
+    assert P.selector_code(dim, "zzz-absent") == -1
+
+    # range -> half-open code interval, all strictness combinations
+    for lo, hi, ls, us in [("p010", "p020", False, False),
+                           ("p010", "p020", True, True),
+                           (None, "p005", False, False),
+                           ("p045", None, True, False)]:
+        clo, chi = P.bound_code_range(dim, lo, hi, ls, us)
+        got = (codes >= clo) & (codes < chi)
+
+        def oracle(s, lo=lo, hi=hi, ls=ls, us=us):
+            ok = True
+            if lo is not None:
+                ok = ok and (s > lo if ls else s >= lo)
+            if hi is not None:
+                ok = ok and (s < hi if us else s <= hi)
+            return ok
+
+        np.testing.assert_array_equal(
+            got, _string_eval(dictionary, codes, oracle), err_msg=str(
+                (lo, hi, ls, us)))
+
+    # IN -> dictionary mask gathered by code; commuted/NOT/OR trees stay
+    # equivalent because the rewrite is per-leaf
+    mask = P.in_code_mask(dictionary, ["p001", "p030", "nope"])
+    in_got = mask[codes]
+    in_want = _string_eval(dictionary, codes,
+                           lambda s: s in ("p001", "p030", "nope"))
+    np.testing.assert_array_equal(in_got, in_want)
+    like = P.pattern_code_mask(dictionary, "like", "p00%")[codes]
+    np.testing.assert_array_equal(
+        like, _string_eval(dictionary, codes,
+                           lambda s: s.startswith("p00")))
+    np.testing.assert_array_equal(
+        ~in_got | like,
+        _string_eval(dictionary, codes,
+                     lambda s: s not in ("p001", "p030", "nope")
+                     or s.startswith("p00")))
+    np.testing.assert_array_equal(
+        P.pattern_code_mask(dictionary, "contains", "03")[codes],
+        _string_eval(dictionary, codes, lambda s: "03" in s))
+    np.testing.assert_array_equal(
+        P.pattern_code_mask(dictionary, "regex", r"p0[12]")[codes],
+        _string_eval(dictionary, codes,
+                     lambda s: __import__("re").search(r"p0[12]", s)
+                     is not None))
+
+    lo_c, hi_c = P.code_mask_bounds(mask)
+    assert np.flatnonzero(mask).min() == lo_c
+    assert np.flatnonzero(mask).max() == hi_c - 1
+    assert P.code_mask_bounds(np.zeros(8, bool)) == (0, 0)
+
+
+# -- snapshot format ----------------------------------------------------------
+
+QUERIES = [
+    "select region, sum(price) as rev, sum(qty) as q, count(*) as n "
+    "from sales group by region order by region",
+    "select product, sum(price) as rev from sales where status = 'O' "
+    "group by product order by rev desc limit 7",
+    "select flag, count(*) as n from sales where qty >= 25 "
+    "group by flag order by flag",
+    "select year(ts) as y, count(*) as n from sales "
+    "group by year(ts) order by y",
+    "select approx_count_distinct(product) as np from sales",
+]
+
+
+def _ctx(root, **extra):
+    return sdot.Context({"sdot.persist.path": str(root), **extra})
+
+
+def _answers(ctx):
+    return {q: ctx.sql(q).to_pandas() for q in QUERIES}
+
+
+def _check(ctx, want):
+    for q in QUERIES:
+        assert_frames_equal(ctx.sql(q).to_pandas(), want[q])
+
+
+def _manifest(ctx, name="sales"):
+    ds_root = ctx.persist._ds_root(name)
+    return SNAP.load_manifest(ds_root, SNAP.current_version(ds_root))
+
+
+def test_encoded_snapshot_roundtrip_and_ratio(tmp_path):
+    ctx = _ctx(tmp_path, **{"sdot.encode.enabled": True})
+    ctx.ingest_dataframe("sales", make_sales_df(), time_column="ts",
+                         target_rows=4096)
+    want = _answers(ctx)
+    ctx.checkpoint("sales")
+    man = _manifest(ctx)
+    ctx.close()
+
+    enc = man.get("encoding")
+    assert enc is not None and enc["version"] == C.ENCODING_VERSION
+    assert enc["columns"], "low-cardinality dims must have been encoded"
+    assert all(c in C.CODECS for c in enc["columns"].values())
+    # the ISSUE's acceptance floor: >= 2x on the encoded column set
+    assert enc["raw_bytes"] / max(enc["encoded_bytes"], 1) >= 2.0
+    # self-describing chunk tables: per-segment (offset, len, header)
+    rel = next(iter(enc["columns"]))
+    segs = man["files"][rel]["enc"]["segments"]
+    assert all(len(s) == 3 and s[2]["n"] >= 0 for s in segs)
+
+    ctx2 = _ctx(tmp_path)                 # raw-config context: manifest,
+    _check(ctx2, want)                    # not config, describes the bytes
+    assert ctx2.engine.last_stats["persist"]["source"] == "snapshot"
+    ctx2.close()
+
+
+def test_raw_snapshot_back_compat_both_directions(tmp_path):
+    # enc-less manifest (pre-subsystem layout): zero manifest churn
+    ctx = _ctx(tmp_path)
+    ctx.ingest_dataframe("sales", make_sales_df(6000), time_column="ts",
+                         target_rows=2048)
+    want = _answers(ctx)
+    ctx.checkpoint("sales")
+    man = _manifest(ctx)
+    assert "encoding" not in man
+    assert all("enc" not in meta for meta in man["files"].values())
+    ctx.close()
+
+    # raw snapshot loads under an encode-enabled context...
+    ctx2 = _ctx(tmp_path, **{"sdot.encode.enabled": True})
+    _check(ctx2, want)
+    # ...and its next checkpoint crosses the format boundary forward
+    ctx2.stream_ingest("sales", make_sales_df(500, seed=21),
+                       time_column="ts")
+    want2 = _answers(ctx2)
+    ctx2.checkpoint("sales")
+    assert _manifest(ctx2).get("encoding")
+    ctx2.close()
+
+    ctx3 = _ctx(tmp_path)                 # and back to a raw-config reader
+    _check(ctx3, want2)
+    ctx3.close()
+
+
+def test_wal_tail_replays_across_format_boundary(tmp_path):
+    ctx = _ctx(tmp_path, **{"sdot.encode.enabled": True})
+    ctx.stream_ingest("sales", make_sales_df(3000), time_column="ts")
+    ctx.checkpoint("sales")
+    # committed appends after the encoded snapshot; no checkpoint — the
+    # RAW WAL tail plus the ENCODED snapshot is what recovery must merge
+    ctx.stream_ingest("sales", make_sales_df(400, seed=5),
+                      time_column="ts")
+    ctx.stream_ingest("sales", make_sales_df(250, seed=6),
+                      time_column="ts")
+    want = _answers(ctx)
+    ctx.close()
+
+    ctx2 = _ctx(tmp_path, **{"sdot.encode.enabled": True})
+    _check(ctx2, want)
+    ctx2.close()
+
+
+def test_corrupt_encoded_blob_quarantined(tmp_path):
+    ctx = _ctx(tmp_path, **{"sdot.encode.enabled": True})
+    ctx.stream_ingest("sales", make_sales_df(3000), time_column="ts")
+    want = _answers(ctx)
+    ctx.checkpoint("sales")
+    ctx.stream_ingest("sales", make_sales_df(100, seed=9),
+                      time_column="ts")
+    ctx.checkpoint("sales")
+    ds_root = ctx.persist._ds_root("sales")
+    cur = SNAP.current_version(ds_root)
+    vdir = os.path.join(ds_root, SNAP.version_dirname(cur))
+    man = SNAP.load_manifest(ds_root, cur)
+    rel = next(iter(man["encoding"]["columns"]))     # an ENCODED blob
+    with open(os.path.join(vdir, rel), "r+b") as f:
+        f.seek(0)
+        f.write(b"\xde\xad\xbe\xef")
+    ctx.close()
+
+    ctx2 = _ctx(tmp_path, **{"sdot.encode.enabled": True})
+    rep = ctx2.persist.recovery_report
+    assert [q["version"] for q in rep["quarantined"]] == [cur]
+    _check(ctx2, want)                    # fell back to the intact version
+    ctx2.close()
+
+
+def test_compaction_reencodes_generations(tmp_path):
+    ctx = _ctx(tmp_path, **{"sdot.encode.enabled": True})
+    for seed in range(4):                 # stream tails -> many segments
+        ctx.stream_ingest("sales", make_sales_df(1200, seed=seed),
+                          time_column="ts")
+    want = _answers(ctx)
+    res = ctx.persist.compact("sales")
+    assert res, "forced compaction must publish a generation"
+    man = _manifest(ctx)
+    assert man.get("encoding"), "compacted generation must re-encode"
+    _check(ctx, want)
+    ctx.close()
+    ctx2 = _ctx(tmp_path)
+    _check(ctx2, want)
+    ctx2.close()
+
+
+def test_encoded_append_races_checkpoint_and_compaction(tmp_path):
+    """Producers stream encoded-store appends while a checkpoint+compact
+    loop publishes encoded generations under them; the final recovered
+    answers must equal the live context's."""
+    ctx = _ctx(tmp_path, **{"sdot.encode.enabled": True})
+    ctx.stream_ingest("sales", make_sales_df(1500, seed=0),
+                      time_column="ts")
+    stop = threading.Event()
+    errs = []
+
+    def churn():
+        try:
+            while not stop.is_set():
+                ctx.checkpoint("sales")
+                ctx.persist.compact("sales")
+        except Exception as e:            # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        for seed in range(1, 6):
+            ctx.stream_ingest("sales", make_sales_df(700, seed=seed),
+                              time_column="ts")
+    finally:
+        stop.set()
+        t.join()
+    assert not errs, errs
+    want = _answers(ctx)
+    ctx.checkpoint("sales")
+    ctx.close()
+
+    ctx2 = _ctx(tmp_path, **{"sdot.encode.enabled": True})
+    _check(ctx2, want)
+    ctx2.close()
+
+
+# -- tiered execution over encoded chunks -------------------------------------
+
+@pytest.fixture(scope="module")
+def tiered_roots(tmp_path_factory):
+    """One synthetic store checkpointed twice: raw and encoded."""
+    roots = {}
+    for leg, enabled in (("raw", False), ("encoded", True)):
+        root = str(tmp_path_factory.mktemp(f"enc-tier-{leg}"))
+        seed = _ctx(root, **{"sdot.encode.enabled": enabled})
+        seed.ingest_dataframe("sales", make_sales_df(), time_column="ts",
+                              target_rows=4096)
+        seed.checkpoint("sales")
+        seed.close()
+        roots[leg] = root
+    return roots
+
+
+def _tiered(root, budget=1 << 20):
+    return _ctx(root, **{"sdot.cache.enabled": False,
+                         "sdot.plan.cache.enabled": False,
+                         "sdot.tier.enabled": True,
+                         "sdot.tier.budget.bytes": budget,
+                         "sdot.tier.wave.io.bytes": budget // 4})
+
+
+def test_tiered_encoded_differential_and_stats(tiered_roots):
+    eager = _ctx(tiered_roots["raw"])
+    want = _answers(eager)
+    eager.close()
+
+    ctx = _tiered(tiered_roots["encoded"])
+    _check(ctx, want)
+    enc = ctx.engine.last_stats.get("encoding")
+    assert enc and enc["encoded_keys"] > 0 and enc["ratio"] > 1.0
+    st = ctx.persist.tier.stats_snapshot()
+    assert st["hot_bytes"] <= st["budget_bytes"]
+    ctx.close()
+
+
+def test_zone_maps_served_from_manifest_without_faults(tiered_roots):
+    """Satellite: per-segment bounds come from the manifest encoding
+    block, so metric pruning must not decode — or even fault — a single
+    cold chunk."""
+    eager = _ctx(tiered_roots["raw"])
+    want_bounds = eager.store.get("sales").segment_metric_bounds("qty")
+    eager.close()
+
+    ctx = _tiered(tiered_roots["encoded"])
+    st0 = ctx.persist.tier.stats_snapshot()["faults"]
+    mins, maxs = ctx.store.get("sales").segment_metric_bounds("qty")
+    assert ctx.persist.tier.stats_snapshot()["faults"] == st0
+    np.testing.assert_allclose(mins, want_bounds[0])
+    np.testing.assert_allclose(maxs, want_bounds[1])
+    ctx.close()
+
+
+def test_same_budget_holds_more_encoded_chunks(tiered_roots):
+    """The tentpole's byte-budget payoff: the hot set stores ENCODED
+    payloads, so the same budget ends up holding at least as many chunks
+    (strictly more whenever anything compressed)."""
+    entries = {}
+    for leg in ("raw", "encoded"):
+        ctx = _tiered(tiered_roots[leg], budget=256 * 1024)
+        for q in QUERIES:
+            ctx.sql(q)
+        st = ctx.persist.tier.stats_snapshot()
+        entries[leg] = st["hot_entries"]
+        ctx.close()
+    assert entries["encoded"] > entries["raw"], entries
+
+
+# -- wire format --------------------------------------------------------------
+
+def test_wire_rle_column_roundtrip_and_shrink():
+    from spark_druid_olap_tpu.cluster import wire as W
+    n = 4000
+    data = {
+        "bucket": np.repeat(np.arange(8, dtype=np.int64), n // 8),
+        "rev": _R.uniform(size=n),                      # floats stay raw
+        "rand": _R.integers(0, 1 << 60, n),             # no shrink -> raw
+    }
+    frame = W.encode_result(list(data), data, stats={"s": 1})
+    raw_frame_floor = data["bucket"].nbytes
+    assert len(frame) < raw_frame_floor + data["rev"].nbytes \
+        + data["rand"].nbytes                           # bucket RLE'd away
+    cols, out, stats = W.decode_result(frame)
+    assert cols == list(data) and stats == {"s": 1}
+    for k in data:
+        np.testing.assert_array_equal(out[k], data[k])
+        assert out[k].dtype == data[k].dtype
+    corrupt = bytearray(frame)
+    corrupt[len(frame) // 2] ^= 0xFF
+    with pytest.raises(ValueError):
+        W.decode_result(bytes(corrupt))
+
+
+# -- TPC-H / SSB differentials ------------------------------------------------
+
+@pytest.fixture(scope="module")
+def star_roots(tmp_path_factory):
+    from spark_druid_olap_tpu.tools import ssb, tpch
+    tpch_flat = tpch.flatten(tpch.generate(sf=0.002))
+    ssb_flat = ssb.flatten(ssb.generate(sf=0.003))
+    roots = {}
+    for leg, enabled in (("raw", False), ("encoded", True)):
+        root = str(tmp_path_factory.mktemp(f"enc-star-{leg}"))
+        seed = _ctx(root, **{"sdot.encode.enabled": enabled})
+        seed.ingest_dataframe("tpch_flat", tpch_flat,
+                              time_column="l_shipdate", target_rows=2048)
+        seed.ingest_dataframe("ssb_flat", ssb_flat,
+                              time_column="lo_orderdate", target_rows=2048)
+        seed.checkpoint()
+        seed.close()
+        roots[leg] = root
+    return roots
+
+
+def _star_ctx(root):
+    from spark_druid_olap_tpu.tools import ssb, tpch
+    ctx = _ctx(root, **{"sdot.cache.enabled": False})
+    ctx.register_star_schema(tpch.star_schema("tpch_flat"))
+    ctx.register_star_schema(ssb.star_schema("ssb_flat"))
+    return ctx
+
+
+@pytest.mark.parametrize("suite,name", [
+    ("tpch", "basic_agg"), ("tpch", "q1"), ("tpch", "q6"),
+    ("tpch", "q14"), ("ssb", "q1.1"), ("ssb", "q3.1")])
+def test_star_schema_encoded_vs_raw(star_roots, suite, name):
+    from spark_druid_olap_tpu.tools import ssb, tpch
+    sql = (tpch if suite == "tpch" else ssb).QUERIES[name]
+    raw = _star_ctx(star_roots["raw"])
+    enc = _star_ctx(star_roots["encoded"])
+    try:
+        assert_frames_equal(enc.sql(sql).to_pandas(),
+                            raw.sql(sql).to_pandas(), rtol=1e-9, atol=1e-9)
+    finally:
+        raw.close()
+        enc.close()
+
+
+@pytest.mark.slow
+def test_cluster_scatter_over_encoded_snapshots(star_roots):
+    """--cluster N leg: historicals recover the ENCODED snapshots, the
+    broker scatters, and replies must match a single-process engine over
+    the raw snapshots (encoded blobs cross the SDW1 wire)."""
+    import socket
+
+    from spark_druid_olap_tpu.cluster.historical import HistoricalNode
+    from spark_druid_olap_tpu.tools import tpch
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    nodes_csv = ",".join(f"127.0.0.1:{free_port()}" for _ in range(2))
+    common = {"sdot.persist.path": star_roots["encoded"],
+              "sdot.cluster.nodes": nodes_csv}
+    hist = [HistoricalNode(dict(common), node_id=i).start()
+            for i in range(2)]
+    broker = sdot.Context({**common, "sdot.cluster.role": "broker"})
+    single = _star_ctx(star_roots["raw"])
+    broker.register_star_schema(tpch.star_schema("tpch_flat"))
+    try:
+        for name in ("basic_agg", "q1", "q6"):
+            got = broker.sql(tpch.QUERIES[name]).to_pandas()
+            want = single.sql(tpch.QUERIES[name]).to_pandas()
+            assert_frames_equal(got, want, rtol=1e-9, atol=1e-9)
+    finally:
+        for h in hist:
+            h.stop()
+        broker.close()
+        single.close()
