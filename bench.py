@@ -560,6 +560,163 @@ def run_pallas_ab(reps: int = 3):
     return out
 
 
+def run_mesh_ab(reps: int = 3):
+    """Multi-chip mesh A-B: the same fused shared-scan storms at every
+    power-of-two device count the process exposes.
+
+    Two canned storms over a TPC-H flat subset run coalesced at
+    n ∈ {1, 2, 4, 8} devices (1 = no mesh, the single-device baseline;
+    the cost model is off so the mesh decision is unconditional).
+    Reports per-device-count median wall ms and the geomean over the
+    storm shapes, the merge-collective bytes the mesh tier statically
+    accounts (ring convention: merged payload x (n-1) x waves), mesh
+    dispatch counters, and an answers-match gate against the 1-device
+    leg. On a real pod this measures ICI scaling; under
+    ``--xla_force_host_platform_device_count=8`` (the CI recipe in
+    docs/MESH.md) the wall numbers measure host-core contention, not
+    interconnect — the accounting + match gate are the pinned part.
+    """
+    import threading
+
+    import jax
+
+    counts = [n for n in (1, 2, 4, 8) if n <= len(jax.devices())]
+    if not counts or counts[-1] < 2:
+        return {"available": False,
+                "reason": "single-device process; set XLA_FLAGS="
+                          "--xla_force_host_platform_device_count=8"}
+
+    from spark_druid_olap_tpu.ir import spec as S
+    from spark_druid_olap_tpu.parallel.executor import QueryEngine
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+    from spark_druid_olap_tpu.tools import tpch
+    from spark_druid_olap_tpu.utils.config import Config
+
+    sf = float(os.environ.get("SDOT_BENCH_MESH_SF", "0.01"))
+    import spark_druid_olap_tpu as sdot
+    ctx = sdot.Context()
+    tpch.setup_context(ctx, sf=sf, target_rows=2048, flat_only=True)
+    store = ctx.store
+
+    aggs = (S.AggregationSpec("doublesum", "rev", field="l_extendedprice"),
+            S.AggregationSpec("longsum", "q", field="l_quantity"),
+            S.AggregationSpec("count", "n"),
+            S.AggregationSpec("doublemax", "mx", field="l_extendedprice"))
+    storms = {
+        "flag_status": [
+            S.GroupByQuerySpec(
+                "tpch_flat",
+                (S.DimensionSpec("l_returnflag", "l_returnflag"),
+                 S.DimensionSpec("l_linestatus", "l_linestatus")), aggs),
+            S.GroupByQuerySpec(
+                "tpch_flat", (S.DimensionSpec("l_shipmode", "l_shipmode"),),
+                aggs, filter=S.SelectorFilter("l_returnflag", "N")),
+            S.TimeseriesQuerySpec("tpch_flat", aggs,
+                                  granularity=S.Granularity("month")),
+        ],
+        "sketch_mix": [
+            S.GroupByQuerySpec(
+                "tpch_flat", (S.DimensionSpec("l_shipmode", "l_shipmode"),),
+                aggs + (S.AggregationSpec("cardinality", "uo",
+                                          field="l_orderkey"),)),
+            S.GroupByQuerySpec(
+                "tpch_flat",
+                (S.DimensionSpec("l_returnflag", "l_returnflag"),),
+                aggs + (S.AggregationSpec("thetasketch", "sk",
+                                          field="l_suppkey"),)),
+        ],
+    }
+
+    def run_batch(eng, specs):
+        res = [None] * len(specs)
+        errs = [None] * len(specs)
+        bar = threading.Barrier(len(specs))
+
+        def worker(i):
+            bar.wait()
+            try:
+                res[i] = eng.execute(specs[i]).to_pandas()
+            except Exception as e:      # noqa: BLE001 — surfaced below
+                errs[i] = e
+
+        th = [threading.Thread(target=worker, args=(i,))
+              for i in range(len(specs))]
+        for t in th:
+            t.start()
+        for t in th:
+            t.join()
+        for e in errs:
+            if e is not None:
+                raise e
+        return res
+
+    def leg(n):
+        eng = QueryEngine(store, config=Config({
+            "sdot.sharedscan.enabled": True,
+            "sdot.wlm.batch.window.ms": 500.0,
+            "sdot.wlm.enabled": False,
+            "sdot.querycostmodel.enabled": False,
+        }), mesh=make_mesh(n) if n > 1 else None)
+        frames, storm_ms = {}, {}
+        for name, specs in storms.items():
+            run_batch(eng, specs)       # warm: compile this leg's program
+            ts = []
+            for _ in range(max(reps, 1)):
+                t0 = time.perf_counter()
+                frames[name] = run_batch(eng, specs)
+                ts.append(time.perf_counter() - t0)
+            storm_ms[name] = float(np.median(ts)) * 1000
+        mst = eng.sharedscan.stats()["mesh"]
+        gm = float(np.exp(np.mean([np.log(max(v, 1e-9))
+                                   for v in storm_ms.values()])))
+        return frames, {
+            "geomean_ms": round(gm, 2),
+            "storm_ms": {k: round(v, 2) for k, v in storm_ms.items()},
+            "collective_bytes": int(mst["collective_bytes"]),
+            "mesh_dispatches": int(mst["dispatches"]),
+            "mesh_groups": int(mst["groups"]),
+            "fallbacks": dict(mst["fallbacks"]),
+        }
+
+    def frames_match(a, b):
+        aa = a.reset_index(drop=True)
+        bb = b.reset_index(drop=True)
+        if list(aa.columns) != list(bb.columns) or len(aa) != len(bb):
+            return False
+        for c in aa.columns:
+            av, bv = aa[c].to_numpy(), bb[c].to_numpy()
+            if av.dtype.kind in "fc":
+                if not np.allclose(av.astype(float), bv.astype(float),
+                                   rtol=1e-9, atol=1e-12, equal_nan=True):
+                    return False
+            elif not np.array_equal(av, bv):
+                return False
+        return True
+
+    base_frames, legs = None, {}
+    match = True
+    for n in counts:
+        frames, stats = leg(n)
+        legs[str(n)] = stats
+        if base_frames is None:
+            base_frames = frames
+        else:
+            for name in storms:
+                for a, b in zip(base_frames[name], frames[name]):
+                    match = match and frames_match(a, b)
+    gm1 = legs[str(counts[0])]["geomean_ms"]
+    gmN = legs[str(counts[-1])]["geomean_ms"]
+    out = {"available": True, "device_counts": counts, "legs": legs,
+           "scaling_vs_single": round(gm1 / max(gmN, 1e-9), 3),
+           "answers_match": bool(match)}
+    curve = ", ".join("%ddev %sms" % (n, legs[str(n)]["geomean_ms"])
+                      for n in counts)
+    log(f"mesh A-B: {curve} (x{out['scaling_vs_single']} at {counts[-1]} "
+        f"devices, collective "
+        f"{legs[str(counts[-1])]['collective_bytes']}B, match={match})")
+    return out
+
+
 def run_encode_ab(reps: int = 3):
     """Encoded-vs-raw A-B over the cold tier (encode/ + tier/).
 
@@ -1071,6 +1228,11 @@ def main():
     except Exception as e:   # noqa: BLE001 — the A-B leg is advisory
         out["encode_ab"] = {"available": False,
                             "error": f"{type(e).__name__}: {e}"}
+    try:
+        out["mesh_ab"] = run_mesh_ab()
+    except Exception as e:   # noqa: BLE001 — the A-B leg is advisory
+        out["mesh_ab"] = {"available": False,
+                          "error": f"{type(e).__name__}: {e}"}
     if gbps:
         try:
             peak = float(os.environ.get("SDOT_BENCH_HBM_PEAK_GBPS", "819"))

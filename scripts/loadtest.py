@@ -920,6 +920,272 @@ def run_sharedscan(args):
     sys.exit(0 if ok else 1)
 
 
+def run_mesh(args):
+    """Multi-chip mesh differential + scaling leg (parallel/meshexec.py).
+
+    In-process: ingest a TPC-H flat subset with mesh-sized segments,
+    capture sequential single-device answers, then replay concurrent
+    fused storms through (a) a single-device engine and (b) an engine
+    sharding fused waves across every local device. Every reply is
+    checked against the reference — any mismatch exit-codes 1 — and the
+    summary reports the wall scaling ratio plus the merge-collective
+    counters (collective_bytes, mesh dispatches/groups, fallback
+    tallies, and the partial-buffer ledger gauge, which must drain to
+    zero). With --cluster N an additional leg spawns N historical
+    subprocesses on an 8-device emulated mesh with ``sdot.mesh.auto``
+    on, storms the mix through an in-process broker, checks every
+    broker answer against a single-process engine, and reports per-node
+    mesh counters polled from /metadata/sharedscan."""
+    import threading
+
+    sys.path.insert(0, ".")
+    import jax
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.ir import spec as S
+    from spark_druid_olap_tpu.parallel.executor import QueryEngine
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh, mesh_size
+    from spark_druid_olap_tpu.tools import tpch
+    from spark_druid_olap_tpu.utils.config import Config
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        print("[mesh] single-device process; set XLA_FLAGS="
+              "--xla_force_host_platform_device_count=8 to emulate a mesh")
+        sys.exit(1)
+
+    sf = args.tpch if args.tpch is not None else 0.01
+    ctx = sdot.Context()
+    tpch.setup_context(ctx, sf=sf, target_rows=2048, flat_only=True)
+    store = ctx.store
+    n_rows = store.get("tpch_flat").num_rows
+    window_ms = float(args.window if args.window is not None else 60.0)
+
+    aggs = (S.AggregationSpec("doublesum", "rev", field="l_extendedprice"),
+            S.AggregationSpec("longsum", "q", field="l_quantity"),
+            S.AggregationSpec("count", "n"),
+            S.AggregationSpec("doublemin", "mn", field="l_discount"),
+            S.AggregationSpec("doublemax", "mx", field="l_extendedprice"),
+            S.AggregationSpec("cardinality", "uo", field="l_orderkey"),
+            S.AggregationSpec("thetasketch", "sk", field="l_suppkey"))
+    specs = [
+        S.GroupByQuerySpec(
+            "tpch_flat",
+            (S.DimensionSpec("l_returnflag", "l_returnflag"),
+             S.DimensionSpec("l_linestatus", "l_linestatus")), aggs),
+        S.GroupByQuerySpec(
+            "tpch_flat", (S.DimensionSpec("l_shipmode", "l_shipmode"),),
+            aggs, filter=S.SelectorFilter("l_returnflag", "N")),
+        S.TimeseriesQuerySpec("tpch_flat", aggs,
+                              granularity=S.Granularity("month")),
+    ]
+
+    def engine(mesh):
+        return QueryEngine(store, config=Config({
+            "sdot.sharedscan.enabled": True,
+            "sdot.wlm.batch.window.ms": window_ms,
+            "sdot.wlm.enabled": False,
+            "sdot.querycostmodel.enabled": False,
+        }), mesh=mesh)
+
+    def run_batch(eng):
+        res = [None] * len(specs)
+        errs = [None] * len(specs)
+        bar = threading.Barrier(len(specs))
+
+        def worker(i):
+            bar.wait()
+            try:
+                res[i] = eng.execute(specs[i]).to_pandas()
+            except Exception as e:      # noqa: BLE001 — surfaced below
+                errs[i] = e
+
+        th = [threading.Thread(target=worker, args=(i,))
+              for i in range(len(specs))]
+        for t in th:
+            t.start()
+        for t in th:
+            t.join()
+        for e in errs:
+            if e is not None:
+                raise e
+        return res
+
+    ref = [QueryEngine(store).execute(q).to_pandas() for q in specs]
+    mismatched = []
+
+    def leg(name, eng):
+        run_batch(eng)                  # warm: compile this leg's program
+        walls, stop = [], time.monotonic() + max(args.duration, 3.0)
+        while time.monotonic() < stop:
+            t0 = time.perf_counter()
+            frames = run_batch(eng)
+            walls.append((time.perf_counter() - t0) * 1000)
+            for i, (got, want) in enumerate(zip(frames, ref)):
+                if not _frames_close(got, want):
+                    mismatched.append(f"[{name}] spec {i}")
+        mst = eng.sharedscan.stats()["mesh"]
+        out = {"batches": len(walls),
+               "p50_ms": round(float(np.percentile(walls, 50)), 2),
+               "devices": mst["devices"],
+               "mesh_groups": mst["groups"],
+               "mesh_dispatches": mst["dispatches"],
+               "collective_bytes": mst["collective_bytes"],
+               "fallbacks": dict(mst["fallbacks"]),
+               "partials_outstanding":
+                   mst["partials"]["outstanding_bytes"]}
+        print(f"  [{name}] p50={out['p50_ms']:7.2f}ms "
+              f"batches={out['batches']} devices={out['devices']} "
+              f"collective={out['collective_bytes']}B "
+              f"dispatches={out['mesh_dispatches']}")
+        return out
+
+    print(f"[mesh] {n_rows} rows, "
+          f"{store.get('tpch_flat').num_segments} segments, "
+          f"{n_dev} devices")
+    single = leg("single-device", engine(None))
+    mesh = leg(f"mesh-{n_dev}dev", engine(make_mesh()))
+    scaling = single["p50_ms"] / max(mesh["p50_ms"], 1e-9)
+    out = {"mode": "mesh", "sf": sf, "rows": int(n_rows),
+           "devices": n_dev, "window_ms": window_ms,
+           "single": single, "mesh": mesh,
+           "scaling_ratio": round(scaling, 3),
+           "result_mismatches": sorted(set(mismatched))}
+    print(f"  scaling {scaling:.2f}x at {n_dev} devices "
+          f"(emulated meshes measure host-core contention, not ICI); "
+          f"collective {mesh['collective_bytes']}B over "
+          f"{mesh['mesh_dispatches']} mesh dispatches"
+          + (f"; RESULT MISMATCH {sorted(set(mismatched))}"
+             if mismatched else ""))
+
+    ok = not mismatched and mesh["mesh_groups"] > 0 \
+        and mesh["collective_bytes"] > 0 \
+        and mesh["partials_outstanding"] == 0 \
+        and single["mesh_dispatches"] == 0
+
+    if args.cluster:
+        cl = _run_mesh_cluster(args)
+        out["cluster"] = cl
+        ok = ok and cl["ok"]
+    print(json.dumps(out))
+    sys.exit(0 if ok else 1)
+
+
+def _run_mesh_cluster(args):
+    """--mesh --cluster N: N historical subprocesses, each on an 8-device
+    emulated mesh with sdot.mesh.auto on, differentially checked through
+    an in-process broker against a single-process engine."""
+    import shutil
+    import tempfile
+    import threading
+
+    import spark_druid_olap_tpu as sdot
+
+    n_nodes = args.cluster
+    window_ms = args.window if args.window is not None else 25.0
+    root = tempfile.mkdtemp(prefix="sdot-mesh-cluster-")
+    caches_off = {"sdot.cache.enabled": False,
+                  "sdot.plan.cache.enabled": False,
+                  "sdot.cluster.subq.cache.enabled": False}
+    procs, broker, single = [], None, None
+    try:
+        seed = sdot.Context({"sdot.persist.path": root})
+        seed.ingest_dataframe("sales", _synthetic_sales(400_000),
+                              time_column="ts", target_rows=4096)
+        seed.checkpoint()
+        seed.close()
+
+        import subprocess
+        ports = [_free_port() for _ in range(n_nodes)]
+        nodes = ",".join(f"127.0.0.1:{p}" for p in ports)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+        for i in range(n_nodes):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "spark_druid_olap_tpu.cluster",
+                 "historical", "--persist", root, "--nodes", nodes,
+                 "--node-id", str(i),
+                 "--set", "sdot.mesh.auto=true",
+                 "--set", "sdot.cache.enabled=false",
+                 "--set", "sdot.plan.cache.enabled=false",
+                 "--set", "sdot.querycostmodel.enabled=false",
+                 "--set", "sdot.sharedscan.enabled=true",
+                 "--set", f"sdot.wlm.batch.window.ms={window_ms}"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
+        print(f"[mesh-cluster] waiting for {n_nodes} meshed historicals...")
+        for p, proc in zip(ports, procs):
+            _wait_ready(p, proc=proc)
+
+        broker = sdot.Context({
+            "sdot.persist.path": root, "sdot.cluster.nodes": nodes,
+            "sdot.cluster.role": "broker", **caches_off})
+        single = sdot.Context({"sdot.persist.path": root, **caches_off})
+        queries = args.sql or DEFAULT_QUERIES
+        answers = {q: single.sql(q).to_pandas() for q in queries}
+
+        mismatched = []
+        lock = threading.Lock()
+        stop = time.monotonic() + max(args.duration, 5.0)
+
+        def worker(tid):
+            i = tid
+            while time.monotonic() < stop:
+                q = queries[i % len(queries)]
+                i += 1
+                try:
+                    df = broker.sql(q).to_pandas()
+                except Exception as e:   # noqa: BLE001 — gate below
+                    with lock:
+                        mismatched.append(f"error {type(e).__name__}: {q}")
+                    continue
+                if not _frames_close(df, answers[q]):
+                    with lock:
+                        mismatched.append(q)
+
+        threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+                   for t in range(args.threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        node_mesh = []
+        for p in ports:
+            try:
+                st = get_json(f"http://127.0.0.1:{p}", "/metadata/sharedscan")
+                node_mesh.append(st.get("mesh", {}))
+            except Exception as e:   # noqa: BLE001 — reported below
+                node_mesh.append({"error": str(e)})
+        meshed_nodes = sum(1 for m in node_mesh
+                           if int(m.get("devices", 1)) > 1)
+        print(f"[mesh-cluster] mismatches={len(mismatched)} "
+              f"meshed_nodes={meshed_nodes}/{n_nodes} per-node mesh: "
+              f"{json.dumps(node_mesh)}")
+        # the gate: exact answers through meshed historicals, and every
+        # node actually built its 8-device mesh (fused-group collective
+        # traffic depends on storm timing; solo subqueries shard via the
+        # executor's own route, so per-node counters are reported, not
+        # pinned)
+        ok = not mismatched and meshed_nodes == n_nodes
+        return {"ok": bool(ok), "nodes": n_nodes,
+                "meshed_nodes": meshed_nodes,
+                "mismatches": sorted(set(mismatched))[:10],
+                "node_mesh": node_mesh}
+    finally:
+        for proc in procs:
+            try:
+                proc.kill()
+            except Exception:   # noqa: BLE001 — already dead
+                pass
+        for c in (broker, single):
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:   # noqa: BLE001 — shutdown race
+                    pass
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _free_port():
     import socket
     s = socket.socket()
@@ -2241,6 +2507,17 @@ def main():
                     help="sdot.wlm.batch.window.ms (micro-batch hold "
                     "window) for --sharedscan (default 8ms) and for the "
                     "historicals in --cluster (default 25ms)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="in-process multi-chip mesh differential: replay "
+                    "concurrent fused storms over a TPC-H flat subset "
+                    "through a single-device engine and a mesh engine "
+                    "sharding waves across every local device (needs >1 "
+                    "device — set XLA_FLAGS=--xla_force_host_platform_"
+                    "device_count=8 to emulate); every reply checked "
+                    "against sequential answers (mismatch -> exit 1); "
+                    "reports the scaling ratio and merge-collective "
+                    "counters; with --cluster N also storms an in-process "
+                    "broker over N meshed historical subprocesses")
     ap.add_argument("--cluster", type=int, default=0, metavar="N",
                     help="multi-process distributed-serving benchmark: "
                     "checkpoint a synthetic store, spawn N historical "
@@ -2288,6 +2565,8 @@ def main():
         return run_chaos(args)
     if args.ingest:
         return run_ingest(args)
+    if args.mesh:
+        return run_mesh(args)
     if args.cluster:
         return run_cluster(args)
     if args.coldstart:

@@ -45,9 +45,11 @@ class Context:
         _enable_x64_once()
         self.config = Config(config)
         self.store = SegmentStore()
-        if mesh is None and auto_mesh and len(jax.devices()) > 1:
-            from spark_druid_olap_tpu.parallel.mesh import make_mesh
-            mesh = make_mesh()
+        if mesh is None and len(jax.devices()) > 1:
+            from spark_druid_olap_tpu.utils.config import MESH_AUTO
+            if auto_mesh or bool(self.config.get(MESH_AUTO)):
+                from spark_druid_olap_tpu.parallel.mesh import make_mesh
+                mesh = make_mesh()
         self.mesh = mesh
         from spark_druid_olap_tpu.parallel.executor import QueryEngine
         self.engine = QueryEngine(self.store, self.config, mesh)

@@ -832,3 +832,31 @@ def merge_partials(partials: Dict[str, object], routes: Dict[str, Route],
         else:                                  # limbs / f64 / i32 sums
             out[name] = jax.lax.psum(arr, axis_name)
     return out
+
+
+def merge_lane_partials(out, routes: Dict[str, Route],
+                        sketch_kinds: Dict[str, str], axis_name: str):
+    """Cross-chip merge of ONE lane's complete output dict — the single
+    mergeable-partial layout every sharded program (solo executor cores
+    and the mesh execution tier, parallel/meshexec.py) folds with:
+
+    - dense routes via :func:`merge_partials` — exactly the register
+      algebra ``AGG_CLOSURE.merge`` declares (``psum`` sums/counts,
+      ``pmin``/``pmax`` extrema); unmerged ff/lanes pairs stay per-chip
+      for the exact f64 host combine,
+    - sketch registers via their own register algebra: HLL rho registers
+      are maxima (``hll.merge_registers``), theta k-min registers are
+      minima (``theta.merge_registers``) — never addition.
+
+    ``sketch_kinds`` maps output name -> "hll" | "theta" for the lane's
+    register-valued aggregations.
+    """
+    from spark_druid_olap_tpu.ops import hll as _hll
+    from spark_druid_olap_tpu.ops import theta as _theta
+    dense = {k: v for k, v in out.items() if k not in sketch_kinds}
+    merged = merge_partials(dense, routes, axis_name)
+    for name, sk in sketch_kinds.items():
+        fold = _hll.merge_registers if sk == "hll" \
+            else _theta.merge_registers
+        merged[name] = fold(out[name], axis_name)
+    return merged

@@ -20,6 +20,7 @@ from spark_druid_olap_tpu.parallel.mesh import mesh_size
 from spark_druid_olap_tpu.utils.config import (
     COST_COMPILE,
     COST_MODEL_ENABLED,
+    COST_PER_BYTE_INTERCONNECT,
     COST_PER_BYTE_TRANSPORT,
     COST_PER_ROW_MERGE,
     COST_PER_ROW_SCAN,
@@ -41,6 +42,7 @@ class CostEstimate:
     n_waves: int = 1
     xhost_bytes: int = 0           # est. cross-host result replication
     host_xhost_bytes: int = 0      # est. host-tier column reassembly bytes
+    ici_bytes: int = 0             # est. intra-host interconnect merge bytes
 
     def table(self) -> str:
         wave = "" if self.n_waves <= 1 else \
@@ -402,10 +404,16 @@ def estimate(ctx_or_engine, q: S.QuerySpec) -> CostEstimate:
         n_hosts = 1
     xhost_bytes = groups * n_aggs * 8 * max(0, n_hosts - 1) \
         if n_hosts > 1 else 0
+    # intra-host interconnect merge bytes: each device contributes its
+    # merged [K x n_aggs] partial block to the all-reduce, so the
+    # reduction moves payload x (n_dev - 1) over the links (ring
+    # convention; parallel/meshexec.py accounts dispatches identically)
+    ici_bytes = groups * n_aggs * 8 * max(0, n_dev - 1)
     sharded = (rows / max(n_dev * eff, 1e-9)) * scan_c \
         + groups * n_aggs * merge_c \
         + groups * byte_c * 16 \
         + xhost_bytes * byte_c \
+        + ici_bytes * conf.get(COST_PER_BYTE_INTERCONNECT) \
         + compile_c * 0.1  # sharded programs compile slower
     recommend = n_dev > 1 and sharded < single
     if not conf.get(COST_MODEL_ENABLED):
@@ -444,7 +452,50 @@ def estimate(ctx_or_engine, q: S.QuerySpec) -> CostEstimate:
     return CostEstimate(rows, sel, groups, single, sharded, n_dev, recommend,
                         scan_bytes=scan_bytes, segments_per_wave=spw,
                         n_waves=waves, xhost_bytes=int(xhost_bytes),
-                        host_xhost_bytes=int(host_xhost))
+                        host_xhost_bytes=int(host_xhost),
+                        ici_bytes=int(ici_bytes))
+
+
+@dataclasses.dataclass
+class MeshEstimate:
+    """Mesh-or-single pricing for one fused shared-scan group
+    (parallel/meshexec.py:decide). The solo path's ``estimate`` prices a
+    whole query spec; the fused tier already holds planned lanes, so
+    this variant takes the resolved quantities directly — including the
+    EXACT merged-payload byte count the packers will ship across the
+    interconnect, not a heuristic."""
+    single_cost: float
+    sharded_cost: float
+    n_devices: int
+    merge_bytes: int
+    recommend_sharded: bool
+
+
+def mesh_estimate(conf, *, n_dev: int, rows: int, groups: int,
+                  n_aggs: int, merge_bytes: int) -> MeshEstimate:
+    """Price one fused dispatch single-device vs sharded over ``n_dev``
+    devices. Same unit costs as ``estimate`` — scan splits across the
+    mesh at the calibrated parallel efficiency; the merge adds a
+    per-row collective term plus the interconnect transport of the
+    merged partial payload (``merge_bytes``, already x(n_dev - 1))."""
+    scan_c = conf.get(COST_PER_ROW_SCAN)
+    merge_c = conf.get(COST_PER_ROW_MERGE)
+    byte_c = conf.get(COST_PER_BYTE_TRANSPORT)
+    compile_c = conf.get(COST_COMPILE)
+    icx_c = conf.get(COST_PER_BYTE_INTERCONNECT)
+    eff = max(1e-3, min(1.0, float(conf.get(COST_SHARD_EFFICIENCY))))
+    n_dev = max(1, int(n_dev))
+    single = rows * scan_c + groups * byte_c * 16
+    sharded = (rows / max(n_dev * eff, 1e-9)) * scan_c \
+        + groups * n_aggs * merge_c \
+        + groups * byte_c * 16 \
+        + merge_bytes * icx_c \
+        + compile_c * 0.1
+    recommend = n_dev > 1 and sharded < single
+    if not conf.get(COST_MODEL_ENABLED):
+        recommend = n_dev > 1
+    return MeshEstimate(single, sharded, n_dev, int(merge_bytes),
+                        recommend)
 
 
 def explain_cost(ctx, q: S.QuerySpec) -> str:

@@ -2635,20 +2635,19 @@ class QueryEngine:
         else:
             mesh = self.mesh
 
+            sketch_kinds = {p.spec.name: "hll" for p in hll_plans}
+            sketch_kinds.update(
+                {p.spec.name: "theta" for p in theta_plans})
+
             def sharded_core(arrays):
                 out = core(arrays)
                 over = out.pop("__over__", None)
-                sk_names = {p.spec.name for p in hll_plans} \
-                    | {p.spec.name for p in theta_plans}
-                dense_out = {k: v for k, v in out.items()
-                             if k not in sk_names}
-                merged = G.merge_partials(dense_out, routes, SEGMENT_AXIS)
-                for p in hll_plans:
-                    merged[p.spec.name] = HLL.merge_registers(
-                        out[p.spec.name], SEGMENT_AXIS)
-                for p in theta_plans:
-                    merged[p.spec.name] = TH.merge_registers(
-                        out[p.spec.name], SEGMENT_AXIS)
+                # ONE mergeable-partial layout for every sharded program
+                # (solo cores here, the fused mesh tier in
+                # parallel/meshexec.py): psum / pmin / pmax per route
+                # algebra, sketch registers per AGG_CLOSURE.merge
+                merged = G.merge_lane_partials(out, routes, sketch_kinds,
+                                               SEGMENT_AXIS)
                 if topk:
                     merged = topk_gather(merged, SEGMENT_AXIS)
                 if over is not None:
@@ -2798,19 +2797,15 @@ class QueryEngine:
             return jax.jit(lambda arrays: finish(core(arrays)))
         mesh = self.mesh
 
+        sketch_kinds = {p.spec.name: "hll" for p in hll_plans}
+        sketch_kinds.update({p.spec.name: "theta" for p in theta_plans})
+
         def sharded_core(arrays):
             out = core(arrays)
-            sk_names = {p.spec.name for p in hll_plans} \
-                | {p.spec.name for p in theta_plans}
-            dense_out = {k: v for k, v in out.items()
-                         if k not in sk_names}
-            merged = G.merge_partials(dense_out, routes, SEGMENT_AXIS)
-            for p in hll_plans:
-                merged[p.spec.name] = HLL.merge_registers(
-                    out[p.spec.name], SEGMENT_AXIS)
-            for p in theta_plans:
-                merged[p.spec.name] = TH.merge_registers(
-                    out[p.spec.name], SEGMENT_AXIS)
+            # shared mergeable-partial layout (ops/groupby.py) — same
+            # register algebra as the fused mesh tier
+            merged = G.merge_lane_partials(out, routes, sketch_kinds,
+                                           SEGMENT_AXIS)
             return finish(merged, SEGMENT_AXIS)
 
         out_specs = self._agg_out_specs(agg_plans, routes)

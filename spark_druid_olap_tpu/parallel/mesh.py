@@ -11,6 +11,8 @@ mesh over ICI/DCN via ``jax.distributed`` — no new code path.
 
 from __future__ import annotations
 
+import os
+import re
 from typing import Optional, Sequence
 
 import jax
@@ -42,6 +44,30 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def mesh_size(mesh: Optional[Mesh]) -> int:
     return 1 if mesh is None else int(np.prod(list(mesh.shape.values())))
+
+
+def mesh_subset(mesh: Mesh, n_devices: int) -> Mesh:
+    """1-D sub-mesh over the first ``n_devices`` of an existing mesh —
+    the bench/loadtest A-B legs scale the SAME device population down
+    (1, 2, 4, ...) instead of constructing meshes from scratch, so every
+    leg shards over a prefix of one device order."""
+    devs = list(np.asarray(mesh.devices).reshape(-1))
+    n = max(1, min(int(n_devices), len(devs)))
+    return Mesh(np.array(devs[:n]), (SEGMENT_AXIS,))
+
+
+_EMULATED_RE = re.compile(
+    r"--xla_force_host_platform_device_count=(\d+)")
+
+
+def emulated_host_devices() -> Optional[int]:
+    """Device count of the CPU-emulated mesh when this process was
+    launched with ``--xla_force_host_platform_device_count=N`` (the
+    chipless-CI recipe, tests/conftest.py / docs/MESH.md), else None.
+    Purely an observability hint — the mesh itself always comes from
+    ``jax.devices()``."""
+    m = _EMULATED_RE.search(os.environ.get("XLA_FLAGS", ""))
+    return int(m.group(1)) if m else None
 
 
 def shard_map(fn, *, mesh: Mesh, in_specs, out_specs, check_vma=False):

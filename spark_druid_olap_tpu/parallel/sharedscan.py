@@ -55,6 +55,8 @@ from spark_druid_olap_tpu.ops import pallas_wave as PW
 from spark_druid_olap_tpu.ops import time_ops as T
 from spark_druid_olap_tpu.ops.scan import ScanContext, array_dtype, array_names
 from spark_druid_olap_tpu.parallel import cost as C
+from spark_druid_olap_tpu.parallel import mesh as M
+from spark_druid_olap_tpu.parallel import meshexec as MX
 from spark_druid_olap_tpu.planner import fusion as FU
 from spark_druid_olap_tpu.result import QueryResult
 from spark_druid_olap_tpu.utils.config import (
@@ -165,6 +167,16 @@ class SharedScanCoalescer:
         self.pallas_tiles = 0
         self.pallas_fallbacks = 0
         self.pallas_vmem_peak = 0
+        # multi-chip mesh tier (parallel/meshexec.py): fused groups whose
+        # segment waves sharded across the local device mesh, with
+        # per-device partials merged on the interconnect. Fallback
+        # reasons mirror the docs/MESH.md matrix; collective_bytes is
+        # the STATIC route-metadata accounting (the mesh lint pass
+        # forbids measuring inside shard bodies)
+        self.mesh_groups = 0            # fused groups dispatched sharded
+        self.mesh_dispatches = 0        # sharded wave dispatches
+        self.mesh_collective_bytes = 0  # est. interconnect merge bytes
+        self.mesh_fallbacks: Dict[str, int] = {}   # reason -> groups
 
     # -- eligibility -----------------------------------------------------------
     def enabled(self) -> bool:
@@ -340,12 +352,18 @@ class SharedScanCoalescer:
         union_time = any(lp.time_in_play for lp in lanes)
         union_names = array_names(ds, union_cols, union_time)
         seg_bytes = C.bytes_per_segment(ds, union_names)
+        # mesh tier (parallel/meshexec.py): static precheck; any
+        # disqualifying condition falls back to single-device with a
+        # named reason. The decision shapes the traced program AND the
+        # wave plan (per-device budgets multiply by n_dev)
+        dec = MX.decide(eng, ds, lanes, len(seg_u))
+        n_dev = dec.n_dev
         spw, n_waves = C.plan_waves(
-            len(seg_u), 1, seg_bytes, C.wave_budget_bytes(eng.config),
+            len(seg_u), n_dev, seg_bytes, C.wave_budget_bytes(eng.config),
             eng.config, max(lp.n_keys for lp in lanes),
             sum(len(lp.agg_plans) for lp in lanes),
             io_budget=C.tier_io_budget(ds, eng.config))
-        s_pad = spw if n_waves > 1 else X._pad_segments(len(seg_u), 1)
+        s_pad = spw if n_waves > 1 else X._pad_segments(len(seg_u), n_dev)
 
         # fusion planning is advisory: any error lowers the unfused way
         # (routing tiers never change). Runs on EVERY fused execution —
@@ -388,7 +406,11 @@ class SharedScanCoalescer:
                wave_ok,
                bool(eng.config.get(PALLAS_WAVE_ENABLED)),
                int(eng.config.get(PALLAS_WAVE_TILE_BYTES)),
-               int(eng.config.get(PALLAS_WAVE_MAX_LANES)))
+               int(eng.config.get(PALLAS_WAVE_MAX_LANES)),
+               # mesh decision re-derived on EVERY fused execution (a
+               # sdot.mesh.* flip, device-count change, or cost-model
+               # swing re-keys the program — sdlint K1)
+               dec.sig_fields())
 
         def _build():
             """Wave first (one pallas launch per wave), jaxpr-fused on
@@ -398,12 +420,14 @@ class SharedScanCoalescer:
                 try:
                     return self._build_wave_program(
                         ds, lanes, min_day, max_day, fplan,
-                        union_names=union_names, s_pad=s_pad)
+                        union_names=union_names, s_pad=s_pad,
+                        mesh_dec=dec)
                 except Exception:  # noqa: BLE001 — WaveFallback + lowering errors
                     with self._lock:
                         self.pallas_fallbacks += 1
             fn, unp = self._build_fused_program(ds, lanes, min_day,
-                                                max_day, fplan)
+                                                max_day, fplan,
+                                                mesh_dec=dec)
             return fn, unp, None
 
         prog_fn, unpacks, wave_info = eng._cached_program(sig, _build)
@@ -411,7 +435,8 @@ class SharedScanCoalescer:
         per_lane_finals = self._dispatch(ds, union_names, seg_u, s_pad,
                                          spw, n_waves, prog_fn, unpacks,
                                          lanes, live[0],
-                                         wave_info=wave_info)
+                                         wave_info=wave_info,
+                                         mesh_dec=dec)
         results = [self._decode_lane(eng, ds, lp, fin)
                    for lp, fin in zip(lanes, per_lane_finals)]
 
@@ -424,10 +449,24 @@ class SharedScanCoalescer:
         if wave_info is not None:
             wave_tiles = -(-(s_pad * ds.padded_rows)
                            // (wave_info["block_rows"] * PW.LANES))
+        # per-device kernel launches: each mesh shard runs its own wave
+        # kernel over its segment slice
+        launches = n_waves * (n_dev if dec.sharded else 1)
+        cbytes = MX.collective_bytes(eng, lanes, n_dev) * n_waves \
+            if dec.sharded else 0
         with self._lock:
             self.groups_coalesced += 1
+            if dec.sharded:
+                self.mesh_groups += 1
+                self.mesh_dispatches += n_waves
+                self.mesh_collective_bytes += cbytes
+            else:
+                self.mesh_fallbacks[dec.reason] = \
+                    self.mesh_fallbacks.get(dec.reason, 0) + 1
             if wave_info is not None:
-                self.pallas_launches += n_waves
+                self.pallas_launches += launches
+                # total tiles are launch-count invariant: the mesh splits
+                # the SAME [s_pad x rows] scan across devices
                 self.pallas_tiles += n_waves * wave_tiles
                 self.pallas_vmem_peak = max(self.pallas_vmem_peak,
                                             wave_info["vmem_bytes"])
@@ -448,10 +487,14 @@ class SharedScanCoalescer:
             fin = per_lane_finals[li]
             m.stats = {
                 "datasource": ds.name, "segments": int(len(lp.seg)),
-                "sharded": False, "rows_scanned": int(ds.num_rows),
+                "sharded": bool(dec.sharded),
+                "rows_scanned": int(ds.num_rows),
                 "groups": int(np.count_nonzero(fin["__rows__"] > 0)),
                 "waves": int(n_waves), "segments_per_wave": int(spw),
                 "bytes_scanned": int(seg_bytes) * int(len(seg_u)),
+                "mesh": {"devices": int(n_dev),
+                         "decision": dec.reason,
+                         "collective_bytes": int(cbytes)},
                 "sharedscan": {
                     "group": g.gid, "queries": len(planned),
                     "lanes": len(lanes),
@@ -460,7 +503,7 @@ class SharedScanCoalescer:
                     "dispatches_saved": saved_disp,
                     "fusion": (fplan.counters()
                                if fplan is not None else None),
-                    "pallas": ({"launches": int(n_waves),
+                    "pallas": ({"launches": int(launches),
                                 "tiles": int(n_waves * wave_tiles),
                                 "block_rows": wave_info["block_rows"],
                                 "vmem_bytes": wave_info["vmem_bytes"]}
@@ -553,12 +596,16 @@ class SharedScanCoalescer:
             return False
 
     def _build_fused_program(self, ds, lanes: List[_LanePlan],
-                             min_day: int, max_day: int, fplan=None):
+                             min_day: int, max_day: int, fplan=None,
+                             mesh_dec=None):
         """(jit_fn, [per-lane unpack]). One ScanContext over the union
         bind; each lane is the engine's dense core (mask -> fused keys ->
         dense_groupby -> sketch registers) packed through its own
         two-buffer packers, so per-lane decode reuses the solo path
-        byte-for-byte.
+        byte-for-byte. With a sharded mesh decision the same per-lane
+        core wraps in ``shard_map`` (parallel/meshexec.py): each device
+        scans its segment slice and partials merge on the interconnect
+        before packing — unpack/decode stay byte-for-byte shared.
 
         With a fusion plan, the program is single-pass with predicate
         CSE: cross-lane shared masks lower FIRST (each union column
@@ -574,7 +621,10 @@ class SharedScanCoalescer:
                                          lp.n_keys, with_idx=False)
                    for lp in lanes]
 
-        def fused(arrays):
+        def lane_outs(arrays):
+            """Per-lane route-conformant output dicts — the shared inner
+            loop both the single-device pack and the mesh shard body
+            close over (each mesh shard runs it over its own slice)."""
             ctx = ScanContext(ds, arrays, min_day, max_day, tz=tz)
             rv = ctx.row_valid()
             cse = None
@@ -582,7 +632,7 @@ class SharedScanCoalescer:
                 cse = FU.CSECache(ctx)
                 cse.prelower(fplan)
             outs = []
-            for lp, (pack, _) in zip(lanes, packers):
+            for lp in lanes:
                 base = rv
                 fm = cse.lower(lp.q.filter) if cse is not None \
                     else F.lower_filter(lp.q.filter, ctx)
@@ -623,14 +673,21 @@ class SharedScanCoalescer:
                     else:
                         out[p.spec.name] = TH.theta_registers(
                             key, m, vals, lp.n_keys)
-                outs.append(pack(out))
-            return tuple(outs)
+                outs.append(out)
+            return outs
 
-        return jax.jit(fused), [u for _, u in packers]
+        if mesh_dec is not None and mesh_dec.sharded:
+            fn = MX.build_sharded_program(eng, lane_outs, lanes, packers)
+        else:
+            def fused(arrays):
+                return tuple(pack(o) for (pack, _), o
+                             in zip(packers, lane_outs(arrays)))
+            fn = jax.jit(fused)
+        return fn, [u for _, u in packers]
 
     def _build_wave_program(self, ds, lanes: List[_LanePlan],
                             min_day: int, max_day: int, fplan=None, *,
-                            union_names, s_pad):
+                            union_names, s_pad, mesh_dec=None):
         """(jit_fn, [per-lane unpack], wave_info). The group's whole wave
         lowers through ONE hand-scheduled Pallas mega-kernel
         (ops/pallas_wave.py); outputs are route-conformant per lane, so
@@ -649,14 +706,23 @@ class SharedScanCoalescer:
                                          lp.n_keys, with_idx=False)
                    for lp in lanes]
 
-        def fused(arrays):
-            outs = wave_fn(arrays)
-            return tuple(pack(o) for (pack, _), o in zip(packers, outs))
-
-        fn = jax.jit(fused)
+        if mesh_dec is not None and mesh_dec.sharded:
+            # the wave mega-kernel is shape-generic over the segment dim:
+            # inside shard_map each device launches it over its own
+            # [s_pad / n_dev, R] slice, partials merge on the
+            # interconnect, and the SAME packers/unpacks apply
+            fn = MX.build_sharded_program(eng, wave_fn, lanes, packers)
+        else:
+            def fused(arrays):
+                outs = wave_fn(arrays)
+                return tuple(pack(o)
+                             for (pack, _), o in zip(packers, outs))
+            fn = jax.jit(fused)
         # surface trace/shape errors at BUILD time (abstract eval — no
         # device compile), so a bad lowering falls back here instead of
-        # failing the group's first dispatch
+        # failing the group's first dispatch; with a mesh decision this
+        # traces THROUGH shard_map, so per-shard lowering rejects also
+        # land here (the group then falls back to the jaxpr program)
         shapes = {k: jax.ShapeDtypeStruct(
             (s_pad, ds.padded_rows),
             jnp.zeros((), dtype=array_dtype(ds, k)).dtype)
@@ -666,47 +732,81 @@ class SharedScanCoalescer:
 
     def _dispatch(self, ds, union_names, seg_u, s_pad, spw, n_waves,
                   prog_fn, unpacks, lanes: List[_LanePlan], leader,
-                  wave_info=None):
+                  wave_info=None, mesh_dec=None):
         """One shared bind + ONE program dispatch per wave (double-
         buffered like _run_waves); per-lane unpack -> finals -> cross-
         wave merge. All device ticks land on the leader's thread —
         including the wave-kernel launch tick (dispatch_counts[2]) when
-        the wave program is live."""
+        the wave program is live. With a sharded mesh decision binds
+        carry the segment-axis sharding, launch ticks count per device,
+        and the packed per-device partial buffers the wave loop holds on
+        device are accounted through the meshexec partial ledger
+        (acquire/release pair — sdlint leaks)."""
         from spark_druid_olap_tpu.parallel import executor as X
         eng = self.engine
+        sharded = mesh_dec is not None and mesh_dec.sharded
+        n_dev = mesh_dec.n_dev if sharded else 1
         if wave_info is not None:
-            eng._tick(2, n_waves)           # pallas kernel launches
+            # pallas kernel launches: one per device per wave
+            eng._tick(2, n_waves * n_dev)
         sketch = [[p for p in lp.agg_plans if p.kind in ("hll", "theta")]
                   for lp in lanes]
+        payload = MX.merged_payload_bytes(eng, lanes) * n_dev
         if n_waves == 1:
-            dev = eng._bind_arrays(ds, union_names, seg_u, s_pad, False)
+            dev = eng._bind_arrays(ds, union_names, seg_u, s_pad, sharded)
             eng._stage_check(leader.q, leader.t0)
             eng._tick()
-            bufs = prog_fn(dev)
-            return [X._finals_from_out(unpacks[i](bufs[i]), lp.routes,
-                                       lp.n_keys, sketch[i])
-                    for i, lp in enumerate(lanes)]
-        wave_segs = [seg_u[i: i + spw] for i in range(0, len(seg_u), spw)]
+            tok = MX.LEDGER.acquire_partials(payload)
+            try:
+                bufs = prog_fn(dev)
+                return [X._finals_from_out(unpacks[i](bufs[i]), lp.routes,
+                                           lp.n_keys, sketch[i])
+                        for i, lp in enumerate(lanes)]
+            finally:
+                MX.LEDGER.release_partials(tok)
+        seg_rows = None
+        if sharded:
+            try:
+                seg_rows = {int(s): int(ds.segments[int(s)].num_rows)
+                            for s in seg_u}
+            except Exception:  # noqa: BLE001 — handles without segment objects
+                seg_rows = None
+        wave_segs = FU.plan_device_waves(seg_u, spw, n_dev, seg_rows)
+        sharding = M.segment_sharding(eng.mesh) if sharded else None
         finals: List[Optional[dict]] = [None] * len(lanes)
-        # cold tier: wave 1's chunks load while wave 0 binds + computes
-        eng._tier_prefetch(ds, union_names, wave_segs, 1)
-        cur = eng._bind_wave(ds, union_names, wave_segs[0], spw, None,
-                             False)
-        for i in range(len(wave_segs)):
-            eng._stage_check(leader.q, leader.t0)
-            eng._tick()
-            bufs = prog_fn(cur)            # async dispatch
-            eng._tier_prefetch(ds, union_names, wave_segs, i + 2)
-            nxt = eng._bind_wave(ds, union_names, wave_segs[i + 1], spw,
-                                 None, False) \
-                if i + 1 < len(wave_segs) else None
-            for li, lp in enumerate(lanes):
-                f = X._finals_from_out(unpacks[li](bufs[li]), lp.routes,
-                                       lp.n_keys, sketch[li])
-                finals[li] = f if finals[li] is None \
-                    else X._merge_wave_finals(finals[li], f, lp.routes,
-                                              sketch[li])
-            cur = nxt
+        # mesh-parallel cold-tier faults: open a devices-aware pin scope
+        # so eviction sees the whole n_dev-wide wave as one pinned unit
+        tier = getattr(ds, "tier", None)
+        ptok = tier.acquire_pins(devices=n_dev) \
+            if (sharded and tier is not None) else None
+        try:
+            tok = MX.LEDGER.acquire_partials(payload)
+            try:
+                # cold tier: wave 1's chunks load while wave 0 binds+computes
+                eng._tier_prefetch(ds, union_names, wave_segs, 1)
+                cur = eng._bind_wave(ds, union_names, wave_segs[0], spw,
+                                     sharding, False)
+                for i in range(len(wave_segs)):
+                    eng._stage_check(leader.q, leader.t0)
+                    eng._tick()
+                    bufs = prog_fn(cur)            # async dispatch
+                    eng._tier_prefetch(ds, union_names, wave_segs, i + 2)
+                    nxt = eng._bind_wave(ds, union_names, wave_segs[i + 1],
+                                         spw, sharding, False) \
+                        if i + 1 < len(wave_segs) else None
+                    for li, lp in enumerate(lanes):
+                        f = X._finals_from_out(unpacks[li](bufs[li]),
+                                               lp.routes, lp.n_keys,
+                                               sketch[li])
+                        finals[li] = f if finals[li] is None \
+                            else X._merge_wave_finals(finals[li], f,
+                                                      lp.routes, sketch[li])
+                    cur = nxt
+            finally:
+                MX.LEDGER.release_partials(tok)
+        finally:
+            if ptok is not None:
+                tier.release_pins(ptok)
         return finals
 
     @staticmethod
@@ -789,6 +889,13 @@ class SharedScanCoalescer:
                         "tiles": self.pallas_tiles,
                         "fallbacks": self.pallas_fallbacks,
                         "vmem_bytes_peak": self.pallas_vmem_peak},
+                    "mesh": {
+                        "devices": M.mesh_size(self.engine.mesh),
+                        "groups": self.mesh_groups,
+                        "dispatches": self.mesh_dispatches,
+                        "collective_bytes": self.mesh_collective_bytes,
+                        "fallbacks": dict(self.mesh_fallbacks),
+                        "partials": MX.LEDGER.stats()},
                     "fusion": {
                         "groups": self.fusion_groups,
                         "plan_fallbacks": self.fusion_fallbacks,

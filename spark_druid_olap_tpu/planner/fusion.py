@@ -235,6 +235,53 @@ def analyze_query(filter_spec: Optional[S.FilterSpec], intervals,
     return totals[0], len(seen)
 
 
+def plan_device_waves(seg_idx, spw: int, n_dev: int,
+                      seg_rows=None) -> list:
+    """Partition a segment selection into dispatch waves of ``spw``
+    slots and, within each wave, order the segments so the mesh's
+    contiguous per-device blocks (``spw / n_dev`` slots each — the
+    layout ``NamedSharding(P(SEGMENT_AXIS))`` splits a ``[S, R]`` bind
+    into) carry balanced ROW loads. The wave kernel's runtime is its
+    slowest device; greedy LPT over per-segment valid-row counts keeps
+    the straggler gap small when segment fill is skewed (a tail segment
+    is routinely near-empty). ``seg_rows`` maps segment id -> valid
+    rows; None degrades to slot-count balancing (original order).
+
+    Correctness-neutral by construction: each wave holds the same
+    segment SET, ``row_valid`` travels in the bound arrays, and the
+    merge algebra is grouping-invariant (psum over f64-exact pairs /
+    pmin / pmax) — only which chip scans which segment changes. The
+    tail wave (fewer than ``spw`` real segments) binds pad slots at the
+    end, so its device blocks are approximate; padding rows are zero
+    work either way.
+
+    Returns the list of per-wave segment-id arrays (``np.ndarray``,
+    last one possibly short — the bind layer pads to ``spw``)."""
+    import numpy as _np
+    seg_idx = _np.asarray(seg_idx)
+    waves = [seg_idx[i: i + spw] for i in range(0, len(seg_idx), spw)]
+    if n_dev <= 1 or seg_rows is None:
+        return waves
+    per_dev = max(1, spw // max(1, n_dev))
+    out = []
+    for w in waves:
+        rows = _np.array([int(seg_rows.get(int(s), 0)) for s in w],
+                         dtype=_np.int64)
+        order = _np.argsort(-rows, kind="stable")
+        buckets: list = [[] for _ in range(n_dev)]
+        loads = _np.zeros(n_dev, dtype=_np.int64)
+        for j in order:
+            free = [d for d in range(n_dev) if len(buckets[d]) < per_dev]
+            if not free:
+                free = list(range(n_dev))
+            d = min(free, key=lambda k: (int(loads[k]), k))
+            buckets[d].append(int(w[j]))
+            loads[d] += int(rows[j])
+        out.append(_np.array([s for b in buckets for s in b],
+                             dtype=w.dtype))
+    return out
+
+
 def plan_wave_tiles(itemsizes: Sequence[int],
                     int_sum_maxabs: Sequence[float],
                     scratch_rows: int, budget_bytes: int,

@@ -111,12 +111,17 @@ class _Entry:
 
 
 class PinToken:
-    """Per-query pin set: chunk key -> refcount contributed."""
+    """Per-query pin set: chunk key -> refcount contributed.
+    ``devices`` records how many mesh devices the pinned wave feeds
+    (1 = single-device) — a mesh-parallel fault pins ``n_dev``x more
+    segments per wave, and eviction pressure accounting wants to see
+    that multiplier, not infer it."""
 
-    __slots__ = ("keys",)
+    __slots__ = ("keys", "devices")
 
-    def __init__(self):
+    def __init__(self, devices: int = 1):
         self.keys: Dict[tuple, int] = {}
+        self.devices = max(1, int(devices))
 
 
 class TieredColumnStore:
@@ -138,6 +143,7 @@ class TieredColumnStore:
         self._lock = threading.RLock()
         self._hot: Dict[tuple, _Entry] = {}
         self._pins: Dict[tuple, int] = {}
+        self._mesh_pins: Dict[tuple, int] = {}   # pins from devices>1 scopes
         self._bytes = 0
         self._tick = 0
         self._verified = set()                 # blob paths CRC-checked OK
@@ -148,7 +154,7 @@ class TieredColumnStore:
             "evictions": 0, "bytes_evicted": 0,
             "crc_verified_files": 0, "crc_failures": 0,
             "crc_verify_ms": 0.0,
-            "pin_tokens": 0,
+            "pin_tokens": 0, "pin_tokens_mesh": 0,
             "prefetch_submitted": 0, "prefetch_loaded": 0,
             "prefetch_dropped": 0,
             "prefetch_hits": 0, "prefetch_hit_bytes": 0,
@@ -164,13 +170,19 @@ class TieredColumnStore:
             s = self._tls.tokens = []
         return s
 
-    def acquire_pins(self) -> PinToken:
+    def acquire_pins(self, devices: int = 1) -> PinToken:
         """Open a pin scope on THIS thread: every chunk faulted until the
-        matching release is held out of eviction's reach."""
-        tok = PinToken()
+        matching release is held out of eviction's reach. ``devices`` > 1
+        marks a mesh-parallel scope (parallel/meshexec.py): the wave
+        being pinned spans the whole device mesh, so its chunks are
+        additionally tracked in the mesh-pin gauge the stats surface
+        reports (eviction itself treats every pin identically)."""
+        tok = PinToken(devices)
         self._token_stack().append(tok)
         with self._lock:
             self.counters["pin_tokens"] += 1
+            if tok.devices > 1:
+                self.counters["pin_tokens_mesh"] += 1
         return tok
 
     def release_pins(self, tok: PinToken) -> None:
@@ -184,6 +196,12 @@ class TieredColumnStore:
                     self._pins.pop(k, None)
                 else:
                     self._pins[k] = r
+                if tok.devices > 1:
+                    rm = self._mesh_pins.get(k, 0) - n
+                    if rm <= 0:
+                        self._mesh_pins.pop(k, None)
+                    else:
+                        self._mesh_pins[k] = rm
             tok.keys.clear()
             self._evict_locked()   # deferred evictions land here
 
@@ -191,6 +209,8 @@ class TieredColumnStore:
         for tok in getattr(self._tls, "tokens", ()):
             tok.keys[key] = tok.keys.get(key, 0) + 1
             self._pins[key] = self._pins.get(key, 0) + 1
+            if tok.devices > 1:
+                self._mesh_pins[key] = self._mesh_pins.get(key, 0) + 1
 
     def pinned_bytes(self) -> int:
         with self._lock:
@@ -468,6 +488,11 @@ class TieredColumnStore:
                 "hot_entries": len(self._hot),
                 "pinned_entries": sum(1 for k in self._hot
                                       if self._pins.get(k)),
+                "mesh_pinned_entries": sum(1 for k in self._hot
+                                           if self._mesh_pins.get(k)),
+                "mesh_pinned_bytes": sum(e.nbytes
+                                         for k, e in self._hot.items()
+                                         if self._mesh_pins.get(k)),
                 "prefetch_overlap_ratio": round(
                     c["prefetch_hit_bytes"] / faulted, 4),
                 **c,

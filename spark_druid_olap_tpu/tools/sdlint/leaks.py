@@ -74,6 +74,13 @@ REGISTRY: Tuple[Resource, ...] = (
     # query faulted resident forever, silently growing the hot set past
     # its byte budget (tier/store.py pin protocol)
     Resource("tier-pin", (("acquire_pins",),), (("release_pins",),)),
+    # mesh-dispatch partial buffers: the fused wave loop holds every
+    # device's packed partial aggregates resident between dispatch and
+    # host unpack (parallel/meshexec.py PartialLedger); an unreleased
+    # token leaves the gauge permanently non-zero, misreporting device
+    # memory pressure to the stats surface
+    Resource("mesh-partials", (("acquire_partials",),),
+             (("release_partials",),)),
     # fault-injection scopes: an unbalanced begin_scope leaves the named
     # scope refcounted open forever, so every rule gated on it keeps
     # firing after the leg that opened it ends (fault/plan.py)

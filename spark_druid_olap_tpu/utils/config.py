@@ -127,6 +127,14 @@ COST_SHARD_EFFICIENCY = _entry(
     "virtual mesh over shared host cores measures far lower and the "
     "single-vs-sharded decision must reflect that. Fit by "
     "tools/calibrate.py from measured wall times.", float)
+COST_PER_BYTE_INTERCONNECT = _entry(
+    "sdot.querycostmodel.interconnect.cost", 5e-10,
+    "Abstract cost to move one byte across the device interconnect (ICI) "
+    "during the cross-chip merge of per-device partial aggregates — the "
+    "mesh tier's analog of the reference's broker-merge transport term. "
+    "Prices the reduction payload (merged partial bytes x (n_dev - 1)) "
+    "so wide outputs on small scans correctly prefer single-device "
+    "execution.", float)
 COST_SORT_ROW = _entry(
     "sdot.querycostmodel.sort.seconds.per.row", 2.2e-10,
     "Measured seconds per row of a 2-operand device lax.sort (the "
@@ -207,6 +215,30 @@ PALLAS_WAVE_MAX_LANES = _entry(
     "Max fused lanes (distinct constituent plans) a single wave "
     "mega-kernel accumulates; larger groups fall back to the jaxpr-fused "
     "program (trace size and scratch rows grow per lane).", int)
+MESH_ENABLED = _entry(
+    "sdot.mesh.enabled", True,
+    "Shared-scan fused groups (parallel/sharedscan.py) shard their "
+    "segment waves across the local device mesh (parallel/meshexec.py): "
+    "each device scans its segment slice — through the Pallas wave "
+    "mega-kernel when the group is wave-eligible — and per-lane partial "
+    "aggregates merge on the interconnect with the register algebra "
+    "AGG_CLOSURE declares (psum sums/counts, pmax min-sentinel-free "
+    "maxima + HLL registers, pmin minima + theta hash minima). False "
+    "pins the fused tier to single-device execution (kill switch); solo "
+    "queries keep their own cost-model shard decision either way.")
+MESH_AUTO = _entry(
+    "sdot.mesh.auto", False,
+    "Build the local device mesh automatically at Context startup when "
+    "more than one device is visible — how subprocess deployments "
+    "(cluster historicals via --set sdot.mesh.auto=true) opt their "
+    "engines into the multi-chip mesh tier without a code-level mesh "
+    "handle. The in-process equivalent is Context(auto_mesh=True).")
+MESH_MIN_SEGMENTS = _entry(
+    "sdot.mesh.min.segments", 2,
+    "Minimum selected segments before the fused tier shards a group "
+    "across the mesh; below it one device owns the whole scan (a "
+    "1-segment-per-device split pays collective latency for no scan "
+    "parallelism).", int)
 GROUPBY_MATMUL_MAX_KEYS = _entry(
     "sdot.engine.groupby.matmul.max.keys", 4096,
     "Dense group-by uses the MXU one-hot matmul path when the fused key "

@@ -350,3 +350,67 @@ def test_compact_gate_decision_matches_measured_ordering():
     measured_prefers_compact = t_on < t_off
     assert gate_compacts == measured_prefers_compact, \
         (gate_compacts, t_on, t_off)
+
+
+# -----------------------------------------------------------------------------
+# mesh-tier pricing (fused shared-scan groups; parallel/meshexec.py:decide)
+# -----------------------------------------------------------------------------
+
+def test_mesh_estimate_large_scan_prefers_sharded():
+    # steady state (compile amortized away): the 8-way scan split
+    # dominates the merge + interconnect terms on a big scan
+    cfg = Config({"sdot.querycostmodel.compile.cost": 0.0})
+    est = C.mesh_estimate(cfg, n_dev=8, rows=50_000_000, groups=64,
+                          n_aggs=4, merge_bytes=64 * 4 * 8 * 7)
+    assert est.recommend_sharded
+    assert est.sharded_cost < est.single_cost
+    assert est.n_devices == 8 and est.merge_bytes == 64 * 4 * 8 * 7
+
+
+def test_mesh_estimate_small_scan_prefers_single():
+    # 20k rows: compile amortization dominates, matching the solo path
+    est = C.mesh_estimate(Config(), n_dev=8, rows=20_000, groups=8,
+                          n_aggs=2, merge_bytes=8 * 2 * 8 * 7)
+    assert not est.recommend_sharded
+
+
+def test_mesh_estimate_single_device_never_recommends():
+    est = C.mesh_estimate(Config({"sdot.querycostmodel.compile.cost": 0.0}),
+                          n_dev=1, rows=50_000_000, groups=8, n_aggs=2,
+                          merge_bytes=0)
+    assert not est.recommend_sharded and est.n_devices == 1
+
+
+def test_mesh_estimate_interconnect_term_is_linear_and_can_flip():
+    from spark_druid_olap_tpu.utils.config import COST_PER_BYTE_INTERCONNECT
+    cfg = Config({"sdot.querycostmodel.compile.cost": 0.0})
+    icx = float(cfg.get(COST_PER_BYTE_INTERCONNECT))
+    base = C.mesh_estimate(cfg, n_dev=8, rows=1_000_000, groups=64,
+                           n_aggs=2, merge_bytes=0)
+    assert base.recommend_sharded
+    extra = 2 * int((base.single_cost - base.sharded_cost) / icx)
+    wide = C.mesh_estimate(cfg, n_dev=8, rows=1_000_000, groups=64,
+                           n_aggs=2, merge_bytes=extra)
+    # exact linearity in the priced bytes...
+    assert wide.sharded_cost == pytest.approx(
+        base.sharded_cost + extra * icx)
+    # ...and a payload wide enough to out-price the scan split flips
+    # the recommendation back to single-device
+    assert not wide.recommend_sharded
+    assert wide.single_cost == base.single_cost
+
+
+def test_mesh_estimate_cost_model_off_forces_sharded():
+    cfg = Config({"sdot.querycostmodel.enabled": False})
+    est = C.mesh_estimate(cfg, n_dev=8, rows=100, groups=8, n_aggs=2,
+                          merge_bytes=1 << 20)
+    assert est.recommend_sharded
+
+
+def test_estimate_prices_interconnect_bytes(store):
+    eng = QueryEngine(store, mesh=make_mesh())
+    est = C.estimate(eng, _q())
+    # _q carries 2 aggregations; the ici term is groups x n_aggs x 8
+    # bytes shipped (n_dev - 1) times, ring convention
+    assert est.ici_bytes == est.output_groups * 2 * 8 * (est.n_devices - 1)
+    assert est.ici_bytes > 0

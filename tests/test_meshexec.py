@@ -1,0 +1,372 @@
+"""Multi-chip mesh execution tier (parallel/meshexec.py).
+
+The acceptance bar is differential, like test_sharedscan.py: a fused
+shared-scan batch sharded across the emulated 8-device mesh must return
+bit-identical answers (sums / counts / min / max) and register-identical
+sketches (HLL / theta) to the same batch on a single device — on the
+sales store, the TPC-H flat index, and the SSB flat index, over both the
+jaxpr-fused core and the Pallas wave mega-kernel. On top of that:
+
+- the static eligibility precheck's fallback matrix: every disqualifying
+  condition declines the mesh with its named reason and the answers stay
+  correct;
+- the ``mesh`` stats surface: engine-wide groups / dispatches /
+  collective_bytes counters, per-query decision snapshots, and the
+  partial-buffer ledger draining to zero;
+- the planner's device-aware wave partitioning (LPT row balancing) and
+  the cost model's interconnect pricing units live in test_cost.py.
+"""
+
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.ir import spec as S
+from spark_druid_olap_tpu.parallel import meshexec as MX
+from spark_druid_olap_tpu.parallel.executor import QueryEngine
+from spark_druid_olap_tpu.parallel.mesh import make_mesh
+from spark_druid_olap_tpu.planner.fusion import plan_device_waves
+from spark_druid_olap_tpu.segment.ingest import ingest_dataframe
+from spark_druid_olap_tpu.segment.store import SegmentStore
+from spark_druid_olap_tpu.tools import ssb, tpch
+from spark_druid_olap_tpu.utils.config import Config
+
+from conftest import assert_frames_equal, make_sales_df
+
+
+# -- harness (mirrors test_sharedscan.py) -------------------------------------
+
+WINDOW_MS = 500.0
+
+# every merge-algebra register class: psum limbs (doublesum/longsum/count),
+# pmin/pmax extrema, pmax HLL registers, pmin theta hash minima
+AGGS = (S.AggregationSpec("doublesum", "revenue", field="price"),
+        S.AggregationSpec("longsum", "units", field="qty"),
+        S.AggregationSpec("count", "n"),
+        S.AggregationSpec("doublemin", "lo", field="price"),
+        S.AggregationSpec("doublemax", "hi", field="price"),
+        S.AggregationSpec("cardinality", "uprod", field="product"),
+        S.AggregationSpec("thetasketch", "tprod", field="product"))
+
+
+def _mesh_engine(store, **overrides):
+    cfg = {"sdot.sharedscan.enabled": True,
+           "sdot.wlm.batch.window.ms": WINDOW_MS,
+           "sdot.wlm.enabled": False,
+           "sdot.querycostmodel.enabled": False}
+    cfg.update(overrides)
+    return QueryEngine(store, config=Config(cfg), mesh=make_mesh())
+
+
+def _ref_engine(store, **overrides):
+    cfg = {"sdot.sharedscan.enabled": False, "sdot.wlm.enabled": False}
+    cfg.update(overrides)
+    return QueryEngine(store, config=Config(cfg))
+
+
+def _run_concurrent(eng, specs):
+    n = len(specs)
+    res, errs, stats = [None] * n, [None] * n, [None] * n
+    bar = threading.Barrier(n)
+
+    def worker(i):
+        bar.wait()
+        try:
+            res[i] = eng.execute(specs[i]).to_pandas()
+            stats[i] = dict(eng.last_stats)
+        except Exception as e:          # noqa: BLE001 - surfaced via errs
+            errs[i] = e
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return res, errs, stats
+
+
+def _sales_batch():
+    return [
+        S.GroupByQuerySpec("sales", (S.DimensionSpec("region", "region"),),
+                           AGGS),
+        S.GroupByQuerySpec("sales", (S.DimensionSpec("flag", "flag"),),
+                           AGGS, filter=S.SelectorFilter("status", "O")),
+        S.TimeseriesQuerySpec("sales", AGGS,
+                              granularity=S.Granularity("month")),
+    ]
+
+
+# fallback-matrix / re-key tests assert the DECISION and counters, not the
+# register algebra (the differentials above cover that) — a 2-lane 2-agg
+# batch keeps each of those engines' compile cost small
+SLIM_AGGS = (S.AggregationSpec("doublesum", "revenue", field="price"),
+             S.AggregationSpec("count", "n"))
+
+
+def _slim_batch():
+    return [
+        S.GroupByQuerySpec("sales", (S.DimensionSpec("region", "region"),),
+                           SLIM_AGGS),
+        S.GroupByQuerySpec("sales", (S.DimensionSpec("flag", "flag"),),
+                           SLIM_AGGS, filter=S.SelectorFilter("status", "O")),
+    ]
+
+
+@pytest.fixture(scope="module")
+def full_ref(store):
+    """Single-device sequential answers for _sales_batch(), computed once
+    for every differential over the shared session store."""
+    eng = _ref_engine(store)
+    return [eng.execute(q).to_pandas() for q in _sales_batch()]
+
+
+@pytest.fixture(scope="module")
+def slim_ref(store):
+    eng = _ref_engine(store)
+    return [eng.execute(q).to_pandas() for q in _slim_batch()]
+
+
+def _mesh_diff(store, specs, *, expect_sharded=True, ref=None, **overrides):
+    """Differential: mesh-sharded coalesced batch == solo single-device
+    sequential answers. Returns (coalescer stats, member stats)."""
+    if ref is None:
+        ref = [_ref_engine(store).execute(q).to_pandas() for q in specs]
+    eng = _mesh_engine(store, **overrides)
+    res, errs, stats = _run_concurrent(eng, specs)
+    assert not any(errs), [e for e in errs if e]
+    for got, want in zip(res, ref):
+        assert_frames_equal(got, want)
+    st = eng.sharedscan.stats()
+    if expect_sharded:
+        assert st["mesh"]["groups"] >= 1, st["mesh"]
+        assert st["mesh"]["collective_bytes"] > 0, st["mesh"]
+        assert any(s.get("sharded") for s in stats if s), stats
+    assert st["mesh"]["partials"]["outstanding_bytes"] == 0, st["mesh"]
+    return st, stats
+
+
+# -- differentials: every register class, both lowering paths -----------------
+
+def test_sales_batch_matches_single_device(store, full_ref):
+    st, stats = _mesh_diff(store, _sales_batch(), ref=full_ref)
+    assert st["mesh"]["devices"] == 8
+    assert st["mesh"]["dispatches"] >= 1
+    mem = next(s["mesh"] for s in stats if s and s.get("sharded"))
+    assert mem["devices"] == 8
+    assert mem["decision"] == "sharded"      # cost model off in harness
+    assert mem["collective_bytes"] > 0
+
+
+def test_pallas_wave_mesh_matches_single_device(monkeypatch):
+    """The Pallas wave mega-kernel runs INSIDE the shard_map body: one
+    launch per device per wave, same answers. Interpret mode executes the
+    kernel tile-by-tile on the host, so this runs on a small dedicated
+    store (8 segments still shards across all 8 devices)."""
+    monkeypatch.setenv("SDOT_PALLAS", "interpret")
+    small = SegmentStore()
+    small.register(ingest_dataframe("sales", make_sales_df(n=8_000),
+                                    time_column="ts", target_rows=1024))
+    st, stats = _mesh_diff(small, _sales_batch()[:2],
+                           **{"sdot.pallas.wave.enabled": True})
+    pal = st["pallas"]
+    assert pal["launches"] >= 8, pal         # >= one wave x 8 devices
+    assert pal["fallbacks"] == 0, pal
+
+
+def test_tpch_flat_mesh_differential():
+    ctx = sdot.Context()
+    tpch.setup_context(ctx, sf=0.002, target_rows=1024, flat_only=True)
+    specs = [
+        S.GroupByQuerySpec(
+            "tpch_flat",
+            (S.DimensionSpec("l_returnflag", "l_returnflag"),
+             S.DimensionSpec("l_linestatus", "l_linestatus")),
+            (S.AggregationSpec("doublesum", "rev", field="l_extendedprice"),
+             S.AggregationSpec("doublemin", "mn", field="l_discount"),
+             S.AggregationSpec("doublemax", "mx", field="l_extendedprice"),
+             S.AggregationSpec("count", "n"),
+             S.AggregationSpec("cardinality", "ok", field="l_orderkey"))),
+        S.GroupByQuerySpec(
+            "tpch_flat",
+            (S.DimensionSpec("l_shipmode", "l_shipmode"),),
+            (S.AggregationSpec("doublesum", "rev", field="l_extendedprice"),
+             S.AggregationSpec("longsum", "q", field="l_quantity"),
+             S.AggregationSpec("thetasketch", "sk", field="l_suppkey"))),
+    ]
+    _mesh_diff(ctx.store, specs)
+
+
+def test_ssb_flat_mesh_differential():
+    ctx = sdot.Context()
+    tables, _flat = ssb.setup_context(ctx, sf=0.003, target_rows=1024)
+    specs = [
+        S.GroupByQuerySpec(
+            "ssb_flat",
+            (S.DimensionSpec("d_year", "d_year"),),
+            (S.AggregationSpec("longsum", "rev", field="lo_revenue"),
+             S.AggregationSpec("longmin", "mn", field="lo_discount"),
+             S.AggregationSpec("longmax", "mx", field="lo_quantity"),
+             S.AggregationSpec("count", "n"))),
+        S.GroupByQuerySpec(
+            "ssb_flat",
+            (S.DimensionSpec("s_region", "s_region"),),
+            (S.AggregationSpec("longsum", "rev", field="lo_revenue"),
+             S.AggregationSpec("cardinality", "uc", field="lo_custkey"))),
+    ]
+    _mesh_diff(ctx.store, specs)
+
+
+def test_multiwave_mesh_matches_single_device(sales_df):
+    """A byte budget small enough to force several device waves: the
+    per-wave merge + host cross-wave fold must still be exact, and the
+    devices-aware LPT partitioning must not change any answer."""
+    st = SegmentStore()
+    st.register(ingest_dataframe("sales", sales_df, time_column="ts",
+                                 target_rows=512))
+    assert st.get("sales").num_segments > 16
+    stats, member = _mesh_diff(
+        st, _sales_batch()[:2],
+        **{"sdot.engine.wave.max.bytes": 200_000})
+    assert stats["mesh"]["groups"] >= 1
+
+
+# -- fallback matrix ----------------------------------------------------------
+
+def test_fallback_no_mesh(store, slim_ref):
+    eng_cfg = {"sdot.sharedscan.enabled": True,
+               "sdot.wlm.batch.window.ms": WINDOW_MS,
+               "sdot.wlm.enabled": False}
+    eng = QueryEngine(store, config=Config(eng_cfg))    # no mesh at all
+    ref = slim_ref
+    res, errs, stats = _run_concurrent(eng, _slim_batch())
+    assert not any(errs), [e for e in errs if e]
+    for got, want in zip(res, ref):
+        assert_frames_equal(got, want)
+    st = eng.sharedscan.stats()
+    assert st["mesh"]["fallbacks"].get("no-mesh", 0) >= 1, st["mesh"]
+    assert st["mesh"]["dispatches"] == 0
+    mem = next(s["mesh"] for s in stats if s and "mesh" in s)
+    assert mem["decision"] == "no-mesh" and mem["devices"] == 1
+    assert mem["collective_bytes"] == 0
+
+
+def test_fallback_kill_switch(store, slim_ref):
+    st, stats = _mesh_diff(store, _slim_batch(), expect_sharded=False,
+                           ref=slim_ref, **{"sdot.mesh.enabled": False})
+    assert st["mesh"]["fallbacks"].get("disabled", 0) >= 1, st["mesh"]
+    assert not any(s.get("sharded") for s in stats if s)
+
+
+def test_fallback_few_segments(sales_df):
+    st = SegmentStore()
+    st.register(ingest_dataframe("sales", sales_df, time_column="ts",
+                                 target_rows=1 << 20))    # one segment
+    assert st.get("sales").num_segments == 1
+    stats, _ = _mesh_diff(st, _slim_batch(), expect_sharded=False)
+    assert stats["mesh"]["fallbacks"].get("few-segments", 0) >= 1
+
+
+def test_fallback_cost_single(store, slim_ref):
+    """Default cost model on a 20k-row store: compile amortization makes
+    the mesh lose; the decision is priced, not hardcoded."""
+    stats, member = _mesh_diff(store, _slim_batch(), expect_sharded=False,
+                               ref=slim_ref,
+                               **{"sdot.querycostmodel.enabled": True})
+    assert stats["mesh"]["fallbacks"].get("cost-single", 0) >= 1
+    mem = next(s["mesh"] for s in member if s and "mesh" in s)
+    assert mem["decision"] == "cost-single"
+
+
+def test_mesh_decision_folds_into_compile_signature(store, slim_ref):
+    """sdlint K1: flipping the mesh decision must re-key the fused
+    executable, not silently reuse a differently-sharded program."""
+    eng = _mesh_engine(store)
+    specs = _slim_batch()
+    _, errs, _ = _run_concurrent(eng, specs)
+    assert not any(errs)
+    n_progs = len(eng._programs)
+    eng.config.set("sdot.mesh.enabled", False)
+    res, errs, stats = _run_concurrent(eng, specs)
+    assert not any(errs)
+    assert len(eng._programs) > n_progs, \
+        "single-device re-run reused the sharded executable"
+    for got, want in zip(res, slim_ref):
+        assert_frames_equal(got, want)
+
+
+# -- decision + accounting units ----------------------------------------------
+
+def test_decide_sig_fields():
+    assert MX.SINGLE.sig_fields() == (False, 1)
+    d = MX.MeshDecision(True, 8, "cost-sharded")
+    assert d.sig_fields() == (True, 8)
+
+
+def test_partial_ledger_lifecycle():
+    led = MX.PartialLedger()
+    t1 = led.acquire_partials(1000)
+    t2 = led.acquire_partials(500)
+    assert led.stats()["outstanding_bytes"] == 1500
+    assert led.stats()["peak_bytes"] == 1500
+    led.release_partials(t1)
+    led.release_partials(t1)            # double release is a no-op
+    assert led.stats()["outstanding_bytes"] == 500
+    led.release_partials(t2)
+    st = led.stats()
+    assert st["outstanding_bytes"] == 0
+    assert st["peak_bytes"] == 1500 and st["acquires"] == 2
+
+
+def test_plan_device_waves_single_device_passthrough():
+    waves = plan_device_waves(np.arange(10), 4, 1, {i: 1 for i in range(10)})
+    assert [list(w) for w in waves] == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
+
+
+def test_plan_device_waves_covers_exactly_once():
+    rows = {i: (i + 1) * 100 for i in range(20)}
+    waves = plan_device_waves(np.arange(20), 8, 8, rows)
+    got = sorted(int(s) for w in waves for s in w)
+    assert got == list(range(20))
+
+
+def test_plan_device_waves_balances_heavy_segments():
+    """LPT: two dominant segments must land on different devices."""
+    rows = {0: 10_000, 7: 10_000}
+    rows.update({i: 1 for i in range(1, 7)})
+    (wave,) = plan_device_waves(np.arange(8), 8, 4, rows)
+    # buckets are consecutive per_dev=2 slices in device order
+    buckets = [set(int(s) for s in wave[i * 2:(i + 1) * 2])
+               for i in range(4)]
+    heavy = [b for b in buckets if 0 in b or 7 in b]
+    assert len(heavy) == 2, buckets
+
+
+# -- tier pin accounting (devices-aware scopes) -------------------------------
+
+def test_tier_pin_token_mesh_accounting(tmp_path):
+    import zlib
+    from spark_druid_olap_tpu.tier.store import BlobRef, TieredColumnStore
+    arr = np.arange(256, dtype=np.int32)
+    p = str(tmp_path / "a.bin")
+    arr.tofile(p)
+    ref = BlobRef(path=p, dtype="int32", start=0, count=256,
+                  crc=zlib.crc32(arr.tobytes()) & 0xFFFFFFFF,
+                  file_bytes=arr.nbytes)
+    tier = TieredColumnStore(budget_bytes=1 << 20)
+    tok = tier.acquire_pins(devices=8)
+    assert tier.counters["pin_tokens_mesh"] == 1
+    np.testing.assert_array_equal(tier.fault("ds", "a", ref), arr)
+    st = tier.stats_snapshot()
+    assert st["mesh_pinned_entries"] == 1
+    assert st["mesh_pinned_bytes"] == arr.nbytes
+    tier.release_pins(tok)
+    st = tier.stats_snapshot()
+    assert st["mesh_pinned_entries"] == 0 and st["mesh_pinned_bytes"] == 0
+    # a plain solo token never touches the mesh gauge
+    tok2 = tier.acquire_pins()
+    assert tier.counters["pin_tokens_mesh"] == 1
+    tier.release_pins(tok2)
+    tier.stop()
