@@ -717,6 +717,154 @@ def run_mesh_ab(reps: int = 3):
     return out
 
 
+def run_join_ab(reps: int = 3):
+    """Device-join-tier A-B over star-unservable queries (join/).
+
+    Three shapes the star rewrite cannot collapse onto the flat fact
+    index — a fact-to-fact join, a self-join funnel, and an equi plus
+    non-equi range join — run through the broadcast join tier and then
+    through the host pandas tier over the SAME stores
+    (``sdot.join.enabled`` toggled; the config fingerprint keys every
+    cache, so both legs execute for real). Reports per-query median
+    wall ms for both legs, the tier's own accounting (mode, build
+    bytes, static match width, shuffle bytes), and two gates: every
+    query must actually engage the tier (``last_stats["join"]``
+    present — a silent host fallback would "pass" while measuring
+    nothing) and must answer exactly like the host. The gates are the
+    pinned part; on the CPU fallback backend the wall numbers measure
+    host-core speed, not device bandwidth.
+    """
+    import pandas as pd
+
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.utils.config import JOIN_ENABLED
+
+    rng = np.random.default_rng(18)
+    n = int(os.environ.get("SDOT_BENCH_JOIN_ROWS", "20000"))
+    regions = ["na", "emea", "apac", "latam"]
+    orders = pd.DataFrame({
+        "ts": (np.datetime64("2024-03-01")
+               + rng.integers(0, 90, n).astype("timedelta64[D]")
+               ).astype("datetime64[ns]"),
+        "order_id": np.arange(n, dtype=np.int64),
+        # ~5 orders per user: the self-join's widest build group stays
+        # far under the default sdot.join.max.matches budget
+        "user_id": rng.integers(0, max(n // 5, 1), n).astype(np.int64),
+        "region": rng.choice(regions, n),
+        "channel": rng.choice(["web", "app", "store"], n),
+        "amount": rng.normal(80, 30, n).round(2),
+    })
+    m = n // 3
+    shipments = pd.DataFrame({
+        "ts": (np.datetime64("2024-03-02")
+               + rng.integers(0, 90, m).astype("timedelta64[D]")
+               ).astype("datetime64[ns]"),
+        # duplicate order_ids: some orders ship in several parcels
+        "order_id": rng.integers(0, n, m).astype(np.int64),
+        "carrier": rng.choice(["ups", "dhl", "fedex", "ems"], m),
+        "weight": rng.normal(4.0, 1.5, m).round(3),
+    })
+    bands = list(zip([-1e9, 25.0, 50.0, 75.0, 100.0, 150.0],
+                     [25.0, 50.0, 75.0, 100.0, 150.0, 1e9]))
+    rates = pd.DataFrame([
+        {"ts": pd.Timestamp("2024-03-01"), "region": rg,
+         "band": "b%d" % i, "lo": lo, "hi": hi}
+        for rg in regions for i, (lo, hi) in enumerate(bands)])
+
+    queries = {
+        # fact-to-fact: both sides are event tables, no star edge
+        "fact_to_fact": """
+            SELECT s.carrier AS c, count(*) AS n, sum(o.amount) AS amt
+            FROM orders o JOIN shipments s ON o.order_id = s.order_id
+            GROUP BY s.carrier ORDER BY c""",
+        # self-join funnel: pairs of orders by the same user where the
+        # second is bigger (alias scoping rewrites the legs)
+        "self_join_funnel": """
+            SELECT a.channel AS c, count(*) AS n
+            FROM orders a JOIN orders b
+              ON a.user_id = b.user_id AND a.amount < b.amount
+            GROUP BY a.channel ORDER BY c""",
+        # equi key (region) + non-equi range residual (amount banding)
+        "non_equi_range": """
+            SELECT r.band AS b, count(*) AS n, sum(o.amount) AS amt
+            FROM orders o JOIN rates r
+              ON o.region = r.region
+             AND o.amount >= r.lo AND o.amount < r.hi
+            GROUP BY r.band ORDER BY b""",
+    }
+
+    ctx = sdot.Context()
+    try:
+        ctx.ingest_dataframe("orders", orders, time_column="ts",
+                             target_rows=2048)
+        ctx.ingest_dataframe("shipments", shipments, time_column="ts",
+                             target_rows=1024)
+        ctx.ingest_dataframe("rates", rates, time_column="ts",
+                             target_rows=64)
+
+        def timed(q):
+            ctx.sql(q)                    # warm: compile this leg
+            ts = []
+            df = None
+            for _ in range(max(reps, 1)):
+                t0 = time.perf_counter()
+                df = ctx.sql(q).to_pandas()
+                ts.append(time.perf_counter() - t0)
+            return df, float(np.median(ts)) * 1000
+
+        def frames_match(a, b):
+            # float tolerance matches the repo's differential comparator
+            # (tests/conftest.assert_frames_equal): metrics are stored
+            # f32, so device accumulation order differs from the host's
+            # f64 pandas sums at ~1e-5 relative on non-x64 backends
+            aa = a.reset_index(drop=True)
+            bb = b.reset_index(drop=True)
+            if list(aa.columns) != list(bb.columns) or len(aa) != len(bb):
+                return False
+            for c in aa.columns:
+                av, bv = aa[c].to_numpy(), bb[c].to_numpy()
+                if av.dtype.kind in "fc":
+                    if not np.allclose(av.astype(float), bv.astype(float),
+                                       rtol=1e-4, atol=1e-6,
+                                       equal_nan=True):
+                        return False
+                elif not np.array_equal(av, bv):
+                    return False
+            return True
+
+        legs, match = {}, True
+        for name, q in queries.items():
+            dev, dev_ms = timed(q)
+            js = dict(ctx.engine.last_stats.get("join") or {})
+            ctx.config.set(JOIN_ENABLED.key, False)
+            try:
+                host, host_ms = timed(q)
+            finally:
+                ctx.config.set(JOIN_ENABLED.key, True)
+            ok = frames_match(dev, host)
+            engaged = bool(js)
+            match = match and ok and engaged
+            legs[name] = {
+                "join_ms": round(dev_ms, 2),
+                "host_ms": round(host_ms, 2),
+                "speedup_vs_host": round(host_ms / max(dev_ms, 1e-9), 2),
+                "mode": js.get("mode"),
+                "build_bytes": js.get("build_bytes"),
+                "match_width": js.get("match_width"),
+                "shuffle_bytes": js.get("shuffle_bytes"),
+                "rows": int(len(dev)),
+                "tier_engaged": engaged,
+                "answers_match": bool(ok),
+            }
+            log(f"join A-B {name}: {dev_ms:.1f}ms {js.get('mode')} vs "
+                f"{host_ms:.1f}ms host (x{legs[name]['speedup_vs_host']}, "
+                f"width={js.get('match_width')}, match={ok})")
+    finally:
+        ctx.close()
+    return {"available": True, "n_rows": n, "queries": legs,
+            "answers_match": bool(match)}
+
+
 def run_encode_ab(reps: int = 3):
     """Encoded-vs-raw A-B over the cold tier (encode/ + tier/).
 
@@ -1232,6 +1380,11 @@ def main():
         out["mesh_ab"] = run_mesh_ab()
     except Exception as e:   # noqa: BLE001 — the A-B leg is advisory
         out["mesh_ab"] = {"available": False,
+                          "error": f"{type(e).__name__}: {e}"}
+    try:
+        out["join_ab"] = run_join_ab()
+    except Exception as e:   # noqa: BLE001 — the A-B leg is advisory
+        out["join_ab"] = {"available": False,
                           "error": f"{type(e).__name__}: {e}"}
     if gbps:
         try:

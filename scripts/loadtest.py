@@ -1186,6 +1186,254 @@ def _run_mesh_cluster(args):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _join_tables(n=60_000):
+    """Synthetic star-unservable join set: two fact tables sharing an
+    order key, plus a small banding table for the non-equi residual."""
+    import pandas as pd
+    rng = np.random.default_rng(18)
+    regions = ["na", "emea", "apac", "latam"]
+    orders = pd.DataFrame({
+        "ts": (np.datetime64("2024-03-01")
+               + rng.integers(0, 90, n).astype("timedelta64[D]")
+               ).astype("datetime64[ns]"),
+        "order_id": np.arange(n, dtype=np.int64),
+        # ~5 orders per user keeps the self-join's widest build group
+        # far under the default sdot.join.max.matches budget
+        "user_id": rng.integers(0, max(n // 5, 1), n).astype(np.int64),
+        "region": rng.choice(regions, n),
+        "channel": rng.choice(["web", "app", "store"], n),
+        "amount": rng.normal(80, 30, n).round(2),
+    })
+    m = n // 3
+    shipments = pd.DataFrame({
+        "ts": (np.datetime64("2024-03-02")
+               + rng.integers(0, 90, m).astype("timedelta64[D]")
+               ).astype("datetime64[ns]"),
+        "order_id": rng.integers(0, n, m).astype(np.int64),
+        "carrier": rng.choice(["ups", "dhl", "fedex", "ems"], m),
+        "weight": rng.normal(4.0, 1.5, m).round(3),
+    })
+    bands = list(zip([-1e9, 25.0, 50.0, 75.0, 100.0, 150.0],
+                     [25.0, 50.0, 75.0, 100.0, 150.0, 1e9]))
+    rates = pd.DataFrame([
+        {"ts": pd.Timestamp("2024-03-01"), "region": rg,
+         "band": "b%d" % i, "lo": lo, "hi": hi}
+        for rg in regions for i, (lo, hi) in enumerate(bands)])
+    return {"orders": orders, "shipments": shipments, "rates": rates}
+
+
+# star-unservable shapes: fact-to-fact, self-join funnel, equi + non-equi
+# range residual — none of these has a star edge the planner can collapse
+JOIN_QUERIES = [
+    """SELECT s.carrier AS c, count(*) AS n, sum(o.amount) AS amt
+       FROM orders o JOIN shipments s ON o.order_id = s.order_id
+       GROUP BY s.carrier ORDER BY c""",
+    """SELECT a.channel AS c, count(*) AS n
+       FROM orders a JOIN orders b
+         ON a.user_id = b.user_id AND a.amount < b.amount
+       GROUP BY a.channel ORDER BY c""",
+    """SELECT r.band AS b, count(*) AS n, sum(o.amount) AS amt
+       FROM orders o JOIN rates r
+         ON o.region = r.region
+        AND o.amount >= r.lo AND o.amount < r.hi
+       GROUP BY r.band ORDER BY b""",
+]
+
+
+def _ingest_join_tables(ctx, n):
+    tables = _join_tables(n)
+    ctx.ingest_dataframe("orders", tables["orders"], time_column="ts",
+                         target_rows=2048)
+    ctx.ingest_dataframe("shipments", tables["shipments"],
+                         time_column="ts", target_rows=1024)
+    ctx.ingest_dataframe("rates", tables["rates"], time_column="ts",
+                         target_rows=64)
+
+
+def _storm_joins(ctx, queries, refs, n_threads, duration, tag):
+    """Round-robin the join mix through ``ctx`` with ``n_threads``
+    workers; every reply is differentially checked against ``refs`` and
+    must have engaged a join tier (``last_stats["join"]`` is per-thread,
+    so each worker audits its own statements). Returns (replies,
+    mismatches, per-mode tallies, statement shuffle-bytes total)."""
+    lock = threading.Lock()
+    mismatched, modes = [], defaultdict(int)
+    replies = [0]
+    shuffle = [0]
+    stop = time.monotonic() + max(duration, 5.0)
+
+    def worker(tid):
+        i = tid
+        while time.monotonic() < stop:
+            q = queries[i % len(queries)]
+            i += 1
+            try:
+                df = ctx.sql(q).to_pandas()
+                js = ctx.engine.last_stats.get("join")
+            except Exception as e:   # noqa: BLE001 — gate below
+                with lock:
+                    mismatched.append(
+                        f"[{tag}] error {type(e).__name__}: {q[:60]}")
+                continue
+            ok = _frames_close(df, refs[q])
+            with lock:
+                replies[0] += 1
+                if not ok:
+                    mismatched.append(f"[{tag}] {q[:60]}")
+                if js is None:
+                    # a silent host fallback answers correctly but
+                    # load-tests nothing — count it as a failure
+                    mismatched.append(f"[{tag}] no join tier: {q[:60]}")
+                else:
+                    modes[js["mode"]] += 1
+                    shuffle[0] += int(js.get("shuffle_bytes", 0))
+
+    threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return replies[0], mismatched, dict(modes), shuffle[0]
+
+
+def run_joins(args):
+    """--joins: device join-tier differential under storm (join/).
+
+    In-process: ingest a synthetic orders/shipments/rates set, capture
+    host-tier reference answers (``sdot.join.enabled`` off — the config
+    fingerprint keys every cache, so both tiers execute for real), then
+    storm the star-unservable join mix — fact-to-fact, self-join
+    funnel, equi + non-equi range — through the broadcast tier with
+    --threads workers. Every reply is checked against the host
+    reference AND must have engaged a join tier (a silent host fallback
+    would pass the differential while load-testing nothing). With
+    --cluster N an additional leg runs N in-process historicals behind
+    a broker forced to ``sdot.join.mode=partitioned``, re-checks every
+    reply, and reports the per-leg shuffle-bytes / scatter counters
+    (deltas of the broker's join_shuffle_bytes / join_scatters). Exit 1
+    on any differential mismatch or missed tier engagement."""
+    sys.path.insert(0, ".")
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.utils.config import JOIN_ENABLED
+
+    n_rows = int(os.environ.get("SDOT_LOADTEST_JOIN_ROWS", "60000"))
+    ctx = sdot.Context()
+    try:
+        _ingest_join_tables(ctx, n_rows)
+        ctx.config.set(JOIN_ENABLED.key, False)
+        try:
+            refs = {q: ctx.sql(q).to_pandas() for q in JOIN_QUERIES}
+        finally:
+            ctx.config.set(JOIN_ENABLED.key, True)
+        for q in JOIN_QUERIES:      # warm: compile each join program
+            ctx.sql(q)
+        print(f"[joins] {n_rows} order rows, {len(JOIN_QUERIES)} "
+              f"star-unservable queries, {args.threads} threads")
+        replies, mismatched, modes, stmt_shuffle = _storm_joins(
+            ctx, JOIN_QUERIES, refs, args.threads, args.duration,
+            "broadcast")
+    finally:
+        ctx.close()
+    single = {"replies": replies, "modes": modes,
+              "shuffle_bytes": stmt_shuffle,
+              "mismatches": sorted(set(mismatched))[:10]}
+    print(f"  [broadcast] replies={replies} modes={json.dumps(modes)} "
+          f"shuffle={stmt_shuffle}B mismatches={len(mismatched)}")
+    ok = replies > 0 and not mismatched \
+        and modes.get("broadcast", 0) == replies \
+        and stmt_shuffle == 0           # broadcast moves no wire bytes
+
+    out = {"mode": "joins", "rows": n_rows, "threads": args.threads,
+           "single": single}
+    if args.cluster:
+        cl = _run_joins_cluster(args, n_rows)
+        out["cluster"] = cl
+        ok = ok and cl["ok"]
+    print(json.dumps(out))
+    sys.exit(0 if ok else 1)
+
+
+def _run_joins_cluster(args, n_rows):
+    """--joins --cluster N: the same join mix through a broker forced to
+    the partitioned tier over N in-process historicals, with per-leg
+    shuffle-bytes accounting from the broker's lifetime counters."""
+    import shutil
+    import tempfile
+
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.cluster.historical import HistoricalNode
+    from spark_druid_olap_tpu.utils.config import JOIN_ENABLED
+
+    root = tempfile.mkdtemp(prefix="sdot-join-cluster-")
+    caches_off = {"sdot.cache.enabled": False,
+                  "sdot.plan.cache.enabled": False,
+                  "sdot.cluster.subq.cache.enabled": False}
+    hist, broker, single = [], None, None
+    try:
+        seed = sdot.Context({"sdot.persist.path": root})
+        _ingest_join_tables(seed, n_rows)
+        seed.checkpoint()
+        seed.close()
+
+        ports = [_free_port() for _ in range(args.cluster)]
+        nodes = ",".join(f"127.0.0.1:{p}" for p in ports)
+        common = {"sdot.persist.path": root, "sdot.cluster.nodes": nodes}
+        hist = [HistoricalNode(dict(common), node_id=i).start()
+                for i in range(args.cluster)]
+        broker = sdot.Context({**common, "sdot.cluster.role": "broker",
+                               "sdot.join.mode": "partitioned",
+                               **caches_off})
+        single = sdot.Context({"sdot.persist.path": root, **caches_off,
+                               "sdot.join.enabled": False})
+        refs = {q: single.sql(q).to_pandas() for q in JOIN_QUERIES}
+        for q in JOIN_QUERIES:      # warm the exchange path
+            broker.sql(q)
+
+        with broker.cluster._lock:
+            before = dict(broker.cluster.counters)
+        replies, mismatched, modes, stmt_shuffle = _storm_joins(
+            broker, JOIN_QUERIES, refs, args.threads, args.duration,
+            "partitioned")
+        with broker.cluster._lock:
+            after = dict(broker.cluster.counters)
+        d_shuffle = (after.get("join_shuffle_bytes", 0)
+                     - before.get("join_shuffle_bytes", 0))
+        d_scatters = (after.get("join_scatters", 0)
+                      - before.get("join_scatters", 0))
+        print(f"  [partitioned] replies={replies} "
+              f"modes={json.dumps(modes)} stmt_shuffle={stmt_shuffle}B "
+              f"leg_shuffle={d_shuffle}B scatters={d_scatters} "
+              f"mismatches={len(mismatched)}")
+        # the gate: exact answers through the exchange, every reply on
+        # the partitioned tier, and the broker's lifetime counters moved
+        # by at least the per-statement accounting (they also cover
+        # retried scatters, so >= rather than ==)
+        ok = replies > 0 and not mismatched \
+            and modes.get("partitioned", 0) == replies \
+            and stmt_shuffle > 0 and d_shuffle >= stmt_shuffle \
+            and d_scatters > 0
+        return {"ok": bool(ok), "nodes": args.cluster,
+                "replies": replies, "modes": modes,
+                "shuffle_bytes": stmt_shuffle,
+                "leg_shuffle_bytes": int(d_shuffle),
+                "leg_scatters": int(d_scatters),
+                "mismatches": sorted(set(mismatched))[:10]}
+    finally:
+        for h in hist:
+            try:
+                h.stop()
+            except Exception:   # noqa: BLE001 — already stopped
+                pass
+        for c in (broker, single):
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:   # noqa: BLE001 — shutdown race
+                    pass
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _free_port():
     import socket
     s = socket.socket()
@@ -2518,6 +2766,15 @@ def main():
                     "reports the scaling ratio and merge-collective "
                     "counters; with --cluster N also storms an in-process "
                     "broker over N meshed historical subprocesses")
+    ap.add_argument("--joins", action="store_true",
+                    help="device join-tier differential under storm: "
+                    "star-unservable queries (fact-to-fact, self-join "
+                    "funnel, non-equi range) through the broadcast tier, "
+                    "every reply checked against the host pandas tier "
+                    "and required to have engaged a join tier; with "
+                    "--cluster N an in-process exchange leg forces the "
+                    "partitioned tier and reports per-leg shuffle-bytes "
+                    "counter deltas (exit 1 on any mismatch)")
     ap.add_argument("--cluster", type=int, default=0, metavar="N",
                     help="multi-process distributed-serving benchmark: "
                     "checkpoint a synthetic store, spawn N historical "
@@ -2559,7 +2816,10 @@ def main():
                     "off, fixed seed)")
     args = ap.parse_args()
     if args.threads is None:
-        args.threads = 32 if args.cluster else 8
+        # the join legs measure the tier, not client fan-in: every
+        # worker drives a full device build+probe (or a scatter), so a
+        # dashboard-storm thread count would just queue on the device
+        args.threads = 8 if args.joins else (32 if args.cluster else 8)
 
     if args.chaos:
         return run_chaos(args)
@@ -2567,6 +2827,8 @@ def main():
         return run_ingest(args)
     if args.mesh:
         return run_mesh(args)
+    if args.joins:
+        return run_joins(args)
     if args.cluster:
         return run_cluster(args)
     if args.coldstart:

@@ -269,7 +269,8 @@ class ClusterClient:
                          "breaker_resets": 0,
                          "subq_cache_hits": 0, "subq_cache_misses": 0,
                          "ingest_pushes": 0, "ingest_push_failures": 0,
-                         "ingest_rows_pushed": 0, "ryw_scatters": 0}
+                         "ingest_rows_pushed": 0, "ryw_scatters": 0,
+                         "join_scatters": 0, "join_shuffle_bytes": 0}
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, int(self.config.get(CLUSTER_SCATTER_THREADS))),
             thread_name_prefix="sdot-scatter")
@@ -968,7 +969,8 @@ class ClusterClient:
             race.settle(nid, out, err)
 
     def _guarded_rpc(self, st: _EpochState, node_id: int, payload: bytes,
-                     deadline: Optional[float]) -> Tuple[int, bytes]:
+                     deadline: Optional[float],
+                     path: str = "/cluster/subquery") -> Tuple[int, bytes]:
         """_rpc wrapped in the node's circuit breaker + health marks."""
         tok = st.breakers.before_attempt(node_id)
         ok = False
@@ -976,7 +978,8 @@ class ClusterClient:
             if tok is None:
                 raise _BreakerOpen(node_id)
             try:
-                status, resp = self._rpc(st, node_id, payload, deadline)
+                status, resp = self._rpc(st, node_id, payload, deadline,
+                                         path=path)
             except OSError:
                 self._mark_down(st, node_id)
                 raise
@@ -988,7 +991,8 @@ class ClusterClient:
         return status, resp
 
     def _rpc(self, st: _EpochState, node_id: int, payload: bytes,
-             deadline: Optional[float]) -> Tuple[int, bytes]:
+             deadline: Optional[float],
+             path: str = "/cluster/subquery") -> Tuple[int, bytes]:
         inj = self.fault
         key = f"node:{node_id}"
         if inj is not None:
@@ -1002,8 +1006,9 @@ class ClusterClient:
         try:
             if inj is not None:
                 inj.fire("rpc.request", key)
-            conn.request("POST", "/cluster/subquery", payload,
-                         {"Content-Type": "application/json"})
+            ctype = "application/json" if path == "/cluster/subquery" \
+                else "application/octet-stream"
+            conn.request("POST", path, payload, {"Content-Type": ctype})
             resp = conn.getresponse()
             body = resp.read()
         finally:

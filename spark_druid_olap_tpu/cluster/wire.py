@@ -171,6 +171,51 @@ def split_subquery(raw: bytes) -> Tuple[dict, Optional[int]]:
     return d, (int(ep) if ep is not None else None)
 
 
+_JOIN_MAGIC = b"SDJ1"
+
+
+def encode_join_exec(spec: dict,
+                     sides: Dict[str, Tuple[List[str],
+                                            Dict[str, np.ndarray]]]) -> bytes:
+    """One partitioned-join exec request: ``b"SDJ1" + uint32le(header_len)
+    + header_json + side frames + uint32le(crc32)``. Each side ("probe",
+    "build") is a full SDW1 result frame (same codec path as shard
+    partials — RLE'd code columns, JSON object columns, CRC per frame),
+    concatenated in header order with lengths in the header. ``spec`` is
+    the JSON-safe lowered join plan (keys, group-by, aggs, residual as
+    serde expr dicts) — no pickle, same RCE posture as subqueries."""
+    frames = [(name, encode_result(cols, data))
+              for name, (cols, data) in sides.items()]
+    header = {"spec": spec,
+              "frames": [{"side": name, "nbytes": len(fb)}
+                         for name, fb in frames]}
+    hb = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    body = b"".join([_JOIN_MAGIC, _LEN.pack(len(hb)), hb]
+                    + [fb for _, fb in frames])
+    return body + _LEN.pack(zlib.crc32(body))
+
+
+def decode_join_exec(payload: bytes) -> Tuple[dict, Dict[str, Tuple[
+        List[str], Dict[str, np.ndarray]]]]:
+    """-> (spec, {side: (columns, data)}). ValueError on a bad frame."""
+    if len(payload) < 12 or payload[:4] != _JOIN_MAGIC:
+        raise ValueError("bad join wire magic")
+    (crc,) = _LEN.unpack_from(payload, len(payload) - 4)
+    if zlib.crc32(payload[:-4]) != crc:
+        raise ValueError("join wire CRC mismatch (truncated or corrupt)")
+    payload = payload[:-4]
+    (hlen,) = _LEN.unpack_from(payload, 4)
+    off = 8 + hlen
+    header = json.loads(payload[8:off].decode("utf-8"))
+    sides = {}
+    for fr in header["frames"]:
+        nb = int(fr["nbytes"])
+        cols, data, _ = decode_result(payload[off:off + nb])
+        sides[str(fr["side"])] = (cols, data)
+        off += nb
+    return header["spec"], sides
+
+
 _INGEST_MAGIC = b"SDI1"
 
 

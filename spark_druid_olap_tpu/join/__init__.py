@@ -1,0 +1,23 @@
+"""General (non-star) join execution tiers.
+
+The reference model only pushes STAR joins down to the engine
+(``planner/builder.py``'s FD-closure rewrite); everything else used to
+fall to the host pandas tier. This package is the device-native join
+surface above ``ops/hash_join.py``:
+
+- :mod:`spark_druid_olap_tpu.join.broadcast` — broadcast hash join:
+  the build side fits ``sdot.join.broadcast.max.bytes``, its hash
+  table is built once per node and probed inside the segment wave
+  loop (composing with the tier pins, the device-array cache, and the
+  local device mesh).
+- :mod:`spark_druid_olap_tpu.join.partitioned` — shard-aligned
+  partitioned join: a broker re-shards both sides on the join key
+  through the historicals (hash-partition exchange over the SDW1 wire
+  with exact shuffle-bytes accounting) and each node joins its
+  aligned partitions locally.
+
+``planner/joinplan.py`` recognizes join statements, picks the tier via
+``parallel/cost.py:join_estimate``, and applies the shared epilogue.
+"""
+
+from spark_druid_olap_tpu.ops.hash_join import JoinUnsupported  # noqa: F401

@@ -1,0 +1,499 @@
+"""Device-side hash-join primitives (the join tier's kernel layer).
+
+The reference system never joins on the accelerator — every non-star
+join falls off the pushdown surface to Spark. This module is the
+device-native replacement, following the operator-placement blueprint
+of Accelerating Presto with GPUs (arxiv 2606.24647): the BUILD side is
+canonicalized and hashed on the host (it is broadcast-sized by
+definition, ``sdot.join.broadcast.max.bytes``), the PROBE runs inside
+the jitted wave program as pure integer compares over device arrays.
+
+Layout contract:
+
+- **Key canonicalization** — every join-key column pair is mapped onto
+  the build side's sorted-unique value domain, so a composite key
+  becomes one dense mixed-radix ``int32`` (exactly the
+  ``groupby.fuse_keys`` trick). Dictionary-coded probe dims map through
+  a host-built ``[cardinality]`` LUT (probe code -> build component, -1
+  miss) and probes never touch a string; numeric probe columns map
+  in-trace via ``searchsorted`` against the build's unique values.
+- **Open addressing** — the table is linear-probed with a fixed
+  multiplicative hash; the host build records the exact maximum
+  displacement D, so the device probe is a static ``D+1``-wide gather
+  with no data-dependent loop (TPU-friendly: no while, no dynamic
+  shapes).
+- **Match expansion** — duplicate build keys group into CSR rows
+  (``slot_start``/``slot_count`` into ``row_idx``); the probe expands
+  each row to a static width C = the widest duplicate group, bounded by
+  ``sdot.join.max.matches`` (a hotter build key declines to the host
+  tier rather than materializing an oversized register expansion).
+- **Residual predicates** (the non-equi part of the join condition)
+  lower through :func:`lower_pred` — a Kleene three-valued in-trace
+  evaluator shared with the probe-side filter lowering.
+
+``JoinUnsupported`` is the single decline signal: the planner catches
+it and routes the statement to the next tier (partitioned / host).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_druid_olap_tpu.ir import expr as E
+
+#: Fibonacci-hash multiplier (2^32 / phi, odd) — the classic
+#: multiplicative constant; identical on the host build and the device
+#: probe, both in wrapping uint32 arithmetic.
+GOLD32 = np.uint32(0x9E3779B1)
+
+#: fused canonical keys must stay strictly inside int32 (TPU-native
+#: integer width; the hash multiply runs in uint32)
+MAX_KEY_DOMAIN = 1 << 31
+
+#: linear-probe displacement ceiling: past this the build doubles the
+#: table instead of widening the probe unroll
+MAX_DISPLACEMENT = 64
+
+
+class JoinUnsupported(Exception):
+    """This statement/table shape declines the device join tier."""
+
+
+# =============================================================================
+# key canonicalization (host)
+# =============================================================================
+
+def _as_key_values(vals: np.ndarray) -> np.ndarray:
+    """Normalize one build key column to a sortable numpy array (object
+    arrays of str stay object; numerics pass through)."""
+    vals = np.asarray(vals)
+    if vals.dtype == object:
+        return np.asarray([None if v is None else str(v) for v in vals],
+                          dtype=object)
+    return vals
+
+
+def build_key_components(build_keys: Sequence[np.ndarray],
+                         build_valid: Sequence[np.ndarray]):
+    """Canonicalize the build side's key columns.
+
+    Returns ``(uniques, comps, row_keep)``: per-column sorted unique
+    value arrays (null rows dropped — inner equi-join semantics), the
+    per-column component codes for the KEPT build rows, and the boolean
+    keep mask over the original build rows.
+    """
+    keep = np.ones(len(build_keys[0]) if build_keys else 0, dtype=bool)
+    for v, ok in zip(build_keys, build_valid):
+        keep &= np.asarray(ok, dtype=bool)
+    uniques, comps = [], []
+    for v in build_keys:
+        v = _as_key_values(v)[keep]
+        if v.dtype == object:
+            uniq = np.unique(v.astype(str)) if len(v) else \
+                np.empty(0, dtype=object)
+            comp = np.searchsorted(uniq, v.astype(str)) if len(v) else \
+                np.empty(0, dtype=np.int64)
+        else:
+            uniq = np.unique(v)
+            comp = np.searchsorted(uniq, v)
+        uniques.append(uniq)
+        comps.append(comp.astype(np.int64))
+    return uniques, comps, keep
+
+
+def fuse_components(comps: Sequence[np.ndarray],
+                    cards: Sequence[int]) -> np.ndarray:
+    """Host mixed-radix fuse of component codes -> one int key array."""
+    key = np.zeros(len(comps[0]) if comps else 0, dtype=np.int64)
+    for comp, card in zip(comps, cards):
+        key = key * np.int64(max(1, card)) + comp
+    return key
+
+
+def key_domain(cards: Sequence[int]) -> int:
+    total = 1
+    for c in cards:
+        total *= max(1, int(c))
+    return total
+
+
+# =============================================================================
+# open-addressing table (host build, device probe)
+# =============================================================================
+
+@dataclasses.dataclass
+class HashTable:
+    """Device-ready open-addressing join table over CSR duplicate
+    groups. All arrays are host numpy; the executor device-puts them as
+    one pytree (replicated per device on the mesh path)."""
+
+    slot_key: np.ndarray     # int32 [T], -1 = empty
+    slot_start: np.ndarray   # int32 [T] -> first row_idx of the group
+    slot_count: np.ndarray   # int32 [T] duplicate-group size
+    row_idx: np.ndarray      # int32 [n_build] build rows grouped by key
+    n_slots: int             # T (power of two)
+    shift: int               # 32 - log2(T): the multiplicative hash shift
+    max_disp: int            # exact max linear-probe displacement D
+    max_count: int           # widest duplicate group C
+    n_build: int             # kept build rows
+
+    def nbytes(self) -> int:
+        return int(self.slot_key.nbytes + self.slot_start.nbytes
+                   + self.slot_count.nbytes + self.row_idx.nbytes)
+
+    def device_tree(self) -> Dict[str, np.ndarray]:
+        return {"slot_key": self.slot_key, "slot_start": self.slot_start,
+                "slot_count": self.slot_count, "row_idx": self.row_idx}
+
+
+def _hash32(keys: np.ndarray, shift: int) -> np.ndarray:
+    h = keys.astype(np.uint32) * GOLD32
+    return (h >> np.uint32(shift)).astype(np.int64)
+
+
+def build_table(fused_keys: np.ndarray, max_matches: int) -> HashTable:
+    """Build the open-addressing table over host ``fused_keys`` (already
+    canonical int, null rows dropped). Exact displacement/duplicate
+    bookkeeping happens here so the device probe is fully static."""
+    n = len(fused_keys)
+    keys = np.asarray(fused_keys, dtype=np.int64)
+    order = np.argsort(keys, kind="stable")
+    skeys = keys[order]
+    uniq, starts, counts = (np.empty(0, dtype=np.int64),) * 3
+    if n:
+        uniq, starts, counts = np.unique(skeys, return_index=True,
+                                         return_counts=True)
+    max_count = int(counts.max()) if n else 0
+    if max_count > int(max_matches):
+        raise JoinUnsupported(
+            f"hot build key: widest duplicate group {max_count} exceeds "
+            f"sdot.join.max.matches={int(max_matches)}")
+    bits = max(3, int(np.ceil(np.log2(max(2 * len(uniq), 8)))))
+    while True:
+        T = 1 << bits
+        shift = 32 - bits
+        slot_key = np.full(T, -1, dtype=np.int64)
+        slot_start = np.zeros(T, dtype=np.int32)
+        slot_count = np.zeros(T, dtype=np.int32)
+        max_disp = 0
+        ok = True
+        for k, st, ct in zip(uniq, starts, counts):
+            s = int(_hash32(np.asarray([k]), shift)[0])
+            d = 0
+            while slot_key[s] != -1:
+                s = (s + 1) & (T - 1)
+                d += 1
+            max_disp = max(max_disp, d)
+            if max_disp > MAX_DISPLACEMENT and bits < 28:
+                ok = False
+                break
+            slot_key[s] = k
+            slot_start[s] = st
+            slot_count[s] = ct
+        if ok:
+            break
+        bits += 1           # too clustered: double the table, retry
+    return HashTable(
+        slot_key=slot_key.astype(np.int32),
+        slot_start=slot_start, slot_count=slot_count,
+        row_idx=order.astype(np.int32), n_slots=T, shift=shift,
+        max_disp=max_disp, max_count=max_count, n_build=n)
+
+
+def probe(tdev: Dict[str, object], key, valid, *, n_slots: int,
+          shift: int, max_disp: int):
+    """In-trace probe: canonical ``key`` [N] + ``valid`` [N] ->
+    ``(start, count)`` int32 [N] into the CSR ``row_idx``. A miss or an
+    invalid (null / filtered) probe row gets count 0. The D+1-wide slot
+    gather is static — no data-dependent control flow."""
+    key = key.astype(jnp.int32)
+    h = (key.astype(jnp.uint32) * GOLD32) >> jnp.uint32(shift)
+    offs = jnp.arange(max_disp + 1, dtype=jnp.uint32)
+    slots = ((h[..., None] + offs) & jnp.uint32(n_slots - 1)) \
+        .astype(jnp.int32)                                   # [N, D+1]
+    sk = tdev["slot_key"][slots]
+    hit = (sk == key[..., None]) & valid[..., None]
+    anyhit = hit.any(axis=-1)
+    first = jnp.argmax(hit, axis=-1)
+    slot = jnp.take_along_axis(slots, first[..., None], axis=-1)[..., 0]
+    start = tdev["slot_start"][slot]
+    count = jnp.where(anyhit, tdev["slot_count"][slot], 0)
+    return start.astype(jnp.int32), count.astype(jnp.int32)
+
+
+def expand(tdev: Dict[str, object], start, count, *, width: int,
+           n_build: int):
+    """CSR match expansion: -> ``(bidx, mvalid)`` each [N, C]. ``bidx``
+    indexes build payload rows (clipped; ``mvalid`` masks the tail of
+    groups narrower than C)."""
+    C = max(1, int(width))
+    lane = jnp.arange(C, dtype=jnp.int32)
+    mvalid = lane[None, :] < count[:, None]
+    pos = start[:, None] + lane[None, :]
+    pos = jnp.clip(pos, 0, max(0, n_build - 1))
+    bidx = tdev["row_idx"][pos] if n_build else jnp.zeros_like(pos)
+    return bidx, mvalid
+
+
+# =============================================================================
+# in-trace expression lowering (probe filters + residual predicates)
+# =============================================================================
+#
+# ``get`` is the environment callback: name -> (value, valid) device
+# arrays (any common broadcastable shape). ``dim`` optionally maps a
+# dimension name to its DimColumn (sorted dictionary) so string
+# comparisons against literals lower to integer code compares — the
+# order-preserving-dictionary payoff. Predicates evaluate with Kleene
+# three-valued logic as (true, unknown) mask pairs, mirroring
+# utils/host_eval._pred3 exactly; the root folds UNKNOWN to drop.
+
+Env = Callable[[str], Tuple[object, object]]
+
+
+def _num(e: E.Expr, get: Env, dim=None):
+    """Numeric (value, valid) lowering. Raises JoinUnsupported on any
+    node outside the supported surface — including dimension columns,
+    whose device representation is dictionary codes (comparing codes as
+    numbers is only meaningful against the same sorted dictionary,
+    which :func:`_dim_cmp` handles)."""
+    if isinstance(e, E.Column):
+        if dim is not None and dim(e.name) is not None:
+            raise JoinUnsupported(
+                f"dimension column {e.name!r} in a numeric join "
+                f"expression (codes are not values)")
+        return get(e.name)
+    if isinstance(e, E.Literal):
+        if e.value is None:
+            return jnp.float32(0.0), jnp.zeros((), dtype=bool)
+        if isinstance(e.value, (int, float, np.integer, np.floating)) \
+                and not isinstance(e.value, bool):
+            return jnp.asarray(e.value), jnp.ones((), dtype=bool)
+        raise JoinUnsupported(f"non-numeric literal {e.value!r} in a "
+                              f"device join expression")
+    if isinstance(e, E.BinaryOp):
+        a, va = _num(e.left, get, dim)
+        b, vb = _num(e.right, get, dim)
+        v = va & vb
+        if e.op == "+":
+            return a + b, v
+        if e.op == "-":
+            return a - b, v
+        if e.op == "*":
+            return a * b, v
+        if e.op == "/":
+            # SQL x/0 -> NULL here (host tier raises; the residual only
+            # needs the row dropped, which invalid achieves)
+            z = b == 0
+            return a / jnp.where(z, 1, b), v & ~z
+        raise JoinUnsupported(f"operator {e.op!r} in a device join "
+                              f"expression")
+    if isinstance(e, E.Cast) and e.to in ("long", "double"):
+        v, ok = _num(e.child, get, dim)
+        return (v.astype(jnp.int64 if e.to == "long"
+                         else jnp.float64)
+                if hasattr(v, "astype") else v), ok
+    raise JoinUnsupported(f"unsupported expression node "
+                          f"{type(e).__name__} in a device join")
+
+
+def _dim_cmp(e: E.Comparison, get: Env, dim):
+    """Comparison(dim column, string literal) -> (t, u) via code
+    compares on the sorted dictionary (code_of / searchsorted bounds)."""
+    col, lit, op = e.left, e.right, e.op
+    if isinstance(col, E.Literal):
+        col, lit = lit, col
+        op = E.FLIP_CMP.get(op, op)
+    d = dim(col.name)
+    code, valid = get(col.name)
+    val = str(lit.value)
+    if op in ("=", "!=", "<>"):
+        c = d.code_of(val)
+        t = (code == c) if c >= 0 else jnp.zeros(code.shape, dtype=bool)
+        if op != "=":
+            t = valid & ~t
+        else:
+            t = valid & t
+        return t, ~valid
+    if op in ("<", "<="):
+        hi = int(np.searchsorted(d.dictionary, val,
+                                 side="right" if op == "<=" else "left"))
+        return valid & (code < hi), ~valid
+    if op in (">", ">="):
+        lo = int(np.searchsorted(d.dictionary, val,
+                                 side="left" if op == ">=" else "right"))
+        return valid & (code >= lo), ~valid
+    raise JoinUnsupported(f"operator {op!r} on a dimension column")
+
+
+def _is_dim(e: E.Expr, dim) -> bool:
+    return isinstance(e, E.Column) and dim is not None \
+        and dim(e.name) is not None
+
+
+def lower_pred(e: E.Expr, get: Env, dim=None):
+    """Kleene (true, unknown) lowering of a predicate tree."""
+    AND, OR, NOT = jnp.logical_and, jnp.logical_or, jnp.logical_not
+    if isinstance(e, E.And):
+        ts, us = zip(*(lower_pred(p, get, dim) for p in e.parts))
+        t = ts[0]
+        for x in ts[1:]:
+            t = AND(t, x)
+        nf = ts[0] | us[0]
+        anyu = us[0]
+        for x, u in zip(ts[1:], us[1:]):
+            nf = AND(nf, x | u)
+            anyu = OR(anyu, u)
+        return t, AND(nf, anyu) & NOT(t)
+    if isinstance(e, E.Or):
+        ts, us = zip(*(lower_pred(p, get, dim) for p in e.parts))
+        t = ts[0]
+        anyu = us[0]
+        for x, u in zip(ts[1:], us[1:]):
+            t = OR(t, x)
+            anyu = OR(anyu, u)
+        return t, AND(NOT(t), anyu)
+    if isinstance(e, E.Not):
+        t, u = lower_pred(e.child, get, dim)
+        return AND(NOT(t), NOT(u)), u
+    if isinstance(e, E.IsNull):
+        _, valid = (get(e.child.name) if isinstance(e.child, E.Column)
+                    else _num(e.child, get, dim))
+        t = ~valid if not e.negated else valid
+        return jnp.broadcast_to(t, jnp.shape(t)), \
+            jnp.zeros(jnp.shape(t), dtype=bool)
+    if isinstance(e, E.Between):
+        lo = E.Comparison(">=", e.child, e.low)
+        hi = E.Comparison("<=", e.child, e.high)
+        t, u = lower_pred(E.And((lo, hi)), get, dim)
+        if e.negated:
+            return AND(NOT(t), NOT(u)), u
+        return t, u
+    if isinstance(e, E.InList):
+        if _is_dim(e.child, dim):
+            parts = tuple(E.Comparison("=", e.child, E.Literal(v))
+                          for v in e.values)
+        else:
+            parts = tuple(E.Comparison("=", e.child, E.Literal(v))
+                          for v in e.values)
+        t, u = lower_pred(E.Or(parts), get, dim) if parts else \
+            (jnp.zeros((), dtype=bool), jnp.zeros((), dtype=bool))
+        if e.negated:
+            return AND(NOT(t), NOT(u)), u
+        return t, u
+    if isinstance(e, E.Comparison):
+        if dim is not None and (
+                (_is_dim(e.left, dim) and isinstance(e.right, E.Literal)
+                 and isinstance(e.right.value, str))
+                or (_is_dim(e.right, dim)
+                    and isinstance(e.left, E.Literal)
+                    and isinstance(e.left.value, str))):
+            return _dim_cmp(e, get, dim)
+        a, va = _num(e.left, get, dim)
+        b, vb = _num(e.right, get, dim)
+        v = va & vb
+        if e.op == "=":
+            t = a == b
+        elif e.op in ("!=", "<>"):
+            t = a != b
+        elif e.op == "<":
+            t = a < b
+        elif e.op == "<=":
+            t = a <= b
+        elif e.op == ">":
+            t = a > b
+        elif e.op == ">=":
+            t = a >= b
+        else:
+            raise JoinUnsupported(f"comparison {e.op!r}")
+        return AND(t, v), NOT(v)
+    raise JoinUnsupported(f"unsupported predicate node "
+                          f"{type(e).__name__} in a device join")
+
+
+def pred_mask(e: Optional[E.Expr], get: Env, dim=None):
+    """Root predicate -> keep mask (UNKNOWN drops, SQL WHERE)."""
+    if e is None:
+        return None
+    t, u = lower_pred(e, get, dim)
+    return jnp.logical_and(t, jnp.logical_not(u))
+
+
+# =============================================================================
+# probe-key canonicalization plans (shared by both join tiers)
+# =============================================================================
+
+@dataclasses.dataclass
+class KeyMap:
+    """How ONE probe key column maps onto its build component domain.
+
+    - ``lut`` (dictionary-coded probe dims): host ``[cardinality]``
+      int32, probe code -> build component or -1; device gather.
+    - ``uniq`` (numeric probe columns): the build side's sorted unique
+      values; in-trace searchsorted + equality check.
+    """
+
+    card: int
+    lut: Optional[np.ndarray] = None
+    uniq: Optional[np.ndarray] = None
+
+    def device_tree(self):
+        out = {}
+        if self.lut is not None:
+            out["lut"] = self.lut
+        if self.uniq is not None:
+            out["uniq"] = self.uniq
+        return out
+
+
+def dim_keymap(dictionary: np.ndarray, uniq: np.ndarray) -> KeyMap:
+    """LUT for a dictionary-coded probe dim: dictionary value ->
+    position in the build's unique set (-1 when absent)."""
+    if len(dictionary) == 0 or len(uniq) == 0:
+        return KeyMap(card=len(uniq),
+                      lut=np.full(max(1, len(dictionary)), -1,
+                                  dtype=np.int32))
+    pos = np.searchsorted(uniq, dictionary.astype(str))
+    pos_c = np.clip(pos, 0, len(uniq) - 1)
+    hit = uniq[pos_c].astype(str) == dictionary.astype(str)
+    lut = np.where(hit, pos_c, -1).astype(np.int32)
+    return KeyMap(card=len(uniq), lut=lut)
+
+
+def numeric_keymap(uniq: np.ndarray, probe_dtype) -> KeyMap:
+    """searchsorted map for a numeric probe column. The uniques are cast
+    to the probe array's device dtype — both sides originate from the
+    same stored precision, so the cast is value-preserving."""
+    return KeyMap(card=len(uniq),
+                  uniq=np.asarray(uniq).astype(probe_dtype))
+
+
+def canonical_key(keymaps: Sequence[KeyMap], kdevs: Sequence[Dict],
+                  probe_vals: Sequence[object],
+                  probe_valid: Sequence[object]):
+    """In-trace composite-key canonicalization: per-column component
+    codes (LUT gather or searchsorted), mixed-radix fuse. Returns
+    ``(key int32, valid bool)`` in the probe arrays' shape."""
+    comps, valid = [], None
+    for km, kd, v, ok in zip(keymaps, kdevs, probe_vals, probe_valid):
+        if km.lut is not None:
+            comp = kd["lut"][v.astype(jnp.int32)]
+        else:
+            uniq = kd["uniq"]
+            if len(km.uniq) == 0:
+                comp = jnp.full(jnp.shape(v), -1, dtype=jnp.int32)
+            else:
+                idx = jnp.searchsorted(uniq, v)
+                idx_c = jnp.clip(idx, 0, len(km.uniq) - 1)
+                comp = jnp.where(uniq[idx_c] == v, idx_c, -1) \
+                    .astype(jnp.int32)
+        ok = jnp.logical_and(ok, comp >= 0)
+        valid = ok if valid is None else jnp.logical_and(valid, ok)
+        comps.append(comp)
+    key = comps[0].astype(jnp.int32)
+    for comp, km in zip(comps[1:], keymaps[1:]):
+        key = key * jnp.int32(max(1, km.card)) + comp
+    return jnp.where(valid, key, 0), valid

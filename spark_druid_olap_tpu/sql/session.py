@@ -406,6 +406,23 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
     except (PlanUnsupported, EngineFallback) as e:
         df = mode = None
         if isinstance(e, PlanUnsupported):
+            # general two-table joins (fact-to-fact, self-join funnel,
+            # non-equi residual) on the device join tiers. Tried BEFORE
+            # the composite planner: recognition is conservative (two
+            # stored relations, >=1 equi key, plain aggregate shape),
+            # and everything it accepts runs the probe inside the
+            # device wave loop — strictly better than the composite
+            # tier's gather-and-host-join finish for the same shape.
+            # Any decline falls through unchanged.
+            from spark_druid_olap_tpu.planner import joinplan
+            try:
+                df = joinplan.try_execute(ctx, stmt)
+            except joinplan.JoinUnsupported:
+                df = None
+            if df is not None:
+                mode = "engine"
+                rollup_status = "base"
+        if df is None and isinstance(e, PlanUnsupported):
             # engine-planned derived tables + dim-scale host finish (the
             # reference's DruidQuery-scans-under-Spark-join shape)
             from spark_druid_olap_tpu.planner import composite

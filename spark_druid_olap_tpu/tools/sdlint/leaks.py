@@ -114,6 +114,12 @@ REGISTRY: Tuple[Resource, ...] = (
     # DrainGate protocol)
     Resource("drain-token", (("begin_subquery",),),
              (("end_subquery",),)),
+    # broadcast-join build tables: an unreleased build token leaves the
+    # device-resident hash table + payload counted as outstanding
+    # forever, misreporting join memory pressure and masking real
+    # leaks of replicated build state (join/broadcast.py BuildLedger)
+    Resource("join-build", (("acquire_build",),),
+             (("release_build",),)),
 )
 
 

@@ -243,6 +243,36 @@ GROUPBY_MATMUL_MAX_KEYS = _entry(
     "sdot.engine.groupby.matmul.max.keys", 4096,
     "Dense group-by uses the MXU one-hot matmul path when the fused key "
     "cardinality is at most this; above it, scatter-add.")
+JOIN_ENABLED = _entry(
+    "sdot.join.enabled", True,
+    "General (non-star) joins execute on the device join tier "
+    "(join/broadcast.py, join/partitioned.py) when the statement shape "
+    "qualifies; False routes every non-star join to the host pandas "
+    "fallback (kill switch — answers are identical, only placement "
+    "changes).")
+JOIN_BROADCAST_MAX_BYTES = _entry(
+    "sdot.join.broadcast.max.bytes", 64 << 20,
+    "Build-side byte ceiling for the broadcast hash-join tier: when the "
+    "smaller side's estimated bytes fit, its hash table is built once "
+    "per node, device-resident, and probed inside the segment wave "
+    "loop. Bigger builds go to the cluster partitioned tier (when a "
+    "broker is attached) or the host fallback.", int)
+JOIN_MAX_MATCHES = _entry(
+    "sdot.join.max.matches", 64,
+    "Widest per-key duplicate group the device probe expands in "
+    "registers (the static match-expansion width C). A build side with "
+    "a hotter key declines to the host fallback instead of "
+    "materializing an oversized expansion.", int)
+JOIN_PARTITIONS = _entry(
+    "sdot.join.partitions", 0,
+    "Hash-partition count for the cluster partitioned-join exchange "
+    "(both sides re-shard on the join key through the historicals). "
+    "0 = one partition per cluster node.", int)
+JOIN_MODE = _entry(
+    "sdot.join.mode", "auto",
+    "Join-tier placement override: 'auto' (cost model picks), "
+    "'broadcast', 'partitioned', or 'host' (device join tiers "
+    "disabled for this statement shape only).")
 GROUPBY_DENSE_MAX_KEYS = _entry(
     "sdot.engine.groupby.dense.max.keys", 1 << 22,
     "Max fused key cardinality for the dense device group-by; above it the "

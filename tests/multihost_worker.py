@@ -388,6 +388,33 @@ def main():
     import spark_druid_olap_tpu as sdot
     from spark_druid_olap_tpu.parallel.mesh import make_mesh
 
+    if mode == "probe":
+        # capability probe: ONE cross-process collective, nothing else.
+        # Succeeds only where the backend implements inter-process
+        # collectives (TPU/GPU, or CPU builds with a cross-host
+        # transport); environments without them fail/hang here instead
+        # of 40 minutes into the census.
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from spark_druid_olap_tpu.parallel.mesh import (
+            SEGMENT_AXIS, shard_map)
+        mesh = make_mesh()
+        n_dev = nproc * devs
+
+        def body(x):
+            return jax.lax.psum(x, SEGMENT_AXIS)
+
+        got = jax.jit(shard_map(
+            body, mesh=mesh, in_specs=P(SEGMENT_AXIS),
+            out_specs=P(), check_vma=False))(
+            jnp.ones((n_dev,), jnp.float32))
+        assert float(got[0]) == float(n_dev), got
+        if pid == 0:
+            with open(outpath, "w") as f:
+                json.dump({"ok": True, "devices": n_dev}, f)
+        print(f"[worker {pid}] probe ok", flush=True)
+        return
+
     if mode == "census":
         ctx = build_census_tpch(nproc, pid)
         ctx_ssb = build_census_ssb(nproc, pid)

@@ -1,0 +1,369 @@
+"""Device join tiers (join/): broadcast hash joins + partitioned joins.
+
+The acceptance bar is differential, same as test_cluster.py: every
+query a join tier serves must answer identically to the host pandas
+tier over the same stores (toggle ``sdot.join.enabled`` — the config
+fingerprint keys the result caches, so both runs execute for real).
+On top of correctness:
+
+- tier engagement is asserted through ``last_stats["join"]`` (a join
+  that silently fell back to host would pass the differential while
+  testing nothing);
+- broadcast and partitioned must agree with each other, not just with
+  the host (``sdot.join.mode`` forces each tier over one cluster);
+- declines must be safe: hot keys past ``sdot.join.max.matches``,
+  null join keys, empty build sides, disabled tier — all must still
+  answer correctly (via fallback or null-drop semantics).
+"""
+
+import socket
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.cluster.historical import HistoricalNode
+from spark_druid_olap_tpu.utils.config import (
+    JOIN_ENABLED, JOIN_MAX_MATCHES, JOIN_MODE)
+
+from conftest import assert_frames_equal
+
+
+def _fact_df(n=8000, seed=11) -> pd.DataFrame:
+    r = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "ts": (np.datetime64("2024-01-01")
+               + r.integers(0, 365, n).astype("timedelta64[D]")
+               ).astype("datetime64[ns]"),
+        # 0..219: ids 200..219 have no users row (unmatched probe rows)
+        "user_id": r.integers(0, 220, n).astype(np.int64),
+        "country": r.choice(["US", "DE", "JP", "BR", "IN"], n),
+        "channel": r.choice(["web", "app", "store"], n),
+        "amount": (r.normal(50, 15, n)).round(2),
+        "qty": r.integers(1, 20, n).astype(np.int64),
+    })
+
+
+def _users_df(seed=12) -> pd.DataFrame:
+    r = np.random.default_rng(seed)
+    n = 230
+    # ids 0..199 match the fact; 1000..1029 match nothing (unmatched
+    # build rows must not leak into any aggregate)
+    ids = np.concatenate([np.arange(200), np.arange(1000, 1030)])
+    return pd.DataFrame({
+        "ts": np.full(n, np.datetime64("2024-01-01")).astype(
+            "datetime64[ns]"),
+        "user_id": ids.astype(np.int64),
+        "segment_name": r.choice(["gold", "silver", "bronze"], n),
+        "country": r.choice(["US", "DE", "JP", "BR", "IN"], n),
+        "credit": r.integers(10, 90, n).astype(np.int64),
+    })
+
+
+def _events_df(n=6000, seed=13) -> pd.DataFrame:
+    """Null-key + skew surface: ``country`` is None for ~10% of rows,
+    ``hot_id`` concentrates 30% of rows on one key."""
+    r = np.random.default_rng(seed)
+    country = r.choice(["US", "DE", "JP", "BR", "IN"], n).astype(object)
+    country[r.random(n) < 0.1] = None
+    hot = r.integers(0, 50, n).astype(np.int64)
+    hot[r.random(n) < 0.3] = 7
+    return pd.DataFrame({
+        "ts": (np.datetime64("2024-06-01")
+               + r.integers(0, 30, n).astype("timedelta64[D]")
+               ).astype("datetime64[ns]"),
+        "country": country,
+        "hot_id": hot,
+        "value": r.integers(0, 1000, n).astype(np.int64),
+    })
+
+
+def _promos_df(seed=14) -> pd.DataFrame:
+    """Small table hot on its own join key: pid 7 repeats 150x, so BOTH
+    orientations of an events-promos join exceed the default 64-wide
+    match budget (events is ~30% hot on the same key)."""
+    r = np.random.default_rng(seed)
+    pid = np.concatenate([np.full(150, 7), r.integers(0, 50, 60)])
+    return pd.DataFrame({
+        "ts": np.full(len(pid), np.datetime64("2024-06-01")).astype(
+            "datetime64[ns]"),
+        "pid": pid.astype(np.int64),
+        "discount": r.integers(1, 30, len(pid)).astype(np.int64),
+    })
+
+
+@pytest.fixture(scope="module")
+def jctx():
+    ctx = sdot.Context()
+    ctx.ingest_dataframe("fact", _fact_df(), time_column="ts",
+                         target_rows=1024)
+    ctx.ingest_dataframe("users", _users_df(), time_column="ts",
+                         target_rows=64)
+    ctx.ingest_dataframe("events", _events_df(), time_column="ts",
+                         target_rows=1024)
+    ctx.ingest_dataframe("promos", _promos_df(), time_column="ts",
+                         target_rows=64)
+    yield ctx
+    ctx.close()
+
+
+def _diff(ctx, q, expect_mode="broadcast"):
+    """Run ``q`` through the join tier, then through the host tier
+    (join disabled), and compare. Returns (frame, join stats)."""
+    got = ctx.sql(q).to_pandas()
+    js = ctx.engine.last_stats.get("join")
+    ctx.config.set(JOIN_ENABLED.key, False)
+    try:
+        want = ctx.sql(q).to_pandas()
+    finally:
+        ctx.config.set(JOIN_ENABLED.key, True)
+    assert_frames_equal(got, want)
+    if expect_mode is None:
+        assert js is None, js
+    else:
+        assert js is not None and js["mode"] == expect_mode, js
+    return got, js
+
+
+# -- broadcast tier: equi / non-equi / shapes ---------------------------------
+
+def test_equi_groupby_matches_host(jctx):
+    got, js = _diff(jctx, """
+        SELECT u.segment_name AS seg, count(*) AS n,
+               sum(f.amount) AS amt, avg(f.qty) AS q
+        FROM fact f JOIN users u ON f.user_id = u.user_id
+        GROUP BY u.segment_name ORDER BY seg""")
+    assert len(got) == 3
+    assert js["build_rows"] == 230
+    # unmatched rows on either side contribute nothing
+    assert got["n"].sum() < 8000
+
+
+def test_global_aggregate_one_row(jctx):
+    got, _ = _diff(jctx, """
+        SELECT count(*) AS n, min(f.amount) AS lo, max(f.amount) AS hi
+        FROM fact f JOIN users u ON f.user_id = u.user_id""")
+    assert len(got) == 1 and got["n"][0] > 0
+
+
+def test_non_equi_residual(jctx):
+    # equi key + residual range predicate (amount > credit) — the
+    # non-equi part must filter PAIRS, not rows of either side alone
+    got, js = _diff(jctx, """
+        SELECT u.segment_name AS seg, count(*) AS n, sum(f.qty) AS tq
+        FROM fact f JOIN users u
+          ON f.user_id = u.user_id AND f.amount > u.credit
+        GROUP BY u.segment_name ORDER BY seg""")
+    loose, _ = _diff(jctx, """
+        SELECT u.segment_name AS seg, count(*) AS n, sum(f.qty) AS tq
+        FROM fact f JOIN users u ON f.user_id = u.user_id
+        GROUP BY u.segment_name ORDER BY seg""")
+    assert got["n"].sum() < loose["n"].sum()
+
+
+def test_side_filters_push_to_sides(jctx):
+    _diff(jctx, """
+        SELECT f.channel AS c, count(*) AS n, sum(f.amount) AS amt
+        FROM fact f JOIN users u ON f.user_id = u.user_id
+        WHERE u.segment_name = 'gold' AND f.qty > 5
+        GROUP BY f.channel ORDER BY c""")
+
+
+def test_dim_string_key_join(jctx):
+    # dictionary-coded string key on BOTH sides (LUT keymap path)
+    _diff(jctx, """
+        SELECT u.segment_name AS seg, count(*) AS n
+        FROM events e JOIN users u ON e.country = u.country
+        GROUP BY u.segment_name ORDER BY seg""")
+
+
+def test_null_join_keys_never_match(jctx):
+    # events.country is None for ~10% of rows: SQL inner-join equality
+    # is null-rejecting, so those rows must vanish from the pair count
+    got, _ = _diff(jctx, """
+        SELECT count(*) AS n
+        FROM events e JOIN users u ON e.country = u.country""")
+    nn = int(_events_df()["country"].notna().sum())
+    per_country = 230 / 5      # users rows per country, on average
+    assert 0 < got["n"][0] < nn * per_country * 2
+
+
+def test_empty_build_side(jctx):
+    # build filter eliminates every build row; grouped result is empty,
+    # global aggregate still returns its one row
+    grouped, _ = _diff(jctx, """
+        SELECT u.segment_name AS seg, count(*) AS n
+        FROM fact f JOIN users u ON f.user_id = u.user_id
+        WHERE u.credit > 1000000 GROUP BY u.segment_name""")
+    assert len(grouped) == 0
+    one, _ = _diff(jctx, """
+        SELECT count(*) AS n
+        FROM fact f JOIN users u ON f.user_id = u.user_id
+        WHERE u.credit > 1000000""")
+    assert len(one) == 1 and one["n"][0] == 0
+
+
+def test_hot_key_past_max_matches_falls_back(jctx):
+    # key 7 is hot on BOTH sides (150x in promos, ~1800x in events), so
+    # neither build orientation fits the default 64-wide match budget
+    q = """
+        SELECT count(*) AS n, sum(e.value) AS v
+        FROM events e JOIN promos p ON e.hot_id = p.pid"""
+    got, js = _diff(jctx, q, expect_mode=None)    # declined -> host
+    assert len(got) == 1
+    prev = jctx.config.get(JOIN_MAX_MATCHES)
+    jctx.config.set(JOIN_MAX_MATCHES.key, 4096)
+    try:
+        wide, js = _diff(jctx, q)                 # budget raised -> device
+    finally:
+        jctx.config.set(JOIN_MAX_MATCHES.key, prev)
+    assert js["match_width"] > 64
+    assert_frames_equal(got, wide)
+
+
+def test_self_join_funnel(jctx):
+    # self-join through alias scoping (rename-projection leaves): pairs
+    # of purchases by the same user where the second one is bigger
+    _diff(jctx, """
+        SELECT a.channel AS c, count(*) AS n
+        FROM fact a JOIN fact b
+          ON a.user_id = b.user_id AND a.amount < b.amount
+        GROUP BY a.channel ORDER BY c""")
+
+
+def test_having_order_limit_epilogue(jctx):
+    got, _ = _diff(jctx, """
+        SELECT u.segment_name AS seg, count(*) AS n
+        FROM fact f JOIN users u ON f.user_id = u.user_id
+        GROUP BY u.segment_name HAVING count(*) > 10
+        ORDER BY n DESC LIMIT 2""")
+    assert len(got) <= 2
+    assert (np.diff(got["n"].to_numpy()) <= 0).all()
+
+
+def test_disabled_tier_still_answers(jctx):
+    jctx.config.set(JOIN_ENABLED.key, False)
+    try:
+        df = jctx.sql("""
+            SELECT count(*) AS n
+            FROM fact f JOIN users u ON f.user_id = u.user_id
+        """).to_pandas()
+        assert jctx.engine.last_stats.get("join") is None
+        assert df["n"][0] > 0
+    finally:
+        jctx.config.set(JOIN_ENABLED.key, True)
+
+
+def test_stats_surface(jctx):
+    jctx.sql("""
+        SELECT count(*) AS n
+        FROM fact f JOIN users u ON f.user_id = u.user_id""")
+    js = jctx.engine.last_stats["join"]
+    for key in ("mode", "build_rows", "build_bytes", "shuffle_bytes",
+                "estimate"):
+        assert key in js, (key, js)
+    assert js["shuffle_bytes"] == 0          # broadcast moves no rows
+    led = js["build_ledger"]
+    assert led["outstanding_bytes"] == 0     # released on every path
+    assert led["peak_bytes"] >= js["build_bytes"]
+
+
+# -- partitioned tier over an in-process cluster ------------------------------
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def jcluster(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("join-deep-storage"))
+    seed = sdot.Context({"sdot.persist.path": root})
+    seed.ingest_dataframe("fact", _fact_df(), time_column="ts",
+                          target_rows=1024)
+    seed.ingest_dataframe("users", _users_df(), time_column="ts",
+                          target_rows=64)
+    seed.ingest_dataframe("events", _events_df(), time_column="ts",
+                          target_rows=1024)
+    seed.checkpoint()
+    seed.close()
+    ports = [_free_port(), _free_port()]
+    nodes_csv = ",".join(f"127.0.0.1:{p}" for p in ports)
+    common = {"sdot.persist.path": root, "sdot.cluster.nodes": nodes_csv}
+    hist = [HistoricalNode(dict(common), node_id=i).start()
+            for i in range(2)]
+    broker = sdot.Context({**common, "sdot.cluster.role": "broker",
+                           "sdot.join.mode": "partitioned"})
+    single = sdot.Context({"sdot.persist.path": root})
+    yield broker, single
+    for h in hist:
+        h.stop()
+    broker.close()
+    single.close()
+
+
+_PARITY_QUERIES = (
+    """SELECT u.segment_name AS seg, count(*) AS n,
+              sum(f.amount) AS amt, avg(f.qty) AS q
+       FROM fact f JOIN users u ON f.user_id = u.user_id
+       GROUP BY u.segment_name ORDER BY seg""",
+    """SELECT u.segment_name AS seg, count(*) AS n, sum(f.qty) AS tq
+       FROM fact f JOIN users u
+         ON f.user_id = u.user_id AND f.amount > u.credit
+       GROUP BY u.segment_name ORDER BY seg""",
+    """SELECT count(*) AS n, min(f.amount) AS lo, max(f.amount) AS hi
+       FROM fact f JOIN users u ON f.user_id = u.user_id""",
+    """SELECT u.segment_name AS seg, count(*) AS n
+       FROM events e JOIN users u ON e.country = u.country
+       GROUP BY u.segment_name ORDER BY seg""",
+)
+
+
+def test_partitioned_matches_broadcast_and_host(jcluster):
+    broker, single = jcluster
+    for q in _PARITY_QUERIES:
+        part = broker.sql(q).to_pandas()
+        pjs = broker.engine.last_stats.get("join")
+        assert pjs is not None and pjs["mode"] == "partitioned", (q, pjs)
+        assert pjs["shuffle_bytes"] > 0
+        bc = single.sql(q).to_pandas()
+        bjs = single.engine.last_stats.get("join")
+        assert bjs is not None and bjs["mode"] == "broadcast", (q, bjs)
+        assert_frames_equal(part, bc)
+        single.config.set(JOIN_ENABLED.key, False)
+        try:
+            host = single.sql(q).to_pandas()
+        finally:
+            single.config.set(JOIN_ENABLED.key, True)
+        assert_frames_equal(part, host)
+
+
+def test_partitioned_counters_accumulate(jcluster):
+    broker, _ = jcluster
+    with broker.cluster._lock:
+        before = dict(broker.cluster.counters)
+    broker.sql(_PARITY_QUERIES[0])
+    with broker.cluster._lock:
+        after = dict(broker.cluster.counters)
+    assert after["join_scatters"] > before.get("join_scatters", 0)
+    assert after["join_shuffle_bytes"] > before.get(
+        "join_shuffle_bytes", 0)
+
+
+def test_broker_falls_back_to_broadcast_in_auto(jcluster):
+    # auto mode on a tiny build side: the estimate picks broadcast even
+    # with a cluster attached (the broker holds the full store)
+    broker, single = jcluster
+    broker.config.set(JOIN_MODE.key, "auto")
+    try:
+        q = _PARITY_QUERIES[0]
+        got = broker.sql(q).to_pandas()
+        js = broker.engine.last_stats.get("join")
+        assert js is not None and js["mode"] == "broadcast", js
+        assert_frames_equal(got, single.sql(q).to_pandas())
+    finally:
+        broker.config.set(JOIN_MODE.key, "partitioned")
