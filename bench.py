@@ -980,6 +980,132 @@ def run_encode_ab(reps: int = 3):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_window_ab(reps: int = 3):
+    """Window post-pass + KLL percentile A-B on a canned store.
+
+    Leg 1 (windows): a storm of OVER(...) statements — ranks over a
+    GROUP BY base, moving/cumulative frames and lag over a row-level
+    scan base — runs through the device window post-pass, and every
+    answer is differentially checked against an exact pandas
+    computation of the same window. Leg 2 (percentile): each
+    percentile_approx answer is gated against numpy's exact order
+    statistics within the sketch's declared rank-error bound
+    (sdot.quantile.rank_bound): the estimate must land between the
+    exact values at rank (q - eps) and (q + eps). Both checks ship in
+    the JSON as hard ok flags; timings compare the device post-pass
+    wall against the exact host reference.
+    """
+    import pandas as pd
+    from spark_druid_olap_tpu.context import Context
+    from spark_druid_olap_tpu.ops import kll as KLL
+
+    rng = np.random.default_rng(11)
+    n = 30_000
+    df = pd.DataFrame({
+        "ts": pd.Timestamp("2015-01-01")
+        + pd.to_timedelta(rng.integers(0, 365 * 24 * 3600, n), unit="s"),
+        "id": np.arange(n, dtype=np.int64),   # unique ORDER BY key:
+        "region": rng.choice(["east", "west", "north", "south"], n),
+        "product": rng.choice([f"p{i:03d}" for i in range(20)], n),
+        "qty": rng.integers(1, 52, n).astype(np.int64),
+        "price": rng.uniform(1.0, 100.0, n),
+    })                                        # ties would make moving
+    ctx = Context({"sdot.cache.enabled": False})  # frames order-dependent
+    ctx.ingest_dataframe("wsales", df, time_column="ts",
+                         target_rows=4096)
+
+    # -- exact pandas references ------------------------------------
+    t0 = time.perf_counter()
+    agg = (df.groupby(["region", "product"], as_index=False)
+             .agg(units=("qty", "sum")))
+    agg["r"] = (agg.groupby("region")["units"]
+                .rank(method="min", ascending=False).astype(np.int64))
+    flt = (df[df["qty"] > 25].sort_values(["region", "id"],
+                                          kind="mergesort"))
+    mv = flt[["id", "region", "qty"]].copy()
+    mv["mv"] = (flt.groupby("region")["qty"]
+                .rolling(4, min_periods=1).sum()
+                .reset_index(level=0, drop=True)).astype(np.int64)
+    head = df[df["id"] < 2000].sort_values(["region", "id"],
+                                           kind="mergesort")
+    lg = head[["id", "region", "price"]].copy()
+    lg["prev"] = head.groupby("region")["price"].shift(1)
+    cum = head[["id", "region"]].copy()
+    cum["cavg"] = (head.groupby("region")["price"]
+                   .expanding().mean().reset_index(level=0, drop=True))
+    cum["rn"] = (head.groupby("region").cumcount() + 1).astype(np.int64)
+    host_ms = (time.perf_counter() - t0) * 1000
+
+    storm = [
+        ("rank_over_groupby",
+         "SELECT region, product, SUM(qty) AS units, "
+         "RANK() OVER (PARTITION BY region ORDER BY SUM(qty) DESC) AS r "
+         "FROM wsales GROUP BY region, product", agg),
+        ("moving_sum_scan",
+         "SELECT id, region, qty, SUM(qty) OVER (PARTITION BY region "
+         "ORDER BY id ROWS BETWEEN 3 PRECEDING AND CURRENT ROW) AS mv "
+         "FROM wsales WHERE qty > 25", mv),
+        ("lag_scan",
+         "SELECT id, region, price, LAG(price, 1) OVER "
+         "(PARTITION BY region ORDER BY id) AS prev "
+         "FROM wsales WHERE id < 2000", lg),
+        ("cumulative_avg_rownum",
+         "SELECT id, region, AVG(price) OVER (PARTITION BY region "
+         "ORDER BY id) AS cavg, ROW_NUMBER() OVER "
+         "(PARTITION BY region ORDER BY id) AS rn "
+         "FROM wsales WHERE id < 2000", cum),
+    ]
+
+    mismatches = []
+    for name, sql, ref in storm:            # cold + differential pass
+        got = ctx.sql(sql).to_pandas()
+        stats = ctx.history.entries()[-1].stats
+        if "window" not in stats:
+            mismatches.append(f"{name}: window post-pass did not engage "
+                              f"(mode={stats.get('mode')})")
+        elif not _frames_equal(got, ref.reset_index(drop=True)):
+            mismatches.append(name)
+    ts = []
+    for _ in range(max(reps, 1)):           # warm: post-pass wall
+        t0 = time.perf_counter()
+        for _, sql, _ref in storm:
+            ctx.sql(sql)
+        ts.append(time.perf_counter() - t0)
+    window_ms = float(np.median(ts)) * 1000
+
+    # -- percentile leg: KLL vs exact order statistics ---------------
+    eps = KLL.rank_bound(ctx.config)
+    pct_fail = []
+    for q in (0.5, 0.9):
+        got = ctx.sql(
+            f"SELECT region, PERCENTILE_APPROX(price, {q}) AS p "
+            f"FROM wsales GROUP BY region").to_pandas()
+        for _, row in got.iterrows():
+            vals = np.sort(df.loc[df["region"] == row["region"],
+                                  "price"].to_numpy())
+            lo = vals[max(int(np.floor((q - eps) * len(vals))), 0)]
+            hi = vals[min(int(np.ceil((q + eps) * len(vals))),
+                          len(vals) - 1)]
+            if not (lo <= float(row["p"]) <= hi):
+                pct_fail.append(f"{row['region']}@q{q}: {row['p']:.4f} "
+                                f"outside [{lo:.4f}, {hi:.4f}]")
+
+    out = {"available": True, "n_rows": n, "n_statements": len(storm),
+           "window_ms": round(window_ms, 2),
+           "host_ref_ms": round(host_ms, 2),
+           "windows_match": not mismatches,
+           "percentile_rank_bound": eps,
+           "percentile_within_bound": not pct_fail}
+    if mismatches:
+        out["window_mismatches"] = mismatches
+    if pct_fail:
+        out["percentile_failures"] = pct_fail
+    log(f"window A-B: {len(storm)} statements {window_ms:.1f}ms device "
+        f"post-pass vs {host_ms:.1f}ms host ref "
+        f"(match={not mismatches}, percentile_ok={not pct_fail})")
+    return out
+
+
 def _frames_equal(a, b) -> bool:
     """Order-insensitive equality with float tolerance (shared by the
     encode A-B differential)."""
@@ -1271,6 +1397,20 @@ def main():
                 # an unannotated zero-dispatch engine query is always a
                 # loud accounting bug.
                 served = meas_stats.get("served_from")
+                if not served:
+                    # a sketch lane answered by a materialized rollup
+                    # reaggregates STORED registers — host-side merge of
+                    # persisted sketch state is a legitimate zero-dispatch
+                    # answer, not a cache accident. Only sketch aggs get
+                    # this exemption; a plain agg off a rollup still
+                    # scans the rollup's segments on device.
+                    roll = str(meas_stats.get("rollup", ""))
+                    if roll.startswith("rollup:") and any(
+                            fn in sql.lower() for fn in
+                            ("percentile_approx", "approx_percentile",
+                             "approx_count_distinct", "approx_distinct",
+                             "theta_sketch")):
+                        served = f"sketch-{roll}"
                 if served:
                     zero_dispatch_served.append(
                         {"query": name, "served_from": str(served)})
@@ -1386,6 +1526,11 @@ def main():
     except Exception as e:   # noqa: BLE001 — the A-B leg is advisory
         out["join_ab"] = {"available": False,
                           "error": f"{type(e).__name__}: {e}"}
+    try:
+        out["window_ab"] = run_window_ab()
+    except Exception as e:   # noqa: BLE001 — the A-B leg is advisory
+        out["window_ab"] = {"available": False,
+                            "error": f"{type(e).__name__}: {e}"}
     if gbps:
         try:
             peak = float(os.environ.get("SDOT_BENCH_HBM_PEAK_GBPS", "819"))

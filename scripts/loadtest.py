@@ -1434,6 +1434,267 @@ def _run_joins_cluster(args, n_rows):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _window_sales(n=60_000):
+    """Synthetic sales frame for the window storm. The ``id`` column is
+    a UNIQUE order key: moving-frame answers are order-dependent, so a
+    tied ORDER BY would make the differential ambiguous."""
+    import pandas as pd
+    rng = np.random.default_rng(23)
+    return pd.DataFrame({
+        "ts": (np.datetime64("2015-01-01")
+               + rng.integers(0, 365 * 24 * 3600, n).astype(
+                   "timedelta64[s]")).astype("datetime64[ns]"),
+        "id": np.arange(n, dtype=np.int64),
+        "region": rng.choice(["east", "west", "north", "south"], n),
+        "product": rng.choice([f"p{i:03d}" for i in range(20)], n),
+        "qty": rng.integers(1, 52, n).astype(np.int64),
+        "price": rng.uniform(1.0, 100.0, n),
+    })
+
+
+# ranks over a GROUP BY base, moving/cumulative frames and lag over a
+# row-level scan base — every tier the window post-pass composes with
+WINDOW_QUERIES = [
+    "SELECT region, product, SUM(qty) AS units, "
+    "RANK() OVER (PARTITION BY region ORDER BY SUM(qty) DESC) AS r "
+    "FROM wsales GROUP BY region, product",
+    "SELECT id, region, qty, SUM(qty) OVER (PARTITION BY region "
+    "ORDER BY id ROWS BETWEEN 3 PRECEDING AND CURRENT ROW) AS mv "
+    "FROM wsales WHERE qty > 25",
+    "SELECT id, region, price, LAG(price, 1) OVER "
+    "(PARTITION BY region ORDER BY id) AS prev "
+    "FROM wsales WHERE id < 2000",
+    "SELECT id, region, AVG(price) OVER (PARTITION BY region "
+    "ORDER BY id) AS cavg, ROW_NUMBER() OVER "
+    "(PARTITION BY region ORDER BY id) AS rn "
+    "FROM wsales WHERE id < 2000",
+]
+PCT_FRACTIONS = (0.5, 0.9, 0.99)
+
+
+def _pct_sql(q):
+    return (f"SELECT region, PERCENTILE_APPROX(price, {q}) AS p "
+            f"FROM wsales GROUP BY region")
+
+
+def _window_refs(df):
+    """Exact pandas references for WINDOW_QUERIES (same order), plus
+    per-region sorted price arrays for the percentile rank-error gate."""
+    agg = (df.groupby(["region", "product"], as_index=False)
+             .agg(units=("qty", "sum")))
+    agg["r"] = (agg.groupby("region")["units"]
+                .rank(method="min", ascending=False).astype(np.int64))
+    flt = df[df["qty"] > 25].sort_values(["region", "id"],
+                                         kind="mergesort")
+    mv = flt[["id", "region", "qty"]].copy()
+    mv["mv"] = (flt.groupby("region")["qty"]
+                .rolling(4, min_periods=1).sum()
+                .reset_index(level=0, drop=True)).astype(np.int64)
+    head = df[df["id"] < 2000].sort_values(["region", "id"],
+                                           kind="mergesort")
+    lg = head[["id", "region", "price"]].copy()
+    lg["prev"] = head.groupby("region")["price"].shift(1)
+    cum = head[["id", "region"]].copy()
+    cum["cavg"] = (head.groupby("region")["price"]
+                   .expanding().mean().reset_index(level=0, drop=True))
+    cum["rn"] = (head.groupby("region").cumcount() + 1).astype(np.int64)
+    refs = dict(zip(WINDOW_QUERIES,
+                    [f.reset_index(drop=True)
+                     for f in (agg, mv, lg, cum)]))
+    exact = {rg: np.sort(df.loc[df["region"] == rg, "price"].to_numpy())
+             for rg in df["region"].unique()}
+    return refs, exact
+
+
+def _pct_failures(got, exact, q, eps):
+    """Rank-error gate: each per-region estimate must land between the
+    exact order statistics at rank (q - eps) and (q + eps)."""
+    fails = []
+    for _, row in got.iterrows():
+        vals = exact[row["region"]]
+        lo = vals[max(int(np.floor((q - eps) * len(vals))), 0)]
+        hi = vals[min(int(np.ceil((q + eps) * len(vals))),
+                      len(vals) - 1)]
+        if not (lo <= float(row["p"]) <= hi):
+            fails.append(f"{row['region']}@q{q}: {row['p']:.4f} outside "
+                         f"[{lo:.4f}, {hi:.4f}]")
+    return fails
+
+
+def _storm_windows(ctx, refs, exact, eps, n_threads, duration, tag,
+                   pct_refs=None, expect_scatter=False):
+    """Round-robin the window + percentile mix through ``ctx``. Window
+    replies are differentially checked against the exact pandas
+    reference; percentile replies against the sketch's rank-error bound
+    (and, when ``pct_refs`` carries the single-engine answers, required
+    BYTE-IDENTICAL to them — the broker's register merge must not
+    change the estimate). With ``expect_scatter`` every reply must have
+    fanned out (engine.last_stats is per-thread, so each worker audits
+    its own statements). Returns (replies, failures)."""
+    lock = threading.Lock()
+    failures, replies = [], [0]
+    pcts = [(_pct_sql(q), q) for q in PCT_FRACTIONS]
+    mix = [(sql, None) for sql in WINDOW_QUERIES] + pcts
+    stop = time.monotonic() + max(duration, 5.0)
+
+    def worker(tid):
+        i = tid
+        while time.monotonic() < stop:
+            sql, frac = mix[i % len(mix)]
+            i += 1
+            try:
+                df = ctx.sql(sql).to_pandas()
+                cl = ctx.engine.last_stats.get("cluster")
+            except Exception as e:   # noqa: BLE001 — gated below
+                with lock:
+                    failures.append(
+                        f"[{tag}] error {type(e).__name__}: {sql[:60]}")
+                continue
+            errs = []
+            if frac is None:
+                if not _frames_close(df, refs[sql]):
+                    errs.append(f"[{tag}] window mismatch: {sql[:60]}")
+            else:
+                errs.extend(f"[{tag}] {f}"
+                            for f in _pct_failures(df, exact, frac, eps))
+                if pct_refs is not None:
+                    a = df.sort_values("region")["p"].to_numpy()
+                    b = pct_refs[frac].sort_values("region")[
+                        "p"].to_numpy()
+                    if not np.array_equal(a, b):
+                        errs.append(f"[{tag}] broker percentile not "
+                                    f"byte-identical to single @q{frac}")
+            if expect_scatter and (cl or {}).get("mode") != "scatter":
+                errs.append(f"[{tag}] no scatter: {sql[:60]}")
+            with lock:
+                replies[0] += 1
+                failures.extend(errs)
+
+    threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return replies[0], failures
+
+
+def run_windows(args):
+    """--windows: window post-pass + KLL percentile differential under
+    storm (window/ + ops/kll.py).
+
+    In-process: ingest a synthetic sales set, compute exact pandas
+    references for the window mix (ranks over a GROUP BY base, moving
+    sum / lag / cumulative avg over row-level scans) and exact
+    per-region order statistics for the percentile gate, then storm
+    the mix with --threads workers. Every window reply must match its
+    reference; every percentile reply must land within the sketch's
+    declared rank-error bound (sdot.quantile.rank_bound). A cold pass
+    first audits that every window statement actually engaged the
+    post-pass (history stats carry a "window" block). With --cluster N
+    an additional leg runs the same storm through a broker over N
+    in-process historicals: every reply re-checked, scatter required,
+    and broker percentile answers required byte-identical to the
+    single-engine answers (the register merge must be lossless). Exit
+    1 on any mismatch or out-of-bound estimate."""
+    sys.path.insert(0, ".")
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.ops import kll as KLL
+
+    n_rows = int(os.environ.get("SDOT_LOADTEST_WINDOW_ROWS", "60000"))
+    df = _window_sales(n_rows)
+    refs, exact = _window_refs(df)
+    ctx = sdot.Context({"sdot.cache.enabled": False})
+    eps = KLL.rank_bound(ctx.config)
+    try:
+        ctx.ingest_dataframe("wsales", df, time_column="ts",
+                             target_rows=4096)
+        engaged = []
+        for sql in WINDOW_QUERIES:   # cold pass: post-pass engagement
+            ctx.sql(sql)
+            st = ctx.history.entries()[-1].stats
+            if "window" not in st:
+                engaged.append(f"no window post-pass "
+                               f"(mode={st.get('mode')}): {sql[:60]}")
+        print(f"[windows] {n_rows} rows, {len(WINDOW_QUERIES)} window + "
+              f"{len(PCT_FRACTIONS)} percentile statements, "
+              f"{args.threads} threads, rank bound {eps}")
+        replies, failures = _storm_windows(
+            ctx, refs, exact, eps, args.threads, args.duration, "single")
+        failures = engaged + failures
+    finally:
+        ctx.close()
+    print(f"  [single] replies={replies} failures={len(failures)}")
+    ok = replies > 0 and not failures
+    out = {"mode": "windows", "rows": n_rows, "threads": args.threads,
+           "rank_bound": eps,
+           "single": {"replies": replies,
+                      "failures": sorted(set(failures))[:10]}}
+    if args.cluster:
+        cl = _run_windows_cluster(args, df, refs, exact, eps)
+        out["cluster"] = cl
+        ok = ok and cl["ok"]
+    print(json.dumps(out))
+    sys.exit(0 if ok else 1)
+
+
+def _run_windows_cluster(args, df, refs, exact, eps):
+    """--windows --cluster N: the same mix through a broker scattering
+    over N in-process historicals; broker percentile answers must be
+    byte-identical to a single-process engine over the same store."""
+    import shutil
+    import tempfile
+
+    import spark_druid_olap_tpu as sdot
+    from spark_druid_olap_tpu.cluster.historical import HistoricalNode
+
+    root = tempfile.mkdtemp(prefix="sdot-window-cluster-")
+    caches_off = {"sdot.cache.enabled": False,
+                  "sdot.cluster.subq.cache.enabled": False}
+    hist, broker, single = [], None, None
+    try:
+        seed = sdot.Context({"sdot.persist.path": root})
+        seed.ingest_dataframe("wsales", df, time_column="ts",
+                              target_rows=4096)
+        seed.checkpoint()
+        seed.close()
+
+        ports = [_free_port() for _ in range(args.cluster)]
+        nodes = ",".join(f"127.0.0.1:{p}" for p in ports)
+        common = {"sdot.persist.path": root, "sdot.cluster.nodes": nodes}
+        hist = [HistoricalNode(dict(common), node_id=i).start()
+                for i in range(args.cluster)]
+        broker = sdot.Context({**common, "sdot.cluster.role": "broker",
+                               **caches_off})
+        single = sdot.Context({"sdot.persist.path": root, **caches_off})
+        pct_refs = {q: single.sql(_pct_sql(q)).to_pandas()
+                    for q in PCT_FRACTIONS}
+        for sql in WINDOW_QUERIES:   # warm + scatter engagement audit
+            broker.sql(sql)
+        replies, failures = _storm_windows(
+            broker, refs, exact, eps, args.threads, args.duration,
+            "cluster", pct_refs=pct_refs, expect_scatter=True)
+        print(f"  [cluster] nodes={args.cluster} replies={replies} "
+              f"failures={len(failures)}")
+        ok = replies > 0 and not failures
+        return {"ok": bool(ok), "nodes": args.cluster,
+                "replies": replies,
+                "failures": sorted(set(failures))[:10]}
+    finally:
+        for h in hist:
+            try:
+                h.stop()
+            except Exception:   # noqa: BLE001 — already stopped
+                pass
+        for c in (broker, single):
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:   # noqa: BLE001 — shutdown race
+                    pass
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _free_port():
     import socket
     s = socket.socket()
@@ -2775,6 +3036,18 @@ def main():
                     "--cluster N an in-process exchange leg forces the "
                     "partitioned tier and reports per-leg shuffle-bytes "
                     "counter deltas (exit 1 on any mismatch)")
+    ap.add_argument("--windows", action="store_true",
+                    help="window post-pass + KLL percentile differential "
+                    "under storm: OVER(...) statements (ranks over a "
+                    "GROUP BY base, moving frames / lag over row-level "
+                    "scans) checked per-reply against exact pandas "
+                    "references, percentile_approx checked against exact "
+                    "order statistics within sdot.quantile.rank_bound; "
+                    "with --cluster N the same storm runs through a "
+                    "broker over N in-process historicals with scatter "
+                    "required and broker percentile answers required "
+                    "byte-identical to a single-process engine (exit 1 "
+                    "on any mismatch or out-of-bound estimate)")
     ap.add_argument("--cluster", type=int, default=0, metavar="N",
                     help="multi-process distributed-serving benchmark: "
                     "checkpoint a synthetic store, spawn N historical "
@@ -2819,7 +3092,8 @@ def main():
         # the join legs measure the tier, not client fan-in: every
         # worker drives a full device build+probe (or a scatter), so a
         # dashboard-storm thread count would just queue on the device
-        args.threads = 8 if args.joins else (32 if args.cluster else 8)
+        args.threads = 8 if (args.joins or args.windows) \
+            else (32 if args.cluster else 8)
 
     if args.chaos:
         return run_chaos(args)
@@ -2829,6 +3103,8 @@ def main():
         return run_mesh(args)
     if args.joins:
         return run_joins(args)
+    if args.windows:
+        return run_windows(args)
     if args.cluster:
         return run_cluster(args)
     if args.coldstart:

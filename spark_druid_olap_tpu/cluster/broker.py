@@ -72,6 +72,8 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from spark_druid_olap_tpu.cluster import epoch as EP
 from spark_druid_olap_tpu.cluster import merge as MG
 from spark_druid_olap_tpu.cluster import subqcache as SQC
@@ -647,7 +649,8 @@ class ClusterClient:
     # -- eligibility -----------------------------------------------------------
     def should_distribute(self, q) -> bool:
         if not isinstance(q, (S.GroupByQuerySpec, S.TimeseriesQuerySpec,
-                              S.TopNQuerySpec)):
+                              S.TopNQuerySpec, S.SelectQuerySpec,
+                              S.SearchQuerySpec)):
             return False
         dp = self.plan.datasources.get(getattr(q, "datasource", None))
         if dp is None:
@@ -660,7 +663,9 @@ class ClusterClient:
         if ver != dp.ingest_version \
                 and self._ryw_state(q.datasource, ver) is None:
             return False
-        for a in q.aggregations:
+        # Select/Search carry no aggregations: their merges (concat +
+        # re-page, count sum + re-limit) are always closed
+        for a in getattr(q, "aggregations", ()):
             if a.kind not in MG.MERGEABLE_KINDS:
                 return False
         return True
@@ -675,13 +680,29 @@ class ClusterClient:
         # serving through their drain grace precisely for us)
         st = self._active
         try:
-            sub, posts, having, limit, key_cols, aggs = _strip(q)
+            if isinstance(q, S.SelectQuerySpec):
+                return self._execute_select(q, st, t0)
+            if isinstance(q, S.SearchQuerySpec):
+                return self._execute_search(q, st, t0)
+            return self._execute_agg(q, st, t0)
+        except _LocalFallback as e:
+            return self._local(e.reason)
+
+    def _scatter(self, q, sub, st: _EpochState, t0: float):
+        """Scatter ``sub`` to every (interval-surviving) shard of the
+        query's datasource and drain the replies. Returns
+        ``(parts, meta)`` where ``parts`` is ``[(shard_index, data)]``
+        in shard-index order (Select needs block order; agg merges are
+        order-free) and ``meta`` carries the scatter accounting shared
+        by every query shape. Raises :class:`_LocalFallback` whenever
+        local execution must take over."""
+        try:
             body = json.dumps(SERDE.query_to_dict(sub)).encode("utf-8")
         except (ValueError, TypeError) as e:
-            return self._local(f"serde: {e}")
+            raise _LocalFallback(f"serde: {e}") from e
         dp = st.plan.datasources.get(q.datasource)
         if dp is None:
-            return self._local("datasource not in the captured plan")
+            raise _LocalFallback("datasource not in the captured plan")
         # read-your-writes scatter: the local version ran past the
         # manifest but the push path confirmed every owner — scatter,
         # restricted to the confirmed replica sets. A version that fails
@@ -692,7 +713,7 @@ class ClusterClient:
         if ver != dp.ingest_version:
             ryw = self._ryw_state(q.datasource, ver)
             if ryw is None:
-                return self._local(
+                raise _LocalFallback(
                     "post-manifest writes not confirmed on owners")
             self.counters["ryw_scatters"] += 1
         deadline = None
@@ -718,7 +739,7 @@ class ClusterClient:
         if not shards:
             # every shard outside the interval: the empty answer is
             # cheaper (and shape-exact) on the broker's local engine
-            return self._local("all shards pruned by query interval")
+            raise _LocalFallback("all shards pruned by query interval")
         partial = bool(self.config.get(CLUSTER_PARTIAL_RESULTS))
         # shard-level cache in front of the scatter: a hit replays the
         # decoded partial (merge never mutates parts) with zero RPCs;
@@ -741,7 +762,7 @@ class ClusterClient:
             data = cache.get(ck) if cache.enabled else None
             if data is not None:
                 cache_hits += 1
-                parts.append(data)
+                parts.append((sh.index, data))
                 covered_rows += sh.rows
                 continue
             name = shard_name(q.datasource, sh.index, dp.n_shards)
@@ -758,7 +779,7 @@ class ClusterClient:
         for sh, ck, f in futs:
             try:
                 data, nid, nbytes = f.result()
-                parts.append(data)
+                parts.append((sh.index, data))
                 nodes_used.add(nid)
                 covered_rows += sh.rows
                 cache.put(ck, data, nbytes)
@@ -773,8 +794,6 @@ class ClusterClient:
                 if err is None:
                     err = e
         if err is not None:
-            if isinstance(err, _LocalFallback):
-                return self._local(err.reason)
             raise err
         degraded = None
         if missing:
@@ -782,15 +801,52 @@ class ClusterClient:
             degraded = {"missing_shards": sorted(missing),
                         "coverage_rows": covered_rows,
                         "total_rows": total_rows}
+        parts.sort(key=lambda t: t[0])
+        meta = {"shards": len(futs) + cache_hits, "pruned": pruned,
+                "nodes_used": nodes_used, "cache_hits": cache_hits,
+                "cache_enabled": cache.enabled, "degraded": degraded,
+                "epoch": st.record.epoch}
+        return parts, meta
+
+    def _finish(self, q, r: QueryResult, meta: dict, merge_ms: float,
+                t0: float) -> QueryResult:
+        """Shared result annotation for every distributed query shape."""
+        self.counters["merge_ms"] += merge_ms
+        r.degraded = meta["degraded"]
+        cl_stats = {
+            "mode": "scatter", "shards": meta["shards"],
+            "shards_pruned": meta["pruned"],
+            "nodes": sorted(meta["nodes_used"]),
+            "epoch": meta["epoch"],
+            "merge_ms": round(merge_ms, 3)}
+        if meta["cache_enabled"]:
+            cl_stats["subq_cache_hits"] = meta["cache_hits"]
+        if meta["degraded"] is not None:
+            cl_stats["degraded"] = meta["degraded"]
+        self.engine.last_stats["cluster"] = cl_stats
+        self.engine.last_stats["datasource"] = q.datasource
+        self.engine.last_stats["total_ms"] = \
+            (_time.perf_counter() - t0) * 1000
+        return r
+
+    def _execute_agg(self, q, st: _EpochState, t0: float) -> QueryResult:
+        sub, posts, having, limit, key_cols, aggs = _strip(q)
+        tagged, meta = self._scatter(q, sub, st, t0)
+        parts = [d for _, d in tagged]
+        # quantile finalization happens exactly once, here: name ->
+        # fraction so the broker's merged KLL registers estimate at the
+        # query's asked-for rank (engines shipped raw registers)
+        fractions = {a.name: a.fraction for a in q.aggregations
+                     if getattr(a, "fraction", None) is not None}
         t_m = _time.perf_counter()
         if parts:
-            columns, data, n = MG.merge_partials(parts, key_cols, aggs)
+            columns, data, n = MG.merge_partials(parts, key_cols, aggs,
+                                                 fractions)
         else:
             # every shard missing (degraded): shape-exact empty answer
             columns, data, n = \
                 list(key_cols) + [name for name, _ in aggs], {}, 0
         merge_ms = (_time.perf_counter() - t_m) * 1000
-        self.counters["merge_ms"] += merge_ms
         names = list(columns)
         if n == 0:
             # match the engine's empty-scan shape (posts stay unevaluated)
@@ -800,21 +856,83 @@ class ClusterClient:
             data = self.engine._agg_epilogue(data, names, posts, having,
                                              limit)
             r = QueryResult(names, data)
-        r.degraded = degraded
-        cl_stats = {
-            "mode": "scatter", "shards": len(futs) + cache_hits,
-            "shards_pruned": pruned, "nodes": sorted(nodes_used),
-            "epoch": st.record.epoch,
-            "merge_ms": round(merge_ms, 3)}
-        if cache.enabled:
-            cl_stats["subq_cache_hits"] = cache_hits
-        if degraded is not None:
-            cl_stats["degraded"] = degraded
-        self.engine.last_stats["cluster"] = cl_stats
-        self.engine.last_stats["datasource"] = q.datasource
-        self.engine.last_stats["total_ms"] = \
-            (_time.perf_counter() - t0) * 1000
-        return r
+        return self._finish(q, r, meta, merge_ms, t0)
+
+    def _execute_select(self, q: S.SelectQuerySpec, st: _EpochState,
+                        t0: float) -> QueryResult:
+        """Distributed paged select: every shard answers an EXTENDED
+        first page (offset + page_size rows — the broker cannot know
+        how the global offset splits across shards), the broker concats
+        the blocks in shard-index order (shards are contiguous time
+        blocks), re-sorts by the time column when it is in the output
+        (stable, so intra-shard row order survives), and re-pages."""
+        sub = dataclasses.replace(q, page_size=q.page_offset + q.page_size,
+                                  page_offset=0)
+        tagged, meta = self._scatter(q, sub, st, t0)
+        t_m = _time.perf_counter()
+        ds = self.engine.store.get(q.datasource)
+        cols = list(q.columns) or ds.column_names()
+        blocks = [d for _, d in tagged if d and len(next(iter(d.values())))]
+        if q.descending:
+            blocks = blocks[::-1]
+        if not blocks:
+            r = QueryResult.empty(cols)
+            return self._finish(
+                q, r, meta, (_time.perf_counter() - t_m) * 1000, t0)
+        data = {c: np.concatenate([b[c] for b in blocks]) for c in cols}
+        tname = ds.time.name if ds.time is not None else None
+        if tname is not None and tname in data:
+            tv = np.asarray(data[tname])
+            if tv.dtype.kind == "M":
+                tv = tv.astype("datetime64[ms]").astype(np.int64)
+            order = np.argsort(-tv if q.descending else tv, kind="stable")
+            data = {c: v[order] for c, v in data.items()}
+        page = slice(q.page_offset, q.page_offset + q.page_size)
+        data = {c: v[page] for c, v in data.items()}
+        r = QueryResult(cols, data)
+        return self._finish(
+            q, r, meta, (_time.perf_counter() - t_m) * 1000, t0)
+
+    def _execute_search(self, q: S.SearchQuerySpec, st: _EpochState,
+                        t0: float) -> QueryResult:
+        """Distributed dimension-value search: per-(dimension, value)
+        occurrence counts SUM across shards (each shard counted its own
+        rows), rows re-sort to the single-engine order — dimensions in
+        query order, values in ascending (global-dictionary) order —
+        and the limit re-applies after the merge."""
+        sub = dataclasses.replace(q, limit=None)
+        tagged, meta = self._scatter(q, sub, st, t0)
+        t_m = _time.perf_counter()
+        value_shape = q.value_output is not None
+        vcol = q.value_output if value_shape else "value"
+        ccol = q.count_output if value_shape else "count"
+        columns = [vcol, ccol] if value_shape \
+            else ["dimension", vcol, ccol]
+        counts: Dict[tuple, int] = {}
+        for _, d in tagged:
+            if not d:
+                continue
+            n = len(d[ccol])
+            for i in range(n):
+                key = (d[vcol][i],) if value_shape \
+                    else (d["dimension"][i], d[vcol][i])
+                counts[key] = counts.get(key, 0) + int(d[ccol][i])
+        dim_pos = {name: i for i, name in enumerate(q.dimensions)}
+        keys = sorted(counts,
+                      key=(lambda k: k[0]) if value_shape
+                      else (lambda k: (dim_pos.get(k[0], len(dim_pos)),
+                                       k[1])))
+        if q.limit is not None:
+            keys = keys[: q.limit]
+        data = {ccol: np.array([counts[k] for k in keys],
+                               dtype=np.int64),
+                vcol: np.array([k[-1] for k in keys], dtype=object)}
+        if not value_shape:
+            data["dimension"] = np.array([k[0] for k in keys],
+                                         dtype=object)
+        r = QueryResult(columns, data)
+        return self._finish(
+            q, r, meta, (_time.perf_counter() - t_m) * 1000, t0)
 
     def _local(self, reason: str) -> None:
         self.counters["local_fallbacks"] += 1
@@ -1087,8 +1205,7 @@ def _strip(q):
             context=q.context)
         posts = q.post_aggregations
         having = None
-        limit = S.LimitSpec((S.OrderByColumn(q.metric, ascending=False),),
-                            q.threshold)
+        limit = S.topn_limit(q)
         dims = (q.dimension,)
     elif isinstance(q, S.GroupByQuerySpec):
         sub = dataclasses.replace(q, post_aggregations=(), having=None,

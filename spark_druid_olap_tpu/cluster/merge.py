@@ -10,9 +10,10 @@ answers vs single-engine execution):
   (all-NaN group -> NaN, matching ``_identity_row``);
 - min/max are NaN/None-aware with the same null-wins-never rule;
 - sketch aggregates merge RAW registers (HLL: elementwise max, theta:
-  elementwise min — both associative and commutative) and the estimate
-  is finalized ONCE here, so the distributed estimate equals the
-  single-engine estimate exactly, not approximately.
+  elementwise min, KLL: lex-min survivor + exact count sum — all
+  associative and commutative) and the estimate is finalized ONCE
+  here, so the distributed estimate equals the single-engine estimate
+  exactly, not approximately.
 
 The mergeable-kind set derives from ``ops/agg_registry.AGG_CLOSURE``
 (the declared merge closure): anything routed sum/min/max/count or
@@ -28,6 +29,7 @@ from typing import Dict, List, Sequence, Tuple
 import numpy as np
 
 from spark_druid_olap_tpu.ops import hll as HLL
+from spark_druid_olap_tpu.ops import kll as KLL
 from spark_druid_olap_tpu.ops import theta as TH
 from spark_druid_olap_tpu.ops.agg_registry import AGG_CLOSURE
 
@@ -35,7 +37,7 @@ from spark_druid_olap_tpu.ops.agg_registry import AGG_CLOSURE
 MERGE_OP: Dict[str, str] = {}
 for _k, _spec in AGG_CLOSURE.items():
     if _spec["sketch"] is not None:
-        MERGE_OP[_k] = _spec["sketch"]                  # hll | theta
+        MERGE_OP[_k] = _spec["sketch"]                  # hll | theta | kll
     elif _k != "anyvalue" and _spec["route"] in ("count", "sum"):
         MERGE_OP[_k] = "sum"
     elif _k != "anyvalue" and _spec["route"] in ("min", "max"):
@@ -80,15 +82,20 @@ class _Acc:
 def merge_partials(parts: Sequence[Dict[str, np.ndarray]],
                    key_cols: Sequence[str],
                    aggs: Sequence[Tuple[str, str]],
+                   fractions: Dict[str, float] = None,
                    ) -> Tuple[List[str], Dict[str, np.ndarray], int]:
     """Merge shard partials into one canonical result.
 
     ``parts``: per-shard column dicts (every part carries all key and
     agg columns). ``aggs``: (output name, druid kind) in output order.
+    ``fractions``: output name -> quantile fraction for 'quantile'
+    aggregations (the broker finalizes each merged KLL register row at
+    that fraction, defaulting to the median).
     Returns (columns, data, n_rows) with rows canonically sorted by the
     key tuple (None first) — the epilogue's own ORDER BY re-sorts when
     the query asks for one, and the canonical order makes unordered
     results deterministic regardless of shard arrival order."""
+    fractions = fractions or {}
     ops = [(name, MERGE_OP[kind]) for name, kind in aggs]
     groups: Dict[tuple, _Acc] = {}
     float_domain = {name: False for name, _ in ops}
@@ -120,10 +127,10 @@ def merge_partials(parts: Sequence[Dict[str, np.ndarray]],
             slots = acc.slots
             for j, (_, op) in enumerate(ops):
                 v = acols[j][i]
-                if op in ("hll", "theta"):
+                if op in ("hll", "theta", "kll"):
                     # v is a 1-D register row — EXCEPT when the shard's
                     # segments all pruned away and its engine emitted
-                    # the scalar identity 0 (_identity_row): that cell
+                    # the scalar identity (_identity_row): that cell
                     # carries no registers and merges as a no-op
                     if not isinstance(v, np.ndarray) or v.ndim != 1:
                         continue
@@ -133,6 +140,8 @@ def merge_partials(parts: Sequence[Dict[str, np.ndarray]],
                         slots[j] = np.array(v, copy=True)
                     elif op == "hll":
                         np.maximum(slots[j], v, out=slots[j])
+                    elif op == "kll":
+                        slots[j] = KLL.merge(slots[j], v)
                     else:
                         np.minimum(slots[j], v, out=slots[j])
                     continue
@@ -166,15 +175,25 @@ def merge_partials(parts: Sequence[Dict[str, np.ndarray]],
             data_out[k] = arr
     for j, (name, op) in enumerate(ops):
         cells = [groups[key].slots[j] for key in keys]
-        if op in ("hll", "theta"):
+        if op in ("hll", "theta", "kll"):
             m = next((len(c) for c in cells if c is not None), 0)
             if n_out == 0 or m == 0:
-                # no shard contributed registers: every group estimates 0
-                data_out[name] = np.zeros(n_out, dtype=np.int64)
+                # no shard contributed registers: count sketches
+                # estimate 0, quantile sketches estimate NaN
+                data_out[name] = (
+                    np.full(n_out, np.nan, dtype=np.float64)
+                    if op == "kll" else np.zeros(n_out, dtype=np.int64))
                 continue
             # a group no shard had registers for uses the empty-register
             # identity (hll: all-zero registers, theta: all-one lane
-            # minima) — both estimate to exactly 0
+            # minima, kll: all-EMPTY survivors / zero counts) — count
+            # sketches estimate 0, a quantile of nothing is NaN
+            if op == "kll":
+                fill = KLL.identity_registers(m)
+                regs = np.stack([fill if c is None else c for c in cells])
+                data_out[name] = KLL.estimate(
+                    regs, fractions.get(name, 0.5))
+                continue
             fill = np.zeros(m, dtype=np.int64) if op == "hll" \
                 else np.ones(m, dtype=np.float64)
             regs = np.stack([fill if c is None else c for c in cells])

@@ -344,9 +344,37 @@ class AggCall(Expr):
     arg: Optional[Expr]          # None for count(*)
     distinct: bool = False
     approx: bool = False         # approximate count-distinct (HLL)
+    fraction: Optional[float] = None  # quantile for percentile_approx
 
     def children(self):
         return (self.arg,) if self.arg is not None else ()
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowCall(Expr):
+    """``fn(args) OVER (PARTITION BY ... ORDER BY ... [ROWS ...])``.
+
+    Never reaches the pushdown builder or the host evaluator: the
+    session's window post-pass (``window/plan.py``) strips these from
+    the statement, runs the base query through the normal engine /
+    cluster / mesh path, and computes the window columns on device over
+    the (merged) result frame.
+
+    ``frame`` is a ROWS frame as (preceding, following) row counts with
+    ``None`` meaning UNBOUNDED on that side; ``frame is None`` means the
+    SQL default (unbounded preceding .. current row when ORDER BY is
+    present, the whole partition otherwise)."""
+
+    fn: str                               # rank | dense_rank | row_number |
+    #                                       lag | lead | sum|min|max|avg|count
+    args: Tuple[Expr, ...]
+    partition_by: Tuple[Expr, ...] = ()
+    order_by: Tuple[Tuple[Expr, bool], ...] = ()   # (expr, ascending)
+    frame: Optional[Tuple[Optional[int], Optional[int]]] = None
+
+    def children(self):
+        return tuple(self.args) + tuple(self.partition_by) \
+            + tuple(x for x, _ in self.order_by)
 
 
 def walk(e: Expr):
@@ -395,7 +423,13 @@ def transform(e: Expr, fn):
                   None if e.otherwise is None else transform(e.otherwise, fn))
     elif isinstance(e, AggCall):
         e2 = AggCall(e.fn, None if e.arg is None else transform(e.arg, fn),
-                     e.distinct, e.approx)
+                     e.distinct, e.approx, e.fraction)
+    elif isinstance(e, WindowCall):
+        e2 = WindowCall(e.fn, tuple(transform(a, fn) for a in e.args),
+                        tuple(transform(p, fn) for p in e.partition_by),
+                        tuple((transform(x, fn), asc)
+                              for x, asc in e.order_by),
+                        e.frame)
     elif isinstance(e, KeyedLookup):
         e2 = KeyedLookup(transform(e.key, fn), e.table, e.default)
     elif isinstance(e, KeyedLookup2):
@@ -445,7 +479,21 @@ def to_sql(e: Expr) -> str:
     if isinstance(e, AggCall):
         arg = "*" if e.arg is None else to_sql(e.arg)
         d = "DISTINCT " if e.distinct else ""
-        return f"{e.fn}({d}{arg})"
+        frac = f", {e.fraction!r}" if e.fraction is not None else ""
+        return f"{e.fn}({d}{arg}{frac})"
+    if isinstance(e, WindowCall):
+        arg = ", ".join(to_sql(a) for a in e.args)
+        parts = []
+        if e.partition_by:
+            parts.append("PARTITION BY "
+                         + ", ".join(to_sql(p) for p in e.partition_by))
+        if e.order_by:
+            parts.append("ORDER BY " + ", ".join(
+                to_sql(x) + ("" if asc else " DESC")
+                for x, asc in e.order_by))
+        if e.frame is not None:
+            parts.append(f"ROWS {e.frame!r}")
+        return f"{e.fn}({arg}) OVER ({' '.join(parts)})"
     if isinstance(e, KeyedLookup):
         return f"lookup[{e.table!r}]({to_sql(e.key)})"
     if isinstance(e, KeyedLookup2):

@@ -306,13 +306,16 @@ def agg_to_dict(a: S.AggregationSpec):
         out["expr"] = expr_to_dict(a.expr)
     if a.filter is not None:
         out["filter"] = filter_to_dict(a.filter)
+    if a.fraction is not None:
+        out["fraction"] = a.fraction
     return out
 
 
 def agg_from_dict(d) -> S.AggregationSpec:
     return S.AggregationSpec(d["type"], d["name"], d.get("fieldName"),
                              expr_from_dict(d.get("expr")),
-                             filter_from_dict(d.get("filter")))
+                             filter_from_dict(d.get("filter")),
+                             d.get("fraction"))
 
 
 # -- query specs --------------------------------------------------------------

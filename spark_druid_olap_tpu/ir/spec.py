@@ -178,12 +178,16 @@ class AggregationSpec:
     ``field`` names a source column; ``expr`` (exclusive with field) is a
     computed input compiled to XLA (reference: JavascriptAggregationSpec via
     JSAggGenerator). ``filter`` makes it a filtered aggregation
-    (reference: FilteredAggregationSpec :362-377)."""
+    (reference: FilteredAggregationSpec :362-377). ``fraction`` is the
+    quantile for ``kind == "quantile"`` (percentile_approx), carried on
+    the spec so the broker can finalize merged KLL registers with the
+    same fraction the engine would."""
     kind: str
     name: str
     field: Optional[str] = None
     expr: Optional[E.Expr] = None
     filter: Optional[FilterSpec] = None
+    fraction: Optional[float] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -330,6 +334,16 @@ class SearchQuerySpec(QuerySpec):
     # [value_output, count_output] instead of [dimension, value, count]
     value_output: Optional[str] = None
     count_output: Optional[str] = None
+
+
+def topn_limit(q: "TopNQuerySpec") -> LimitSpec:
+    """The ORDER BY metric DESC LIMIT threshold epilogue a TopN implies.
+    One definition shared by the engine (parallel/executor.py) and the
+    broker's post-merge epilogue (cluster/broker.py), so the broker's
+    re-sort of merged TopN partials can never drift from the engine's
+    own order/limit epilogue."""
+    return LimitSpec((OrderByColumn(q.metric, ascending=False),),
+                     q.threshold)
 
 
 def filter_and(parts: Sequence[Optional[FilterSpec]]) -> Optional[FilterSpec]:

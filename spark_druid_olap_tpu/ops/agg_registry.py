@@ -21,9 +21,12 @@ Fields per druid-level kind:
 - ``sketch`` — "hll"/"theta" for register-valued aggregates that need
   their own shared-scan demux + wave-merge handling, else None.
 - ``merge``  — for sketches, the register algebra cross-chip merges
-  must use: "max" (HLL rho registers) or "min" (theta k-min hashes).
-  Summing registers double-counts silently; the ``mesh`` sdlint pass
-  checks ``ops/<sketch>.py:merge_registers`` against this field.
+  must use: "max" (HLL rho registers), "min" (theta k-min hashes), or
+  "minsum" (KLL lane lex-minima + exact level-count sums). Summing
+  min-valued registers double-counts silently; the ``mesh`` sdlint pass
+  checks ``ops/<sketch>.py:merge_registers`` against this field, and
+  the ``mergeclosure`` pass cross-checks it against the runtime merge
+  table (``ops/groupby.py:SKETCH_MERGE_OPS``).
 
 Kept import-free and ``ast.literal_eval``-parseable on purpose: sdlint
 reads this file without importing it (and so without jax installed).
@@ -48,6 +51,8 @@ AGG_CLOSURE = {
                     "reagg": None, "sketch": "hll", "merge": "max"},
     "thetasketch": {"route": "theta", "dtype": "int64",
                     "reagg": None, "sketch": "theta", "merge": "min"},
+    "quantile":    {"route": "kll", "dtype": "float64",
+                    "reagg": None, "sketch": "kll", "merge": "minsum"},
     "anyvalue":    {"route": "max", "dtype": "float64",
                     "reagg": "anyvalue", "sketch": None},
 }

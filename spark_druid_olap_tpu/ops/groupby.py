@@ -834,6 +834,12 @@ def merge_partials(partials: Dict[str, object], routes: Dict[str, Route],
     return out
 
 
+# Sketch register algebras by sketch family — the runtime source of
+# truth the lint pass (tools/sdlint/mergeclosure.py) cross-checks each
+# AGG_CLOSURE ``merge`` declaration against. Keep this a plain literal.
+SKETCH_MERGE_OPS = {"hll": "max", "theta": "min", "kll": "minsum"}
+
+
 def merge_lane_partials(out, routes: Dict[str, Route],
                         sketch_kinds: Dict[str, str], axis_name: str):
     """Cross-chip merge of ONE lane's complete output dict — the single
@@ -846,17 +852,20 @@ def merge_lane_partials(out, routes: Dict[str, Route],
       for the exact f64 host combine,
     - sketch registers via their own register algebra: HLL rho registers
       are maxima (``hll.merge_registers``), theta k-min registers are
-      minima (``theta.merge_registers``) — never addition.
+      minima (``theta.merge_registers``), KLL survivor registers are a
+      lex-min over (tiebreak, value) plus an exact count psum
+      (``kll.merge_registers``) — never plain addition.
 
-    ``sketch_kinds`` maps output name -> "hll" | "theta" for the lane's
-    register-valued aggregations.
+    ``sketch_kinds`` maps output name -> "hll" | "theta" | "kll" for the
+    lane's register-valued aggregations (algebra per SKETCH_MERGE_OPS).
     """
     from spark_druid_olap_tpu.ops import hll as _hll
+    from spark_druid_olap_tpu.ops import kll as _kll
     from spark_druid_olap_tpu.ops import theta as _theta
     dense = {k: v for k, v in out.items() if k not in sketch_kinds}
     merged = merge_partials(dense, routes, axis_name)
+    folds = {"hll": _hll.merge_registers, "theta": _theta.merge_registers,
+             "kll": _kll.merge_registers}
     for name, sk in sketch_kinds.items():
-        fold = _hll.merge_registers if sk == "hll" \
-            else _theta.merge_registers
-        merged[name] = fold(out[name], axis_name)
+        merged[name] = folds[sk](out[name], axis_name)
     return merged

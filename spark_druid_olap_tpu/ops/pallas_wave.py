@@ -71,6 +71,7 @@ from jax.experimental import pallas as pl
 from spark_druid_olap_tpu.ops import filters as F
 from spark_druid_olap_tpu.ops import groupby as G
 from spark_druid_olap_tpu.ops import hll as HLL
+from spark_druid_olap_tpu.ops import kll as KLL
 from spark_druid_olap_tpu.ops import pallas_groupby as PG
 from spark_druid_olap_tpu.ops import theta as TH
 from spark_druid_olap_tpu.ops.scan import ScanContext, array_dtype
@@ -118,7 +119,7 @@ def wave_eligible(lanes, max_lanes: int) -> bool:
                 return False
         for p in lp.agg_plans:
             if p.kind not in ("count", "sum", "min", "max", "hll",
-                              "theta"):
+                              "theta", "kll"):
                 return False
     return True
 
@@ -178,7 +179,7 @@ def _lane_parts(lp, ctx: ScanContext, cse: Optional[FU.CSECache]):
     for p in lp.agg_plans:
         vals = p.build_values(ctx)
         am = p.build_mask(ctx, cse=cse)
-        if p.kind in ("hll", "theta"):
+        if p.kind in ("hll", "theta", "kll"):
             sketch.append((p, vals, am))
         else:
             dense.append((p.kind, p.spec.name, vals, am))
@@ -194,11 +195,11 @@ class _LaneLayout:
     """Scratch rows one lane owns inside the wave accumulator block."""
 
     __slots__ = ("base", "offs", "rpk", "dense_meta", "theta_base",
-                 "theta_epilogue", "hll", "next_row")
+                 "theta_epilogue", "hll", "kll", "next_row")
 
     def __init__(self, lp, base_row: int):
         dense_kinds = [p.kind for p in lp.agg_plans
-                       if p.kind not in ("hll", "theta")] + ["count"]
+                       if p.kind not in ("hll", "theta", "kll")] + ["count"]
         self.offs, self.rpk = PG._row_offsets(
             [(k, None, None) for k in dense_kinds])
         self.base = base_row
@@ -207,12 +208,14 @@ class _LaneLayout:
         self.dense_meta = [
             G.AggInput(p.spec.name, p.kind, is_int=p.is_int,
                        maxabs=p.maxabs)
-            for p in lp.agg_plans if p.kind not in ("hll", "theta")]
+            for p in lp.agg_plans
+            if p.kind not in ("hll", "theta", "kll")]
         self.dense_meta.append(
             G.AggInput("__rows__", "count", is_int=True, maxabs=1.0))
         self.theta_base: Dict[str, int] = {}
         self.theta_epilogue: List[str] = []
         self.hll: List[str] = []
+        self.kll: List[str] = []
         for p in lp.agg_plans:
             if p.kind == "theta":
                 stripe = lp.n_keys * TH.K_LANES
@@ -223,6 +226,10 @@ class _LaneLayout:
                     self.theta_epilogue.append(p.spec.name)
             elif p.kind == "hll":
                 self.hll.append(p.spec.name)
+            elif p.kind == "kll":
+                # survivor registers need a segment_min scatter over
+                # (key, level, lane) — XLA epilogue, same as HLL
+                self.kll.append(p.spec.name)
         self.next_row = row
 
 
@@ -252,7 +259,8 @@ def _prep_dtype(dt) -> object:
 # =============================================================================
 
 def build_wave_fn(ds, lanes, min_day: int, max_day: int, fplan, *,
-                  union_names, tz: str, log2m: int, tile_bytes: int):
+                  union_names, tz: str, log2m: int, tile_bytes: int,
+                  kll_lanes: int = KLL.K_LANES):
     """Lower a fused group to the wave mega-kernel.
 
     Returns ``(wave_fn, info)`` where ``wave_fn(arrays)`` maps the wave's
@@ -409,7 +417,8 @@ def build_wave_fn(ds, lanes, min_day: int, max_day: int, fplan, *,
     tile = block_rows * LANES
     blk = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0))
     out_blk = pl.BlockSpec((out_rows, LANES), lambda i: (0, 0))
-    need_epilogue = any(lay.hll or lay.theta_epilogue for lay in layouts)
+    need_epilogue = any(lay.hll or lay.theta_epilogue or lay.kll
+                        for lay in layouts)
 
     def wave_fn(arrays):
         n = 1
@@ -468,7 +477,7 @@ def build_wave_fn(ds, lanes, min_day: int, max_day: int, fplan, *,
                 tb = out[tbase: tbase + lp.n_keys * TH.K_LANES, :] \
                     .reshape(lp.n_keys, TH.K_LANES, LANES)
                 routed[name] = jnp.min(tb, axis=-1)      # exact min union
-            if lay.hll or lay.theta_epilogue:
+            if lay.hll or lay.theta_epilogue or lay.kll:
                 ctx, cse = epi
                 base, key, _, sketch = _lane_parts(lp, ctx, cse)
                 for p, vals, am in sketch:
@@ -479,6 +488,11 @@ def build_wave_fn(ds, lanes, min_day: int, max_day: int, fplan, *,
                     if p.kind == "hll":
                         routed[nm] = HLL.hll_registers(
                             key, m, vals, lp.n_keys, log2m)
+                    elif p.kind == "kll":
+                        tcol = ctx.col(ds.time.name) \
+                            if ds.time is not None else None
+                        routed[nm] = KLL.kll_registers(
+                            key, m, vals, tcol, lp.n_keys, kll_lanes)
                     else:
                         routed[nm] = TH.theta_registers(
                             key, m, vals, lp.n_keys)
@@ -492,7 +506,7 @@ def build_wave_fn(ds, lanes, min_day: int, max_day: int, fplan, *,
         "interpret": bool(interpret),
         "theta_inkernel": sum(len(lay.theta_base) for lay in layouts),
         "sketch_epilogue": sum(len(lay.hll) + len(lay.theta_epilogue)
-                               for lay in layouts),
+                               + len(lay.kll) for lay in layouts),
         # double-buffered input tiles + the resident scratch block
         "vmem_bytes": int(block_rows * LANES * sum(itemsizes) * 2
                           + out_rows * LANES * 4),

@@ -39,6 +39,7 @@ from spark_druid_olap_tpu.ops import filters as F
 from spark_druid_olap_tpu.ops import groupby as G
 from spark_druid_olap_tpu.ops import hash_groupby as H
 from spark_druid_olap_tpu.ops import hll as HLL
+from spark_druid_olap_tpu.ops import kll as KLL
 from spark_druid_olap_tpu.ops import pallas_groupby as PG_tpu
 from spark_druid_olap_tpu.ops import sorted_groupby as SG
 from spark_druid_olap_tpu.ops import theta as TH
@@ -81,6 +82,7 @@ from spark_druid_olap_tpu.utils.config import (
     GROUPBY_PALLAS_MAX_KEYS,
     HAVING_DEVICE_MIN_KEYS,
     HLL_LOG2M,
+    QUANTILE_LANES,
     SELECT_DEVICE_MIN_ROWS,
     SHAREDSCAN_FUSION_ENABLED,
     TOPN_DEVICE_MIN_KEYS,
@@ -506,6 +508,13 @@ class AggPlan:
                         jax.lax.bitcast_convert_type(ctx.col(a.field),
                                                      jnp.int32)
                 raise EngineFallback(f"cardinality over {k}")
+            if self.kind == "kll":
+                # quantile domain: the actual numeric values (canonical
+                # f32 inside kll_registers so every tier sees one bit
+                # pattern per value)
+                if k in (ColumnKind.LONG, ColumnKind.DOUBLE):
+                    return ctx.col(a.field)
+                raise EngineFallback(f"quantile over {k}")
             if k in (ColumnKind.LONG, ColumnKind.DOUBLE, ColumnKind.DATE):
                 return ctx.col(a.field)
             if k == ColumnKind.DIM and self.dim_codes:
@@ -564,6 +573,7 @@ _AGG_KIND = {"count": ("count", np.int64), "longsum": ("sum", np.int64),
              "doublemax": ("max", np.float64),
              "cardinality": ("hll", np.int64),
              "thetasketch": ("theta", np.int64),
+             "quantile": ("kll", np.float64),
              "anyvalue": ("max", np.float64)}
 
 
@@ -658,7 +668,9 @@ def plan_aggregation(a: S.AggregationSpec, ds: Datasource) -> AggPlan:
     elif a.field is not None:
         cols.add(a.field)
         ck = ds.column_kind(a.field)
-        if a.kind == "anyvalue" or kind in ("hll", "theta"):
+        if kind == "kll" and ds.time is not None:
+            cols.add(ds.time.name)   # content salt for the sampled set
+        if a.kind == "anyvalue" or kind in ("hll", "theta", "kll"):
             is_int, maxabs = _col_bounds(ds, a.field)
             if ck == ColumnKind.DOUBLE:
                 is_int = False
@@ -1103,8 +1115,7 @@ class QueryEngine:
                               None, None, q.granularity, q.filter,
                               q.intervals, t0)
         elif isinstance(q, S.TopNQuerySpec):
-            limit = S.LimitSpec((S.OrderByColumn(q.metric, ascending=False),),
-                                q.threshold)
+            limit = S.topn_limit(q)
             r = self._run_agg(q, [q.dimension], q.aggregations,
                               q.post_aggregations, None, limit,
                               q.granularity, q.filter, q.intervals, t0)
@@ -1186,7 +1197,7 @@ class QueryEngine:
             from spark_druid_olap_tpu.utils import config as CF
             min_k = int(self.config.get(CF.GROUPBY_SORTED_MIN_KEYS))
             if min_k > 0 and n_keys >= min_k \
-                    and not any(p.kind in ("hll", "theta")
+                    and not any(p.kind in ("hll", "theta", "kll")
                                 for p in agg_plans) \
                     and self._sorted_run_wanted():
                 route_hashed = True
@@ -1211,7 +1222,8 @@ class QueryEngine:
         if multihost:
             seg_idx, s_pad, spw, n_waves = self._multihost_layout(
                 ds, seg_idx, n_waves, seg_bytes)
-        sketch_plans = [p for p in agg_plans if p.kind in ("hll", "theta")]
+        sketch_plans = [p for p in agg_plans
+                        if p.kind in ("hll", "theta", "kll")]
         topk = self._plan_device_topk(limit, having, agg_plans, n_keys) \
             if n_waves == 1 and not no_topk else None
         having_dev = self._plan_device_having(having, routes, agg_plans,
@@ -1228,6 +1240,7 @@ class QueryEngine:
                     self.config.get(TZ_ID),
                     self.config.get(GROUPBY_MATMUL_MAX_KEYS),
                     self.config.get(HLL_LOG2M),
+                    self.config.get(QUANTILE_LANES),
                     bool(self.config.get(ENCODE_ENABLED)),
                     jax.default_backend(),
                     bool(jax.config.jax_enable_x64),
@@ -1375,16 +1388,21 @@ class QueryEngine:
                 columns.append(p.output_name)
         for p in agg_plans:
             name = p.spec.name
-            if p.kind in ("hll", "theta"):
+            if p.kind in ("hll", "theta", "kll"):
                 regs = finals[name]
                 if self.partial_sketches:
                     # cluster historical mode: ship the raw [G, m]
                     # register block; the broker merges registers
-                    # across shards (max/min) and finalizes the
+                    # across shards (max/min/minsum) and finalizes the
                     # estimate once (cluster/merge.py) — that is what
                     # makes the distributed estimate EQUAL the
                     # single-engine one, not merely close
                     data[name] = np.asarray(regs)[sel]
+                    columns.append(name)
+                    continue
+                if p.kind == "kll":
+                    data[name] = KLL.estimate(
+                        regs, p.spec.fraction or 0.5)[sel]
                     columns.append(name)
                     continue
                 est = (HLL.estimate(regs) if p.kind == "hll"
@@ -1572,7 +1590,7 @@ class QueryEngine:
             return None
         oc = limit.columns[0]
         mplan = next((p for p in agg_plans if p.spec.name == oc.name), None)
-        if mplan is None or mplan.kind in ("hll", "theta"):
+        if mplan is None or mplan.kind in ("hll", "theta", "kll"):
             return None
         if mplan.dim_codes:
             # string min/max decodes to text: the exactness proof can't
@@ -1629,9 +1647,9 @@ class QueryEngine:
         on host. Table overflow retries at 4x slots, then falls back.
         ≈ Druid groupBy v2 never refusing on cardinality
         (DruidQuerySpec.scala:558-571)."""
-        if any(p.kind in ("hll", "theta") for p in agg_plans):
+        if any(p.kind in ("hll", "theta", "kll") for p in agg_plans):
             raise EngineFallback(
-                "approximate count-distinct over hashed group-by")
+                "sketch aggregation over hashed group-by")
         cards = [p.card for p in dim_plans]
         try:
             parts = H.split_parts(cards)
@@ -2448,7 +2466,7 @@ class QueryEngine:
         the '__rows__' group-occupancy count."""
         metas = [G.AggInput(p.spec.name, p.kind, is_int=p.is_int,
                             maxabs=p.maxabs)
-                 for p in agg_plans if p.kind not in ("hll", "theta")]
+                 for p in agg_plans if p.kind not in ("hll", "theta", "kll")]
         metas.append(G.AggInput("__rows__", "count", is_int=True, maxabs=1.0))
         return G.plan_routes(
             metas, n_keys, self.config.get(GROUPBY_MATMUL_MAX_KEYS),
@@ -2487,10 +2505,12 @@ class QueryEngine:
                    compact_m=None):
         matmul_max = self.config.get(GROUPBY_MATMUL_MAX_KEYS)
         log2m = self.config.get(HLL_LOG2M)
+        kll_lanes = self.config.get(QUANTILE_LANES)
         hll_plans = [p for p in agg_plans if p.kind == "hll"]
         theta_plans = [p for p in agg_plans if p.kind == "theta"]
+        kll_plans = [p for p in agg_plans if p.kind == "kll"]
         dense_plans = [p for p in agg_plans
-                       if p.kind not in ("hll", "theta")]
+                       if p.kind not in ("hll", "theta", "kll")]
 
         cheap_f, exp_f = (self._split_filter_staged(filter_spec)
                           if compact_m else (filter_spec, None))
@@ -2567,6 +2587,15 @@ class QueryEngine:
                 am = p.build_mask(ctx, cse=cse)
                 m = base if am is None else (base & am)
                 out[p.spec.name] = TH.theta_registers(key, m, vals, n_keys)
+            for p in kll_plans:
+                vals = p.build_values(ctx)
+                am = p.build_mask(ctx, cse=cse)
+                m = base if am is None else (base & am)
+                # the time column joins the content salt so duplicate
+                # values in distinct rows keep distinct survivor draws
+                tcol = ctx.col(ds.time.name) if ds.time is not None else None
+                out[p.spec.name] = KLL.kll_registers(
+                    key, m, vals, tcol, n_keys, kll_lanes)
             if n_over is not None:
                 out["__over__"] = n_over.reshape(1)
             return out
@@ -2602,6 +2631,7 @@ class QueryEngine:
                                compact_m=compact_m)
         hll_plans = [p for p in agg_plans if p.kind == "hll"]
         theta_plans = [p for p in agg_plans if p.kind == "theta"]
+        kll_plans = [p for p in agg_plans if p.kind == "kll"]
         pack, unpack = self._agg_meta_packers(
             agg_plans, routes, topk[1] if topk else n_keys,
             with_idx=bool(topk), with_score=bool(topk),
@@ -2638,6 +2668,8 @@ class QueryEngine:
             sketch_kinds = {p.spec.name: "hll" for p in hll_plans}
             sketch_kinds.update(
                 {p.spec.name: "theta" for p in theta_plans})
+            sketch_kinds.update(
+                {p.spec.name: "kll" for p in kll_plans})
 
             def sharded_core(arrays):
                 out = core(arrays)
@@ -2784,6 +2816,7 @@ class QueryEngine:
                                intervals, min_day, max_day, n_keys, routes)
         hll_plans = [p for p in agg_plans if p.kind == "hll"]
         theta_plans = [p for p in agg_plans if p.kind == "theta"]
+        kll_plans = [p for p in agg_plans if p.kind == "kll"]
 
         def finish(out, axis_name=None):
             out = dict(out)
@@ -2799,6 +2832,7 @@ class QueryEngine:
 
         sketch_kinds = {p.spec.name: "hll" for p in hll_plans}
         sketch_kinds.update({p.spec.name: "theta" for p in theta_plans})
+        sketch_kinds.update({p.spec.name: "kll" for p in kll_plans})
 
         def sharded_core(arrays):
             out = core(arrays)
@@ -2820,7 +2854,7 @@ class QueryEngine:
         per-chip along the segment axis."""
         specs = {}
         for p in agg_plans:
-            if p.kind in ("hll", "theta"):
+            if p.kind in ("hll", "theta", "kll"):
                 specs[p.spec.name] = P()
                 continue
             r = routes[p.spec.name]
@@ -2890,9 +2924,11 @@ class QueryEngine:
         the '__topk_idx__' key map)."""
         hll_plans = [p for p in agg_plans if p.kind == "hll"]
         theta_plans = [p for p in agg_plans if p.kind == "theta"]
+        kll_plans = [p for p in agg_plans if p.kind == "kll"]
         dense_plans = [p for p in agg_plans
-                       if p.kind not in ("hll", "theta")]
+                       if p.kind not in ("hll", "theta", "kll")]
         m = 1 << self.config.get(HLL_LOG2M)
+        kll_w = KLL.width(self.config.get(QUANTILE_LANES))
         x64 = G._x64()
         # (out_name, flat_len, dtype_str, merged)
         meta = []
@@ -2906,6 +2942,8 @@ class QueryEngine:
         meta += [(p.spec.name, n_out * m, "i32", True) for p in hll_plans]
         meta += [(p.spec.name, n_out * TH.K_LANES,
                   "f64" if x64 else "f32", True) for p in theta_plans]
+        meta += [(p.spec.name, n_out * kll_w, "i32", True)
+                 for p in kll_plans]
         if with_idx:
             meta.append(("__topk_idx__", n_out, "i32", True))
         if with_score:
@@ -2948,6 +2986,9 @@ class QueryEngine:
                 elif any(oname == p.spec.name for p in theta_plans):
                     chunk = np.asarray(chunk, np.float32) \
                         .reshape(n_out, TH.K_LANES)
+                elif any(oname == p.spec.name for p in kll_plans):
+                    chunk = np.rint(chunk).astype(np.int32) \
+                        .reshape(n_out, kll_w)
                 out[oname] = chunk
             if perchip_len:
                 chips = uflat.reshape(-1, perchip_len)
@@ -3741,14 +3782,19 @@ def _finals_from_out(out, routes, n_keys, sketch_plans):
 def _merge_wave_finals(acc, new, routes, sketch_plans=()):
     """Cross-wave merge: sums/counts add exactly (i64 or f64 finals), min/max
     keep their empty-group sentinels, sketch registers take their union
-    (HLL: elementwise max; theta k-mins: elementwise min)."""
+    (HLL: elementwise max; theta k-mins: elementwise min; KLL: lex-min
+    survivor + exact count sum — ops/kll.py merge)."""
     theta_names = {p.spec.name for p in sketch_plans
                    if p.kind == "theta"}
+    kll_names = {p.spec.name for p in sketch_plans if p.kind == "kll"}
     for name, v in new.items():
         r = routes.get(name)
         if r is None:                       # sketch registers
-            acc[name] = np.minimum(acc[name], v) if name in theta_names \
-                else np.maximum(acc[name], v)
+            if name in kll_names:
+                acc[name] = KLL.merge(acc[name], v)
+            else:
+                acc[name] = np.minimum(acc[name], v) \
+                    if name in theta_names else np.maximum(acc[name], v)
         elif r.kind == "min":
             acc[name] = np.minimum(acc[name], v)
         elif r.kind == "max":

@@ -51,6 +51,7 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 from spark_druid_olap_tpu.ops import groupby as G
+from spark_druid_olap_tpu.ops import kll as KLL
 from spark_druid_olap_tpu.ops import theta as TH
 from spark_druid_olap_tpu.parallel import cost as C
 from spark_druid_olap_tpu.parallel import mesh as M
@@ -61,6 +62,7 @@ from spark_druid_olap_tpu.utils.config import (
     HLL_LOG2M,
     MESH_ENABLED,
     MESH_MIN_SEGMENTS,
+    QUANTILE_LANES,
 )
 
 
@@ -133,20 +135,23 @@ def merged_payload_bytes(eng, lanes) -> int:
     """Size of the replicated (collective-merged) output buffers for one
     dispatch, computed from route metadata exactly the way
     ``_agg_meta_packers`` lays the merged buffer out: merged routes +
-    rows route + HLL register blocks + theta lane blocks, at the packed
-    buffer itemsize (i64 on x64 backends, i32 otherwise)."""
+    rows route + HLL register blocks + theta lane blocks + KLL survivor
+    blocks, at the packed buffer itemsize (i64 on x64 backends, i32
+    otherwise)."""
     m = 1 << int(eng.config.get(HLL_LOG2M))
+    kll_w = KLL.width(int(eng.config.get(QUANTILE_LANES)))
+    widths = {"hll": m, "theta": TH.K_LANES, "kll": kll_w}
     itemsize = 8 if G._x64() else 4
     elems = 0
     for lp in lanes:
         sketch = {p.spec.name: p.kind for p in lp.agg_plans
-                  if p.kind in ("hll", "theta")}
+                  if p.kind in ("hll", "theta", "kll")}
         for name, r in lp.routes.items():
             if name in sketch or not r.merged:
                 continue
             elems += sum(size for _, size, _ in r.outputs(lp.n_keys))
         for name, kind in sketch.items():
-            elems += lp.n_keys * (m if kind == "hll" else TH.K_LANES)
+            elems += lp.n_keys * widths[kind]
     return elems * itemsize
 
 
@@ -179,7 +184,7 @@ def build_sharded_program(eng, lane_outs_fn: Callable, lanes,
     mesh = eng.mesh
     sketch_kinds = [
         {p.spec.name: p.kind for p in lp.agg_plans
-         if p.kind in ("hll", "theta")}
+         if p.kind in ("hll", "theta", "kll")}
         for lp in lanes]
 
     def sharded_lanes(arrays):

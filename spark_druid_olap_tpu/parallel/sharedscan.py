@@ -50,6 +50,7 @@ from spark_druid_olap_tpu.ir import spec as S
 from spark_druid_olap_tpu.ops import filters as F
 from spark_druid_olap_tpu.ops import groupby as G
 from spark_druid_olap_tpu.ops import hll as HLL
+from spark_druid_olap_tpu.ops import kll as KLL
 from spark_druid_olap_tpu.ops import theta as TH
 from spark_druid_olap_tpu.ops import pallas_wave as PW
 from spark_druid_olap_tpu.ops import time_ops as T
@@ -66,6 +67,7 @@ from spark_druid_olap_tpu.utils.config import (
     PALLAS_WAVE_ENABLED,
     PALLAS_WAVE_MAX_LANES,
     PALLAS_WAVE_TILE_BYTES,
+    QUANTILE_LANES,
     SHAREDSCAN_ENABLED,
     SHAREDSCAN_FUSION_ENABLED,
     SHAREDSCAN_FUSION_MAX_NODES,
@@ -392,7 +394,8 @@ class SharedScanCoalescer:
                min_day, max_day, tuple(union_names),
                eng.config.get(TZ_ID),
                eng.config.get(GROUPBY_MATMUL_MAX_KEYS),
-               eng.config.get(HLL_LOG2M), jax.default_backend(),
+               eng.config.get(HLL_LOG2M),
+               eng.config.get(QUANTILE_LANES), jax.default_backend(),
                bool(jax.config.jax_enable_x64), sigs,
                # the fusion plan shapes the traced program: the token is
                # a pure function of the sorted lane set (arrival-order
@@ -564,7 +567,7 @@ class SharedScanCoalescer:
                 return False    # hashed tier: solo handles it
             min_k = int(eng.config.get(CF.GROUPBY_SORTED_MIN_KEYS))
             if min_k > 0 and n_keys >= min_k \
-                    and not any(p.kind in ("hll", "theta")
+                    and not any(p.kind in ("hll", "theta", "kll")
                                 for p in agg_plans) \
                     and eng._sorted_run_wanted():
                 return False    # medium-K reroute territory: keep parity
@@ -616,6 +619,7 @@ class SharedScanCoalescer:
         eng = self.engine
         matmul_max = eng.config.get(GROUPBY_MATMUL_MAX_KEYS)
         log2m = eng.config.get(HLL_LOG2M)
+        kll_lanes = eng.config.get(QUANTILE_LANES)
         tz = eng.config.get(TZ_ID)
         packers = [eng._agg_meta_packers(lp.agg_plans, lp.routes,
                                          lp.n_keys, with_idx=False)
@@ -650,7 +654,7 @@ class SharedScanCoalescer:
                     key = jnp.zeros_like(base, dtype=jnp.int32)
                 inputs = []
                 for p in lp.agg_plans:
-                    if p.kind in ("hll", "theta"):
+                    if p.kind in ("hll", "theta", "kll"):
                         continue
                     inputs.append(G.AggInput(p.spec.name, p.kind,
                                              p.build_values(ctx),
@@ -662,7 +666,7 @@ class SharedScanCoalescer:
                 out = G.dense_groupby(key, base, lp.n_keys, inputs,
                                       lp.routes, matmul_max)
                 for p in lp.agg_plans:
-                    if p.kind not in ("hll", "theta"):
+                    if p.kind not in ("hll", "theta", "kll"):
                         continue
                     vals = p.build_values(ctx)
                     am = p.build_mask(ctx, cse=cse)
@@ -670,6 +674,11 @@ class SharedScanCoalescer:
                     if p.kind == "hll":
                         out[p.spec.name] = HLL.hll_registers(
                             key, m, vals, lp.n_keys, log2m)
+                    elif p.kind == "kll":
+                        tcol = ctx.col(ds.time.name) \
+                            if ds.time is not None else None
+                        out[p.spec.name] = KLL.kll_registers(
+                            key, m, vals, tcol, lp.n_keys, kll_lanes)
                     else:
                         out[p.spec.name] = TH.theta_registers(
                             key, m, vals, lp.n_keys)
@@ -701,7 +710,8 @@ class SharedScanCoalescer:
         wave_fn, info = PW.build_wave_fn(
             ds, lanes, min_day, max_day, fplan,
             union_names=union_names, tz=tz, log2m=log2m,
-            tile_bytes=int(eng.config.get(PALLAS_WAVE_TILE_BYTES)))
+            tile_bytes=int(eng.config.get(PALLAS_WAVE_TILE_BYTES)),
+            kll_lanes=eng.config.get(QUANTILE_LANES))
         packers = [eng._agg_meta_packers(lp.agg_plans, lp.routes,
                                          lp.n_keys, with_idx=False)
                    for lp in lanes]
@@ -749,7 +759,8 @@ class SharedScanCoalescer:
         if wave_info is not None:
             # pallas kernel launches: one per device per wave
             eng._tick(2, n_waves * n_dev)
-        sketch = [[p for p in lp.agg_plans if p.kind in ("hll", "theta")]
+        sketch = [[p for p in lp.agg_plans
+                   if p.kind in ("hll", "theta", "kll")]
                   for lp in lanes]
         payload = MX.merged_payload_bytes(eng, lanes) * n_dev
         if n_waves == 1:
@@ -831,13 +842,18 @@ class SharedScanCoalescer:
                 columns.append(p.output_name)
         for p in lp.agg_plans:
             name = p.spec.name
-            if p.kind in ("hll", "theta"):
+            if p.kind in ("hll", "theta", "kll"):
                 regs = finals[name]
                 if eng.partial_sketches:
                     # cluster historical: ship the raw [G, m] register
                     # block exactly like the solo decode — the broker
                     # merges registers across shards and finalizes once
                     data[name] = np.asarray(regs)[sel]
+                    columns.append(name)
+                    continue
+                if p.kind == "kll":
+                    data[name] = KLL.estimate(
+                        regs, p.spec.fraction or 0.5)[sel]
                     columns.append(name)
                     continue
                 est = (HLL.estimate(regs) if p.kind == "hll"

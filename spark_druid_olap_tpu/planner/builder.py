@@ -580,6 +580,18 @@ class Builder:
                                                  field=call.arg.name)
             self._agg_by_call[key] = name
             return name
+        if call.fn == "percentile":
+            if not isinstance(call.arg, E.Column):
+                raise PlanUnsupported("percentile_approx over expression")
+            kind = self._col_kind(call.arg.name)
+            if kind not in (ColumnKind.LONG, ColumnKind.DOUBLE):
+                raise PlanUnsupported(
+                    "percentile_approx over non-numeric column")
+            self._aggs[name] = S.AggregationSpec(
+                "quantile", name, field=call.arg.name,
+                fraction=call.fraction)
+            self._agg_by_call[key] = name
+            return name
         if call.distinct:
             raise PlanUnsupported(f"distinct {call.fn}")
         self._register_agg(call, name)
@@ -656,6 +668,13 @@ class Builder:
         stmt = self.stmt
         if _stmt_has_subquery(stmt):
             raise PlanUnsupported("subquery")
+        # the session's window post-pass strips WindowCalls before
+        # planning; one surviving here (derived table / assisted subtree)
+        # can't be pushed
+        for item in stmt.items:
+            if item.expr != "*" and any(
+                    isinstance(n, E.WindowCall) for n in E.walk(item.expr)):
+                raise PlanUnsupported("window function in a subtree")
         ds_name, consumed = self.resolve_relation()
         self.ds = self.ctx.store.get(ds_name)
 

@@ -953,6 +953,10 @@ def _compute_agg(series_env, df, call: E.AggCall, ctx, outer_env, group_ids,
         # theta-sketch-class approx distinct: the host tier computes exact
         # (nunique already excludes nulls, like the count-distinct branch)
         out = s.groupby(g).nunique()
+    elif call.fn == "percentile":
+        # host tier computes the exact quantile (the KLL estimate is
+        # checked against this within the configured rank-error bound)
+        out = s.astype(np.float64).groupby(g).quantile(call.fraction)
     else:
         raise HostExecError(f"aggregate {call.fn}")
     full = out.reindex(range(n_groups))

@@ -749,7 +749,10 @@ class Parser:
                 else:
                     raise SqlSyntaxError(f"bad qualified name at {nxt.pos}")
             if self.at_op("("):
-                return self.parse_function_call(name)
+                call = self.parse_function_call(name)
+                if self._at_word("over"):
+                    return self.parse_over(call, name)
+                return call
             return E.Column(name, qual=qual)
         raise SqlSyntaxError(
             f"unexpected token {t.value!r} at {t.pos}")
@@ -811,7 +814,114 @@ class Parser:
             return E.AggCall("count", args[0], distinct=True, approx=True)
         if lname in ("approx_count_distinct_theta", "theta_sketch"):
             return E.AggCall("theta", args[0])
+        if lname in ("percentile_approx", "approx_percentile",
+                     "approx_quantile"):
+            if len(args) != 2 or not isinstance(args[1], E.Literal) \
+                    or isinstance(args[1].value, bool) \
+                    or not isinstance(args[1].value, (int, float)):
+                raise SqlSyntaxError(
+                    f"{name}(value, fraction) expects a literal fraction")
+            frac = float(args[1].value)
+            if not 0.0 <= frac <= 1.0:
+                raise SqlSyntaxError(
+                    "percentile fraction must be in [0, 1]")
+            return E.AggCall("percentile", args[0], fraction=frac)
         return E.Func(lname, tuple(args))
+
+    # -- window functions (OVER / PARTITION / ROWS etc. are soft words;
+    # ORDER, BY, BETWEEN, AND are real keywords) ------------------------------
+    _WINDOW_FUNCS = {"rank", "dense_rank", "row_number", "lag", "lead",
+                     "sum", "min", "max", "avg", "count"}
+
+    def parse_over(self, call: E.Expr, name: str) -> E.Expr:
+        self._expect_word("over")
+        self.expect_op("(")
+        partition: List[E.Expr] = []
+        if self._at_word("partition"):
+            self.next()
+            self.expect_kw("by")
+            partition.append(self.parse_expr())
+            while self.at_op(","):
+                self.next()
+                partition.append(self.parse_expr())
+        order: List = []
+        if self.at_kw("order"):
+            self.next()
+            self.expect_kw("by")
+            o = self.parse_order_item()
+            order.append((o.expr, o.ascending))
+            while self.at_op(","):
+                self.next()
+                o = self.parse_order_item()
+                order.append((o.expr, o.ascending))
+        frame = None
+        if self._at_word("rows"):
+            self.next()
+            if self.eat_kw("between"):
+                lo = self._parse_frame_bound()
+                self.expect_kw("and")
+                hi = self._parse_frame_bound()
+            else:
+                lo = self._parse_frame_bound()
+                hi = (0, 0)
+            frame = (self._frame_side(lo, start=True),
+                     self._frame_side(hi, start=False))
+        self.expect_op(")")
+        if isinstance(call, E.AggCall):
+            if call.distinct or call.approx or call.fraction is not None:
+                raise SqlSyntaxError(
+                    f"{call.fn} OVER does not support this aggregate form")
+            fn = call.fn
+            args = () if call.arg is None else (call.arg,)
+        elif isinstance(call, E.Func) and call.name in self._WINDOW_FUNCS:
+            fn = call.name
+            args = call.args
+        else:
+            raise SqlSyntaxError(f"{name} is not a window function")
+        if fn in ("rank", "dense_rank") and not order:
+            raise SqlSyntaxError(f"{fn}() OVER requires ORDER BY")
+        if fn in ("lag", "lead"):
+            if not 1 <= len(args) <= 3:
+                raise SqlSyntaxError(f"{fn} expects 1 to 3 arguments")
+            if not order:
+                raise SqlSyntaxError(f"{fn}() OVER requires ORDER BY")
+        return E.WindowCall(fn, tuple(args), tuple(partition), tuple(order),
+                            frame)
+
+    def _parse_frame_bound(self):
+        if self._at_word("unbounded"):
+            self.next()
+            if self._at_word("preceding"):
+                self.next()
+                return ("unbounded", -1)
+            self._expect_word("following")
+            return ("unbounded", 1)
+        if self._at_word("current"):
+            self.next()
+            self._expect_word("row")
+            return (0, 0)
+        t = self.next()
+        if t.kind != "number":
+            raise SqlSyntaxError(f"expected a ROWS frame bound at {t.pos}")
+        n = int(t.value)
+        if self._at_word("preceding"):
+            self.next()
+            return (n, -1)
+        self._expect_word("following")
+        return (n, 1)
+
+    @staticmethod
+    def _frame_side(bound, start: bool):
+        kind, sign = bound
+        if kind == "unbounded":
+            if (start and sign > 0) or (not start and sign < 0):
+                raise SqlSyntaxError("unsupported ROWS frame direction")
+            return None
+        if sign == 0:
+            return 0
+        if (start and sign > 0) or (not start and sign < 0):
+            raise SqlSyntaxError("unsupported ROWS frame direction")
+        return kind
 
 
 def parse_statement(sql: str) -> A.Statement:

@@ -329,6 +329,9 @@ def _transform_tracer(ctx):
 def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
     if isinstance(stmt, A.UnionAll):
         return _run_union(ctx, stmt, sql)
+    wp = _maybe_windows(ctx, stmt)
+    if wp is not None:
+        return _run_windowed(ctx, wp, sql)
     t0 = _time.perf_counter()
     dc0 = list(ctx.engine.dispatch_counts)
     sq0 = getattr(_subq_tls, "hits", 0)
@@ -491,6 +494,42 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
     # cluster entry from the previous engine query — gate on mode.
     res.degraded = (stats.get("cluster") or {}).get("degraded") \
         if mode == "engine" else None
+    return res
+
+
+def _maybe_windows(ctx, stmt):
+    """Strip ``OVER (...)`` calls BEFORE any planning (window/plan.py).
+    Returns ``(base_stmt, WindowPlan)`` or None. Runs ahead of the plan
+    cache on purpose: the base statement is what gets planned/cached,
+    so a windowed statement and its base share cache entries."""
+    from spark_druid_olap_tpu.window import plan as WPLAN
+    return WPLAN.extract(ctx, stmt)
+
+
+def _run_windowed(ctx, wp, sql: str) -> QueryResult:
+    """Window post-pass: run the base statement through the normal
+    tiers (engine pushdown / cluster scatter / composite / host), then
+    compute the window columns on device over the merged result frame
+    and apply the deferred ORDER BY / LIMIT / OFFSET
+    (window/exec.py). Distribution composes for free: on a broker the
+    base statement scatters and merges before the post-pass sees it."""
+    from spark_druid_olap_tpu.window import exec as WEXEC
+    base_stmt, plan = wp
+    t0 = _time.perf_counter()
+    base = _run_select_tz(ctx, base_stmt, f"{sql} <window base>")
+    _tw = _time.perf_counter()
+    df = WEXEC.apply(ctx, plan, base.to_pandas())
+    stats = dict(ctx.engine.last_stats)
+    stats["mode"] = "engine+window"
+    stats["window"] = {"n_windows": len(plan.windows),
+                       "fns": sorted({w.fn for w in plan.windows}),
+                       "window_ms": round(
+                           (_time.perf_counter() - _tw) * 1000, 2)}
+    stats["total_ms"] = (_time.perf_counter() - t0) * 1000
+    ctx.history.record(base_stmt, stats, sql=sql)
+    res = QueryResult(list(df.columns),
+                      {c: df[c].to_numpy() for c in df.columns})
+    res.degraded = base.degraded
     return res
 
 

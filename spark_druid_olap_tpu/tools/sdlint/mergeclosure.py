@@ -16,6 +16,14 @@ against the four sites that must each handle every aggregate:
   mentions (neither in ``_REAGG_KINDS`` nor as a special-case literal).
 - ``demux-gap``        — a sketch kind ``parallel/sharedscan.py`` never
   special-cases in its fused program / demux.
+- ``undeclared-sketch-merge`` — a sketch-valued kind whose registry
+  entry has no ``merge`` field: the register algebra (``max``/``min``/
+  ``minsum``) is what every cross-chip and broker merge must agree on,
+  so a sketch without a declared algebra is unmergeable by contract.
+- ``sketch-merge-drift`` — the declared ``merge`` disagrees with (or is
+  missing from) the runtime merge table
+  ``ops/groupby.py:SKETCH_MERGE_OPS`` that the device merge dispatches
+  on.
 
 Anchors are found by path suffix, so fixture trees carrying only the
 anchors their seeded violation needs still exercise the pass; a missing
@@ -38,17 +46,21 @@ _SHAREDSCAN_SUFFIX = "parallel/sharedscan.py"
 _PSUM_ROUTES = {"sum", "count"}
 
 
-def _registry(mod: Module) -> Optional[Dict[str, dict]]:
+def _dict_literal(mod: Module, name: str) -> Optional[Dict]:
     for node in ast.walk(mod.tree):
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Name) \
-                and node.targets[0].id == "AGG_CLOSURE":
+                and node.targets[0].id == name:
             try:
                 v = ast.literal_eval(node.value)
             except ValueError:
                 return None
             return v if isinstance(v, dict) else None
     return None
+
+
+def _registry(mod: Module) -> Optional[Dict[str, dict]]:
+    return _dict_literal(mod, "AGG_CLOSURE")
 
 
 def _agg_kind_literal(mod: Module) -> Dict[str, tuple]:
@@ -126,8 +138,38 @@ def run(project: Project) -> List[Finding]:
                 f"AGG_CLOSURE declares {kind!r} but executor._AGG_KIND "
                 f"no longer registers it"))
 
+    # sketch entries must DECLARE their register algebra, and the
+    # declaration must match the runtime dispatch table the device
+    # merge actually folds with
+    for kind, ent in sorted(registry.items()):
+        sketch = ent.get("sketch")
+        if sketch is not None and not ent.get("merge"):
+            out.append(Finding(
+                "mergeclosure", "undeclared-sketch-merge",
+                reg_mod.relpath, 1, kind,
+                f"sketch aggregate {kind!r} ({sketch}) declares no "
+                f"'merge' register algebra in AGG_CLOSURE — cross-chip "
+                f"and broker merges have nothing to check against, and "
+                f"a psum over {sketch} registers corrupts silently"))
+
     gb_mod = project.by_suffix(_GROUPBY_SUFFIX)
     if gb_mod is not None:
+        runtime_ops = _dict_literal(gb_mod, "SKETCH_MERGE_OPS")
+        if runtime_ops is not None:
+            for kind, ent in sorted(registry.items()):
+                sketch, merge = ent.get("sketch"), ent.get("merge")
+                if sketch is None or not merge:
+                    continue
+                got = runtime_ops.get(sketch)
+                if got != merge:
+                    out.append(Finding(
+                        "mergeclosure", "sketch-merge-drift",
+                        gb_mod.relpath, 1, kind,
+                        f"AGG_CLOSURE declares {sketch} merges via "
+                        f"{merge!r} but ops/groupby.py:SKETCH_MERGE_OPS "
+                        f"{'has no entry for it' if got is None else f'dispatches {got!r}'}"
+                        f" — the device fold and the declared closure "
+                        f"disagree"))
         handled = _function_literals(gb_mod, "merge_partials")
         for kind, ent in sorted(registry.items()):
             route = ent.get("route")

@@ -61,8 +61,13 @@ _COLLECTIVES = frozenset({"psum", "pmin", "pmax", "pmean", "all_gather",
 _MERGE_COLLECTIVES = frozenset({"psum", "pmin", "pmax", "pmean"})
 #: register algebra per sketch when the registry predates the
 #: ``merge`` field; the registry declaration wins when present
-_SKETCH_MERGE_DEFAULT = {"hll": "max", "theta": "min"}
-_MERGE_TO_COLLECTIVE = {"sum": "psum", "max": "pmax", "min": "pmin"}
+_SKETCH_MERGE_DEFAULT = {"hll": "max", "theta": "min", "kll": "minsum"}
+#: merge algebra -> the collective(s) it may lower to. Composite
+#: algebras (KLL "minsum": lex-min survivor lanes via pmin + exact
+#: level counts via psum) legitimately use more than one collective in
+#: the same merge body.
+_MERGE_TO_COLLECTIVE = {"sum": {"psum"}, "max": {"pmax"},
+                        "min": {"pmin"}, "minsum": {"pmin", "psum"}}
 
 #: host-callback / RNG vocabulary the purity pass does NOT already
 #: flag (purity covers time/random/np.random/threading/os/...; these
@@ -356,8 +361,8 @@ class _Mesh:
             seen_sketches.add(sketch)
             merge = entry.get("merge") \
                 or _SKETCH_MERGE_DEFAULT.get(sketch)
-            expected = _MERGE_TO_COLLECTIVE.get(merge)
-            if expected is None:
+            allowed = _MERGE_TO_COLLECTIVE.get(merge)
+            if allowed is None:
                 continue
             smod = self.project.by_suffix(f"ops/{sketch}.py")
             if smod is None:
@@ -371,13 +376,13 @@ class _Mesh:
                     continue
                 leaf = call_chain(node.func)[-1:]
                 if leaf and leaf[0] in _MERGE_COLLECTIVES \
-                        and leaf[0] != expected:
+                        and leaf[0] not in allowed:
                     out.append(Finding(
                         "mesh", "sketch-merge-mismatch", smod.relpath,
                         node.lineno, f"{sketch}.merge_registers",
                         f"{sketch} registers merge via {leaf[0]} but "
                         f"AGG_CLOSURE declares the {merge!r} register "
-                        f"algebra ({expected}); "
+                        f"algebra ({sorted(allowed)}); "
                         f"{'summing' if leaf[0] == 'psum' else 'folding'}"
                         f" registers with the wrong operator corrupts "
                         f"every cross-chip cardinality silently"))

@@ -365,6 +365,28 @@ HLL_LOG2M = _entry(
     "sdot.engine.hll.log2m", 11,
     "log2 of the HLL register count for approximate count-distinct "
     "(reference: Druid hyperUnique uses 2^11 registers).")
+QUANTILE_LANES = _entry(
+    "sdot.quantile.lanes", 256,
+    "Sample lanes per KLL level for percentile_approx (ops/kll.py). "
+    "Register width is 2*4*lanes + 4 int32 per group; rank error "
+    "shrinks ~1/sqrt(lanes). Must match across every engine in a "
+    "cluster — registers merge elementwise at the broker.")
+QUANTILE_RANK_BOUND = _entry(
+    "sdot.quantile.rank_bound", 0.05,
+    "Maximum |rank(estimate) - fraction| the bench/loadtest percentile "
+    "differential gates accept from the KLL estimate (rank space, not "
+    "value space — value error is unbounded for heavy-tailed data).")
+WINDOW_ENABLED = _entry(
+    "sdot.window.enabled", True,
+    "Window-function post-pass (window/): OVER (PARTITION BY ... ORDER "
+    "BY ...) computed by segment-sorted device kernels over the grouped "
+    "(and, clustered, broker-merged) result frame. Off = window queries "
+    "raise unsupported.")
+WINDOW_MAX_FRAME = _entry(
+    "sdot.window.max.frame", 1024,
+    "Largest bounded ROWS frame (preceding + following + 1) the device "
+    "window kernels lower via shift-stacking; wider frames raise "
+    "unsupported rather than materializing an unbounded shift stack.")
 # --- semantic result cache (cache/) -------------------------------------------
 CACHE_ENABLED = _entry(
     "sdot.cache.enabled", True,

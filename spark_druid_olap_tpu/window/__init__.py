@@ -1,0 +1,11 @@
+"""Device-side window-function post-pass.
+
+``plan.extract`` strips ``OVER (...)`` calls out of a SELECT statement so
+the base query runs through the normal engine / cluster / mesh path
+untouched; ``exec.apply`` then computes the window columns over the
+(merged) result frame as segment-sorted jit kernels — partition-boundary
+masks plus prefix scans, no host loop over rows. See docs/WINDOWS.md.
+"""
+
+from spark_druid_olap_tpu.window.plan import extract  # noqa: F401
+from spark_druid_olap_tpu.window.exec import apply  # noqa: F401

@@ -1,0 +1,362 @@
+"""Device-side window kernels over the base query's result frame.
+
+Each window call lowers to ONE jit program over integer partition/order
+codes plus the argument column: a ``jnp.lexsort`` groups rows into
+segment runs, a partition-boundary mask derives per-row segment
+start/end indices, and the function body is prefix scans (segmented via
+``lax.associative_scan`` with reset flags) or frame gathers — no host
+loop over rows. Results scatter back to the original row order through
+the inverse permutation.
+
+String/object columns participate through sorted factorized codes
+(``pd.factorize(sort=True)``): code order equals value order, so
+min/max/lag/lead over codes map back to values exactly.
+
+Null semantics (shared with the pandas references in
+tests/test_window.py): ORDER BY treats NULL as the LARGEST value (last
+ascending, first descending); aggregate arguments skip NULLs
+(all-null frame -> NULL); lag/lead return the stored value inside the
+partition (NULL included) and the default only past its edge.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import numpy as np
+import pandas as pd
+
+import jax
+import jax.numpy as jnp
+
+from spark_druid_olap_tpu.window.plan import (OFFSET_FNS, RANKING_FNS,
+                                              WindowCol, WindowPlan,
+                                              WindowUnsupported)
+
+_I64MAX = np.int64(2 ** 62)     # in-band infinity for int min/max
+
+
+# -- code building (host: factorize is inherently a host operation) ----------
+
+def _order_key(col: pd.Series, ascending: bool) -> np.ndarray:
+    """Integer sort key for one ORDER BY column: sorted factorize codes
+    with NULL mapped past the largest code, negated for DESC."""
+    codes, uniq = pd.factorize(col, sort=True, use_na_sentinel=True)
+    key = np.where(codes < 0, len(uniq), codes).astype(np.int64)
+    return key if ascending else -key
+
+
+def _partition_ids(df: pd.DataFrame, cols: Tuple[str, ...]) -> np.ndarray:
+    if not cols:
+        return np.zeros(len(df), dtype=np.int64)
+    mats = []
+    for c in cols:
+        codes, _ = pd.factorize(df[c], sort=False, use_na_sentinel=False)
+        mats.append(codes.astype(np.int64))
+    if len(mats) == 1:
+        return mats[0]
+    _, pid = np.unique(np.stack(mats, axis=1), axis=0, return_inverse=True)
+    return pid.astype(np.int64)
+
+
+def _prep_arg(col: pd.Series):
+    """(values int64/float64, valid mask, decoder) for an argument
+    column. The decoder maps kernel-space values + validity back to the
+    column's domain (datetime ticks, factorized object codes)."""
+    a = col.to_numpy()
+    if a.dtype.kind == "M":
+        iv = a.astype("datetime64[ns]").view(np.int64)
+        vm = ~np.isnat(a)
+
+        def dec(v, ok):
+            out = v.astype(np.int64).view("datetime64[ns]").copy()
+            out[~ok] = np.datetime64("NaT")
+            return out
+        return np.where(vm, iv, 0), vm, dec
+    if a.dtype.kind == "f":
+        vm = ~np.isnan(a)
+
+        def dec(v, ok):
+            return np.where(ok, v, np.nan).astype(np.float64)
+        return a.astype(np.float64), vm, dec
+    if a.dtype.kind in "iub":
+        vm = np.ones(len(a), dtype=bool)
+
+        def dec(v, ok):
+            v = np.asarray(v)
+            if ok.all():
+                return v.astype(np.int64)
+            return np.where(ok, v.astype(np.float64), np.nan)
+        return a.astype(np.int64), vm, dec
+    # object / strings: sorted codes so code order == value order
+    codes, uniq = pd.factorize(col, sort=True, use_na_sentinel=True)
+    vm = codes >= 0
+
+    def dec(v, ok):
+        out = np.empty(len(v), dtype=object)
+        vv = np.asarray(v).astype(np.int64)
+        for i in range(len(v)):
+            out[i] = uniq[vv[i]] if ok[i] else None
+        return out
+    return np.where(vm, codes, 0).astype(np.int64), vm, dec
+
+
+# -- jit kernels --------------------------------------------------------------
+
+def _segments(pid, n):
+    """(perm, sorted pid, boundary, seg_start, seg_end, iota) given the
+    UNSORTED pid and the precomputed perm is folded in by callers."""
+    iota = jnp.arange(n, dtype=jnp.int64)
+    boundary = jnp.concatenate(
+        [jnp.ones(1, dtype=bool), pid[1:] != pid[:-1]])
+    seg_start = jax.lax.cummax(jnp.where(boundary, iota, 0))
+    b_end = jnp.concatenate(
+        [pid[:-1] != pid[1:], jnp.ones(1, dtype=bool)])
+    start_rev = jax.lax.cummax(jnp.where(b_end[::-1], iota, 0))
+    seg_end = (n - 1) - start_rev[::-1]
+    return iota, boundary, seg_start, seg_end
+
+
+def _segscan(op, vals, boundary, reverse=False):
+    """Segmented inclusive scan: ``op`` accumulates within a segment and
+    resets at each boundary flag."""
+    if reverse:
+        return _segscan(op, vals[::-1], boundary[::-1])[::-1]
+
+    def combine(a, b):
+        fa, va = a
+        fb, vb = b
+        return (fa | fb, jnp.where(fb, vb, op(va, vb)))
+    _, out = jax.lax.associative_scan(combine, (boundary, vals))
+    return out
+
+
+def _shift(a, k, fill):
+    n = a.shape[0]
+    if k == 0:
+        return a
+    if abs(k) >= n:
+        return jnp.full_like(a, fill)
+    if k > 0:
+        return jnp.concatenate([jnp.full(k, fill, a.dtype), a[:-k]])
+    return jnp.concatenate([a[-k:], jnp.full(-k, fill, a.dtype)])
+
+
+@functools.partial(jax.jit, static_argnames=("fn", "n_keys"))
+def _rank_kernel(pid, keys, fn: str, n_keys: int):
+    n = pid.shape[0]
+    perm = jnp.lexsort(tuple(keys[::-1]) + (pid,))
+    sp = pid[perm]
+    iota, boundary, seg_start, _ = _segments(sp, n)
+    if fn == "row_number":
+        out_sorted = iota - seg_start + 1
+    else:
+        change = boundary
+        for k in keys:
+            sk = k[perm]
+            change = change | jnp.concatenate(
+                [jnp.ones(1, dtype=bool), sk[1:] != sk[:-1]])
+        if fn == "rank":
+            out_sorted = jax.lax.cummax(
+                jnp.where(change, iota, 0)) - seg_start + 1
+        else:                                   # dense_rank
+            c = jnp.cumsum(change.astype(jnp.int64))
+            c0 = jax.lax.cummax(jnp.where(boundary, c, 0))
+            out_sorted = c - c0 + 1
+    return jnp.zeros(n, out_sorted.dtype).at[perm].set(out_sorted)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _offset_kernel(pid, keys, vals, vm, k: int):
+    """lag (k>0) / lead (k<0): (value, in_partition, value_valid)."""
+    n = pid.shape[0]
+    perm = jnp.lexsort(tuple(keys[::-1]) + (pid,))
+    sp = pid[perm]
+    sv, svm = vals[perm], vm[perm]
+    shifted = _shift(sv, k, jnp.zeros((), sv.dtype))
+    pin = _shift(sp, k, jnp.full((), -1, sp.dtype)) == sp
+    sok = _shift(svm, k, jnp.zeros((), bool))
+    scatter = lambda a: jnp.zeros(n, a.dtype).at[perm].set(a)  # noqa: E731
+    return scatter(shifted), scatter(pin), scatter(sok)
+
+
+@functools.partial(jax.jit, static_argnames=("fn", "frame"))
+def _agg_kernel(pid, keys, vals, vm, fn: str, frame):
+    """Framed aggregate: returns (acc, cnt) — the op-accumulated value
+    over the frame's valid rows and the count of valid rows, both in
+    original row order."""
+    n = pid.shape[0]
+    perm = jnp.lexsort(tuple(keys[::-1]) + (pid,))
+    sp = pid[perm]
+    sv, svm = vals[perm], vm[perm]
+    iota, boundary, seg_start, seg_end = _segments(sp, n)
+    is_f = jnp.issubdtype(sv.dtype, jnp.floating)
+    if fn in ("sum", "avg", "count"):
+        op, identity = jnp.add, jnp.zeros((), sv.dtype)
+    elif fn == "min":
+        op = jnp.minimum
+        identity = jnp.array(jnp.inf, sv.dtype) if is_f else _I64MAX
+    else:
+        op = jnp.maximum
+        identity = jnp.array(-jnp.inf, sv.dtype) if is_f else -_I64MAX
+    mv = jnp.where(svm, sv, identity)
+    cm = svm.astype(jnp.int64)
+    p, f = frame
+    if p is None:
+        fwd_v = _segscan(op, mv, boundary)
+        fwd_c = _segscan(jnp.add, cm, boundary)
+        hi = seg_end if f is None else jnp.minimum(iota + f, seg_end)
+        acc, cnt = fwd_v[hi], fwd_c[hi]
+    elif f is None:
+        b_end = jnp.concatenate(
+            [sp[:-1] != sp[1:], jnp.ones(1, dtype=bool)])
+        rev_v = _segscan(op, mv, b_end, reverse=True)
+        rev_c = _segscan(jnp.add, cm, b_end, reverse=True)
+        lo = jnp.maximum(iota - p, seg_start)
+        acc, cnt = rev_v[lo], rev_c[lo]
+    elif op is jnp.add:
+        fwd_v = _segscan(jnp.add, mv, boundary)
+        fwd_c = _segscan(jnp.add, cm, boundary)
+        hi = jnp.minimum(iota + f, seg_end)
+        lo = jnp.maximum(iota - p, seg_start)
+        base = jnp.maximum(lo - 1, 0)
+        acc = fwd_v[hi] - jnp.where(lo > seg_start, fwd_v[base], 0)
+        cnt = fwd_c[hi] - jnp.where(lo > seg_start, fwd_c[base], 0)
+    else:
+        # bounded min/max: the scan does not invert, so stack shifted
+        # lanes across the frame (trace-time unroll, capped by
+        # sdot.window.max.frame before the kernel is built)
+        acc = jnp.full(n, identity, mv.dtype)
+        cnt = jnp.zeros(n, jnp.int64)
+        for k in range(-f, p + 1):
+            skv = _shift(mv, k, identity)
+            skc = _shift(cm, k, jnp.zeros((), jnp.int64))
+            ok = _shift(sp, k, jnp.full((), -1, sp.dtype)) == sp
+            acc = op(acc, jnp.where(ok, skv, identity))
+            cnt = cnt + jnp.where(ok, skc, 0)
+    scatter = lambda a: jnp.zeros(n, a.dtype).at[perm].set(a)  # noqa: E731
+    return scatter(acc), scatter(cnt)
+
+
+# -- per-call evaluation ------------------------------------------------------
+
+def _compute(ctx, w: WindowCol, df: pd.DataFrame) -> np.ndarray:
+    n = len(df)
+    if n == 0:
+        if w.fn in RANKING_FNS or w.fn == "count":
+            return np.zeros(0, dtype=np.int64)
+        return np.zeros(0, dtype=np.float64)
+    pid = jnp.asarray(_partition_ids(df, w.part_cols))
+    keys = tuple(jnp.asarray(_order_key(df[c], asc))
+                 for c, asc in w.order_cols)
+
+    if w.fn in RANKING_FNS:
+        out = _rank_kernel(pid, keys, fn=w.fn, n_keys=len(keys))
+        return np.asarray(out).astype(np.int64)
+
+    if w.fn in OFFSET_FNS:
+        vals, vm, dec = _prep_arg(df[w.arg_cols[0]])
+        k = w.offset if w.fn == "lag" else -w.offset
+        v, pin, ok = _offset_kernel(pid, keys, jnp.asarray(vals),
+                                    jnp.asarray(vm), k=k)
+        v, pin, ok = np.asarray(v), np.asarray(pin), np.asarray(ok)
+        out = dec(v, pin & ok)
+        if w.default is not None:
+            edge = ~pin
+            if out.dtype == object:
+                out[edge] = w.default
+            elif np.issubdtype(out.dtype, np.datetime64):
+                out[edge] = np.datetime64(w.default)
+            else:
+                out = out.astype(np.float64) \
+                    if isinstance(w.default, float) \
+                    and out.dtype.kind != "f" else out
+                out[edge] = w.default
+        return out
+
+    # framed aggregates
+    frame = w.frame
+    if frame is None:
+        frame = (None, 0) if w.order_cols else (None, None)
+    p, f = frame
+    if p is not None and f is not None:
+        from spark_druid_olap_tpu.utils.config import WINDOW_MAX_FRAME
+        cap = int(ctx.config.get(WINDOW_MAX_FRAME))
+        if p + f + 1 > cap:
+            raise WindowUnsupported(
+                f"ROWS frame spans {p + f + 1} rows; cap is "
+                f"sdot.window.max.frame={cap}")
+    if w.fn == "count" and not w.arg_cols:
+        vals = np.ones(n, dtype=np.int64)
+        vm = np.ones(n, dtype=bool)
+        dec = None
+    else:
+        vals, vm, dec = _prep_arg(df[w.arg_cols[0]])
+        if w.fn in ("sum", "avg") and df[w.arg_cols[0]].dtype == object:
+            raise WindowUnsupported(
+                f"window {w.fn}() over a non-numeric column")
+    acc, cnt = _agg_kernel(pid, keys, jnp.asarray(vals), jnp.asarray(vm),
+                           fn=w.fn, frame=frame)
+    acc, cnt = np.asarray(acc), np.asarray(cnt)
+    if w.fn == "count":
+        return cnt.astype(np.int64)
+    ok = cnt > 0
+    if w.fn == "avg":
+        return np.where(ok, acc.astype(np.float64)
+                        / np.maximum(cnt, 1), np.nan)
+    if w.fn == "sum":
+        if acc.dtype.kind == "f" or not ok.all():
+            return np.where(ok, acc.astype(np.float64), np.nan)
+        return acc.astype(np.int64)
+    # min / max map back through the argument decoder (datetime ticks,
+    # object codes) so string and timestamp extremes round-trip exactly
+    return dec(acc, ok)
+
+
+# -- plan application ---------------------------------------------------------
+
+def apply(ctx, plan: WindowPlan, df: pd.DataFrame) -> pd.DataFrame:
+    """Compute the window columns over the base result frame and
+    assemble the statement's output (deferred ORDER BY / LIMIT / OFFSET
+    included)."""
+    from spark_druid_olap_tpu.utils import host_eval
+    env: Dict[str, np.ndarray] = {c: df[c].to_numpy() for c in df.columns}
+    for w in plan.windows:
+        env[w.slot] = _compute(ctx, w, df)
+
+    from spark_druid_olap_tpu.ir import expr as E
+    out = pd.DataFrame(index=df.index)
+    helper = set(plan.aux_cols)
+    base_cols = [c for c in df.columns if c not in helper]
+    for it in plan.items:
+        if it.expr == "*":
+            for c in base_cols:
+                out[c] = df[c]
+            continue
+        if isinstance(it.expr, E.Column) and it.expr.name in env:
+            v = env[it.expr.name]
+        else:
+            v = np.asarray(host_eval.eval_expr(it.expr, env))
+        out[it.name] = np.broadcast_to(v, (len(df),)) if v.ndim == 0 else v
+        env[it.name] = out[it.name].to_numpy()
+
+    if plan.order_by:
+        out_cols = list(out.columns)
+        skeys = []
+        for i, (e, asc) in enumerate(plan.order_by):
+            if isinstance(e, E.Literal) and isinstance(e.value, int):
+                e = E.Column(out_cols[e.value - 1])      # ordinal
+            v = np.asarray(host_eval.eval_expr(e, env))
+            sk = f"__wsort{i}"
+            out[sk] = np.broadcast_to(v, (len(out),)) if v.ndim == 0 else v
+            skeys.append((sk, asc))
+        out = out.sort_values([c for c, _ in skeys],
+                              ascending=[a for _, a in skeys],
+                              kind="mergesort")
+        out = out.drop(columns=[c for c, _ in skeys])
+    if plan.offset:
+        out = out.iloc[plan.offset:]
+    if plan.limit is not None:
+        out = out.head(plan.limit)
+    return out.reset_index(drop=True)

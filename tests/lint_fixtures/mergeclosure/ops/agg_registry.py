@@ -1,10 +1,18 @@
 """Fixture registry: 'median' routes as a non-psum kind merge_partials
 has no branch for (unmergeable-agg); the fixture executor also registers
-'mode' which is absent here (unregistered-agg)."""
+'mode' which is absent here (unregistered-agg); 'window_p95' is a
+sketch-valued window aggregate that declares NO register merge algebra
+(undeclared-sketch-merge — unmergeable by contract); 'quantile' declares
+'minsum' but the fixture groupby's runtime table dispatches 'max'
+(sketch-merge-drift)."""
 
 AGG_CLOSURE = {
     "longsum": {"route": "sum", "dtype": "int64", "reagg": "longsum",
                 "sketch": None},
     "median": {"route": "median", "dtype": "float64", "reagg": None,
                "sketch": None},
+    "window_p95": {"route": "wsk", "dtype": "float64", "reagg": None,
+                   "sketch": "wsk"},
+    "quantile": {"route": "kll", "dtype": "float64", "reagg": None,
+                 "sketch": "kll", "merge": "minsum"},
 }

@@ -1,6 +1,9 @@
 """Fixture merge: only min/max get literal branches; everything else
 rides the psum default — so the registry's 'median' route is
-unmergeable."""
+unmergeable. The runtime sketch table dispatches 'kll' registers with
+'max', drifting from the registry's declared 'minsum'."""
+
+SKETCH_MERGE_OPS = {"kll": "max"}
 
 
 def merge_partials(route, partials):

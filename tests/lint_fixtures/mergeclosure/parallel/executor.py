@@ -7,4 +7,6 @@ _AGG_KIND = {
     "longsum": ("sum", np.int64),
     "median": ("median", np.float64),
     "mode": ("mode", np.int64),
+    "window_p95": ("wsk", np.float64),
+    "quantile": ("kll", np.float64),
 }

@@ -118,6 +118,12 @@ def test_mergeclosure_pass_fires_on_unmergeable_agg_fixture():
     by_rule = {f.rule: f for f in found}
     assert by_rule["unmergeable-agg"].symbol == "median"
     assert by_rule["unregistered-agg"].symbol == "mode"
+    # sketch-valued window agg with no declared register algebra:
+    # unmergeable by contract, the cluster/mesh tiers have nothing to
+    # verify their folds against
+    assert by_rule["undeclared-sketch-merge"].symbol == "window_p95"
+    # declared algebra drifts from the runtime dispatch table
+    assert by_rule["sketch-merge-drift"].symbol == "quantile"
     assert "stale-registry" not in by_rule, found
 
 
@@ -396,13 +402,18 @@ def test_kernel_and_mesh_invariants_stay_clean():
 def test_registry_declares_sketch_merge_algebra():
     """The merge field is what the sketch-merge-mismatch rule checks
     ops/<sketch>.py:merge_registers against — it must stay declared and
-    correct (HLL rho registers are maxima, theta k-min hashes minima)."""
+    correct (HLL rho registers are maxima, theta k-min hashes minima,
+    KLL survivor lanes lex-minima plus exact count sums) and must agree
+    with the runtime dispatch table the device fold actually uses."""
     from spark_druid_olap_tpu.ops.agg_registry import AGG_CLOSURE
+    from spark_druid_olap_tpu.ops.groupby import SKETCH_MERGE_OPS
     for kind, ent in AGG_CLOSURE.items():
         if ent.get("sketch"):
-            assert ent.get("merge") in ("max", "min"), kind
+            assert ent.get("merge") in ("max", "min", "minsum"), kind
+            assert SKETCH_MERGE_OPS[ent["sketch"]] == ent["merge"], kind
     assert AGG_CLOSURE["cardinality"]["merge"] == "max"
     assert AGG_CLOSURE["thetasketch"]["merge"] == "min"
+    assert AGG_CLOSURE["quantile"]["merge"] == "minsum"
 
 
 def test_fingerprint_excludes_operational_keys():

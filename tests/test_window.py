@@ -1,0 +1,305 @@
+"""Window-function post-pass: pandas differentials, error shapes, stats
+contract, and distributed (2-node scatter) parity.
+
+Every test is a differential against an exact pandas computation of the
+same window — the post-pass lowers to segment-sorted jit kernels, but
+its CONTRACT is exact SQL window semantics, not sketch semantics. The
+``id`` column is a unique ORDER BY key on purpose: moving-frame answers
+are order-dependent, so tied order keys would make references ambiguous.
+
+The cluster section replays window + percentile statements through an
+in-process broker over two historicals: the BASE statement scatters and
+merges first, the post-pass runs over the merged frame, so broker
+answers must be byte-identical to the single-process engine (``.equals``,
+no tolerance). Select/Search specs ride the same scatter tier and get
+parity checks here too.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sdot
+from spark_druid_olap_tpu.cluster.historical import HistoricalNode
+from spark_druid_olap_tpu.ir import spec as S
+from spark_druid_olap_tpu.window.plan import WindowUnsupported
+
+from conftest import assert_frames_equal
+from test_cluster import _free_port
+
+
+def _wsales_df(n=12_000):
+    rng = np.random.default_rng(31)
+    ts = (np.datetime64("2015-01-01")
+          + rng.integers(0, 365 * 24 * 3600, n).astype("timedelta64[s]"))
+    return pd.DataFrame({
+        "ts": ts.astype("datetime64[ns]"),
+        "id": np.arange(n, dtype=np.int64),
+        "region": rng.choice(["east", "west", "north", "south"], n),
+        "product": rng.choice([f"p{i:03d}" for i in range(20)], n),
+        "flag": rng.choice(["A", "N", "R"], n),
+        "qty": rng.integers(1, 52, n).astype(np.int64),
+        "price": np.round(rng.uniform(1.0, 100.0, n), 2),
+        # nullable metric: ~15% NULL, for the null-skipping contract
+        "mprice": np.where(rng.random(n) < 0.15, np.nan,
+                           np.round(rng.uniform(1.0, 100.0, n), 2)),
+    })
+
+
+WDF = _wsales_df()
+
+
+@pytest.fixture(scope="module")
+def wctx():
+    ctx = sdot.Context()
+    ctx.ingest_dataframe("wsales", WDF, time_column="ts",
+                         target_rows=4096)
+    yield ctx
+    ctx.close()
+
+
+# -- single-process pandas differentials --------------------------------------
+
+def test_rank_dense_rank_over_groupby(wctx):
+    got = wctx.sql(
+        "select region, product, sum(qty) as units, "
+        "rank() over (partition by region order by sum(qty) desc) as r, "
+        "dense_rank() over (partition by region order by sum(qty) desc) "
+        "as dr from wsales group by region, product").to_pandas()
+    want = (WDF.groupby(["region", "product"], as_index=False)
+            .agg(units=("qty", "sum")))
+    want["r"] = (want.groupby("region")["units"]
+                 .rank(method="min", ascending=False).astype(np.int64))
+    want["dr"] = (want.groupby("region")["units"]
+                  .rank(method="dense", ascending=False).astype(np.int64))
+    assert_frames_equal(got, want, sort_by=["region", "product"])
+
+
+def test_moving_sum_frame_over_scan(wctx):
+    got = wctx.sql(
+        "select id, region, qty, sum(qty) over (partition by region "
+        "order by id rows between 3 preceding and current row) as mv "
+        "from wsales where qty > 25").to_pandas()
+    flt = WDF[WDF["qty"] > 25].sort_values(["region", "id"],
+                                           kind="mergesort")
+    want = flt[["id", "region", "qty"]].copy()
+    want["mv"] = (flt.groupby("region")["qty"]
+                  .rolling(4, min_periods=1).sum()
+                  .reset_index(level=0, drop=True)).astype(np.int64)
+    assert_frames_equal(got, want, sort_by=["id"])
+
+
+def test_lag_lead_with_default(wctx):
+    got = wctx.sql(
+        "select id, region, price, "
+        "lag(price, 1) over (partition by region order by id) as prev, "
+        "lead(price, 2, -1.0) over (partition by region order by id) "
+        "as nxt from wsales where id < 3000").to_pandas()
+    head = WDF[WDF["id"] < 3000].sort_values(["region", "id"],
+                                             kind="mergesort")
+    want = head[["id", "region", "price"]].copy()
+    want["prev"] = head.groupby("region")["price"].shift(1)
+    want["nxt"] = head.groupby("region")["price"].shift(-2).fillna(-1.0)
+    assert_frames_equal(got, want, sort_by=["id"])
+
+
+def test_cumulative_avg_and_row_number(wctx):
+    got = wctx.sql(
+        "select id, region, "
+        "avg(price) over (partition by region order by id) as cavg, "
+        "row_number() over (partition by region order by id) as rn "
+        "from wsales where id < 3000").to_pandas()
+    head = WDF[WDF["id"] < 3000].sort_values(["region", "id"],
+                                             kind="mergesort")
+    want = head[["id", "region"]].copy()
+    want["cavg"] = (head.groupby("region")["price"]
+                    .expanding().mean().reset_index(level=0, drop=True))
+    want["rn"] = (head.groupby("region").cumcount() + 1).astype(np.int64)
+    assert_frames_equal(got, want, sort_by=["id"])
+
+
+def test_bounded_min_max_and_partition_count(wctx):
+    got = wctx.sql(
+        "select id, region, "
+        "min(price) over (partition by region order by id "
+        "rows between 2 preceding and current row) as mn, "
+        "max(price) over (partition by region order by id "
+        "rows between 2 preceding and current row) as mx, "
+        "count(*) over (partition by region) as n "
+        "from wsales where id < 3000").to_pandas()
+    head = WDF[WDF["id"] < 3000].sort_values(["region", "id"],
+                                             kind="mergesort")
+    want = head[["id", "region"]].copy()
+    grp = head.groupby("region")["price"]
+    want["mn"] = (grp.rolling(3, min_periods=1).min()
+                  .reset_index(level=0, drop=True))
+    want["mx"] = (grp.rolling(3, min_periods=1).max()
+                  .reset_index(level=0, drop=True))
+    want["n"] = head.groupby("region")["id"].transform("size") \
+        .astype(np.int64)
+    assert_frames_equal(got, want, sort_by=["id"])
+
+
+def test_null_arguments_skip_in_frames(wctx):
+    """Aggregate window args skip NULLs; an all-null frame is NULL
+    (NaN). lag returns the STORED value — NULL included — inside the
+    partition, so its NaN pattern shifts with the rows."""
+    got = wctx.sql(
+        "select id, region, "
+        "avg(mprice) over (partition by region order by id "
+        "rows between 2 preceding and current row) as av, "
+        "lag(mprice, 1) over (partition by region order by id) as prev "
+        "from wsales where id < 3000").to_pandas()
+    head = WDF[WDF["id"] < 3000].sort_values(["region", "id"],
+                                             kind="mergesort")
+    want = head[["id", "region"]].copy()
+    want["av"] = (head["mprice"].groupby(head["region"])
+                  .rolling(3, min_periods=1).mean()
+                  .reset_index(level=0, drop=True))
+    want["prev"] = head.groupby("region")["mprice"].shift(1)
+    assert_frames_equal(got, want, sort_by=["id"])
+
+
+def test_deferred_order_by_and_limit(wctx):
+    """The outer ORDER BY / LIMIT apply AFTER the window columns: the
+    rank is computed over the FULL result set, then the top rows of the
+    epilogue ordering are returned, in order."""
+    got = wctx.sql(
+        "select region, product, sum(qty) as units, "
+        "rank() over (partition by region order by sum(qty) desc) as r "
+        "from wsales group by region, product "
+        "order by r, region, product limit 10").to_pandas()
+    want = (WDF.groupby(["region", "product"], as_index=False)
+            .agg(units=("qty", "sum")))
+    want["r"] = (want.groupby("region")["units"]
+                 .rank(method="min", ascending=False).astype(np.int64))
+    want = (want.sort_values(["r", "region", "product"], kind="mergesort")
+            .head(10).reset_index(drop=True))
+    assert len(got) == 10
+    assert_frames_equal(got, want, sort_by=[])   # order matters
+
+
+def test_window_stats_contract(wctx):
+    wctx.sql("select region, row_number() over (order by sum(qty)) as rn "
+             "from wsales group by region")
+    st = wctx.history.entries()[-1].stats
+    assert st["mode"] == "engine+window"
+    w = st["window"]
+    assert w["n_windows"] == 1 and w["fns"] == ["row_number"]
+    assert w["window_ms"] >= 0
+
+
+def test_unsupported_shapes_raise(wctx):
+    with pytest.raises(WindowUnsupported, match="DISTINCT"):
+        wctx.sql("select distinct region, rank() over "
+                 "(order by sum(qty)) from wsales group by region")
+    with pytest.raises(WindowUnsupported, match="WHERE"):
+        wctx.sql("select id from wsales "
+                 "where row_number() over (order by id) > 5")
+    wctx.config.set("sdot.window.enabled", False)
+    try:
+        with pytest.raises(WindowUnsupported, match="disabled"):
+            wctx.sql("select id, row_number() over (order by id) as rn "
+                     "from wsales where id < 10")
+    finally:
+        wctx.config.set("sdot.window.enabled", True)
+
+
+# -- distributed: 2-node scatter parity ---------------------------------------
+
+class WEnv:
+    def __init__(self, hist, broker, single):
+        self.hist = hist
+        self.broker = broker
+        self.single = single
+
+
+@pytest.fixture(scope="module")
+def wenv(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("window-deep-storage"))
+    seed = sdot.Context({"sdot.persist.path": root})
+    seed.ingest_dataframe("wsales", WDF, time_column="ts",
+                          target_rows=2048)   # small segments: real shards
+    seed.checkpoint()
+    seed.close()
+    ports = [_free_port(), _free_port()]
+    nodes = ",".join(f"127.0.0.1:{p}" for p in ports)
+    common = {"sdot.persist.path": root, "sdot.cluster.nodes": nodes}
+    hist = [HistoricalNode(dict(common), node_id=i).start()
+            for i in range(2)]
+    broker = sdot.Context({**common, "sdot.cluster.role": "broker"})
+    single = sdot.Context({"sdot.persist.path": root})
+    e = WEnv(hist, broker, single)
+    yield e
+    for h in hist:
+        h.stop()
+    broker.close()
+    single.close()
+
+
+def _diff(wenv, sql):
+    """Broker answer must be BYTE-IDENTICAL to the single engine, and
+    the base statement must actually have scattered."""
+    got = wenv.broker.sql(sql).to_pandas()
+    st = wenv.broker.engine.last_stats.get("cluster") or {}
+    assert st.get("mode") == "scatter", st
+    want = wenv.single.sql(sql).to_pandas()
+    assert got.equals(want), f"broker != single for: {sql}"
+    return got
+
+
+def test_cluster_window_over_groupby(wenv):
+    got = _diff(wenv,
+                "select region, product, sum(qty) as units, "
+                "rank() over (partition by region order by sum(qty) desc)"
+                " as r from wsales group by region, product "
+                "order by region, product")
+    assert len(got) == len(WDF.groupby(["region", "product"]))
+
+
+def test_cluster_window_over_scan(wenv):
+    _diff(wenv,
+          "select id, region, qty, sum(qty) over (partition by region "
+          "order by id rows between 3 preceding and current row) as mv "
+          "from wsales where qty > 45 order by id")
+
+
+def test_cluster_percentile_byte_identical(wenv):
+    for q in (0.5, 0.95):
+        got = _diff(wenv,
+                    f"select region, percentile_approx(price, {q}) as p "
+                    f"from wsales group by region order by region")
+        assert len(got) == 4 and got["p"].notna().all()
+
+
+def test_cluster_window_plus_percentile_compose(wenv):
+    _diff(wenv,
+          "select region, percentile_approx(price, 0.9) as p90, "
+          "rank() over (order by percentile_approx(price, 0.9) desc) "
+          "as r from wsales group by region order by region")
+
+
+def test_select_spec_scatter_parity(wenv):
+    q = S.SelectQuerySpec(
+        datasource="wsales",
+        columns=("id", "region", "price"),
+        filter=S.BoundFilter("id", upper=200, numeric=True),
+        page_size=500)
+    got = wenv.broker.execute(q).to_pandas()
+    assert (wenv.broker.engine.last_stats.get("cluster") or {}) \
+        .get("mode") == "scatter"
+    want = wenv.single.execute(q).to_pandas()
+    assert got.equals(want)
+
+
+def test_search_spec_scatter_parity(wenv):
+    q = S.SearchQuerySpec(
+        datasource="wsales",
+        dimensions=("region", "product"),
+        query="p00")
+    got = wenv.broker.execute(q).to_pandas()
+    assert (wenv.broker.engine.last_stats.get("cluster") or {}) \
+        .get("mode") == "scatter"
+    want = wenv.single.execute(q).to_pandas()
+    assert got.equals(want)
+    assert len(got) > 0
