@@ -1266,6 +1266,8 @@ def main():
     zero_dispatch = []
     zero_dispatch_served = []
     fusion_fallback = []
+    qphases = {}            # per-query stats["phases"] from the measured rep
+    host_overhead = {}      # engine queries: wall minus device dispatch ms
 
     def _fusion_stats():
         # engine fusion-planner counters (0s until any engine query runs);
@@ -1433,6 +1435,18 @@ def main():
             dd += f", lm={cm}"      # late-materialization budget engaged
         if meas_stats.get("compact_overflow"):
             dd += ", lm-overflow"
+        # host critical-path accounting from the always-on phase profiler:
+        # host overhead is the measured wall minus the device-dispatch
+        # phase — everything the host does around the actual execution
+        # (parse/plan/admit/cache/bind/demux). Tracked per engine query so
+        # the round-over-round guard below can flag host-side regressions
+        # that adjusted geomean (dominated by dispatch) would hide.
+        ph = meas_stats.get("phases")
+        if isinstance(ph, dict) and ph:
+            qphases[name] = {k: float(v) for k, v in ph.items()}
+            if mode == "engine":
+                host_overhead[name] = round(
+                    max(wall - float(ph.get("dispatch", 0.0)), 0.0), 3)
         log(f"{name}: {wall:.1f}ms wall ({adj:.1f}ms floor-adjusted, cold "
             f"{cold:.2f}s, mode={mode}, {len(r)} rows{gb}{dd})")
 
@@ -1496,6 +1510,49 @@ def main():
         out["zero_dispatch_engine"] = zero_dispatch
     if zero_dispatch_served:
         out["zero_dispatch_served"] = zero_dispatch_served
+    if qphases:
+        # suite-level host critical path: per-phase geomean (ms) over the
+        # queries that reported the phase. Inclusive timers — parents
+        # contain children — so rows are read individually, not summed.
+        pnames = sorted({p for d in qphases.values() for p in d})
+        out["phases"] = {
+            p: round(geomean({q: d[p] for q, d in qphases.items()
+                              if p in d}), 3)
+            for p in pnames}
+        log("host phases (geomean ms over reporting queries): "
+            + ", ".join(f"{p}={v}" for p, v in out["phases"].items()))
+    if host_overhead:
+        out["host_overhead_ms"] = host_overhead
+        # regression guard vs the previous BENCH round file (repo root):
+        # flag engine queries whose host overhead grew >25% (and by at
+        # least 1ms — sub-ms jitter is timer noise, not a regression).
+        # Older rounds predate this counter; the guard stays inert until
+        # a round with host_overhead_ms exists to compare against.
+        prev = {}
+        try:
+            import glob as _glob
+            rounds = sorted(_glob.glob(
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_r*.json")))
+            if rounds:
+                with open(rounds[-1], "r", encoding="utf-8") as f:
+                    doc = json.load(f)
+                doc = doc.get("parsed") or doc
+                prev = dict(doc.get("host_overhead_ms") or {})
+        except Exception:   # noqa: BLE001 — the guard is advisory
+            prev = {}
+        regressed = []
+        for qn, cur in host_overhead.items():
+            old = prev.get(qn)
+            if old is None or float(old) <= 0:
+                continue
+            if cur > float(old) * 1.25 and cur - float(old) >= 1.0:
+                regressed.append({"query": qn, "prev_ms": round(float(old), 3),
+                                  "now_ms": cur})
+                log(f"{qn}: WARNING host overhead regressed "
+                    f"{float(old):.1f}ms -> {cur:.1f}ms (>25%)")
+        if regressed:
+            out["host_overhead_regressions"] = regressed
     fus_end = _fusion_stats()
     if fus_end:
         # deterministic CSE counters for the whole suite: how much

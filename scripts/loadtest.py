@@ -747,6 +747,32 @@ def run_wlm(args):
     sys.exit(0 if ok else 1)
 
 
+def _phase_deltas(ctx, mark):
+    """Mean per-phase host milliseconds over the history entries recorded
+    after ``mark`` (the last record before the leg started). History is a
+    bounded deque, so a long storm covers the most recent <= maxlen
+    queries of the leg — a representative per-query profile, not a total.
+    Phase timers are inclusive (parents contain children): read rows
+    individually, don't sum them."""
+    sums, counts = {}, {}
+    for rec in reversed(ctx.history.entries()):
+        if rec is mark:
+            break
+        ph = rec.stats.get("phases") if isinstance(rec.stats, dict) else None
+        if not isinstance(ph, dict):
+            continue
+        for k, v in ph.items():
+            sums[k] = sums.get(k, 0.0) + float(v)
+            counts[k] = counts.get(k, 0) + 1
+    return {k: round(sums[k] / counts[k], 3) for k in sorted(sums)}
+
+
+def _print_phase_deltas(tag, ph):
+    if ph:
+        print(f"  [{tag}] phases (mean ms/query): "
+              + " ".join(f"{k}={v}" for k, v in ph.items()))
+
+
 def run_sharedscan(args):
     """Shared-scan comparison: K client threads replay a fixed BI
     dashboard mix over one TPC-H star (in process, caches off so every
@@ -797,6 +823,7 @@ def run_sharedscan(args):
         ctx.config.set("sdot.sharedscan.fusion.enabled", fused)
         ctx.config.set("sdot.pallas.wave.enabled", wave)
         coal0 = dict(ctx.engine.sharedscan.stats())
+        ph_mark = (ctx.history.entries() or [None])[-1]
         lat, errors, dispatches = [], [0], [0]
         lock = threading.Lock()
         stop = time.monotonic() + args.duration
@@ -869,6 +896,8 @@ def run_sharedscan(args):
         legs[leg]["pallas"] = {
             k: int(p1.get(k, 0)) - int(p0.get(k, 0))
             for k in ("launches", "tiles", "fallbacks")}
+        legs[leg]["phases_ms"] = _phase_deltas(ctx, ph_mark)
+        _print_phase_deltas(leg, legs[leg]["phases_ms"])
         print(f"  [{leg}] qps={legs[leg]['qps']:7.1f} "
               f"p50={legs[leg]['p50_ms']:7.1f}ms "
               f"p99={legs[leg]['p99_ms']:7.1f}ms n={served:5d} "
@@ -1619,16 +1648,20 @@ def run_windows(args):
         print(f"[windows] {n_rows} rows, {len(WINDOW_QUERIES)} window + "
               f"{len(PCT_FRACTIONS)} percentile statements, "
               f"{args.threads} threads, rank bound {eps}")
+        ph_mark = (ctx.history.entries() or [None])[-1]
         replies, failures = _storm_windows(
             ctx, refs, exact, eps, args.threads, args.duration, "single")
         failures = engaged + failures
+        phases_ms = _phase_deltas(ctx, ph_mark)
     finally:
         ctx.close()
     print(f"  [single] replies={replies} failures={len(failures)}")
+    _print_phase_deltas("single", phases_ms)
     ok = replies > 0 and not failures
     out = {"mode": "windows", "rows": n_rows, "threads": args.threads,
            "rank_bound": eps,
            "single": {"replies": replies,
+                      "phases_ms": phases_ms,
                       "failures": sorted(set(failures))[:10]}}
     if args.cluster:
         cl = _run_windows_cluster(args, df, refs, exact, eps)
@@ -1671,14 +1704,18 @@ def _run_windows_cluster(args, df, refs, exact, eps):
                     for q in PCT_FRACTIONS}
         for sql in WINDOW_QUERIES:   # warm + scatter engagement audit
             broker.sql(sql)
+        ph_mark = (broker.history.entries() or [None])[-1]
         replies, failures = _storm_windows(
             broker, refs, exact, eps, args.threads, args.duration,
             "cluster", pct_refs=pct_refs, expect_scatter=True)
+        phases_ms = _phase_deltas(broker, ph_mark)
         print(f"  [cluster] nodes={args.cluster} replies={replies} "
               f"failures={len(failures)}")
+        _print_phase_deltas("cluster", phases_ms)
         ok = replies > 0 and not failures
         return {"ok": bool(ok), "nodes": args.cluster,
                 "replies": replies,
+                "phases_ms": phases_ms,
                 "failures": sorted(set(failures))[:10]}
     finally:
         for h in hist:

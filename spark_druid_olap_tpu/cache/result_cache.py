@@ -23,6 +23,7 @@ import numpy as np
 
 from spark_druid_olap_tpu.cache import keys as K
 from spark_druid_olap_tpu.result import QueryResult
+from spark_druid_olap_tpu.utils import phases as PH
 
 
 def nbytes_of(obj) -> int:
@@ -167,7 +168,13 @@ class SemanticResultCache:
         return K.canonical_key(q, ds_version, self.config.fingerprint())
 
     def lookup(self, q, ds_version: int):
-        """Return ``(QueryResult, 'hit'|'subsumed')`` or ``(None, 'miss')``."""
+        """Return ``(QueryResult, 'hit'|'subsumed')`` or ``(None, 'miss')``.
+        Probe time (subsumption derivation included) lands in the
+        per-query phase profile as ``cache.lookup``."""
+        with PH.phase("cache.lookup"):
+            return self._lookup(q, ds_version)
+
+    def _lookup(self, q, ds_version: int):
         entry = self.lru.get(self._key(q, ds_version), count=False)
         if entry is not None:
             with self._lock:

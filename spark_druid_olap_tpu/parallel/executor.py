@@ -65,6 +65,7 @@ from spark_druid_olap_tpu.segment.column import ColumnKind
 from spark_druid_olap_tpu.segment.store import (Datasource, Segment,
                                                 SegmentStore)
 from spark_druid_olap_tpu.utils import host_eval
+from spark_druid_olap_tpu.utils import phases as PH
 from spark_druid_olap_tpu.utils.config import (
     Config,
     TZ_ID,
@@ -1259,7 +1260,9 @@ class QueryEngine:
             if t0 is not None:
                 self._stage_check(q, t0)
             self._tick()
+            _td = _time.perf_counter()
             table = dict(progA(dev_arrays))
+            PH.add("dispatch", _time.perf_counter() - _td)
             cnt = int(np.asarray(table.pop("__stats__"))[0])
             n_out = min(n_keys,
                         1 << max(6, (max(cnt, 1) - 1).bit_length()))
@@ -1273,7 +1276,9 @@ class QueryEngine:
                 lambda: self._build_agg_gather_program(
                     agg_plans, routes, n_out, n_keys, sharded, full=full))
             self._tick()
+            _td = _time.perf_counter()
             out = unpackB(gfn(table))
+            PH.add("dispatch", _time.perf_counter() - _td)
             if t0 is not None:
                 self._stage_check(q, t0)
             finals = _finals_from_out(out, routes, n_out, sketch_plans)
@@ -1321,6 +1326,7 @@ class QueryEngine:
                     self._stamp("device_ms", _td)
                 out = unpack(bufs)
                 self._stamp("fetch_ms", _td)
+                PH.add("dispatch", _time.perf_counter() - _td)
                 if t0 is not None:
                     self._stage_check(q, t0)  # post-device boundary
                 over = out.pop("__over__", None)
@@ -1829,6 +1835,7 @@ class QueryEngine:
                         _tf = _time.perf_counter()
                         raw = unpackB(gfn(table))
                         self._stamp("fetch_ms", _tf)
+                        PH.add("dispatch", _time.perf_counter() - _tf)
                         partials.extend(
                             _hash_chip_partials(raw, routes, k_sel, n_dev))
                         continue
@@ -1844,6 +1851,7 @@ class QueryEngine:
                     _tf = _time.perf_counter()
                     raw = unpackB(gfn(table))
                     self._stamp("fetch_ms", _tf)
+                    PH.add("dispatch", _time.perf_counter() - _tf)
                     partials.extend(
                         _hash_chip_partials(raw, routes, kg, n_dev))
                 else:
@@ -1861,6 +1869,9 @@ class QueryEngine:
                     _tf = _time.perf_counter()
                     raw = unpack(buf)
                     self._stamp("fetch_ms", _tf)
+                    # overlapped prefetch/bind charged to their own
+                    # phases; the rest of this interval is device work
+                    PH.add("dispatch", _time.perf_counter() - _td)
                     cur = nxt
                     unresolved += int(raw.pop("__unres__").sum())
                     if unresolved:
@@ -2403,12 +2414,16 @@ class QueryEngine:
             if t0 is not None:
                 self._stage_check(q, t0)   # per-wave boundary
             self._tick()
+            _td = _time.perf_counter()
             bufs = prog_fn(cur)            # async dispatch
             # wave i+2's cold chunks load behind wave i's compute and
             # wave i+1's (synchronous) bind
             self._tier_prefetch(ds, names, wave_segs, i + 2)
             nxt = bind(wave_segs[i + 1]) if i + 1 < len(wave_segs) else None
             out = unpack(bufs)             # blocks on the device round-trip
+            # the overlapped prefetch/bind above charge to their own
+            # phases; what's left of this interval is device round-trip
+            PH.add("dispatch", _time.perf_counter() - _td)
             over = out.pop("__over__", None)
             if over is not None:
                 n_over = int(np.asarray(over).reshape(-1)[0])
@@ -2734,7 +2749,8 @@ class QueryEngine:
                         __import__("threading").Event()
             if owner:
                 try:
-                    prog = build()
+                    with PH.phase("compile"):
+                        prog = build()
                     with self._compile_lock:
                         self._programs[sig] = prog
                 finally:
@@ -3314,21 +3330,22 @@ class QueryEngine:
         the shards its devices own — the wave layout is host-blocked
         (multihost.layout_segments_waves), so a block's non-local segment
         ids never reach this process's builder."""
-        self._tick(1, len(names))
-        if multihost:
-            out = {}
-            for k in names:
-                dt = array_dtype(ds, k)
-                if dt == np.int64 and not G._x64():
-                    raise EngineFallback(
-                        f"wide integer column {k!r} on a 32-bit backend")
-                out[k] = MH.put_sharded_blocks(
-                    lambda ids, k=k: build_array_blocks(ds, k, ids),
-                    w, ds.padded_rows, dt, sharding)
-            return out
-        return {k: _device_put_retry(
-            _build_array_checked(ds, k, w, s_pad), sharding)
-            for k in names}
+        with PH.phase("bind"):
+            self._tick(1, len(names))
+            if multihost:
+                out = {}
+                for k in names:
+                    dt = array_dtype(ds, k)
+                    if dt == np.int64 and not G._x64():
+                        raise EngineFallback(
+                            f"wide integer column {k!r} on a 32-bit backend")
+                    out[k] = MH.put_sharded_blocks(
+                        lambda ids, k=k: build_array_blocks(ds, k, ids),
+                        w, ds.padded_rows, dt, sharding)
+                return out
+            return {k: _device_put_retry(
+                _build_array_checked(ds, k, w, s_pad), sharding)
+                for k in names}
 
     def _bind_arrays(self, ds, names, seg_idx, s_pad, sharded):
         """Fetch-or-build the device arrays a program binds. Cached per
@@ -3341,6 +3358,11 @@ class QueryEngine:
         devices own — ``jax.make_array_from_callback`` invokes the block
         builder per locally-addressable device, so no process ever
         materializes (or ships) another host's rows."""
+        with PH.phase("bind"):
+            return self._bind_arrays_inner(ds, names, seg_idx, s_pad,
+                                           sharded)
+
+    def _bind_arrays_inner(self, ds, names, seg_idx, s_pad, sharded):
         sharding = NamedSharding(self.mesh, P(SEGMENT_AXIS, None)) \
             if sharded else None
         multihost = sharded and MH.is_multihost()

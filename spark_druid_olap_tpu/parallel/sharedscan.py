@@ -60,6 +60,7 @@ from spark_druid_olap_tpu.parallel import mesh as M
 from spark_druid_olap_tpu.parallel import meshexec as MX
 from spark_druid_olap_tpu.planner import fusion as FU
 from spark_druid_olap_tpu.result import QueryResult
+from spark_druid_olap_tpu.utils import phases as PH
 from spark_druid_olap_tpu.utils.config import (
     GROUPBY_DENSE_MAX_KEYS,
     GROUPBY_MATMUL_MAX_KEYS,
@@ -800,6 +801,7 @@ class SharedScanCoalescer:
                 for i in range(len(wave_segs)):
                     eng._stage_check(leader.q, leader.t0)
                     eng._tick()
+                    _td = _time.perf_counter()
                     bufs = prog_fn(cur)            # async dispatch
                     eng._tier_prefetch(ds, union_names, wave_segs, i + 2)
                     nxt = eng._bind_wave(ds, union_names, wave_segs[i + 1],
@@ -812,6 +814,9 @@ class SharedScanCoalescer:
                         finals[li] = f if finals[li] is None \
                             else X._merge_wave_finals(finals[li], f,
                                                       lp.routes, sketch[li])
+                    # leader-thread attribution: overlapped prefetch/bind
+                    # charge to their own phases inside this interval
+                    PH.add("dispatch", _time.perf_counter() - _td)
                     cur = nxt
             finally:
                 MX.LEDGER.release_partials(tok)
@@ -824,7 +829,15 @@ class SharedScanCoalescer:
     def _decode_lane(eng, ds, lp: _LanePlan, finals) -> QueryResult:
         """Host demultiplex of one lane: the solo dense decode (group
         selection, dictionary decode, identity row, epilogue) minus the
-        device-topk/having specializations the fused tier never plans."""
+        device-topk/having specializations the fused tier never plans.
+        Charged to the ``demux`` phase of whichever statement's thread
+        runs the decode."""
+        with PH.phase("demux"):
+            return SharedScanCoalescer._decode_lane_inner(
+                eng, ds, lp, finals)
+
+    @staticmethod
+    def _decode_lane_inner(eng, ds, lp: _LanePlan, finals) -> QueryResult:
         from spark_druid_olap_tpu.parallel import executor as X
         rows = finals["__rows__"]
         sel = np.nonzero(rows > 0)[0]

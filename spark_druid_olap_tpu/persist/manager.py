@@ -139,8 +139,9 @@ class PersistManager:
         # TieredDatasources whose columns fault from the snapshot blobs
         # through this byte-budgeted hot set (tier/store.py)
         from spark_druid_olap_tpu.utils.config import (
-            TIER_BUDGET_BYTES, TIER_ENABLED, TIER_PREFETCH_ENABLED,
-            TIER_PREFETCH_THREADS, TIER_VERIFY_CHECKSUMS)
+            TIER_BUDGET_BYTES, TIER_DECODED_CACHE_BYTES, TIER_ENABLED,
+            TIER_PREFETCH_ENABLED, TIER_PREFETCH_THREADS,
+            TIER_VERIFY_CHECKSUMS)
         self.tier = None
         if bool(cfg.get(TIER_ENABLED)):
             from spark_druid_olap_tpu.tier.store import TieredColumnStore
@@ -148,7 +149,8 @@ class PersistManager:
                 int(cfg.get(TIER_BUDGET_BYTES)),
                 verify=bool(cfg.get(TIER_VERIFY_CHECKSUMS)),
                 popularity=self._tier_popularity,
-                on_corrupt=self._on_tier_corrupt)
+                on_corrupt=self._on_tier_corrupt,
+                decoded_budget=int(cfg.get(TIER_DECODED_CACHE_BYTES)))
             # .fault on the tier store is the demand-fault METHOD, so
             # the injector rides a different name there
             self.tier.chaos = self.fault

@@ -36,6 +36,7 @@ from spark_druid_olap_tpu.planner.plans import (
 )
 from spark_druid_olap_tpu.segment.column import ColumnKind
 from spark_druid_olap_tpu.sql import ast as A
+from spark_druid_olap_tpu.utils import phases as PH
 from spark_druid_olap_tpu.utils.config import NON_AGG_PUSHDOWN
 
 _TIME_FIELD_FUNCS = {"year", "month", "quarter", "day", "week", "dow", "doy",
@@ -141,21 +142,23 @@ class Builder:
         # (shared dim tables can belong to several stars — e.g. supplier in
         # both the lineitem and partsupp stars; try each candidate and keep
         # the one whose fact anchors this join tree)
-        cands: List[StarSchema] = []
-        for t in tables:
-            for s in self.ctx.catalog.star_schemas_of(t):
-                if s not in cands:
-                    cands.append(s)
-        if not cands:
-            raise PlanUnsupported("join without a registered star schema")
-        where_conjs = _split_conjuncts(self.stmt.where)
-        errors: List[str] = []
-        for star in cands:
-            r = self._try_star(star, tables, join_conds, where_conjs, store)
-            if isinstance(r, tuple):
-                return r
-            errors.append(r)
-        raise PlanUnsupported("; ".join(dict.fromkeys(errors)))
+        with PH.phase("plan.star"):
+            cands: List[StarSchema] = []
+            for t in tables:
+                for s in self.ctx.catalog.star_schemas_of(t):
+                    if s not in cands:
+                        cands.append(s)
+            if not cands:
+                raise PlanUnsupported("join without a registered star schema")
+            where_conjs = _split_conjuncts(self.stmt.where)
+            errors: List[str] = []
+            for star in cands:
+                r = self._try_star(star, tables, join_conds, where_conjs,
+                                   store)
+                if isinstance(r, tuple):
+                    return r
+                errors.append(r)
+            raise PlanUnsupported("; ".join(dict.fromkeys(errors)))
 
     def _try_star(self, star: StarSchema, tables, join_conds, where_conjs,
                   store):
@@ -858,7 +861,8 @@ class Builder:
             # materialized-rollup rewrite, BEFORE spec transforms so a
             # rewritten GroupBy can still become timeseries/topN/search
             from spark_druid_olap_tpu.mv import match as MV
-            q2, mv_name = MV.try_rewrite(self.ctx, q)
+            with PH.phase("plan.rollup"):
+                q2, mv_name = MV.try_rewrite(self.ctx, q)
             if q2 is not None:
                 q = q2
                 rollup_used = mv_name

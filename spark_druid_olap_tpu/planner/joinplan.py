@@ -39,6 +39,7 @@ from spark_druid_olap_tpu.ir import expr as E
 from spark_druid_olap_tpu.ops.hash_join import JoinUnsupported
 from spark_druid_olap_tpu.segment.column import ColumnKind
 from spark_druid_olap_tpu.sql import ast as A
+from spark_druid_olap_tpu.utils import phases as PH
 from spark_druid_olap_tpu.utils.config import (
     JOIN_ENABLED,
     JOIN_MAX_MATCHES,
@@ -419,11 +420,19 @@ def _epilogue(plan: JoinPlan, data: Dict[str, np.ndarray]) -> pd.DataFrame:
     return df
 
 
-def try_execute(ctx, stmt: A.SelectStmt) -> Optional[pd.DataFrame]:
+_RECOGNIZE = object()   # default: recognize internally via try_plan
+
+
+def try_execute(ctx, stmt: A.SelectStmt,
+                plan=_RECOGNIZE) -> Optional[pd.DataFrame]:
     """Session hook: None = not recognized (host tier takes over);
     raises :class:`JoinUnsupported` when recognized but undeliverable
     (same outcome for the caller). On success the join stats land in
-    ``ctx.engine.last_stats['join']``."""
+    ``ctx.engine.last_stats['join']``. The session's planning-cascade
+    memo passes its cached :func:`try_plan` outcome (a JoinPlan, or
+    None for a memoized decline) via ``plan``; recognition is the only
+    memoizable part — the kill switch, cost arbitration and execution
+    below stay live on every call."""
     conf = ctx.config
     # a previous statement's join stats must never survive into this
     # one's snapshot (engine.execute clears last_stats per statement;
@@ -431,7 +440,8 @@ def try_execute(ctx, stmt: A.SelectStmt) -> Optional[pd.DataFrame]:
     ctx.engine.last_stats.pop("join", None)
     if not bool(conf.get(JOIN_ENABLED)):
         return None
-    plan = try_plan(ctx, stmt)
+    if plan is _RECOGNIZE:
+        plan = try_plan(ctx, stmt)
     if plan is None:
         return None
     # same per-statement contract as engine.execute (executor clears
@@ -490,6 +500,7 @@ def try_execute(ctx, stmt: A.SelectStmt) -> Optional[pd.DataFrame]:
         "shuffle_bytes": est.shuffle_bytes,
     }
     js.setdefault("shuffle_bytes", 0)
-    df = _epilogue(plan, data)
+    with PH.phase("epilogue"):
+        df = _epilogue(plan, data)
     ctx.engine.last_stats["join"] = js
     return df

@@ -8,6 +8,7 @@ runtime :class:`EngineFallback` routes to the pandas host executor.
 
 from __future__ import annotations
 
+import functools as _functools
 import time as _time
 from typing import List, Optional
 
@@ -23,6 +24,7 @@ from spark_druid_olap_tpu.planner.plans import PlannedQuery, PlanUnsupported
 from spark_druid_olap_tpu.result import QueryResult
 from spark_druid_olap_tpu.sql import ast as A
 from spark_druid_olap_tpu.sql.parser import parse_statement
+from spark_druid_olap_tpu.utils import phases as PH
 
 # per-thread count of subquery-channel cache hits (planner/decorrelate
 # _cached_inner): statements diff it to annotate ``served_from`` when a
@@ -109,6 +111,62 @@ class _NegativePlan:
         self.reason = reason
 
 
+_UNSET = object()   # "this memo slot was never computed" (None is a value)
+
+
+class _StmtMemo:
+    """Planning-cascade memo for one canonical statement: every
+    recognizer outcome along the select path, INCLUDING negative ones
+    (window extraction found nothing, join recognizer declined, builder
+    rejected). Keyed like the plan cache — (store version, config
+    fingerprint, repr(stmt)) — plus a lookup-table fingerprint, so any
+    ingest, config flip, CLEAR METADATA, rollup DDL (registry bumps the
+    store version) or lookup registration re-plans from scratch. A warm
+    repeated statement skips straight from key to cached plan."""
+
+    __slots__ = ("window", "resolved", "pq", "join", "composite")
+
+    def __init__(self):
+        self.window = _UNSET      # None | (base_stmt, WindowPlan)
+        self.resolved = _UNSET    # offset-stripped, fully resolved stmt
+        self.pq = _UNSET          # PlannedQuery | _NegativePlan
+        self.join = _UNSET        # JoinPlan | None (declined)
+        self.composite = _UNSET   # CompositePlan | None (rejected)
+
+
+def _lookups_fp(ctx) -> int:
+    """Registered-lookup fingerprint for the memo key: lookup tables
+    inline into the resolved statement WITHOUT bumping the store
+    version, so re-registering one must miss the memo. Tables are
+    dim-scale (the inlined-pairs literal already embeds them in plans),
+    so hashing them per statement is noise next to the cascade."""
+    lk = getattr(ctx, "lookups", None)
+    if not lk:
+        return 0
+    return hash(tuple((n, tuple(sorted(t.items())))
+                      for n, t in sorted(lk.items())))
+
+
+def _memo_put(cache, key, val, bound: int) -> None:
+    """LRU insert honoring sdot.plan.memo.entries (the shared
+    result_cache_put has its own fixed bound)."""
+    cache[key] = val
+    cache.move_to_end(key)
+    while len(cache) > max(1, bound):
+        cache.popitem(last=False)
+
+
+@_functools.lru_cache(maxsize=256)
+def _parse_cached(sql: str):
+    """Memoized parse (AST nodes are frozen dataclasses — safely
+    shared). Timed INSIDE the miss path so ``stats['phases']['parse']``
+    only appears when the parser actually ran."""
+    t0 = _time.perf_counter()
+    stmt = parse_statement(sql)
+    PH.stash("parse", _time.perf_counter() - t0)
+    return stmt
+
+
 def run_sql(ctx, sql: str, query_id: Optional[str] = None,
             lane: Optional[str] = None, tenant: Optional[str] = None,
             priority: Optional[int] = None) -> QueryResult:
@@ -144,7 +202,16 @@ def _run_sql_inner(ctx, sql: str) -> QueryResult:
         r = handler(ctx, sql)
         if r is not None:
             return r
-    stmt = parse_statement(sql)
+    # statement boundary: a previous statement's un-consumed parse time
+    # must not leak into this one's accumulator
+    PH.clear_stash()
+    from spark_druid_olap_tpu.utils.config import PLAN_MEMO_ENABLED
+    if ctx.config.get(PLAN_MEMO_ENABLED):
+        stmt = _parse_cached(sql)
+    else:
+        _tp = _time.perf_counter()
+        stmt = parse_statement(sql)
+        PH.stash("parse", _time.perf_counter() - _tp)
     if isinstance(stmt, A.ClearMetadata):
         from spark_druid_olap_tpu.mv.registry import clear_rollups
         if stmt.datasource:
@@ -329,9 +396,46 @@ def _transform_tracer(ctx):
 def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
     if isinstance(stmt, A.UnionAll):
         return _run_union(ctx, stmt, sql)
-    wp = _maybe_windows(ctx, stmt)
-    if wp is not None:
-        return _run_windowed(ctx, wp, sql)
+    from spark_druid_olap_tpu.utils.config import (PHASES_ENABLED,
+                                                   PLAN_MEMO_ENABLED,
+                                                   PLAN_MEMO_ENTRIES)
+    # nested entries (union branches, window base statements) get None
+    # back and merge their phases into the outer statement's accumulator
+    ph_tok = PH.begin(bool(ctx.config.get(PHASES_ENABLED)))
+    try:
+        memo = None
+        memo_hit = None
+        if ctx.config.get(PLAN_MEMO_ENABLED):
+            with PH.phase("plan.memo"):
+                _mcache, _mkey = host_exec.result_cache(ctx, "stmtmemo",
+                                                        stmt)
+                _mkey = _mkey + (_lookups_fp(ctx),)
+                memo = _mcache.get(_mkey)
+                memo_hit = memo is not None
+                if memo_hit:
+                    _mcache.move_to_end(_mkey)
+                else:
+                    memo = _StmtMemo()
+                    _memo_put(_mcache, _mkey, memo,
+                              int(ctx.config.get(PLAN_MEMO_ENTRIES)))
+        if memo is not None and memo.window is not _UNSET:
+            wp = memo.window
+        else:
+            with PH.phase("plan.window"):
+                wp = _maybe_windows(ctx, stmt)
+            if memo is not None:
+                # WindowUnsupported propagates UNCACHED (slot stays
+                # _UNSET): only deterministic outcomes memoize
+                memo.window = wp
+        if wp is not None:
+            return _run_windowed(ctx, wp, sql, ph_tok)
+        return _run_select_planned(ctx, stmt, sql, ph_tok, memo, memo_hit)
+    finally:
+        PH.end(ph_tok)   # idempotent: normally closed at stats assembly
+
+
+def _run_select_planned(ctx, stmt, sql: str, ph_tok, memo,
+                        memo_hit) -> QueryResult:
     t0 = _time.perf_counter()
     dc0 = list(ctx.engine.dispatch_counts)
     sq0 = getattr(_subq_tls, "hits", 0)
@@ -350,11 +454,17 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
         stmt = _dc.replace(stmt, offset=0,
                            limit=None if stmt.limit is None
                            else stmt.limit + offset)
-    from spark_druid_olap_tpu.planner.scoping import (resolve_alias_scopes,
-                                                      resolve_databases)
-    stmt = resolve_databases(ctx, stmt)
-    stmt = resolve_alias_scopes(ctx, stmt)
-    stmt = resolve_lookups(ctx, stmt)
+    if memo is not None and memo.resolved is not _UNSET:
+        stmt = memo.resolved
+    else:
+        with PH.phase("plan.resolve"):
+            from spark_druid_olap_tpu.planner.scoping import (
+                resolve_alias_scopes, resolve_databases)
+            stmt = resolve_databases(ctx, stmt)
+            stmt = resolve_alias_scopes(ctx, stmt)
+            stmt = resolve_lookups(ctx, stmt)
+        if memo is not None:
+            memo.resolved = stmt
     trace = _transform_tracer(ctx)
     rollup_status = None  # engine path only: 'rollup:<name>' | 'base'
     try:
@@ -369,38 +479,59 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
         # folded into the key by result_cache. Inlined subquery RESULTS
         # embedded in the plan stay valid under the same key.
         from spark_druid_olap_tpu.utils.config import PLAN_CACHE_ENABLED
+        plan_cached = False
         _pc_on = ctx.config.get(PLAN_CACHE_ENABLED)
-        _pcache, _pkey = host_exec.result_cache(ctx, "plan", stmt)
-        pq = _pcache.get(_pkey) if _pc_on else None
-        plan_cached = pq is not None
-        if plan_cached:
-            _pcache.move_to_end(_pkey)
+        if memo is not None and memo.pq is not _UNSET:
+            pq = memo.pq
+            # the memo subsumes the plan cache (same key discipline:
+            # store version + config fingerprint), so a memo-served
+            # plan reports as a statement-cache hit when the plan
+            # cache is on — stats["plan_cached"] keeps its contract
+            plan_cached = bool(_pc_on)
             if isinstance(pq, _NegativePlan):
-                # negative entry: the builder deterministically rejects
-                # this statement under the current store/config — skip
-                # straight to the composite/host tiers
                 raise PlanUnsupported(pq.reason)
         else:
-            _tr = _time.perf_counter()
-            stmt2 = trace("merge_derived", stmt, merge_derived(ctx, stmt))
-            stmt2 = trace("decorrelate_semijoins", stmt2,
-                          decorrelate_semijoins(ctx, stmt2))
-            stmt2 = trace("inline_correlated_scalars", stmt2,
-                          inline_correlated_scalars(ctx, stmt2))
-            stmt2 = trace("inline_subqueries", stmt2,
-                          inline_subqueries(ctx, stmt2))
-            _mark("stmt_rewrite_ms", _tr)
-            _tb = _time.perf_counter()
-            try:
-                pq = B.build(ctx, stmt2)
-            except PlanUnsupported as pe:
+            _pcache, _pkey = host_exec.result_cache(ctx, "plan", stmt)
+            pq = _pcache.get(_pkey) if _pc_on else None
+            plan_cached = pq is not None
+            if plan_cached:
+                _pcache.move_to_end(_pkey)
+                if memo is not None:
+                    memo.pq = pq
+                if isinstance(pq, _NegativePlan):
+                    # negative entry: the builder deterministically
+                    # rejects this statement under the current
+                    # store/config — skip straight to the
+                    # composite/host tiers
+                    raise PlanUnsupported(pq.reason)
+            else:
+                _tr = _time.perf_counter()
+                with PH.phase("plan.rewrite"):
+                    stmt2 = trace("merge_derived", stmt,
+                                  merge_derived(ctx, stmt))
+                    stmt2 = trace("decorrelate_semijoins", stmt2,
+                                  decorrelate_semijoins(ctx, stmt2))
+                    stmt2 = trace("inline_correlated_scalars", stmt2,
+                                  inline_correlated_scalars(ctx, stmt2))
+                    stmt2 = trace("inline_subqueries", stmt2,
+                                  inline_subqueries(ctx, stmt2))
+                _mark("stmt_rewrite_ms", _tr)
+                _tb = _time.perf_counter()
+                try:
+                    with PH.phase("plan.build"):
+                        pq = B.build(ctx, stmt2)
+                except PlanUnsupported as pe:
+                    neg = _NegativePlan(str(pe))
+                    if _pc_on:
+                        host_exec.result_cache_put(_pcache, _pkey, neg)
+                    if memo is not None:
+                        memo.pq = neg
+                    raise
+                _mark("stmt_build_ms", _tb)
                 if _pc_on:
-                    host_exec.result_cache_put(_pcache, _pkey,
-                                               _NegativePlan(str(pe)))
-                raise
-            _mark("stmt_build_ms", _tb)
-            if _pc_on:
-                host_exec.result_cache_put(_pcache, _pkey, pq)
+                    host_exec.result_cache_put(_pcache, _pkey, pq)
+                if memo is not None:
+                    memo.pq = pq
         _te = _time.perf_counter()
         df = execute_planned(ctx, pq)
         _mark("stmt_exec_ms", _te)
@@ -418,8 +549,22 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
             # tier's gather-and-host-join finish for the same shape.
             # Any decline falls through unchanged.
             from spark_druid_olap_tpu.planner import joinplan
+            from spark_druid_olap_tpu.utils.config import JOIN_ENABLED
             try:
-                df = joinplan.try_execute(ctx, stmt)
+                if memo is not None and memo.join is not _UNSET:
+                    jp = memo.join
+                else:
+                    # recognition only (pure) — cost arbitration and the
+                    # JOIN_ENABLED kill switch stay live in try_execute;
+                    # JOIN_ENABLED is semantic (in the fingerprint), so
+                    # a memoized decline can't outlive a flip
+                    with PH.phase("plan.join"):
+                        jp = (joinplan.try_plan(ctx, stmt)
+                              if bool(ctx.config.get(JOIN_ENABLED))
+                              else None)
+                    if memo is not None:
+                        memo.join = jp
+                df = joinplan.try_execute(ctx, stmt, plan=jp)
             except joinplan.JoinUnsupported:
                 df = None
             if df is not None:
@@ -438,15 +583,31 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
                 # config fingerprint in the key).
                 from spark_druid_olap_tpu.utils.config import (
                     PLAN_CACHE_ENABLED)
-                _cc_on = ctx.config.get(PLAN_CACHE_ENABLED)
-                _ccache, _ckey = host_exec.result_cache(ctx, "cplan", stmt)
-                cp = _ccache.get(_ckey) if _cc_on else None
-                if cp is not None:
-                    _ccache.move_to_end(_ckey)
+                if memo is not None and memo.composite is not _UNSET:
+                    cp = memo.composite
+                    if cp is None:   # memoized deterministic rejection
+                        raise PlanUnsupported("composite rejected (memo)")
                 else:
-                    cp = composite.build_composite(ctx, stmt)
-                    if _cc_on:
-                        host_exec.result_cache_put(_ccache, _ckey, cp)
+                    _cc_on = ctx.config.get(PLAN_CACHE_ENABLED)
+                    _ccache, _ckey = host_exec.result_cache(ctx, "cplan",
+                                                            stmt)
+                    cp = _ccache.get(_ckey) if _cc_on else None
+                    if cp is not None:
+                        _ccache.move_to_end(_ckey)
+                    else:
+                        try:
+                            with PH.phase("plan.composite"):
+                                cp = composite.build_composite(ctx, stmt)
+                        except PlanUnsupported:
+                            # deterministic rejection memoizes; runtime
+                            # EngineFallback/HostExecError do NOT
+                            if memo is not None:
+                                memo.composite = None
+                            raise
+                        if _cc_on:
+                            host_exec.result_cache_put(_ccache, _ckey, cp)
+                    if memo is not None:
+                        memo.composite = cp
                 df = composite.execute_composite(ctx, cp)
                 mode = "engine"
                 rollup_status = "base"
@@ -483,6 +644,11 @@ def _run_select_tz(ctx, stmt, sql: str) -> QueryResult:
         stats["served_from"] = "subquery_cache"
     if plan_cached:
         stats["plan_cached"] = True
+    if memo_hit is not None:
+        stats["plan_memo"] = {"hit": bool(memo_hit)}
+    phases = PH.end(ph_tok)
+    if phases is not None:
+        stats["phases"] = {k: round(v, 3) for k, v in phases.items()}
     stats.update(_marks)
     ctx.history.record(stmt, stats, sql=sql)
     res = QueryResult(list(df.columns),
@@ -506,19 +672,23 @@ def _maybe_windows(ctx, stmt):
     return WPLAN.extract(ctx, stmt)
 
 
-def _run_windowed(ctx, wp, sql: str) -> QueryResult:
+def _run_windowed(ctx, wp, sql: str, ph_tok=None) -> QueryResult:
     """Window post-pass: run the base statement through the normal
     tiers (engine pushdown / cluster scatter / composite / host), then
     compute the window columns on device over the merged result frame
     and apply the deferred ORDER BY / LIMIT / OFFSET
     (window/exec.py). Distribution composes for free: on a broker the
-    base statement scatters and merges before the post-pass sees it."""
+    base statement scatters and merges before the post-pass sees it.
+    The base statement re-enters ``_run_select_tz`` with the phase
+    accumulator already open, so its phases merge here and this
+    statement's ``stats['phases']`` covers the whole pipeline."""
     from spark_druid_olap_tpu.window import exec as WEXEC
     base_stmt, plan = wp
     t0 = _time.perf_counter()
     base = _run_select_tz(ctx, base_stmt, f"{sql} <window base>")
     _tw = _time.perf_counter()
-    df = WEXEC.apply(ctx, plan, base.to_pandas())
+    with PH.phase("epilogue"):
+        df = WEXEC.apply(ctx, plan, base.to_pandas())
     stats = dict(ctx.engine.last_stats)
     stats["mode"] = "engine+window"
     stats["window"] = {"n_windows": len(plan.windows),
@@ -526,6 +696,9 @@ def _run_windowed(ctx, wp, sql: str) -> QueryResult:
                        "window_ms": round(
                            (_time.perf_counter() - _tw) * 1000, 2)}
     stats["total_ms"] = (_time.perf_counter() - t0) * 1000
+    phases = PH.end(ph_tok)
+    if phases is not None:
+        stats["phases"] = {k: round(v, 3) for k, v in phases.items()}
     ctx.history.record(base_stmt, stats, sql=sql)
     res = QueryResult(list(df.columns),
                       {c: df[c].to_numpy() for c in df.columns})

@@ -35,6 +35,16 @@ Mechanics:
   device, so cold loads hide behind dispatch. Prefetched entries are
   flagged; a later demand fault that lands on one counts as prefetch
   overlap (``prefetch_hit_bytes``).
+- **Decode-ahead** (``sdot.tier.decoded.cache.bytes`` > 0): the
+  prefetch worker also DECODES encoded chunks into a separate
+  LRU cache accounted at decoded size, so a hot repeated scan stops
+  paying the per-serve decode on the demand path (the saving lands in
+  ``decode_ms_saved``). Decoded copies are derived data: they evict
+  before any encoded payload — their own LRU bounds steady state, and
+  encoded-budget pressure flushes them entirely before the eviction
+  loop touches a single compressed payload. A served decoded array
+  stays alive with its query via numpy refcounting, so mid-query
+  eviction is safe without pin integration.
 """
 
 from __future__ import annotations
@@ -45,11 +55,13 @@ import queue
 import threading
 import time
 import zlib
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from spark_druid_olap_tpu.persist.snapshot import SnapshotCorrupt
+from spark_druid_olap_tpu.utils import phases as PH
 
 
 @dataclasses.dataclass(frozen=True)
@@ -110,6 +122,20 @@ class _Entry:
         self.prefetched = prefetched
 
 
+class _DecEntry:
+    """One decode-ahead chunk: the decoded ndarray, its DECODED size
+    (what the cache budget charges), the measured decode cost a future
+    demand fault is spared, and whether the prefetcher produced it."""
+
+    __slots__ = ("arr", "nbytes", "decode_ms", "prefetched")
+
+    def __init__(self, arr, nbytes, decode_ms, prefetched):
+        self.arr = arr
+        self.nbytes = nbytes
+        self.decode_ms = decode_ms
+        self.prefetched = prefetched
+
+
 class PinToken:
     """Per-query pin set: chunk key -> refcount contributed.
     ``devices`` records how many mesh devices the pinned wave feeds
@@ -132,8 +158,10 @@ class TieredColumnStore:
 
     def __init__(self, budget_bytes: int, verify: bool = True,
                  popularity: Optional[Callable[[str, str], float]] = None,
-                 on_corrupt: Optional[Callable[[str, str, str], None]] = None):
+                 on_corrupt: Optional[Callable[[str, str, str], None]] = None,
+                 decoded_budget: int = 0):
         self.budget = max(1, int(budget_bytes))
+        self.dec_budget = max(0, int(decoded_budget))   # 0 = decode-ahead off
         self.verify = bool(verify)
         self.popularity = popularity
         self.on_corrupt = on_corrupt
@@ -158,7 +186,10 @@ class TieredColumnStore:
             "prefetch_submitted": 0, "prefetch_loaded": 0,
             "prefetch_dropped": 0,
             "prefetch_hits": 0, "prefetch_hit_bytes": 0,
+            "decode_ms_saved": 0.0, "decoded_evictions": 0,
         }
+        self._dec: "OrderedDict[tuple, _DecEntry]" = OrderedDict()
+        self._dec_bytes = 0
         self._pf_queue: Optional[queue.Queue] = None
         self._pf_threads: List[threading.Thread] = []
         self._pf_stop = threading.Event()
@@ -224,16 +255,100 @@ class TieredColumnStore:
         needed. Demand faults (prefetch=False) pin into the calling
         thread's open tokens and count hit/prefetch-overlap stats.
 
-        Encoded refs are held hot in COMPRESSED form and decoded here,
-        per serve, OUTSIDE the store lock — the decode is per-segment
-        numpy work and must not serialize concurrent faulting threads.
-        Prefetch serves skip the decode (the prefetcher only warms
-        bytes; the later demand fault pays the decode it needs)."""
-        stored = self._fault_stored(ds_name, column, ref, prefetch)
-        if ref.enc is None or prefetch:
+        Encoded refs are held hot in COMPRESSED form and decoded
+        OUTSIDE the store lock — the decode is per-segment numpy work
+        and must not serialize concurrent faulting threads. With
+        decode-ahead ON (``dec_budget`` > 0) the prefetch path decodes
+        into the decoded-chunk cache so a later demand fault skips the
+        decode entirely (served at decoded size, ``decode_ms_saved``
+        credited); with it off, prefetch serves only warm bytes and the
+        demand fault pays the decode, as before."""
+        if ref.enc is None:
+            if prefetch:
+                return self._fault_stored(ds_name, column, ref, True)
+            t0 = time.perf_counter()
+            arr = self._fault_stored(ds_name, column, ref, False)
+            PH.add("tier.fault", time.perf_counter() - t0)
+            return arr
+        key = (ds_name, ref.path, int(ref.start), int(ref.count))
+        if prefetch:
+            stored = self._fault_stored(ds_name, column, ref, True)
+            if self.dec_budget > 0:
+                self._decode_ahead(key, stored, ref)
             return stored
+        if self.dec_budget > 0:
+            hit = self._serve_decoded(key)
+            if hit is not None:
+                return hit
+        t0 = time.perf_counter()
+        stored = self._fault_stored(ds_name, column, ref, False)
+        PH.add("tier.fault", time.perf_counter() - t0)
         from spark_druid_olap_tpu.encode import codecs as EN
-        return EN.decode_array(stored, ref.header())
+        t0 = time.perf_counter()
+        arr = EN.decode_array(stored, ref.header())
+        dms = (time.perf_counter() - t0) * 1000.0
+        PH.add("tier.decode", dms / 1000.0)
+        if self.dec_budget > 0:
+            # demand-decoded chunks are cache-worthy too: the NEXT
+            # repeat of this scan serves decoded even when the
+            # prefetcher never saw the chunk (single-wave scans)
+            self._dec_install(key, arr, ref, dms, prefetched=False)
+        return arr
+
+    def _serve_decoded(self, key: tuple) -> Optional[np.ndarray]:
+        """Demand serve from the decode-ahead cache. Counts the serve
+        as a hot hit, credits the spared decode, and — when the chunk
+        was prefetcher-produced — counts prefetch overlap at DECODED
+        size (that is what the demand path was spared end to end)."""
+        with self._lock:
+            d = self._dec.get(key)
+            if d is None:
+                return None
+            self._dec.move_to_end(key)
+            self._tick += 1
+            self.counters["hits"] += 1
+            self.counters["decode_ms_saved"] += d.decode_ms
+            if d.prefetched:
+                d.prefetched = False
+                self.counters["prefetch_hits"] += 1
+                self.counters["prefetch_hit_bytes"] += d.nbytes
+                e = self._hot.get(key)
+                if e is not None:
+                    # the compressed twin was never demand-served; it
+                    # must not claim the same overlap again later
+                    e.prefetched = False
+            self._pin_into_active_locked(key)
+            return d.arr
+
+    def _decode_ahead(self, key: tuple, stored: np.ndarray,
+                      ref: BlobRef) -> None:
+        """Prefetch-worker decode, outside the lock; first-wins."""
+        with self._lock:
+            if key in self._dec:
+                return
+        from spark_druid_olap_tpu.encode import codecs as EN
+        t0 = time.perf_counter()
+        try:
+            arr = EN.decode_array(stored, ref.header())
+        except Exception:  # noqa: BLE001 — advisory; demand decode re-raises
+            return
+        dms = (time.perf_counter() - t0) * 1000.0
+        self._dec_install(key, arr, ref, dms, prefetched=True)
+
+    def _dec_install(self, key: tuple, arr: np.ndarray, ref: BlobRef,
+                     decode_ms: float, prefetched: bool) -> None:
+        nb = int(ref.decoded_nbytes)
+        if nb > self.dec_budget:
+            return   # a chunk larger than the whole budget never admits
+        with self._lock:
+            if key in self._dec:
+                return
+            self._dec[key] = _DecEntry(arr, nb, decode_ms, prefetched)
+            self._dec_bytes += nb
+            while self._dec_bytes > self.dec_budget and self._dec:
+                _, old = self._dec.popitem(last=False)
+                self._dec_bytes -= old.nbytes
+                self.counters["decoded_evictions"] += 1
 
     def _fault_stored(self, ds_name: str, column: str, ref: BlobRef,
                       prefetch: bool) -> np.ndarray:
@@ -379,6 +494,13 @@ class TieredColumnStore:
     def _evict_locked(self) -> None:
         if self._bytes <= self.budget:
             return
+        # decoded copies are DERIVED data (recreatable from the encoded
+        # payloads below): under encoded-budget pressure they all go
+        # before a single compressed payload is touched
+        while self._dec:
+            _, old = self._dec.popitem(last=False)
+            self._dec_bytes -= old.nbytes
+            self.counters["decoded_evictions"] += 1
         cand = [(self._score(e, k[0]), e.tick, k)
                 for k, e in self._hot.items() if not self._pins.get(k)]
         cand.sort()
@@ -405,6 +527,8 @@ class TieredColumnStore:
                 self._bytes -= e.nbytes
                 self._pins.pop(k, None)
                 paths.add(k[1])
+            for k in [k for k in self._dec if k[0] == name]:
+                self._dec_bytes -= self._dec.pop(k).nbytes
             live_paths = {k[1] for k in self._hot}
             self._verified -= (paths - live_paths)
 
@@ -414,6 +538,8 @@ class TieredColumnStore:
             self._pins.clear()
             self._verified.clear()
             self._bytes = 0
+            self._dec.clear()
+            self._dec_bytes = 0
 
     # -- prefetch --------------------------------------------------------------
     def start_prefetcher(self, threads: int = 2,
@@ -481,11 +607,15 @@ class TieredColumnStore:
         with self._lock:
             c = dict(self.counters)
             c["crc_verify_ms"] = round(c["crc_verify_ms"], 3)
+            c["decode_ms_saved"] = round(c["decode_ms_saved"], 3)
             faulted = max(1, c["bytes_faulted"])
             return {
                 "budget_bytes": self.budget,
                 "hot_bytes": self._bytes,
                 "hot_entries": len(self._hot),
+                "decoded_budget_bytes": self.dec_budget,
+                "decoded_cache_bytes": self._dec_bytes,
+                "decoded_cache_entries": len(self._dec),
                 "pinned_entries": sum(1 for k in self._hot
                                       if self._pins.get(k)),
                 "mesh_pinned_entries": sum(1 for k in self._hot

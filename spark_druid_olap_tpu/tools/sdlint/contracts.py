@@ -15,8 +15,17 @@ drifted one PR at a time:
   ``m.stats = {...}``) must be documented in ``docs/STATS.md``, and every
   documented key must still be emitted somewhere.
 
+A third contract rides the same doc: **phase names** — every name timed
+via ``PH.phase("...")`` / ``PH.add("...")`` / ``PH.stash("...")`` must be
+registered in the ``PHASES`` literal of ``utils/phases.py``, and the
+registry must match the marker-delimited phase table in ``docs/STATS.md``
+(``<!-- phases:begin -->`` .. ``<!-- phases:end -->``) in both
+directions. The marker region is excluded from the stats-key scan — phase
+names are not stats keys.
+
 Rules: ``undeclared-key``, ``unread-key``, ``undocumented-stats-key``,
-``stale-stats-doc``.
+``stale-stats-doc``, ``unregistered-phase``, ``undocumented-phase``,
+``stale-phase-doc``.
 """
 
 from __future__ import annotations
@@ -124,22 +133,112 @@ def _config_reads(project: Project) -> List[Tuple[str, str, int, str]]:
     return out
 
 
-def _stats_doc(project: Project) -> Tuple[Optional[str], Dict[str, int]]:
-    """docs/STATS.md keys (backticked tokens in table rows)."""
+_PHASES_BEGIN = "<!-- phases:begin -->"
+_PHASES_END = "<!-- phases:end -->"
+
+
+def _stats_md_path(project: Project) -> Optional[str]:
     for cand in (os.path.join(project.root, os.pardir, "docs", "STATS.md"),
                  os.path.join(project.root, "docs", "STATS.md")):
         if os.path.exists(cand):
-            keys: Dict[str, int] = {}
-            with open(cand, encoding="utf-8") as f:
-                for i, ln in enumerate(f, start=1):
-                    if not ln.lstrip().startswith("|"):
-                        continue
-                    for m in _DOC_KEY_RE.finditer(ln):
-                        keys.setdefault(m.group(1), i)
-            rel = os.path.relpath(os.path.abspath(cand),
-                                  os.path.dirname(project.root))
-            return rel, keys
-    return None, {}
+            return cand
+    return None
+
+
+def _stats_doc(project: Project) -> Tuple[Optional[str], Dict[str, int]]:
+    """docs/STATS.md keys (backticked tokens in table rows). The
+    marker-delimited phase table is excluded — phase names (``plan.build``
+    etc.) document profiler phases, not stats keys, and are cross-checked
+    separately against the ``PHASES`` registry."""
+    cand = _stats_md_path(project)
+    if cand is None:
+        return None, {}
+    keys: Dict[str, int] = {}
+    in_phases = False
+    with open(cand, encoding="utf-8") as f:
+        for i, ln in enumerate(f, start=1):
+            if _PHASES_BEGIN in ln:
+                in_phases = True
+                continue
+            if _PHASES_END in ln:
+                in_phases = False
+                continue
+            if in_phases or not ln.lstrip().startswith("|"):
+                continue
+            for m in _DOC_KEY_RE.finditer(ln):
+                keys.setdefault(m.group(1), i)
+    rel = os.path.relpath(os.path.abspath(cand),
+                          os.path.dirname(project.root))
+    return rel, keys
+
+
+def _phases_doc(project: Project) -> Tuple[Optional[str], Dict[str, int]]:
+    """Phase names documented in STATS.md's marker-delimited table."""
+    cand = _stats_md_path(project)
+    if cand is None:
+        return None, {}
+    names: Dict[str, int] = {}
+    in_phases = False
+    with open(cand, encoding="utf-8") as f:
+        for i, ln in enumerate(f, start=1):
+            if _PHASES_BEGIN in ln:
+                in_phases = True
+                continue
+            if _PHASES_END in ln:
+                in_phases = False
+                continue
+            if not in_phases or not ln.lstrip().startswith("|"):
+                continue
+            for m in _DOC_KEY_RE.finditer(ln):
+                names.setdefault(m.group(1), i)
+    rel = os.path.relpath(os.path.abspath(cand),
+                          os.path.dirname(project.root))
+    return rel, names
+
+
+def _phases_registry(project: Project) \
+        -> Tuple[Optional[Module], Dict[str, int]]:
+    """The ``PHASES = {...}`` literal in utils/phases.py (name -> line).
+    Absent module (lint fixture projects) disables the phase contract."""
+    mod = project.by_suffix("utils/phases.py")
+    if mod is None:
+        return None, {}
+    names: Dict[str, int] = {}
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and stmt.targets[0].id == "PHASES" \
+                and isinstance(stmt.value, ast.Dict):
+            for k in stmt.value.keys:
+                if isinstance(k, ast.Constant) \
+                        and isinstance(k.value, str):
+                    names.setdefault(k.value, k.lineno)
+    return mod, names
+
+
+_PHASE_METHODS = {"phase", "add", "stash"}
+_PHASE_RECEIVERS = {"PH", "phases"}
+
+
+def _phase_call_sites(project: Project) -> List[Tuple[str, str, int]]:
+    """(name, relpath, line) for every literal-named timer call —
+    ``PH.phase("x")`` / ``PH.add("x", dt)`` / ``PH.stash("x", dt)``."""
+    out = []
+    for mod in project.modules.values():
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _PHASE_METHODS):
+                continue
+            recv = dotted_name(node.func.value)
+            if recv is None \
+                    or recv.split(".")[-1] not in _PHASE_RECEIVERS:
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            out.append((node.args[0].value, mod.relpath, node.lineno))
+    return out
 
 
 def _is_stats_base(expr: ast.expr) -> bool:
@@ -246,4 +345,30 @@ def run(project: Project) -> List[Finding]:
                     "contracts", "stale-stats-doc", doc_path, line, key,
                     f"docs/STATS.md documents stats key {key!r} but "
                     f"nothing emits it"))
+    phases_mod, registry = _phases_registry(project)
+    if phases_mod is not None and registry:
+        for name, path, line in sorted(_phase_call_sites(project)):
+            if name not in registry:
+                out.append(Finding(
+                    "contracts", "unregistered-phase", path, line, name,
+                    f"phase {name!r} is timed here but not registered in "
+                    f"the PHASES literal of utils/phases.py — it would "
+                    f"surface in stats['phases'] undocumented"))
+        ph_doc_path, ph_documented = _phases_doc(project)
+        if ph_doc_path is not None:
+            for name, line in sorted(registry.items()):
+                if name not in ph_documented:
+                    out.append(Finding(
+                        "contracts", "undocumented-phase",
+                        phases_mod.relpath, line, name,
+                        f"phase {name!r} is registered in utils/phases.py "
+                        f"but missing from the phases:begin/phases:end "
+                        f"table in docs/STATS.md"))
+            for name, line in sorted(ph_documented.items()):
+                if name not in registry:
+                    out.append(Finding(
+                        "contracts", "stale-phase-doc", ph_doc_path, line,
+                        name,
+                        f"docs/STATS.md phase table documents {name!r} "
+                        f"but utils/phases.py does not register it"))
     return out

@@ -88,6 +88,13 @@ QUERY_HISTORY_SIZE = _entry(
     "sdot.query.history.size", 500,
     "Bounded size of the in-memory query history queue (reference: "
     "DruidQueryHistory MAX_SIZE=500).")
+PHASES_ENABLED = _entry(
+    "sdot.phases.enabled", True,
+    "Per-query host-path phase profiler (utils/phases.py): attribute "
+    "host time to named phases (parse, plan.*, wlm.admit, compile, "
+    "bind, dispatch, ...) emitted as stats[\"phases\"] and aggregated "
+    "into BENCH JSON. Two clock reads per phase — cheap enough to stay "
+    "always-on (< 1% of wall; no Druid analog).", semantic=False)
 NON_AGG_PUSHDOWN = _entry(
     "sdot.nonagg.handling", "push_project_and_filters",
     "Handling of non-aggregate queries: push_project_and_filters | "
@@ -424,6 +431,22 @@ PLAN_CACHE_ENABLED = _entry(
     "version and config fingerprint). Benchmarks disable it so measured "
     "reps time the full rewrite/build/execute path instead of a "
     "statement-cache hit.")
+PLAN_MEMO_ENABLED = _entry(
+    "sdot.plan.memo.enabled", True,
+    "Memoize the planning-cascade outcome per canonical statement "
+    "(window extraction, resolution, rewrites, built plan, join "
+    "recognition, composite plan — including NEGATIVE recognizer "
+    "results), keyed like the plan cache on store version + config "
+    "fingerprint plus a lookup-table fingerprint. A warm repeated "
+    "statement skips straight from canonical key to the cached "
+    "compiled program; distinct from sdot.plan.cache.enabled, which "
+    "benchmarks disable. Purely a host-latency optimization: the "
+    "memoized plan is bit-identical to a cold re-plan.",
+    semantic=False)
+PLAN_MEMO_ENTRIES = _entry(
+    "sdot.plan.memo.entries", 128,
+    "Max memoized planning-cascade outcomes; least-recently-used "
+    "statements evict past it.", int, semantic=False)
 # --- workload management (wlm/) -----------------------------------------------
 WLM_ENABLED = _entry(
     "sdot.wlm.enabled", True,
@@ -812,6 +835,17 @@ TIER_PREFETCH_THREADS = _entry(
     "sdot.tier.prefetch.threads", 2,
     "Prefetcher worker threads draining the cold-load queue.",
     int, semantic=False)
+TIER_DECODED_CACHE_BYTES = _entry(
+    "sdot.tier.decoded.cache.bytes", 128 << 20,
+    "Byte budget of the decode-ahead cache: decoded arrays for hot "
+    "ENCODED chunks, accounted at DECODED size on top of the encoded "
+    "hot set (not against sdot.tier.budget.bytes; combined residency "
+    "is budget + decoded cache). The prefetcher decodes into it and "
+    "demand faults serve from it, taking decode off the critical path "
+    "(counters \"decode_ms_saved\" in stats[\"tier\"]). Decoded "
+    "entries evict before any encoded payload. 0 disables decode-"
+    "ahead; raw (unencoded) stores are unaffected.", int,
+    semantic=False)
 TIER_WAVE_IO_BYTES = _entry(
     "sdot.tier.wave.io.bytes", 256 << 20,
     "Per-wave host-I/O byte cap on a tiered scan (the wave planner's "

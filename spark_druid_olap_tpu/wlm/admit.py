@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Optional, Tuple
 
+from spark_druid_olap_tpu.utils import phases as PH
 from spark_druid_olap_tpu.wlm.lanes import (AdmissionRejected, Lane,
                                             LaneConfig, parse_lanes)
 from spark_druid_olap_tpu.wlm.quota import QuotaManager, quotas_from_config
@@ -214,7 +215,14 @@ class WorkloadManager:
     def admit(self, engine, q, t0: float,
               cancel_event: Optional[threading.Event] = None) -> Ticket:
         """Block until a lane slot is granted (or raise). ``t0`` is the
-        engine's query start — queue wait counts against the deadline."""
+        engine's query start — queue wait counts against the deadline.
+        Admission time (queue wait INCLUDED) lands in the per-query
+        phase profile as ``wlm.admit``."""
+        with PH.phase("wlm.admit"):
+            return self._admit(engine, q, t0, cancel_event)
+
+    def _admit(self, engine, q, t0: float,
+               cancel_event: Optional[threading.Event] = None) -> Ticket:
         inj = self.fault
         if inj is not None:
             # chaos site (before the lock — a delay rule models slot

@@ -1,0 +1,9 @@
+"""Fixture consumer timing one registered and one unregistered phase."""
+
+from utils import phases as PH
+
+
+def run():
+    with PH.phase("parse"):
+        pass
+    PH.add("rogue.phase", 0.0)

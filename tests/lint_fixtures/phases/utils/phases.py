@@ -1,0 +1,7 @@
+"""Fixture phase registry: one name timed + documented, one registered
+but absent from the doc table (``undocumented-phase``)."""
+
+PHASES = {
+    "parse": "statement parse",
+    "ghost.phase": "registered here but missing from docs/STATS.md",
+}

@@ -113,6 +113,22 @@ def test_contracts_pass_fires_on_undeclared_key_fixture():
     assert by_rule["unread-key"].symbol == "sdot.fixture.declared"
 
 
+def test_contracts_pass_fires_on_phase_fixture():
+    """The phase contract fires in all three directions: a timer call
+    using a name the PHASES registry lacks, a registered name missing
+    from the docs/STATS.md marker table, and a documented name nothing
+    registers. Other passes stay quiet on the tree (liveness proof that
+    the findings come from the contracts pass alone)."""
+    by_rule = {f.rule: f for f in _fixture("phases", ("contracts",))}
+    assert by_rule["unregistered-phase"].symbol == "rogue.phase"
+    assert by_rule["unregistered-phase"].path == "engine.py"
+    assert by_rule["undocumented-phase"].symbol == "ghost.phase"
+    assert by_rule["stale-phase-doc"].symbol == "stale.phase"
+    assert len(by_rule) == 3, by_rule
+    others = tuple(p for p in PASSES if p != "contracts")
+    assert not _fixture("phases", others)
+
+
 def test_mergeclosure_pass_fires_on_unmergeable_agg_fixture():
     found = _fixture("mergeclosure", ("mergeclosure",))
     by_rule = {f.rule: f for f in found}
